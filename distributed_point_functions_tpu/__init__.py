@@ -1,0 +1,13 @@
+"""TPU-native distributed point functions framework.
+
+A ground-up JAX/XLA re-design of the capabilities of Google's
+`distributed_point_functions` C++ library: incremental DPFs, distributed
+comparison functions, FSS gates, and two-server PIR — with the hot paths
+(AES PRG tree expansion, XOR inner products) built for TPU (bitsliced AES on
+the VPU, parity matmuls on the MXU, `shard_map` scale-out over ICI).
+"""
+
+from . import keys  # noqa: F401
+from .ops import aes  # noqa: F401
+
+__version__ = "0.1.0"

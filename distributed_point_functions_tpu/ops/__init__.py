@@ -1,0 +1,1 @@
+from . import aes  # noqa: F401

@@ -1,0 +1,110 @@
+"""Known-answer and differential tests for the AES core.
+
+Mirrors the reference's test strategy (SURVEY.md §4.2-4.3): FIPS-197
+known-answer vectors for the numpy oracle, then SIMD-vs-scalar style
+differential tests of the bitsliced JAX kernel against the oracle.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu import keys
+from distributed_point_functions_tpu.ops import aes
+
+# FIPS-197 Appendix C.1: AES-128 known-answer vector.
+FIPS_KEY = bytes(range(16))
+FIPS_PT = bytes(int(f"{h}{h}", 16) for h in "0123456789abcdef")
+FIPS_CT = bytes.fromhex("69c4e0d86a7b0430d8cdb78070b4c55a")
+
+# FIPS-197 Appendix B worked example.
+B_KEY = bytes.fromhex("2b7e151628aed2a6abf7158809cf4f3c")
+B_PT = bytes.fromhex("3243f6a8885a308d313198a2e0370734")
+B_CT = bytes.fromhex("3925841d02dc09fbdc118597196a0b32")
+
+
+@pytest.mark.parametrize(
+    "key,pt,ct", [(FIPS_KEY, FIPS_PT, FIPS_CT), (B_KEY, B_PT, B_CT)]
+)
+def test_numpy_oracle_fips_vectors(key, pt, ct):
+    rk = aes.key_expansion(key)
+    out = aes.aes_encrypt_np(rk, np.frombuffer(pt, dtype=np.uint8).reshape(1, 16))
+    assert out.tobytes() == ct
+
+
+def test_sbox_known_entries():
+    # Spot values from the published S-box table.
+    assert aes.SBOX[0x00] == 0x63
+    assert aes.SBOX[0x01] == 0x7C
+    assert aes.SBOX[0x53] == 0xED
+    assert aes.SBOX[0xFF] == 0x16
+
+
+def test_limb_byte_roundtrip():
+    rng = np.random.default_rng(0)
+    limbs = rng.integers(0, 2**32, size=(17, 4), dtype=np.uint32)
+    assert np.array_equal(
+        aes.bytes_to_limbs_np(aes.limbs_to_bytes_np(limbs)), limbs
+    )
+    x = 0x0123456789ABCDEF_FEDCBA9876543210
+    assert aes.limbs_to_u128(aes.u128_to_limbs(x)) == x
+
+
+def test_jax_matches_oracle_fips():
+    rk = aes.key_expansion(FIPS_KEY)
+    limbs = aes.bytes_to_limbs_np(np.frombuffer(FIPS_PT, dtype=np.uint8).reshape(1, 16))
+    out = np.asarray(aes.aes_encrypt(rk, limbs))
+    assert aes.limbs_to_bytes_np(out).tobytes() == FIPS_CT
+
+
+def test_jax_matches_oracle_random_batch():
+    rng = np.random.default_rng(42)
+    blocks = rng.integers(0, 2**32, size=(133, 4), dtype=np.uint32)
+    for rk in (keys.RK_LEFT, keys.RK_RIGHT, keys.RK_VALUE):
+        expect = aes.bytes_to_limbs_np(
+            aes.aes_encrypt_np(rk, aes.limbs_to_bytes_np(blocks))
+        )
+        got = np.asarray(aes.aes_encrypt(rk, blocks))
+        np.testing.assert_array_equal(got, expect)
+
+
+def test_key_select_matches_individual_keys():
+    rng = np.random.default_rng(7)
+    blocks = rng.integers(0, 2**32, size=(64, 4), dtype=np.uint32)
+    select = rng.integers(0, 2, size=(64,), dtype=np.uint32)
+    got = np.asarray(
+        aes.aes_encrypt_select(keys.RK_LEFT, keys.RK_RIGHT, select, blocks)
+    )
+    left = np.asarray(aes.aes_encrypt(keys.RK_LEFT, blocks))
+    right = np.asarray(aes.aes_encrypt(keys.RK_RIGHT, blocks))
+    expect = np.where(select[:, None] != 0, right, left)
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_mmo_hash_jax_vs_numpy():
+    rng = np.random.default_rng(3)
+    blocks = rng.integers(0, 2**32, size=(50, 4), dtype=np.uint32)
+    expect = aes.mmo_hash_np(keys.RK_LEFT, blocks)
+    got = np.asarray(aes.mmo_hash(keys.RK_LEFT, blocks))
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_mmo_hash_select_matches():
+    rng = np.random.default_rng(4)
+    blocks = rng.integers(0, 2**32, size=(32, 4), dtype=np.uint32)
+    select = rng.integers(0, 2, size=(32,), dtype=np.uint32)
+    got = np.asarray(
+        aes.mmo_hash_select(keys.RK_LEFT, keys.RK_RIGHT, select, blocks)
+    )
+    left = aes.mmo_hash_np(keys.RK_LEFT, blocks)
+    right = aes.mmo_hash_np(keys.RK_RIGHT, blocks)
+    expect = np.where(select[:, None] != 0, right, left)
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_sigma_semantics():
+    # sigma(x) = (hi ^ lo, hi): low 64 bits of output = hi, high 64 = hi ^ lo.
+    x = 0x00112233445566778899AABBCCDDEEFF
+    limbs = aes.u128_to_limbs(x)[None, :]
+    s = aes.limbs_to_u128(np.asarray(aes.sigma(limbs))[0])
+    hi, lo = x >> 64, x & ((1 << 64) - 1)
+    assert s == (((hi ^ lo) << 64) | hi)
