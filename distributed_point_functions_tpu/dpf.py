@@ -1,0 +1,895 @@
+"""Incremental Distributed Point Functions, TPU-native.
+
+The capabilities of the reference's `DistributedPointFunction`
+(`dpf/distributed_point_function.h:87`) rebuilt for JAX/XLA:
+
+* **Key generation** runs host-side with Python-int arithmetic and the numpy
+  AES oracle — it is O(tree depth) per key (sequential recurrence,
+  `dpf/distributed_point_function.cc:121-222`), never a hot path.
+* **Evaluation** runs on device. Full-domain expansion is a width-doubling
+  sequence of jitted level steps (each level: one batched left+right MMO
+  hash, masked correction, control-bit extraction — the TPU analog of
+  `ExpandSeeds`, `dpf/distributed_point_function.cc:289-372`). Batched point
+  evaluation walks all paths simultaneously with a `lax.scan` over levels and
+  per-lane PRG-key selection (the analog of the Highway kernel
+  `dpf/internal/evaluate_prg_hwy.cc:150-539`).
+* **EvaluationContext** is the serializable checkpoint of a partially
+  evaluated DPF (prefix -> (seed, control bit)), mirroring the proto
+  `dpf/distributed_point_function.proto:156-171`; hierarchical evaluation
+  resumes from it.
+
+Control bits are carried as separate uint32 arrays; on the wire/in keys they
+are embedded in seed LSBs exactly like the reference
+(`dpf/internal/evaluate_prg_hwy.h:32-36` extract-and-clear convention).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import secrets
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from . import keys as fixed_keys
+from .ops import aes, limb
+from .value_types import ValueType
+
+U32 = jnp.uint32
+_U128_MASK = (1 << 128) - 1
+
+
+# ---------------------------------------------------------------------------
+# Data model
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DpfParameters:
+    """Parameters of one hierarchy level (`distributed_point_function.proto:92-105`)."""
+
+    log_domain_size: int
+    value_type: ValueType
+    security_parameter: float = 0.0  # 0 => default 40 + log_domain_size
+
+
+@dataclasses.dataclass
+class CorrectionWord:
+    """Per-tree-level correction (`distributed_point_function.proto:114-126`)."""
+
+    seed: int  # uint128
+    control_left: bool
+    control_right: bool
+    value_correction: Optional[list] = None
+
+
+@dataclasses.dataclass
+class DpfKey:
+    """One party's DPF key (`distributed_point_function.proto:129-140`)."""
+
+    seed: int  # uint128
+    party: int
+    correction_words: List[CorrectionWord]
+    last_level_value_correction: list
+
+
+@dataclasses.dataclass
+class EvaluationContext:
+    """Checkpoint of a partially evaluated DPF (proto `:156-171`)."""
+
+    key: DpfKey
+    previous_hierarchy_level: int = -1
+    # prefix -> (seed uint128, control bit), at tree level
+    # hierarchy_to_tree[partial_evaluations_level].
+    partial_evaluations: Dict[int, Tuple[int, int]] = dataclasses.field(
+        default_factory=dict
+    )
+    partial_evaluations_level: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Host-side AES helpers (numpy oracle; keygen only)
+# ---------------------------------------------------------------------------
+
+
+def _mmo_host(rk: np.ndarray, xs: Sequence[int]) -> List[int]:
+    blocks = np.stack([aes.u128_to_limbs(x) for x in xs])
+    out = aes.mmo_hash_np(rk, blocks)
+    return [aes.limbs_to_u128(out[i]) for i in range(out.shape[0])]
+
+
+def _value_hash_bytes_host(seed: int, num_blocks: int) -> bytes:
+    xs = [(seed + j) & _U128_MASK for j in range(num_blocks)]
+    outs = _mmo_host(fixed_keys.RK_VALUE, xs)
+    return b"".join(x.to_bytes(16, "little") for x in outs)
+
+
+# ---------------------------------------------------------------------------
+# Jitted device stages
+# ---------------------------------------------------------------------------
+
+# Mask clearing the control-bit LSB of a 128-bit limb block
+# (`ExtractAndClearLowestBit`, `evaluate_prg_hwy.h:32-36`).
+_CLEAR_LSB = np.array(
+    [0xFFFFFFFE, 0xFFFFFFFF, 0xFFFFFFFF, 0xFFFFFFFF], dtype=np.uint32
+)
+
+
+@jax.jit
+def _expand_level(seeds, control, cw_seed, cw_left, cw_right):
+    """One breadth-first expansion level: [n] seeds -> [2n] seeds.
+
+    seeds: uint32[n, 4]; control: uint32[n]; cw_seed: uint32[4];
+    cw_left/right: uint32 scalars. TPU analog of the reference's
+    `ExpandSeeds` inner loop (`distributed_point_function.cc:327-370`).
+    """
+    left = aes.mmo_hash(fixed_keys.RK_LEFT, seeds)
+    right = aes.mmo_hash(fixed_keys.RK_RIGHT, seeds)
+    corr = jnp.where(control[:, None] != 0, cw_seed[None, :], U32(0))
+    left = left ^ corr
+    right = right ^ corr
+    t_left = left[:, 0] & U32(1)
+    t_right = right[:, 0] & U32(1)
+    clear = jnp.asarray(_CLEAR_LSB)
+    left = left & clear
+    right = right & clear
+    t_left = t_left ^ (control * cw_left)
+    t_right = t_right ^ (control * cw_right)
+    seeds_out = jnp.stack([left, right], axis=1).reshape(-1, 4)
+    control_out = jnp.stack([t_left, t_right], axis=1).reshape(-1)
+    return seeds_out, control_out
+
+
+@jax.jit
+def _eval_paths(seeds, control, paths, cw_seeds, cw_left, cw_right, bit_indices):
+    """Walk `L` tree levels for a batch of paths simultaneously.
+
+    seeds: uint32[n, 4]; control: uint32[n]; paths: uint32[n, 4];
+    cw_seeds: uint32[L, m, 4]; cw_left/right: uint32[L, m] with m == 1
+    (shared correction words) or m == n (per-seed, the multi-key batch mode
+    of `evaluate_prg_hwy.h:58-65`); bit_indices: int32[L].
+
+    One `lax.scan` step = one level: per-lane PRG-key selection by path bit
+    (single AES pass), masked seed correction, control-bit extract/clear.
+    """
+
+    clear = jnp.asarray(_CLEAR_LSB)
+
+    def body(carry, x):
+        seeds, control = carry
+        cw_seed, cw_l, cw_r, bit_index = x
+        pbit = limb.get_bit(paths, bit_index)  # uint32[n]
+        h = aes.mmo_hash_select(
+            fixed_keys.RK_LEFT, fixed_keys.RK_RIGHT, pbit, seeds
+        )
+        corr = jnp.where(control[:, None] != 0, cw_seed, U32(0))
+        h = h ^ corr
+        t_new = h[:, 0] & U32(1)
+        h = h & clear
+        cw_dir = jnp.where(pbit != 0, cw_r, cw_l)
+        t_new = t_new ^ (control * cw_dir)
+        return (h, t_new), None
+
+    (seeds, control), _ = lax.scan(
+        body, (seeds, control), (cw_seeds, cw_left, cw_right, bit_indices)
+    )
+    return seeds, control
+
+
+@functools.partial(jax.jit, static_argnames=("num_blocks",))
+def _value_hash(seeds, num_blocks):
+    """H_value(seed + j) for j < num_blocks: uint32[n,4] -> uint32[n,B,4].
+
+    The iota-offset output PRG of `HashExpandedSeeds`
+    (`distributed_point_function.cc:523-547`).
+    """
+    offs = [limb.add_scalar(seeds, j) for j in range(num_blocks)]
+    stacked = jnp.stack(offs, axis=1)  # [n, B, 4]
+    return aes.mmo_hash(fixed_keys.RK_VALUE, stacked)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("vtype", "cepb", "num_blocks", "party")
+)
+def _leaf_stage(seeds, control, vc_dev, vtype, cepb, num_blocks, party):
+    """Hash seeds into value blocks, parse, apply value correction.
+
+    Returns a value pytree with batch shape [n, cepb] (the first
+    `corrected_elements_per_block` elements of each block, mirroring
+    `EvaluateUntil`'s correction loop, `distributed_point_function.h:838-862`).
+    """
+    blocks = _value_hash(seeds, num_blocks)
+    values = vtype.dev_from_value_blocks(blocks)  # [n, epb, ...]
+    values = jax.tree_util.tree_map(lambda x: x[:, :cepb], values)
+    vc = jax.tree_util.tree_map(lambda x: x[None, :cepb], vc_dev)
+    mask = jnp.broadcast_to(
+        (control != 0)[:, None], seeds.shape[:1] + (cepb,)
+    )
+    vc_b = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x, mask.shape + x.shape[2:]), vc
+    )
+    corrected = vtype.dev_where(mask, vtype.dev_add(values, vc_b), values)
+    if party == 1:
+        corrected = vtype.dev_neg(corrected)
+    return corrected
+
+
+@functools.partial(
+    jax.jit, static_argnames=("vtype", "num_blocks", "party")
+)
+def _leaf_stage_at(seeds, control, vc_dev, block_indices, vtype, num_blocks, party):
+    """Leaf values at specific block indices (point evaluation).
+
+    vc_dev leaves may have a leading per-key axis matching n, or be shared.
+    Returns a value pytree with batch shape [n].
+    """
+    blocks = _value_hash(seeds, num_blocks)
+    values = vtype.dev_from_value_blocks(blocks)  # [n, epb, ...]
+    picked = vtype.dev_take_element(values, block_indices)  # [n, ...]
+    vc_picked = vtype.dev_take_element(vc_dev, block_indices)
+    mask = control != 0
+    corrected = vtype.dev_where(
+        mask, vtype.dev_add(picked, vc_picked), picked
+    )
+    if party == 1:
+        corrected = vtype.dev_neg(corrected)
+    return corrected
+
+
+# ---------------------------------------------------------------------------
+# The DPF itself
+# ---------------------------------------------------------------------------
+
+
+class DistributedPointFunction:
+    """Incremental DPF with hierarchical evaluation.
+
+    Create with `create` (single hierarchy level) or `create_incremental`.
+    """
+
+    def __init__(self, parameters: Sequence[DpfParameters]):
+        parameters = list(parameters)
+        if not parameters:
+            raise ValueError("parameters must not be empty")
+        resolved = []
+        prev_lds = 0
+        for i, p in enumerate(parameters):
+            if p.log_domain_size < 0 or p.log_domain_size > 128:
+                raise ValueError("log_domain_size must be in [0, 128]")
+            if i > 0 and p.log_domain_size <= prev_lds:
+                raise ValueError("log_domain_size must be strictly ascending")
+            prev_lds = p.log_domain_size
+            sec = p.security_parameter
+            if sec != 0.0 and not (0 <= sec <= 128):
+                raise ValueError("security_parameter must be in [0, 128]")
+            if sec == 0.0:
+                sec = 40 + p.log_domain_size
+            resolved.append(
+                DpfParameters(p.log_domain_size, p.value_type, sec)
+            )
+        self.parameters = resolved
+
+        # Hierarchy -> tree level mapping (`proto_validator.cc:127-153`):
+        # packed value types shorten the tree by up to 7 levels.
+        self._hierarchy_to_tree: List[int] = []
+        self._tree_to_hierarchy: Dict[int, int] = {}
+        self._bits_needed: List[int] = []
+        tree_levels_needed = 0
+        for i, p in enumerate(self.parameters):
+            bits = p.value_type.bits_needed(p.security_parameter)
+            self._bits_needed.append(bits)
+            log_bits = max(0, (bits - 1).bit_length())  # ceil(log2(bits))
+            tree_level = max(
+                tree_levels_needed, p.log_domain_size - 7 + min(log_bits, 7)
+            )
+            self._tree_to_hierarchy[tree_level] = i
+            self._hierarchy_to_tree.append(tree_level)
+            tree_levels_needed = max(tree_levels_needed, tree_level + 1)
+        self._tree_levels_needed = tree_levels_needed
+        self._blocks_needed = [(b + 127) // 128 for b in self._bits_needed]
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def create(cls, parameters: DpfParameters) -> "DistributedPointFunction":
+        return cls([parameters])
+
+    @classmethod
+    def create_incremental(
+        cls, parameters: Sequence[DpfParameters]
+    ) -> "DistributedPointFunction":
+        return cls(parameters)
+
+    # -- key generation (host) ---------------------------------------------
+
+    def generate_keys(self, alpha: int, beta) -> Tuple[DpfKey, DpfKey]:
+        """Single-hierarchy-level key generation."""
+        if len(self.parameters) != 1:
+            raise ValueError(
+                "generate_keys requires exactly one hierarchy level; use "
+                "generate_keys_incremental"
+            )
+        return self.generate_keys_incremental(alpha, [beta])
+
+    def generate_keys_incremental(
+        self, alpha: int, betas: Sequence
+    ) -> Tuple[DpfKey, DpfKey]:
+        """Generate the two parties' keys for point alpha with values betas.
+
+        Follows the recurrence of `GenerateKeysIncremental` / `GenerateNext`
+        (`distributed_point_function.cc:642-707, 121-222`), including the
+        PRG-evaluation optimization (value correction computed from the
+        *pre-expansion* seeds at each output level).
+        """
+        if len(betas) != len(self.parameters):
+            raise ValueError("betas must have one entry per hierarchy level")
+        for p, b in zip(self.parameters, betas):
+            p.value_type.validate(b)
+        last_lds = self.parameters[-1].log_domain_size
+        if not (0 <= alpha < (1 << last_lds)):
+            raise ValueError("alpha out of domain range")
+
+        root_seeds = [secrets.randbits(128), secrets.randbits(128)]
+        seeds = list(root_seeds)
+        control = [0, 1]
+        correction_words: List[CorrectionWord] = []
+
+        for tree_level in range(1, self._tree_levels_needed):
+            value_correction = None
+            if (tree_level - 1) in self._tree_to_hierarchy:
+                hl = self._tree_to_hierarchy[tree_level - 1]
+                value_correction = self._compute_value_correction(
+                    hl, seeds, alpha, betas[hl], invert=bool(control[1])
+                )
+            # Expand both parties' seeds left and right.
+            l0, l1 = _mmo_host(fixed_keys.RK_LEFT, seeds)
+            r0, r1 = _mmo_host(fixed_keys.RK_RIGHT, seeds)
+            t = [[l0 & 1, l1 & 1], [r0 & 1, r1 & 1]]  # [branch][party]
+            l0 &= ~1
+            l1 &= ~1
+            r0 &= ~1
+            r1 &= ~1
+            expanded = [[l0, l1], [r0, r1]]
+
+            bit_pos = last_lds - tree_level
+            current_bit = (alpha >> bit_pos) & 1 if bit_pos < 128 else 0
+            keep, lose = current_bit, 1 - current_bit
+
+            cw_seed = expanded[lose][0] ^ expanded[lose][1]
+            cw_control = [
+                t[0][0] ^ t[0][1] ^ current_bit ^ 1,  # left
+                t[1][0] ^ t[1][1] ^ current_bit,  # right
+            ]
+            new_seeds = [
+                expanded[keep][b] ^ (cw_seed if control[b] else 0)
+                for b in (0, 1)
+            ]
+            new_control = [
+                t[keep][b] ^ (control[b] & cw_control[keep]) for b in (0, 1)
+            ]
+            correction_words.append(
+                CorrectionWord(
+                    seed=cw_seed,
+                    control_left=bool(cw_control[0]),
+                    control_right=bool(cw_control[1]),
+                    value_correction=value_correction,
+                )
+            )
+            seeds, control = new_seeds, new_control
+
+        last_vc = self._compute_value_correction(
+            len(self.parameters) - 1,
+            seeds,
+            alpha,
+            betas[-1],
+            invert=bool(control[1]),
+        )
+        key0 = DpfKey(
+            seed=root_seeds[0],
+            party=0,
+            correction_words=correction_words,
+            last_level_value_correction=list(last_vc),
+        )
+        key1 = DpfKey(
+            seed=root_seeds[1],
+            party=1,
+            correction_words=[
+                dataclasses.replace(
+                    cw,
+                    value_correction=(
+                        None
+                        if cw.value_correction is None
+                        else list(cw.value_correction)
+                    ),
+                )
+                for cw in correction_words
+            ],
+            last_level_value_correction=list(last_vc),
+        )
+        return key0, key1
+
+    # -- evaluation (device) ------------------------------------------------
+
+    def create_evaluation_context(self, key: DpfKey) -> EvaluationContext:
+        self._validate_key(key)
+        return EvaluationContext(key=key)
+
+    def _validate_key(self, key: DpfKey) -> None:
+        if key.party not in (0, 1):
+            raise ValueError("key.party must be 0 or 1")
+        if len(key.correction_words) != self._tree_levels_needed - 1:
+            raise ValueError(
+                f"key has {len(key.correction_words)} correction words, "
+                f"expected {self._tree_levels_needed - 1}"
+            )
+
+    def _stage_correction_words(self, key: DpfKey, start: int, stop: int):
+        """Correction words [start, stop) as device-ready numpy arrays."""
+        n = stop - start
+        cw_seeds = np.zeros((n, 4), dtype=np.uint32)
+        cw_left = np.zeros((n,), dtype=np.uint32)
+        cw_right = np.zeros((n,), dtype=np.uint32)
+        for i, cw in enumerate(key.correction_words[start:stop]):
+            cw_seeds[i] = aes.u128_to_limbs(cw.seed)
+            cw_left[i] = cw.control_left
+            cw_right[i] = cw.control_right
+        return cw_seeds, cw_left, cw_right
+
+    def _stage_value_correction(self, key: DpfKey, hierarchy_level: int):
+        """Device pytree [epb] of the value correction at a hierarchy level.
+
+        Stored in the correction word at the level's tree level, except for
+        the last hierarchy level which uses the dedicated key field
+        (`distributed_point_function.h:820-834`).
+        """
+        if hierarchy_level < len(self.parameters) - 1:
+            vc = key.correction_words[
+                self._hierarchy_to_tree[hierarchy_level]
+            ].value_correction
+        else:
+            vc = key.last_level_value_correction
+        vt = self.parameters[hierarchy_level].value_type
+        parts = [vt.dev_const(v, ()) for v in vc]
+        return jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs, axis=0), *parts
+        )
+
+    def _expand(self, seeds: jnp.ndarray, control: jnp.ndarray,
+                key: DpfKey, start: int, stop: int):
+        """Expand seeds from tree level `start` to `stop` (width-doubling)."""
+        if stop - start > 62:
+            raise ValueError(
+                "trying to expand more than 62 tree levels at once; insert "
+                "intermediate hierarchy levels"
+            )
+        cw_seeds, cw_left, cw_right = self._stage_correction_words(
+            key, start, stop
+        )
+        for i in range(stop - start):
+            seeds, control = _expand_level(
+                seeds,
+                control,
+                jnp.asarray(cw_seeds[i]),
+                U32(cw_left[i]),
+                U32(cw_right[i]),
+            )
+        return seeds, control
+
+    def _walk_paths(self, seeds, control, paths_np, key_or_keys, start: int,
+                    stop: int, rightshift: int):
+        """Point-evaluate: walk paths from tree level start to stop.
+
+        `key_or_keys` is one key (shared correction words) or a list of
+        per-seed keys (multi-key batch mode). `paths_np` is uint32[n, 4].
+        The path bit for level j in [start, stop) is bit
+        (stop - 1 - j + rightshift) of the path, mirroring
+        `EvaluateSeedsNoHwy` (`evaluate_prg_hwy.cc:591-633`).
+        """
+        num_levels = stop - start
+        if num_levels == 0:
+            return seeds, control
+        if isinstance(key_or_keys, DpfKey):
+            cw_seeds, cw_left, cw_right = self._stage_correction_words(
+                key_or_keys, start, stop
+            )
+            cw_seeds = cw_seeds[:, None, :]  # [L, 1, 4]
+            cw_left = cw_left[:, None]
+            cw_right = cw_right[:, None]
+        else:
+            staged = [
+                self._stage_correction_words(k, start, stop)
+                for k in key_or_keys
+            ]
+            cw_seeds = np.stack([s[0] for s in staged], axis=1)  # [L, n, 4]
+            cw_left = np.stack([s[1] for s in staged], axis=1)
+            cw_right = np.stack([s[2] for s in staged], axis=1)
+        bit_indices = np.array(
+            [num_levels - 1 - j + rightshift for j in range(num_levels)],
+            dtype=np.int32,
+        )
+        return _eval_paths(
+            seeds,
+            control,
+            jnp.asarray(paths_np),
+            jnp.asarray(cw_seeds),
+            jnp.asarray(cw_left),
+            jnp.asarray(cw_right),
+            jnp.asarray(bit_indices),
+        )
+
+    def _leaf_values(self, seeds, control, key: DpfKey, hierarchy_level: int):
+        """Full-expansion leaf values, flattened to domain order."""
+        vt = self.parameters[hierarchy_level].value_type
+        cepb = 1 << (
+            self.parameters[hierarchy_level].log_domain_size
+            - self._hierarchy_to_tree[hierarchy_level]
+        )
+        vc_dev = self._stage_value_correction(key, hierarchy_level)
+        values = _leaf_stage(
+            seeds,
+            control,
+            vc_dev,
+            self.parameters[hierarchy_level].value_type,
+            cepb,
+            self._blocks_needed[hierarchy_level],
+            key.party,
+        )
+        # Flatten [n, cepb, ...] -> [n * cepb, ...] (domain order).
+        return jax.tree_util.tree_map(
+            lambda x: x.reshape((-1,) + x.shape[2:]), values
+        )
+
+    def evaluate_next(self, prefixes: Sequence[int], ctx: EvaluationContext):
+        """Evaluate the hierarchy level after `ctx.previous_hierarchy_level`.
+
+        On the first call `prefixes` must be empty (full expansion of level
+        0); on later calls it holds domain indices at the previous hierarchy
+        level whose subtrees to expand. Mirrors `EvaluateNext`
+        (`distributed_point_function.h:319-333`).
+        """
+        return self.evaluate_until(
+            ctx.previous_hierarchy_level + 1, prefixes, ctx
+        )
+
+    def evaluate_until(self, hierarchy_level: int, prefixes: Sequence[int],
+                       ctx: EvaluationContext):
+        """Hierarchical evaluation up to `hierarchy_level` (`EvaluateUntil`).
+
+        Returns a value pytree with leading dim
+        `len(prefixes) * 2^(lds_level - lds_prev)` (or the full expansion on
+        the first call), in domain order grouped by prefix.
+        """
+        key = ctx.key
+        self._validate_key(key)
+        if hierarchy_level < 0 or hierarchy_level >= len(self.parameters):
+            raise ValueError("hierarchy_level out of range")
+        if hierarchy_level <= ctx.previous_hierarchy_level:
+            raise ValueError(
+                "hierarchy_level must be greater than "
+                "ctx.previous_hierarchy_level"
+            )
+        if (ctx.previous_hierarchy_level < 0) != (len(prefixes) == 0):
+            raise ValueError(
+                "prefixes must be empty iff this is the first call with ctx"
+            )
+        prev_hl = ctx.previous_hierarchy_level
+        prev_lds = (
+            self.parameters[prev_hl].log_domain_size if prev_hl >= 0 else 0
+        )
+        lds = self.parameters[hierarchy_level].log_domain_size
+        if lds - prev_lds > 62:
+            raise ValueError(
+                "output size would exceed 2^62; evaluate fewer hierarchy "
+                "levels at once"
+            )
+        for prefix in prefixes:
+            if not (0 <= prefix < (1 << prev_lds)):
+                raise ValueError(f"prefix {prefix} out of range")
+
+        stop_level = self._hierarchy_to_tree[hierarchy_level]
+        if not prefixes:
+            seeds = jnp.asarray(aes.u128_to_limbs(key.seed))[None, :]
+            control = jnp.asarray(
+                np.array([key.party], dtype=np.uint32)
+            )
+            seeds, control = self._expand(seeds, control, key, 0, stop_level)
+            out = self._leaf_values(seeds, control, key, hierarchy_level)
+            ctx.previous_hierarchy_level = hierarchy_level
+            return out
+
+        # Split prefixes into unique tree indices + block indices
+        # (packed value types: several prefixes share a tree node).
+        tree_indices: List[int] = []
+        tree_pos: Dict[int, int] = {}
+        prefix_map: List[Tuple[int, int]] = []
+        for prefix in prefixes:
+            ti = self._domain_to_tree_index(prefix, prev_hl)
+            bi = self._domain_to_block_index(prefix, prev_hl)
+            if ti not in tree_pos:
+                tree_pos[ti] = len(tree_indices)
+                tree_indices.append(ti)
+            prefix_map.append((tree_pos[ti], bi))
+
+        update_ctx = hierarchy_level < len(self.parameters) - 1
+        seeds, control = self._compute_partial_evaluations(
+            tree_indices, prev_hl, update_ctx, ctx
+        )
+        start_level = self._hierarchy_to_tree[prev_hl]
+        seeds, control = self._expand(
+            seeds, control, key, start_level, stop_level
+        )
+        values = self._leaf_values(seeds, control, key, hierarchy_level)
+
+        # Select the per-prefix output spans.
+        outputs_per_prefix = 1 << (lds - prev_lds)
+        cepb = 1 << (lds - stop_level)
+        blocks_per_tree_prefix = (1 << (stop_level - start_level))
+        span = blocks_per_tree_prefix * cepb
+        idx = np.empty((len(prefixes), outputs_per_prefix), dtype=np.int64)
+        base = np.arange(outputs_per_prefix, dtype=np.int64)
+        for i, (tp, bi) in enumerate(prefix_map):
+            idx[i] = tp * span + bi * outputs_per_prefix + base
+        flat_idx = jnp.asarray(idx.reshape(-1))
+        out = jax.tree_util.tree_map(
+            lambda x: jnp.take(x, flat_idx, axis=0), values
+        )
+        ctx.previous_hierarchy_level = hierarchy_level
+        return out
+
+    def _compute_partial_evaluations(
+        self, tree_indices: Sequence[int], hierarchy_level: int,
+        update_ctx: bool, ctx: EvaluationContext,
+    ):
+        """Seeds/control bits for `tree_indices` at hierarchy_level's tree
+        level, resuming from `ctx.partial_evaluations` when possible
+        (`ComputePartialEvaluations`, `distributed_point_function.cc:374-476`).
+        """
+        key = ctx.key
+        stop_level = self._hierarchy_to_tree[hierarchy_level]
+        start_level = self._hierarchy_to_tree[ctx.partial_evaluations_level]
+        n = len(tree_indices)
+        paths_np = np.stack(
+            [aes.u128_to_limbs(t) for t in tree_indices]
+        ).astype(np.uint32)
+
+        if ctx.partial_evaluations and start_level <= stop_level:
+            seeds_np = np.zeros((n, 4), dtype=np.uint32)
+            control_np = np.zeros((n,), dtype=np.uint32)
+            shift = stop_level - start_level
+            for i, ti in enumerate(tree_indices):
+                prev_prefix = ti >> shift if shift < 128 else 0
+                if prev_prefix not in ctx.partial_evaluations:
+                    raise ValueError(
+                        f"prefix {prev_prefix} not present in "
+                        f"ctx.partial_evaluations at hierarchy level "
+                        f"{hierarchy_level}"
+                    )
+                seed, t = ctx.partial_evaluations[prev_prefix]
+                seeds_np[i] = aes.u128_to_limbs(seed)
+                control_np[i] = t
+        else:
+            seeds_np = np.broadcast_to(
+                aes.u128_to_limbs(key.seed), (n, 4)
+            ).copy()
+            control_np = np.full((n,), key.party, dtype=np.uint32)
+            start_level = 0
+
+        seeds, control = self._walk_paths(
+            jnp.asarray(seeds_np),
+            jnp.asarray(control_np),
+            paths_np,
+            key,
+            start_level,
+            stop_level,
+            rightshift=0,
+        )
+
+        ctx.partial_evaluations = {}
+        if update_ctx:
+            seeds_host = np.asarray(seeds)
+            control_host = np.asarray(control)
+            for i, ti in enumerate(tree_indices):
+                ctx.partial_evaluations[ti] = (
+                    aes.limbs_to_u128(seeds_host[i]),
+                    int(control_host[i]),
+                )
+        ctx.partial_evaluations_level = hierarchy_level
+        return seeds, control
+
+    def evaluate_at(self, key: DpfKey, hierarchy_level: int,
+                    evaluation_points: Sequence[int],
+                    ctx: Optional[EvaluationContext] = None):
+        """Evaluate `key` at the given points of hierarchy level's domain.
+
+        Returns a value pytree with leading dim len(evaluation_points).
+        Mirrors `EvaluateAt` (`distributed_point_function.h:913-1070`).
+        """
+        if ctx is not None and ctx.key is not key:
+            raise ValueError("key and ctx.key must be the same object")
+        self._validate_key(key)
+        if not (0 <= hierarchy_level < len(self.parameters)):
+            raise ValueError("hierarchy_level out of range")
+        lds = self.parameters[hierarchy_level].log_domain_size
+        for pt in evaluation_points:
+            if not (0 <= pt < (1 << lds)):
+                raise ValueError(f"evaluation point {pt} out of range")
+        n = len(evaluation_points)
+        if n == 0:
+            vt = self.parameters[hierarchy_level].value_type
+            return vt.dev_zeros((0,))
+
+        tree_indices = [
+            self._domain_to_tree_index(pt, hierarchy_level)
+            for pt in evaluation_points
+        ]
+        stop_level = self._hierarchy_to_tree[hierarchy_level]
+        paths_np = np.stack(
+            [aes.u128_to_limbs(t) for t in tree_indices]
+        ).astype(np.uint32)
+
+        if ctx is None:
+            seeds_np = np.broadcast_to(
+                aes.u128_to_limbs(key.seed), (n, 4)
+            ).copy()
+            control_np = np.full((n,), key.party, dtype=np.uint32)
+            seeds, control = self._walk_paths(
+                jnp.asarray(seeds_np),
+                jnp.asarray(control_np),
+                paths_np,
+                key,
+                0,
+                stop_level,
+                rightshift=0,
+            )
+        else:
+            seeds, control = self._compute_partial_evaluations(
+                tree_indices, hierarchy_level, True, ctx
+            )
+            ctx.previous_hierarchy_level = hierarchy_level
+
+        vc_dev = self._stage_value_correction(key, hierarchy_level)
+        block_indices = jnp.asarray(
+            np.array(
+                [
+                    self._domain_to_block_index(pt, hierarchy_level)
+                    for pt in evaluation_points
+                ],
+                dtype=np.int32,
+            )
+        )
+        vc_dev = jax.tree_util.tree_map(lambda x: x[None], vc_dev)
+        return _leaf_stage_at(
+            seeds,
+            control,
+            vc_dev,
+            block_indices,
+            self.parameters[hierarchy_level].value_type,
+            self._blocks_needed[hierarchy_level],
+            key.party,
+        )
+
+    def evaluate_and_apply(self, keys: Sequence[DpfKey],
+                           evaluation_points: Sequence[int],
+                           op, evaluation_points_rightshift: int = 0):
+        """Evaluate many keys, each at its own point, across all hierarchy
+        levels, calling `op(values_pytree, hierarchy_level)` after each level.
+
+        `values_pytree` has leading dim len(keys). The engine behind DCF
+        batch evaluation, mirroring `EvaluateAndApply`
+        (`distributed_point_function.h:1072-1198`).
+        """
+        if len(keys) != len(evaluation_points):
+            raise ValueError("keys and evaluation_points size mismatch")
+        for k in keys:
+            self._validate_key(k)
+        n = len(keys)
+        last_lds = self.parameters[-1].log_domain_size
+        seeds = jnp.asarray(
+            np.stack([aes.u128_to_limbs(k.seed) for k in keys]).astype(
+                np.uint32
+            )
+        )
+        control = jnp.asarray(
+            np.array([k.party for k in keys], dtype=np.uint32)
+        )
+        paths_np = np.stack(
+            [aes.u128_to_limbs(p) for p in evaluation_points]
+        ).astype(np.uint32)
+
+        start_level = 0
+        for hl in range(len(self.parameters)):
+            stop_level = self._hierarchy_to_tree[hl]
+            tree_rightshift = (
+                evaluation_points_rightshift
+                + last_lds
+                - stop_level
+            )
+            seeds, control = self._walk_paths(
+                seeds, control, paths_np, list(keys), start_level, stop_level,
+                rightshift=tree_rightshift,
+            )
+            start_level = stop_level
+
+            # Leaf values at this hierarchy level, per key.
+            vt = self.parameters[hl].value_type
+            domain_rightshift = (
+                evaluation_points_rightshift
+                + last_lds
+                - self.parameters[hl].log_domain_size
+            )
+            block_indices = []
+            for pt in evaluation_points:
+                shifted = pt >> domain_rightshift if domain_rightshift < 128 else 0
+                block_indices.append(self._domain_to_block_index(shifted, hl))
+            vc_parts = [self._stage_value_correction(k, hl) for k in keys]
+            vc_dev = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs, axis=0), *vc_parts
+            )
+            values = _leaf_stage_at(
+                seeds,
+                control,
+                vc_dev,
+                jnp.asarray(np.array(block_indices, dtype=np.int32)),
+                vt,
+                self._blocks_needed[hl],
+                -1,  # party negation handled below per key
+            )
+            parties = jnp.asarray(
+                np.array([k.party for k in keys], dtype=np.uint32)
+            )
+            values = vt.dev_where(parties != 0, vt.dev_neg(values), values)
+            if op(values, hl) is False:
+                break
+
+    # -- internals ----------------------------------------------------------
+
+    def _compute_value_correction(
+        self, hierarchy_level: int, seeds: Sequence[int], alpha: int,
+        beta, invert: bool,
+    ) -> list:
+        """Value correction at an output level (`ComputeValueCorrection`,
+        `distributed_point_function.cc:81-117`)."""
+        p = self.parameters[hierarchy_level]
+        num_blocks = self._blocks_needed[hierarchy_level]
+        bytes_a = _value_hash_bytes_host(seeds[0], num_blocks)
+        bytes_b = _value_hash_bytes_host(seeds[1], num_blocks)
+        shift = self.parameters[-1].log_domain_size - p.log_domain_size
+        alpha_prefix = alpha >> shift if shift < 128 else 0
+        index_in_block = self._domain_to_block_index(
+            alpha_prefix, hierarchy_level
+        )
+        vt = p.value_type
+        epb = vt.elements_per_block()
+        if vt.can_convert_directly():
+            ebytes = (vt.total_bit_size() + 7) // 8
+            ints_a = [vt.parse_direct(bytes_a, i * ebytes) for i in range(epb)]
+            ints_b = [vt.parse_direct(bytes_b, i * ebytes) for i in range(epb)]
+        else:
+            ints_a = [vt.from_bytes(bytes_a)]
+            ints_b = [vt.from_bytes(bytes_b)]
+        ints_b[index_in_block] = vt.add(ints_b[index_in_block], beta)
+        out = []
+        for a, b in zip(ints_a, ints_b):
+            c = vt.sub(b, a)
+            if invert:
+                c = vt.neg(c)
+            out.append(c)
+        return out
+
+    def _domain_to_tree_index(self, domain_index: int, hierarchy_level: int) -> int:
+        bits = (
+            self.parameters[hierarchy_level].log_domain_size
+            - self._hierarchy_to_tree[hierarchy_level]
+        )
+        return domain_index >> bits
+
+    def _domain_to_block_index(self, domain_index: int, hierarchy_level: int) -> int:
+        bits = (
+            self.parameters[hierarchy_level].log_domain_size
+            - self._hierarchy_to_tree[hierarchy_level]
+        )
+        return domain_index & ((1 << bits) - 1)

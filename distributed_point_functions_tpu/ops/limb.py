@@ -1,0 +1,188 @@
+"""uint32-limb arithmetic for 128-bit blocks and wide integers on device.
+
+TPUs are 32-bit-int machines at heart; everything device-side in this
+framework represents wide integers as little-endian uint32 limb arrays
+(`uint32[..., nlimbs]`, limb 0 least significant). This module holds the
+shared carry/borrow arithmetic, byte-lane views, and a generic binary
+long-division used by IntModN sampling (`dpf/int_mod_n.h:159-182` semantics
+in the reference).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+U32 = jnp.uint32
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Limbwise add with carry propagation; both uint32[..., n], same n."""
+    n = a.shape[-1]
+    out = []
+    carry = jnp.zeros(a.shape[:-1], dtype=U32)
+    for i in range(n):
+        s = a[..., i] + b[..., i]
+        c1 = (s < a[..., i]).astype(U32)
+        s2 = s + carry
+        c2 = (s2 < s).astype(U32)
+        out.append(s2)
+        carry = c1 | c2
+    return jnp.stack(out, axis=-1)
+
+
+def add_scalar(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Add a small non-negative Python int to uint32[..., n] limbs."""
+    n = a.shape[-1]
+    limbs = [(k >> (32 * i)) & 0xFFFFFFFF for i in range(n)]
+    out = []
+    carry = jnp.zeros(a.shape[:-1], dtype=U32)
+    for i in range(n):
+        s = a[..., i] + jnp.uint32(limbs[i])
+        c1 = (s < a[..., i]).astype(U32)
+        s2 = s + carry
+        c2 = (s2 < s).astype(U32)
+        out.append(s2)
+        carry = c1 | c2
+    return jnp.stack(out, axis=-1)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Limbwise subtract with borrow; both uint32[..., n]."""
+    n = a.shape[-1]
+    out = []
+    borrow = jnp.zeros(a.shape[:-1], dtype=U32)
+    for i in range(n):
+        d = a[..., i] - b[..., i]
+        b1 = (a[..., i] < b[..., i]).astype(U32)
+        d2 = d - borrow
+        b2 = (d < borrow).astype(U32)
+        out.append(d2)
+        borrow = b1 | b2
+    return jnp.stack(out, axis=-1)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    """Two's complement negation of uint32[..., n] limbs."""
+    return add_scalar(~a, 1)
+
+
+def ge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Lexicographic a >= b over limbs; returns bool[...]."""
+    n = a.shape[-1]
+    res = jnp.ones(a.shape[:-1], dtype=jnp.bool_)  # equal so far -> ge
+    for i in range(n):  # low to high: the last (most significant) limb wins
+        gt = a[..., i] > b[..., i]
+        lt = a[..., i] < b[..., i]
+        res = gt | (~lt & res)
+        # res after processing limbs 0..i: a[0..i] >= b[0..i] as an integer
+    return res
+
+
+def shl1_with_bit(a: jnp.ndarray, bit: jnp.ndarray) -> jnp.ndarray:
+    """(a << 1) | bit over uint32[..., n] limbs; bit is uint32[...] 0/1."""
+    n = a.shape[-1]
+    out = []
+    carry_in = bit.astype(U32)
+    for i in range(n):
+        out.append((a[..., i] << 1) | carry_in)
+        carry_in = a[..., i] >> 31
+    return jnp.stack(out, axis=-1)
+
+
+def get_bit(a: jnp.ndarray, index) -> jnp.ndarray:
+    """Bit `index` (may be a traced scalar) of uint32[..., n]; uint32 0/1.
+
+    Out-of-range indices (>= 32*n) return 0, matching the reference's
+    explicit `bit_index < 128` guard (`evaluate_prg_hwy.cc:591-597`) — the
+    DCF rightshift path evaluates at bit indices up to the full block width.
+    """
+    index = jnp.asarray(index, dtype=jnp.int32)
+    limb_idx = index >> 5
+    bit_idx = (index & 31).astype(U32)
+    n = a.shape[-1]
+    limb = a[..., 0]
+    for i in range(1, n):
+        limb = jnp.where(limb_idx == i, a[..., i], limb)
+    bit = (limb >> bit_idx) & U32(1)
+    return jnp.where(limb_idx >= n, U32(0), bit)
+
+
+def to_const(value: int, nlimbs: int) -> np.ndarray:
+    return np.array(
+        [(value >> (32 * i)) & 0xFFFFFFFF for i in range(nlimbs)],
+        dtype=np.uint32,
+    )
+
+
+def to_byte_lanes(limbs: jnp.ndarray) -> jnp.ndarray:
+    """uint32[..., n] -> uint32[..., 4n] byte values (little-endian)."""
+    parts = [(limbs >> (8 * k)) & U32(0xFF) for k in range(4)]
+    stacked = jnp.stack(parts, axis=-1)  # [..., n, 4]
+    return stacked.reshape(limbs.shape[:-1] + (4 * limbs.shape[-1],))
+
+
+def from_byte_lanes(b: jnp.ndarray) -> jnp.ndarray:
+    """uint32[..., 4n] byte values -> uint32[..., n] limbs."""
+    nb = b.shape[-1]
+    assert nb % 4 == 0
+    b = b.reshape(b.shape[:-1] + (nb // 4, 4))
+    out = b[..., 0]
+    for k in range(1, 4):
+        out = out | (b[..., k] << (8 * k))
+    return out
+
+
+def divmod_const(x: jnp.ndarray, n_const: int, q_limbs: int) -> tuple:
+    """Binary restoring division of uint32[..., nl] by a constant.
+
+    Returns (quotient uint32[..., q_limbs], remainder uint32[..., nl]).
+    Works for any 0 < n_const < 2^(32*nl); used by IntModN sampling where a
+    128-bit block is repeatedly div/mod-ed by the modulus (the iterated
+    sampling of the reference's `int_mod_n.h:159-182`). O(bits) scan steps,
+    fully vectorized across the batch.
+    """
+    nl = x.shape[-1]
+    nbits = 32 * nl
+    n_arr = jnp.asarray(to_const(n_const, nl))
+
+    def body(carry, i):
+        rem, q = carry
+        bit = get_bit(x, nbits - 1 - i)
+        rem = shl1_with_bit(rem, bit)
+        geq = ge(rem, n_arr)
+        rem = jnp.where(geq[..., None], sub(rem, n_arr), rem)
+        # Set quotient bit (nbits - 1 - i) where geq.
+        j = nbits - 1 - i
+        limb_idx = j >> 5
+        bit_in_limb = (j & 31).astype(U32)
+        qbit = geq.astype(U32) << bit_in_limb
+        updates = []
+        for li in range(q.shape[-1]):
+            updates.append(
+                jnp.where(limb_idx == li, q[..., li] | qbit, q[..., li])
+            )
+        q = jnp.stack(updates, axis=-1)
+        return (rem, q), None
+
+    rem0 = jnp.zeros_like(x)
+    q0 = jnp.zeros(x.shape[:-1] + (q_limbs,), dtype=U32)
+    (rem, q), _ = lax.scan(body, (rem0, q0), jnp.arange(nbits, dtype=jnp.int32))
+    return q, rem
+
+
+def mask_top_bits(a: jnp.ndarray, bits: int) -> jnp.ndarray:
+    """Keep only the low `bits` bits of uint32[..., n] limbs."""
+    n = a.shape[-1]
+    masks = []
+    for i in range(n):
+        lo = 32 * i
+        if bits <= lo:
+            masks.append(0)
+        elif bits >= lo + 32:
+            masks.append(0xFFFFFFFF)
+        else:
+            masks.append((1 << (bits - lo)) - 1)
+    m = np.array(masks, dtype=np.uint32)
+    return a & jnp.asarray(m)

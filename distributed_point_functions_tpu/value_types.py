@@ -1,0 +1,586 @@
+"""Value types supported by the DPF: integers, XOR-wrappers, IntModN, tuples.
+
+Re-designs the reference's compile-time trait machinery
+(`dpf/internal/value_type_helpers.h`, `dpf/int_mod_n.h`, `dpf/tuple.h`,
+`dpf/xor_wrapper.h`) as runtime objects with two faces:
+
+* **host face** — Python-int group arithmetic and byte parsing used during
+  key generation (O(tree depth), never hot);
+* **device face** — uint32-limb JAX arrays and vectorized parsing/sampling
+  used during evaluation (the hot path).
+
+Packing follows the reference exactly: a type of `total_bit_size() == b <= 128`
+packs `128 // b` elements per 128-bit leaf block
+(`value_type_helpers.h:525-537`), which shortens the evaluation tree
+(`proto_validator.cc:140-153`). Types that cannot be bijectively mapped to
+fixed-size bit strings (IntModN, tuples containing it) are *sampled* from a
+pseudorandom byte stream via the iterated div/mod chain of
+`int_mod_n.h:159-182`, with statistical-security byte counts from
+`value_type_helpers.cc:71-139`.
+
+Host values: Python ints (arbitrary precision) / tuples thereof.
+Device values: pytrees whose leaves are uint32[..., nlimbs] little-endian
+limb arrays; the leading dims are batch dims shared across the pytree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ops import limb
+
+U32 = jnp.uint32
+_U128_MASK = (1 << 128) - 1
+
+
+def _nlimbs(bits: int) -> int:
+    return max(1, (bits + 31) // 32)
+
+
+class _HostSampleState:
+    """Sequential sampling state: a 128-bit accumulator plus a byte stream."""
+
+    def __init__(self, data: bytes):
+        self.block = int.from_bytes(data[:16], "little")
+        self.data = data
+        self.pos = 16
+
+    def next_bytes(self, n: int) -> int:
+        v = int.from_bytes(self.data[self.pos : self.pos + n], "little")
+        self.pos += n
+        return v
+
+
+class _DevSampleState:
+    """Device-side analog of _HostSampleState (static byte offsets)."""
+
+    def __init__(self, byte_lanes: jnp.ndarray):
+        self.block = limb.from_byte_lanes(byte_lanes[..., :16])  # [..., 4]
+        self.bytes = byte_lanes
+        self.pos = 16
+
+
+def _parse_dev_bytes(byte_lanes, offset: int, nbytes: int, nl: int):
+    """Little-endian integer from byte lanes -> uint32[..., nl] limbs."""
+    limbs = []
+    for li in range(nl):
+        v = None
+        for k in range(4):
+            b = 4 * li + k
+            if b < nbytes:
+                term = byte_lanes[..., offset + b] << (8 * k)
+                v = term if v is None else v | term
+        limbs.append(
+            v if v is not None else jnp.zeros(byte_lanes.shape[:-1], U32)
+        )
+    return jnp.stack(limbs, axis=-1)
+
+
+class ValueType:
+    """Abstract value type. See module docstring for the two faces."""
+
+    # --- descriptors -------------------------------------------------------
+    def can_convert_directly(self) -> bool:
+        raise NotImplementedError
+
+    def total_bit_size(self) -> int:
+        """Bit size for directly-convertible types."""
+        raise NotImplementedError
+
+    def bits_needed(self, security_parameter: float) -> int:
+        """Pseudorandom bits needed for one uniform element."""
+        raise NotImplementedError
+
+    def elements_per_block(self) -> int:
+        if self.can_convert_directly() and self.total_bit_size() <= 128:
+            return 128 // self.total_bit_size()
+        return 1
+
+    # --- host face ---------------------------------------------------------
+    def validate(self, v) -> None:
+        raise NotImplementedError
+
+    def zero(self):
+        raise NotImplementedError
+
+    def add(self, a, b):
+        raise NotImplementedError
+
+    def neg(self, a):
+        raise NotImplementedError
+
+    def sub(self, a, b):
+        return self.add(a, self.neg(b))
+
+    def from_bytes(self, data: bytes):
+        """Parse one element from `data` (ConvertBytesToArrayOf semantics)."""
+        if self.can_convert_directly():
+            return self.parse_direct(data, 0)
+        state = _HostSampleState(data)
+        return self.sample_host(state, update=False)
+
+    def parse_direct(self, data: bytes, offset: int):
+        raise NotImplementedError
+
+    def sample_host(self, state: _HostSampleState, update: bool):
+        raise NotImplementedError
+
+    # --- device face -------------------------------------------------------
+    def dev_zeros(self, shape):
+        raise NotImplementedError
+
+    def dev_const(self, host_value, shape):
+        """Broadcast a host value to a device pytree with batch `shape`."""
+        raise NotImplementedError
+
+    def dev_add(self, a, b):
+        raise NotImplementedError
+
+    def dev_neg(self, a):
+        raise NotImplementedError
+
+    def dev_where(self, mask, a, b):
+        """Select per batch element; `mask` is bool[batch dims]."""
+        raise NotImplementedError
+
+    def dev_parse_direct(self, byte_lanes, offset: int):
+        raise NotImplementedError
+
+    def dev_sample(self, state: _DevSampleState, update: bool):
+        raise NotImplementedError
+
+    def dev_from_value_blocks(self, blocks: jnp.ndarray):
+        """Parse `elements_per_block()` elements from value-hash blocks.
+
+        `blocks` is uint32[..., B, 4] (B = blocks needed). Returns a pytree
+        with batch shape [..., elements_per_block].
+        """
+        lanes = limb.to_byte_lanes(
+            blocks.reshape(blocks.shape[:-2] + (blocks.shape[-2] * 4,))
+        )
+        if self.can_convert_directly():
+            epb = self.elements_per_block()
+            ebytes = (self.total_bit_size() + 7) // 8
+            parts = [
+                self.dev_parse_direct(lanes, e * ebytes) for e in range(epb)
+            ]
+            return jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs, axis=lanes.ndim - 1), *parts
+            )
+        state = _DevSampleState(lanes)
+        v = self.dev_sample(state, update=False)
+        # elements_per_block == 1: add the singleton element axis before limbs.
+        return jax.tree_util.tree_map(lambda x: x[..., None, :], v)
+
+    def dev_take_element(self, values, indices):
+        """values: pytree with [..., epb(,limbs)]; indices: int32[...]."""
+        def take(x):  # leaves are [..., epb, limbs]
+            return jnp.take_along_axis(x, indices[..., None, None], axis=-2)[
+                ..., 0, :
+            ]
+
+        return jax.tree_util.tree_map(take, values)
+
+    def to_python(self, dev_value, index=()):
+        """Extract the host value at batch position `index` (numpy side)."""
+        raise NotImplementedError
+
+
+class _LimbValueType(ValueType):
+    """Shared device plumbing for types whose device value is one limb array.
+
+    Subclasses provide an ``nlimbs`` property; leaves are uint32[..., nlimbs].
+    """
+
+    def dev_zeros(self, shape):
+        return jnp.zeros(tuple(shape) + (self.nlimbs,), dtype=U32)
+
+    def dev_const(self, host_value, shape):
+        c = jnp.asarray(limb.to_const(host_value, self.nlimbs))
+        return jnp.broadcast_to(c, tuple(shape) + (self.nlimbs,))
+
+    def dev_where(self, mask, a, b):
+        return jnp.where(mask[..., None], a, b)
+
+    def to_python(self, dev_value, index=()):
+        arr = np.asarray(dev_value)[index]
+        return sum(int(arr[i]) << (32 * i) for i in range(self.nlimbs))
+
+
+@dataclasses.dataclass(frozen=True)
+class IntType(_LimbValueType):
+    """Unsigned integer mod 2^bits; bits in {8, 16, 32, 64, 128}.
+
+    Mirrors the reference's plain integer value types
+    (`value_type_helpers.h:182-252`).
+    """
+
+    bits: int
+
+    def __post_init__(self):
+        if self.bits not in (8, 16, 32, 64, 128):
+            raise ValueError(f"unsupported integer bitsize {self.bits}")
+
+    @property
+    def nlimbs(self) -> int:
+        return _nlimbs(self.bits)
+
+    def can_convert_directly(self) -> bool:
+        return True
+
+    def total_bit_size(self) -> int:
+        return self.bits
+
+    def bits_needed(self, security_parameter: float) -> int:
+        return self.bits
+
+    def validate(self, v) -> None:
+        if not isinstance(v, int) or not (0 <= v < (1 << self.bits)):
+            raise ValueError(f"value {v!r} out of range for uint{self.bits}")
+
+    def zero(self):
+        return 0
+
+    def add(self, a, b):
+        return (a + b) & ((1 << self.bits) - 1)
+
+    def neg(self, a):
+        return (-a) & ((1 << self.bits) - 1)
+
+    def parse_direct(self, data: bytes, offset: int):
+        return int.from_bytes(data[offset : offset + self.bits // 8], "little")
+
+    def sample_host(self, state: _HostSampleState, update: bool):
+        result = state.block & ((1 << self.bits) - 1)
+        if update:
+            nbytes = self.bits // 8
+            state.block = (state.block >> self.bits) << self.bits
+            state.block |= state.next_bytes(nbytes)
+        return result
+
+    def dev_add(self, a, b):
+        return limb.mask_top_bits(limb.add(a, b), self.bits)
+
+    def dev_neg(self, a):
+        return limb.mask_top_bits(limb.neg(a), self.bits)
+
+    def dev_parse_direct(self, byte_lanes, offset: int):
+        return _parse_dev_bytes(byte_lanes, offset, self.bits // 8, self.nlimbs)
+
+    def dev_sample(self, state: _DevSampleState, update: bool):
+        result = limb.mask_top_bits(state.block, min(self.bits, 128))
+        result = result[..., : self.nlimbs]
+        if update:
+            nbytes = self.bits // 8
+            if self.bits >= 128:
+                cleared = jnp.zeros_like(state.block)
+            elif self.bits % 32 == 0:
+                k = self.bits // 32
+                cleared = jnp.concatenate(
+                    [jnp.zeros_like(state.block[..., :k]), state.block[..., k:]],
+                    axis=-1,
+                )
+            else:  # 8- or 16-bit: clear low bits within limb 0
+                mask = np.array(
+                    [0xFFFFFFFF ^ ((1 << self.bits) - 1)] + [0xFFFFFFFF] * 3,
+                    dtype=np.uint32,
+                )
+                cleared = state.block & jnp.asarray(mask)
+            nxt = _parse_dev_bytes(state.bytes, state.pos, nbytes, 4)
+            state.block = cleared | nxt
+            state.pos += nbytes
+        return result
+
+
+@dataclasses.dataclass(frozen=True)
+class XorType(_LimbValueType):
+    """Integer whose group operation is XOR (GF(2^n) shares).
+
+    Mirrors `dpf/xor_wrapper.h:25-55`; the block type of PIR selection
+    vectors.
+    """
+
+    bits: int
+
+    def __post_init__(self):
+        if self.bits not in (8, 16, 32, 64, 128):
+            raise ValueError(f"unsupported xor bitsize {self.bits}")
+
+    @property
+    def nlimbs(self) -> int:
+        return _nlimbs(self.bits)
+
+    def can_convert_directly(self) -> bool:
+        return True
+
+    def total_bit_size(self) -> int:
+        return self.bits
+
+    def bits_needed(self, security_parameter: float) -> int:
+        return self.bits
+
+    def validate(self, v) -> None:
+        if not isinstance(v, int) or not (0 <= v < (1 << self.bits)):
+            raise ValueError(f"value {v!r} out of range for xor{self.bits}")
+
+    def zero(self):
+        return 0
+
+    def add(self, a, b):
+        return a ^ b
+
+    def neg(self, a):
+        return a
+
+    def parse_direct(self, data: bytes, offset: int):
+        return int.from_bytes(data[offset : offset + self.bits // 8], "little")
+
+    def sample_host(self, state: _HostSampleState, update: bool):
+        return IntType(self.bits).sample_host(state, update)
+
+    def dev_add(self, a, b):
+        return a ^ b
+
+    def dev_neg(self, a):
+        return a
+
+    def dev_parse_direct(self, byte_lanes, offset: int):
+        return _parse_dev_bytes(byte_lanes, offset, self.bits // 8, self.nlimbs)
+
+    def dev_sample(self, state: _DevSampleState, update: bool):
+        return IntType(self.bits).dev_sample(state, update)
+
+
+@dataclasses.dataclass(frozen=True)
+class IntModNType(_LimbValueType):
+    """Integers modulo an arbitrary constant N, sampled statistically.
+
+    Mirrors `dpf/int_mod_n.h`: sampling draws a 128-bit accumulator and
+    iterates `value = acc % N; acc = (acc / N) << base_bits | fresh_base_int`,
+    giving statistical distance < 2^-sigma with
+    sigma = 128 + 3 - log2(N) - log2(n) - log2(n+1) for n joint samples
+    (`int_mod_n.cc:29-34`).
+    """
+
+    base_bits: int
+    modulus: int
+
+    def __post_init__(self):
+        if self.base_bits not in (8, 16, 32, 64, 128):
+            raise ValueError(f"unsupported base bitsize {self.base_bits}")
+        if not (0 < self.modulus < (1 << self.base_bits)):
+            raise ValueError("modulus out of range for base integer")
+
+    @property
+    def nlimbs(self) -> int:
+        return _nlimbs(self.base_bits)
+
+    @staticmethod
+    def security_level(num_samples: int, modulus: int) -> float:
+        return 128 + 3 - (
+            math.log2(modulus)
+            + math.log2(num_samples)
+            + math.log2(num_samples + 1)
+        )
+
+    @classmethod
+    def bytes_needed_joint(
+        cls, num_samples: int, base_bits: int, modulus: int,
+        security_parameter: float,
+    ) -> int:
+        sigma = cls.security_level(num_samples, modulus)
+        if security_parameter > sigma:
+            raise ValueError(
+                f"IntModN sampling gives only {sigma:.2f} bits of statistical "
+                f"security for num_samples={num_samples}, modulus={modulus}"
+            )
+        return 16 + (base_bits // 8) * (num_samples - 1)
+
+    def can_convert_directly(self) -> bool:
+        return False
+
+    def bits_needed(self, security_parameter: float) -> int:
+        return 8 * self.bytes_needed_joint(
+            1, self.base_bits, self.modulus, security_parameter
+        )
+
+    def validate(self, v) -> None:
+        if not isinstance(v, int) or not (0 <= v < self.modulus):
+            raise ValueError(f"value {v!r} out of range mod {self.modulus}")
+
+    def zero(self):
+        return 0
+
+    def add(self, a, b):
+        return (a + b) % self.modulus
+
+    def neg(self, a):
+        return (-a) % self.modulus
+
+    def sample_host(self, state: _HostSampleState, update: bool):
+        result = state.block % self.modulus
+        if update:
+            q = state.block // self.modulus
+            state.block = ((q << self.base_bits) & _U128_MASK) | state.next_bytes(
+                self.base_bits // 8
+            )
+        return result
+
+    def dev_add(self, a, b):
+        n_arr = jnp.asarray(limb.to_const(self.modulus, self.nlimbs))
+        s = limb.add(a, b)
+        # Wrap-around (s < a) or s >= N ==> subtract N (all mod 2^base limbs).
+        wrapped = ~limb.ge(s, a)
+        over = limb.ge(s, jnp.broadcast_to(n_arr, s.shape))
+        cond = wrapped | over
+        return jnp.where(cond[..., None], limb.sub(s, n_arr), s)
+
+    def dev_neg(self, a):
+        n_arr = jnp.asarray(limb.to_const(self.modulus, self.nlimbs))
+        is_zero = jnp.all(a == 0, axis=-1)
+        return jnp.where(
+            is_zero[..., None], a, limb.sub(jnp.broadcast_to(n_arr, a.shape), a)
+        )
+
+    def dev_sample(self, state: _DevSampleState, update: bool):
+        q, r = limb.divmod_const(state.block, self.modulus, 4)
+        result = r[..., : self.nlimbs]
+        if update:
+            shift_bytes = self.base_bits // 8
+            # block = (q << base_bits) | next_base_int, truncated to 128 bits.
+            if self.base_bits >= 128:
+                shifted = jnp.zeros_like(q)
+            elif self.base_bits % 32 == 0:
+                k = self.base_bits // 32
+                shifted = jnp.concatenate(
+                    [jnp.zeros_like(q[..., :k]), q[..., : 4 - k]], axis=-1
+                )
+            else:  # 8/16-bit shifts within limbs
+                s = self.base_bits
+                parts = []
+                for i in range(4):
+                    lo = q[..., i] << s
+                    hi = q[..., i - 1] >> (32 - s) if i > 0 else jnp.zeros_like(q[..., 0])
+                    parts.append((lo | hi) & U32(0xFFFFFFFF))
+                shifted = jnp.stack(parts, axis=-1)
+            nxt = _parse_dev_bytes(state.bytes, state.pos, shift_bytes, 4)
+            state.block = shifted | nxt
+            state.pos += shift_bytes
+        return result
+
+
+@dataclasses.dataclass(frozen=True)
+class TupleType(ValueType):
+    """Tuple of value types with element-wise group structure.
+
+    Mirrors `dpf/tuple.h` + the tuple trait helpers
+    (`value_type_helpers.h:351-461`). All IntModN elements in a tuple must be
+    identical (they are sampled jointly, `value_type_helpers.cc:75-101`).
+    """
+
+    elements: tuple
+
+    def __init__(self, elements: Sequence[ValueType]):
+        object.__setattr__(self, "elements", tuple(elements))
+        if not self.elements:
+            raise ValueError("tuple must have at least one element")
+        mod_n = [e for e in self.elements if isinstance(e, IntModNType)]
+        if mod_n and any(e != mod_n[0] for e in mod_n):
+            raise ValueError(
+                "all IntModN elements in a tuple must be the same type"
+            )
+
+    def can_convert_directly(self) -> bool:
+        return all(e.can_convert_directly() for e in self.elements)
+
+    def total_bit_size(self) -> int:
+        return sum(e.total_bit_size() for e in self.elements)
+
+    def bits_needed(self, security_parameter: float) -> int:
+        mod_n = [e for e in self.elements if isinstance(e, IntModNType)]
+        others = [e for e in self.elements if not isinstance(e, IntModNType)]
+        bits = 0
+        if others:
+            per_el_sec = security_parameter + math.log2(len(others))
+            bits += sum(e.bits_needed(per_el_sec) for e in others)
+        if mod_n:
+            e = mod_n[0]
+            bits += 8 * IntModNType.bytes_needed_joint(
+                len(mod_n), e.base_bits, e.modulus, security_parameter
+            )
+        return bits
+
+    def validate(self, v) -> None:
+        if not isinstance(v, tuple) or len(v) != len(self.elements):
+            raise ValueError("tuple value arity mismatch")
+        for e, x in zip(self.elements, v):
+            e.validate(x)
+
+    def zero(self):
+        return tuple(e.zero() for e in self.elements)
+
+    def add(self, a, b):
+        return tuple(e.add(x, y) for e, x, y in zip(self.elements, a, b))
+
+    def neg(self, a):
+        return tuple(e.neg(x) for e, x in zip(self.elements, a))
+
+    def parse_direct(self, data: bytes, offset: int):
+        out = []
+        for e in self.elements:
+            out.append(e.parse_direct(data, offset))
+            offset += (e.total_bit_size() + 7) // 8
+        return tuple(out)
+
+    def sample_host(self, state: _HostSampleState, update: bool):
+        out = []
+        n = len(self.elements)
+        for i, e in enumerate(self.elements):
+            update2 = update or (i + 1 < n)
+            out.append(e.sample_host(state, update2))
+        return tuple(out)
+
+    def dev_zeros(self, shape):
+        return tuple(e.dev_zeros(shape) for e in self.elements)
+
+    def dev_const(self, host_value, shape):
+        return tuple(
+            e.dev_const(v, shape) for e, v in zip(self.elements, host_value)
+        )
+
+    def dev_add(self, a, b):
+        return tuple(e.dev_add(x, y) for e, x, y in zip(self.elements, a, b))
+
+    def dev_neg(self, a):
+        return tuple(e.dev_neg(x) for e, x in zip(self.elements, a))
+
+    def dev_where(self, mask, a, b):
+        return tuple(
+            e.dev_where(mask, x, y) for e, x, y in zip(self.elements, a, b)
+        )
+
+    def dev_parse_direct(self, byte_lanes, offset: int):
+        out = []
+        for e in self.elements:
+            out.append(e.dev_parse_direct(byte_lanes, offset))
+            offset += (e.total_bit_size() + 7) // 8
+        return tuple(out)
+
+    def dev_sample(self, state: _DevSampleState, update: bool):
+        out = []
+        n = len(self.elements)
+        for i, e in enumerate(self.elements):
+            update2 = update or (i + 1 < n)
+            out.append(e.dev_sample(state, update2))
+        return tuple(out)
+
+    def to_python(self, dev_value, index=()):
+        return tuple(
+            e.to_python(x, index) for e, x in zip(self.elements, dev_value)
+        )

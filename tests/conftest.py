@@ -1,8 +1,17 @@
 """Test configuration.
 
 Forces JAX onto a virtual 8-device CPU mesh so sharding tests run hermetically
-without TPU hardware (the driver's dryrun does the same). Must run before jax
-initializes its backends, which pytest guarantees by importing conftest first.
+without TPU hardware (the driver's dryrun does the same).
+
+Two subtleties:
+* ``XLA_FLAGS`` must be set before the CPU client is created (env is read at
+  backend-init time, which pytest's conftest-first import order guarantees).
+* The environment's ``sitecustomize`` registers the remote-TPU PJRT plugin at
+  interpreter startup and forces ``jax_platforms="axon,cpu"`` — plain
+  ``JAX_PLATFORMS=cpu`` in the env is overridden, and initializing the remote
+  backend dials a tunnel (slow/hanging under test). Overriding the *config*
+  after import wins, because the backend itself is only created lazily at
+  first ``jax.devices()``.
 """
 
 import os
@@ -13,3 +22,7 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

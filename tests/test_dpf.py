@@ -1,0 +1,280 @@
+"""Share-correctness property tests for the DPF core.
+
+Mirrors the reference's `IncrementalDpfTest` / `DpfEvaluationTest` strategy
+(`dpf/distributed_point_function_test.cc:320-1196`): generate keys, evaluate
+*both* shares, and check the group sum is beta at/under alpha and zero
+elsewhere — over sweeps of domain sizes, value types, and evaluation modes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_point_functions_tpu import dpf as dpf_mod
+from distributed_point_functions_tpu.value_types import (
+    IntModNType,
+    IntType,
+    TupleType,
+    XorType,
+)
+
+DPF = dpf_mod.DistributedPointFunction
+Params = dpf_mod.DpfParameters
+
+
+def both_full_expansions(d, k0, k1, level=None):
+    ctx0 = d.create_evaluation_context(k0)
+    ctx1 = d.create_evaluation_context(k1)
+    if level is None:
+        level = len(d.parameters) - 1
+    v0 = d.evaluate_until(level, [], ctx0)
+    v1 = d.evaluate_until(level, [], ctx1)
+    return v0, v1
+
+
+def check_share_sums(vt, v0, v1, alpha, beta, domain_size):
+    v0 = jax.tree_util.tree_map(np.asarray, v0)
+    v1 = jax.tree_util.tree_map(np.asarray, v1)
+    for x in range(domain_size):
+        s = vt.add(vt.to_python(v0, (x,)), vt.to_python(v1, (x,)))
+        expected = beta if x == alpha else vt.zero()
+        assert s == expected, f"x={x}: got {s}, want {expected}"
+
+
+INT_TYPES = [IntType(8), IntType(16), IntType(32), IntType(64), IntType(128)]
+
+
+@pytest.mark.parametrize("vt", INT_TYPES, ids=lambda t: f"u{t.bits}")
+@pytest.mark.parametrize("lds", [0, 1, 2, 5, 7])
+def test_single_level_full_expansion_integers(vt, lds):
+    d = DPF.create(Params(lds, vt))
+    domain = 1 << lds
+    alpha = domain // 2 if domain > 1 else 0
+    beta = (123456789123456789 % (1 << vt.bits)) | 1
+    k0, k1 = d.generate_keys(alpha, beta)
+    v0, v1 = both_full_expansions(d, k0, k1)
+    check_share_sums(vt, v0, v1, alpha, beta, domain)
+
+
+@pytest.mark.parametrize(
+    "vt",
+    [
+        XorType(32),
+        XorType(128),
+        IntModNType(32, 4294967291),  # largest 32-bit prime
+        IntModNType(8, 251),
+        TupleType([IntType(32), IntType(32)]),
+        TupleType([IntType(8), IntType(16)]),
+        TupleType([IntType(64), IntType(64), IntType(64)]),
+        TupleType([IntType(32), IntModNType(32, 4294967291)]),
+        TupleType([IntModNType(16, 65521), IntModNType(16, 65521)]),
+    ],
+    ids=str,
+)
+def test_single_level_full_expansion_type_zoo(vt):
+    lds = 4
+    d = DPF.create(Params(lds, vt))
+    alpha = 9
+
+    def make_beta(t):
+        if isinstance(t, TupleType):
+            return tuple(make_beta(e) for e in t.elements)
+        if isinstance(t, IntModNType):
+            return 987654321 % t.modulus
+        return 987654321 % (1 << t.bits)
+
+    beta = make_beta(vt)
+    k0, k1 = d.generate_keys(alpha, beta)
+    v0, v1 = both_full_expansions(d, k0, k1)
+    check_share_sums(vt, v0, v1, alpha, beta, 1 << lds)
+
+
+@pytest.mark.parametrize("vt", [IntType(32), IntType(128), XorType(64)],
+                         ids=lambda t: str(t))
+def test_evaluate_at_matches_expansion(vt):
+    lds = 6
+    d = DPF.create(Params(lds, vt))
+    alpha, beta = 37, 999
+    k0, k1 = d.generate_keys(alpha, beta)
+    points = [0, 1, 36, 37, 38, 63, 17]
+    e0 = d.evaluate_at(k0, 0, points)
+    e1 = d.evaluate_at(k1, 0, points)
+    e0 = jax.tree_util.tree_map(np.asarray, e0)
+    e1 = jax.tree_util.tree_map(np.asarray, e1)
+    for i, x in enumerate(points):
+        s = vt.add(vt.to_python(e0, (i,)), vt.to_python(e1, (i,)))
+        expected = beta if x == alpha else 0
+        assert s == expected, f"x={x}"
+
+
+@pytest.mark.parametrize("level_step", [1, 2, 3])
+def test_incremental_hierarchical_evaluation(level_step):
+    """Multi-level keys; evaluate with prefixes descending the hierarchy."""
+    vt = IntType(32)
+    lds_list = [2, 4, 6, 8]
+    params = [Params(l, vt) for l in lds_list]
+    d = DPF.create_incremental(params)
+    alpha = 0b10110101  # in the final domain
+    betas = [10, 20, 30, 40]
+    k0, k1 = d.generate_keys_incremental(alpha, betas)
+
+    ctx0 = d.create_evaluation_context(k0)
+    ctx1 = d.create_evaluation_context(k1)
+
+    level = -1
+    prefixes = []
+    prev_lds = 0
+    while level < len(params) - 1:
+        level = min(level + level_step, len(params) - 1)
+        v0 = d.evaluate_until(level, prefixes, ctx0)
+        v1 = d.evaluate_until(level, prefixes, ctx1)
+        v0 = jax.tree_util.tree_map(np.asarray, v0)
+        v1 = jax.tree_util.tree_map(np.asarray, v1)
+        lds = lds_list[level]
+        # Determine which domain indices the outputs correspond to.
+        if not prefixes:
+            indices = list(range(1 << lds))
+        else:
+            opp = 1 << (lds - prev_lds)
+            indices = []
+            for p in prefixes:
+                indices.extend(p * opp + j for j in range(opp))
+        alpha_here = alpha >> (lds_list[-1] - lds)
+        for i, x in enumerate(indices):
+            s = vt.add(vt.to_python(v0, (i,)), vt.to_python(v1, (i,)))
+            expected = betas[level] if x == alpha_here else 0
+            assert s == expected, f"level={level} x={x}"
+        # Next round: descend under alpha's prefix plus some cold prefixes.
+        prev_lds = lds
+        prefixes = sorted({alpha_here, 0, (1 << lds) - 1})
+
+
+def test_keygen_validation_errors():
+    d = DPF.create(Params(5, IntType(32)))
+    with pytest.raises(ValueError):
+        d.generate_keys(32, 1)  # alpha out of range
+    with pytest.raises(ValueError):
+        d.generate_keys(3, 1 << 32)  # beta out of range
+    with pytest.raises(ValueError):
+        DPF.create_incremental(
+            [Params(5, IntType(32)), Params(5, IntType(32))]
+        )  # non-ascending domains
+    with pytest.raises(ValueError):
+        DPF.create_incremental([])
+
+
+def test_context_prefix_errors():
+    d = DPF.create_incremental(
+        [Params(2, IntType(32)), Params(4, IntType(32))]
+    )
+    k0, _ = d.generate_keys_incremental(5, [1, 2])
+    ctx0 = d.create_evaluation_context(k0)
+    with pytest.raises(ValueError):
+        d.evaluate_until(0, [1], ctx0)  # prefixes must be empty on 1st call
+    d.evaluate_until(0, [], ctx0)
+    with pytest.raises(ValueError):
+        d.evaluate_until(0, [1], ctx0)  # level must increase
+    with pytest.raises(ValueError):
+        d.evaluate_until(1, [], ctx0)  # prefixes required now
+
+
+def test_packed_type_tree_shortening():
+    # u8 packs 16 elements/block: domain 2^5 needs just one tree level.
+    d = DPF.create(Params(5, IntType(8)))
+    assert d._tree_levels_needed == 2  # 5 - 7 + 3 = 1 -> levels {0,1}
+    alpha, beta = 21, 200
+    k0, k1 = d.generate_keys(alpha, beta)
+    v0, v1 = both_full_expansions(d, k0, k1)
+    check_share_sums(IntType(8), v0, v1, alpha, beta, 32)
+
+
+def test_evaluate_and_apply_multi_key():
+    """Many independent keys, each at its own point, per-key correction words."""
+    vt = IntType(32)
+    d = DPF.create(Params(8, vt))
+    cases = [(13, 100), (200, 5), (13, 7), (255, 9)]  # (alpha, beta)
+    keys, points, expected = [], [], []
+    for i, (alpha, beta) in enumerate(cases):
+        k0, k1 = d.generate_keys(alpha, beta)
+        pt = alpha if i % 2 == 0 else (alpha ^ 0x55)  # half hit, half miss
+        keys += [k0, k1]
+        points += [pt, pt]
+        expected.append(beta if pt == alpha else 0)
+
+    got = {}
+
+    def op(values, hl):
+        got[hl] = jax.tree_util.tree_map(np.asarray, values)
+
+    d.evaluate_and_apply(keys, points, op)
+    assert list(got) == [0]
+    v = got[0]
+    for i, want in enumerate(expected):
+        s = vt.add(vt.to_python(v, (2 * i,)), vt.to_python(v, (2 * i + 1,)))
+        assert s == want, f"pair {i}"
+
+
+def test_evaluate_and_apply_rightshift():
+    """rightshift=1: keys on alpha, evaluated at (x >> 1) — the DCF pattern.
+
+    Uses the per-bit hierarchy a DCF builds (one level per domain bit), so
+    the out-of-range path-bit guard (`evaluate_prg_hwy.cc:591-597` semantics)
+    and the per-level block arithmetic are both exercised.
+    """
+    vt = IntType(32)
+    lds = 5
+    d = DPF.create_incremental([Params(i + 1, vt) for i in range(lds)])
+    alpha = 0b1011  # 4 bits within the 5-bit final domain -> key on alpha
+    betas = [(i + 1) * 11 for i in range(lds)]
+    k0, k1 = d.generate_keys_incremental(alpha, betas)
+
+    x = 0b10111  # x >> 1 == alpha
+    got = {}
+
+    def op(values, hl):
+        got[hl] = jax.tree_util.tree_map(np.asarray, values)
+
+    d.evaluate_and_apply(
+        [k0, k1], [x, x], op, evaluation_points_rightshift=1
+    )
+    assert list(got) == list(range(lds))
+    for hl in range(lds):
+        v = got[hl]
+        s = vt.add(vt.to_python(v, (0,)), vt.to_python(v, (1,)))
+        # At hierarchy level hl (domain size 2^(hl+1)) the evaluated prefix
+        # is (x >> 1) >> (lds - 1 - hl); it hits iff it equals alpha's prefix
+        # alpha >> (lds - 1 - hl).
+        hits = ((x >> 1) >> (lds - 1 - hl)) == (alpha >> (lds - 1 - hl))
+        want = betas[hl] if hits else 0
+        assert s == want, f"hl={hl}: got {s}, want {want}"
+
+
+def test_evaluate_and_apply_early_stop():
+    vt = IntType(32)
+    d = DPF.create_incremental([Params(2, vt), Params(4, vt)])
+    k0, k1 = d.generate_keys_incremental(5, [1, 2])
+    seen = []
+
+    def op(values, hl):
+        seen.append(hl)
+        return False  # stop after the first level
+
+    d.evaluate_and_apply([k0, k1], [5, 5], op)
+    assert seen == [0]
+
+
+def test_128bit_domain_point_eval():
+    d = DPF.create(Params(128, IntType(64)))
+    alpha = (1 << 127) + 12345
+    beta = 77
+    k0, k1 = d.generate_keys(alpha, beta)
+    points = [alpha, alpha - 1, alpha + 1, 0, (1 << 128) - 1]
+    e0 = d.evaluate_at(k0, 0, points)
+    e1 = d.evaluate_at(k1, 0, points)
+    vt = IntType(64)
+    e0 = jax.tree_util.tree_map(np.asarray, e0)
+    e1 = jax.tree_util.tree_map(np.asarray, e1)
+    for i, x in enumerate(points):
+        s = vt.add(vt.to_python(e0, (i,)), vt.to_python(e1, (i,)))
+        assert s == (beta if x == alpha else 0), f"x={x}"
