@@ -1,0 +1,15 @@
+"""FSS gates built on DCF (reference: `dcf/fss_gates/`)."""
+
+from .multiple_interval_containment import (
+    Interval,
+    MicKey,
+    MicParameters,
+    MultipleIntervalContainmentGate,
+)
+
+__all__ = [
+    "Interval",
+    "MicKey",
+    "MicParameters",
+    "MultipleIntervalContainmentGate",
+]

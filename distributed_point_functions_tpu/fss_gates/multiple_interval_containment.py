@@ -1,0 +1,188 @@
+"""Multiple Interval Containment FSS gate (eprint 2020/1392, Fig. 14).
+
+Secret shares of 1 for every public interval `[p_i, q_i]` containing the
+masked input — rebuilt from the reference's
+`dcf/fss_gates/multiple_interval_containment.{h,cc}`:
+
+* `gen(r_in, r_out)` creates one DCF key at `gamma = (N-1+r_in) mod N` with
+  `beta = 1`, plus per-interval additively-shared correction terms `z_i`
+  that account for potential wrap-arounds of the masked bounds
+  (`multiple_interval_containment.cc:110-209`, Lemmas 1-2 / Theorem 3 of
+  the paper).
+* `batch_eval(keys, x)` runs two DCF evaluations per (key, interval) at the
+  shifted points `x + N - 1 - p` and `x + N - 1 - q'` and combines them with
+  the mask shares (`multiple_interval_containment.cc:211-308`).
+
+The group is Z_N with N = 2^log_group_size, so reductions are bit masks.
+The DCF value type is a 128-bit integer, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import secrets
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from ..dcf import DcfKey, DistributedComparisonFunction
+from ..value_types import IntType
+
+
+@dataclasses.dataclass(frozen=True)
+class Interval:
+    lower_bound: int
+    upper_bound: int
+
+
+@dataclasses.dataclass(frozen=True)
+class MicParameters:
+    log_group_size: int
+    intervals: Tuple[Interval, ...]
+
+    def __init__(self, log_group_size: int, intervals: Sequence[Interval]):
+        object.__setattr__(self, "log_group_size", log_group_size)
+        object.__setattr__(self, "intervals", tuple(intervals))
+
+
+@dataclasses.dataclass
+class MicKey:
+    dcf_key: DcfKey
+    output_mask_share: List[int]
+
+
+class MultipleIntervalContainmentGate:
+    """See module docstring; mirrors `MultipleIntervalContainmentGate`."""
+
+    def __init__(self, parameters: MicParameters):
+        if parameters.log_group_size < 1 or parameters.log_group_size > 127:
+            raise ValueError("log_group_size must be in [1, 127]")
+        if not parameters.intervals:
+            raise ValueError("at least one interval is required")
+        n = 1 << parameters.log_group_size
+        for iv in parameters.intervals:
+            if not (0 <= iv.lower_bound < n) or not (0 <= iv.upper_bound < n):
+                raise ValueError(
+                    "interval bounds should be between 0 and 2^log_group_size"
+                )
+            if iv.lower_bound > iv.upper_bound:
+                raise ValueError(
+                    "interval upper bounds should be >= lower bound"
+                )
+        self.parameters = parameters
+        self._n = n
+        self.dcf = DistributedComparisonFunction.create(
+            parameters.log_group_size, IntType(128)
+        )
+
+    @classmethod
+    def create(cls, parameters: MicParameters):
+        return cls(parameters)
+
+    def gen(self, r_in: int, r_out: Sequence[int]) -> Tuple[MicKey, MicKey]:
+        """Generate the two parties' MIC keys for input mask r_in and
+        per-interval output masks r_out."""
+        if len(r_out) != len(self.parameters.intervals):
+            raise ValueError(
+                "count of output masks should be equal to the number of "
+                "intervals"
+            )
+        n = self._n
+        if not (0 <= r_in < n):
+            raise ValueError(
+                "input mask should be between 0 and 2^log_group_size"
+            )
+        for r in r_out:
+            if not (0 <= r < n):
+                raise ValueError(
+                    "output mask should be between 0 and 2^log_group_size"
+                )
+
+        gamma = (n - 1 + r_in) % n
+        key0, key1 = self.dcf.generate_keys(gamma, 1)
+        k0 = MicKey(dcf_key=key0, output_mask_share=[])
+        k1 = MicKey(dcf_key=key1, output_mask_share=[])
+
+        for i, iv in enumerate(self.parameters.intervals):
+            p, q = iv.lower_bound, iv.upper_bound
+            q_prime = (q + 1) % n
+            alpha_p = (p + r_in) % n
+            alpha_q = (q + r_in) % n
+            alpha_q_prime = (q + 1 + r_in) % n
+            z = (
+                r_out[i]
+                + (1 if alpha_p > alpha_q else 0)
+                + (-1 if alpha_p > p else 0)
+                + (1 if alpha_q_prime > q_prime else 0)
+                + (1 if alpha_q == n - 1 else 0)
+            ) % n
+            z0 = secrets.randbits(128) % n
+            z1 = (z - z0) % n
+            k0.output_mask_share.append(z0)
+            k1.output_mask_share.append(z1)
+        return k0, k1
+
+    def eval(self, key: MicKey, x: int) -> List[int]:
+        """Single-key evaluation: one share of containment per interval."""
+        return self.batch_eval([key], [x])[0]
+
+    def batch_eval(
+        self, keys: Sequence[MicKey], evaluation_points: Sequence[int]
+    ) -> List[List[int]]:
+        """Evaluate each key at its own masked point.
+
+        Returns, per key, one Z_N share per interval.
+        """
+        if len(keys) != len(evaluation_points):
+            raise ValueError(
+                "keys and evaluation_points must have the same size"
+            )
+        n = self._n
+        for x in evaluation_points:
+            if not (0 <= x < n):
+                raise ValueError(
+                    "masked input should be between 0 and 2^log_group_size"
+                )
+        intervals = self.parameters.intervals
+        ni = len(intervals)
+        p = [iv.lower_bound for iv in intervals]
+        q_prime = [(iv.upper_bound + 1) % n for iv in intervals]
+
+        dcf_keys: List[DcfKey] = []
+        x_p: List[int] = []
+        x_q_prime: List[int] = []
+        for i, x in enumerate(evaluation_points):
+            for j in range(ni):
+                x_p.append((x + n - 1 - p[j]) % n)
+                x_q_prime.append((x + n - 1 - q_prime[j]) % n)
+                dcf_keys.append(keys[i].dcf_key)
+
+        s_p = np.asarray(self.dcf.batch_evaluate(dcf_keys, x_p))
+        s_q_prime = np.asarray(self.dcf.batch_evaluate(dcf_keys, x_q_prime))
+
+        def u128(limbs) -> int:
+            return sum(int(limbs[k]) << (32 * k) for k in range(4))
+
+        results: List[List[int]] = []
+        for i, x in enumerate(evaluation_points):
+            key = keys[i]
+            party = key.dcf_key.key.party
+            shares = []
+            for j in range(ni):
+                index = i * ni + j
+                sp = u128(s_p[index]) % n
+                sq = u128(s_q_prime[index]) % n
+                z = key.output_mask_share[j]
+                y = (
+                    (
+                        (1 if x > p[j] else 0) - (1 if x > q_prime[j] else 0)
+                        if party
+                        else 0
+                    )
+                    - sp
+                    + sq
+                    + z
+                ) % n
+                shares.append(y)
+            results.append(shares)
+        return results

@@ -1,0 +1,28 @@
+"""Two-server DPF-based private information retrieval, TPU-native."""
+
+from .client import DenseDpfPirClient
+from .database import DenseDpfPirDatabase
+from .messages import (
+    DpfPirResponse,
+    EncryptedHelperRequest,
+    HelperRequest,
+    LeaderRequest,
+    PirRequest,
+    PirResponse,
+    PlainRequest,
+)
+from .server import DenseDpfPirServer, DpfPirServer
+
+__all__ = [
+    "DenseDpfPirClient",
+    "DenseDpfPirDatabase",
+    "DenseDpfPirServer",
+    "DpfPirServer",
+    "DpfPirResponse",
+    "EncryptedHelperRequest",
+    "HelperRequest",
+    "LeaderRequest",
+    "PirRequest",
+    "PirResponse",
+    "PlainRequest",
+]

@@ -1,0 +1,109 @@
+"""Dense in-memory PIR database living in device HBM.
+
+The reference packs all records into one 128-bit-aligned host buffer and
+XORs with Highway SIMD (`pir/dense_dpf_pir_database.h:101-111`,
+`.cc:112-161`). The TPU redesign packs records into a single
+`uint32[num_records_padded, record_words]` array resident in HBM: every
+record is zero-padded to the maximum record size, and the record count is
+padded to a multiple of 128 so whole selection blocks line up with rows.
+`inner_product_with` runs the jitted XOR-reduction kernel
+(`ops/inner_product.py`) over the entire query batch in one database pass.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.inner_product import xor_inner_product
+
+
+class DenseDpfPirDatabase:
+    """Immutable dense database; construct via `DenseDpfPirDatabase.Builder`."""
+
+    class Builder:
+        def __init__(self):
+            self._records: List[bytes] = []
+
+        def insert(self, value: bytes) -> "DenseDpfPirDatabase.Builder":
+            if isinstance(value, str):
+                value = value.encode()
+            self._records.append(bytes(value))
+            return self
+
+        def clone(self) -> "DenseDpfPirDatabase.Builder":
+            b = DenseDpfPirDatabase.Builder()
+            b._records = list(self._records)
+            return b
+
+        def build(self) -> "DenseDpfPirDatabase":
+            return DenseDpfPirDatabase(self._records)
+
+    def __init__(self, records: Sequence[bytes]):
+        self._records = [bytes(r) for r in records]
+        self._max_value_size = max((len(r) for r in self._records), default=0)
+        num_records = len(self._records)
+        self._num_padded = max(128, ((num_records + 127) // 128) * 128)
+        record_bytes = max(4, ((self._max_value_size + 3) // 4) * 4)
+        buf = np.zeros((self._num_padded, record_bytes), dtype=np.uint8)
+        for i, r in enumerate(self._records):
+            buf[i, : len(r)] = np.frombuffer(r, dtype=np.uint8)
+        self._db_words = jnp.asarray(
+            np.ascontiguousarray(buf).view("<u4").astype(np.uint32)
+        )
+
+    @property
+    def size(self) -> int:
+        """Number of records."""
+        return len(self._records)
+
+    @property
+    def max_value_size(self) -> int:
+        return self._max_value_size
+
+    @property
+    def num_selection_bits(self) -> int:
+        """Selection bits a query must provide (padded record count)."""
+        return self._num_padded
+
+    @property
+    def num_selection_blocks(self) -> int:
+        return self._num_padded // 128
+
+    @property
+    def db_words(self) -> jnp.ndarray:
+        """uint32[num_records_padded, record_words] HBM-resident buffer."""
+        return self._db_words
+
+    def record(self, i: int) -> bytes:
+        return self._records[i]
+
+    def inner_product_with(self, selections: jnp.ndarray) -> List[bytes]:
+        """XOR of all records whose selection bit is 1, per query.
+
+        `selections`: uint32[num_queries, B, 4] packed blocks with
+        B * 128 >= num_selection_bits. Returns one byte-string of
+        `max_value_size` per query (the reference's result convention,
+        `inner_product_hwy.cc:271-272`).
+        """
+        if selections.ndim != 3 or selections.shape[-1] != 4:
+            raise ValueError("selections must be uint32[nq, B, 4]")
+        if selections.shape[1] * 128 < self.size:
+            raise ValueError(
+                f"selections contain {selections.shape[1] * 128} bits, "
+                f"expected at least {self.size}"
+            )
+        needed = self.num_selection_blocks
+        if selections.shape[1] > needed:
+            selections = selections[:, :needed]
+        elif selections.shape[1] < needed:
+            pad = needed - selections.shape[1]
+            selections = jnp.pad(selections, ((0, 0), (0, pad), (0, 0)))
+        out = np.asarray(xor_inner_product(self._db_words, selections))
+        raw = np.ascontiguousarray(out.astype("<u4")).view(np.uint8)
+        return [
+            raw[q, : self._max_value_size].tobytes()
+            for q in range(out.shape[0])
+        ]

@@ -1,0 +1,155 @@
+"""Fused multi-key selection-vector evaluation for dense PIR.
+
+The reference server runs, per DPF key, a *full-domain* expansion of a
+`2^ceil(log2(n))`-leaf tree whose leaves are 128-bit selection blocks
+(`dense_dpf_pir_server.cc:92-127`) — even though only the first
+`ceil(n/128)` blocks carry selection bits (the inner product stops at the
+database size, `inner_product_hwy.cc:279-281`). Since the client puts the
+query's block index in `alpha = index/128` (`dense_dpf_pir_client.cc:92-95`),
+all the *useful* leaves live in the subtree under the all-zeros prefix of
+depth `log_domain_size - ceil(log2(num_blocks))`.
+
+The TPU pipeline exploits that: walk the all-zeros path down the shared
+prefix (a `lax.scan` — one AES per key per level), then breadth-first
+expand only the needed subtree (width-doubling, all keys batched), then hash
+leaves to value blocks. Output is bit-identical to the reference's full
+expansion restricted to the first `num_blocks` blocks, at ~1/128 of the AES
+work for large domains.
+
+All queries in a batch are evaluated together: seeds are stacked on a key
+axis and correction words looked up per key, mirroring the per-seed
+correction-word mode of `evaluate_prg_hwy.h:58-65`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import keys as fixed_keys
+from ..dpf import DpfKey
+from ..ops import aes
+
+U32 = jnp.uint32
+
+_CLEAR_LSB = np.array(
+    [0xFFFFFFFE, 0xFFFFFFFF, 0xFFFFFFFF, 0xFFFFFFFF], dtype=np.uint32
+)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("walk_levels", "expand_levels", "num_blocks")
+)
+def evaluate_selection_blocks(
+    seeds0: jnp.ndarray,
+    control0: jnp.ndarray,
+    cw_seeds: jnp.ndarray,
+    cw_left: jnp.ndarray,
+    cw_right: jnp.ndarray,
+    last_vc: jnp.ndarray,
+    *,
+    walk_levels: int,
+    expand_levels: int,
+    num_blocks: int,
+) -> jnp.ndarray:
+    """Selection blocks for a batch of single-level 128-bit-XOR DPF keys.
+
+    seeds0: uint32[nk, 4] root seeds; control0: uint32[nk] (= party);
+    cw_seeds: uint32[L, nk, 4], cw_left/right: uint32[L, nk] with
+    L = walk_levels + expand_levels (level-major for the scan);
+    last_vc: uint32[nk, 4] last-level value correction.
+    Returns uint32[nk, num_blocks, 4] selection blocks (the first
+    `num_blocks` leaves of each key's tree).
+    """
+    clear = jnp.asarray(_CLEAR_LSB)
+    seeds, control = seeds0, control0
+
+    # Phase 1: walk the all-zeros prefix (left child each level).
+    if walk_levels > 0:
+        def walk_body(carry, x):
+            s, t = carry
+            cw_s, cw_l = x  # [nk, 4], [nk]
+            h = aes.mmo_hash(fixed_keys.RK_LEFT, s)
+            h = h ^ jnp.where(t[:, None] != 0, cw_s, U32(0))
+            t_new = h[:, 0] & U32(1)
+            h = h & clear
+            t_new = t_new ^ (t * cw_l)
+            return (h, t_new), None
+
+        (seeds, control), _ = lax.scan(
+            walk_body,
+            (seeds, control),
+            (cw_seeds[:walk_levels], cw_left[:walk_levels]),
+        )
+
+    # Phase 2: width-doubling expansion of the subtree, all keys batched.
+    seeds = seeds[:, None, :]  # [nk, w, 4]
+    control = control[:, None]  # [nk, w]
+    for i in range(expand_levels):
+        lvl = walk_levels + i
+        cw_s = cw_seeds[lvl][:, None, :]  # [nk, 1, 4]
+        cw_l = cw_left[lvl][:, None]
+        cw_r = cw_right[lvl][:, None]
+        left = aes.mmo_hash(fixed_keys.RK_LEFT, seeds)
+        right = aes.mmo_hash(fixed_keys.RK_RIGHT, seeds)
+        corr = jnp.where(control[..., None] != 0, cw_s, U32(0))
+        left = left ^ corr
+        right = right ^ corr
+        t_left = left[..., 0] & U32(1)
+        t_right = right[..., 0] & U32(1)
+        left = left & clear
+        right = right & clear
+        t_left = t_left ^ (control * cw_l)
+        t_right = t_right ^ (control * cw_r)
+        nk, w = seeds.shape[:2]
+        seeds = jnp.stack([left, right], axis=2).reshape(nk, 2 * w, 4)
+        control = jnp.stack([t_left, t_right], axis=2).reshape(nk, 2 * w)
+
+    # Phase 3: leaf value blocks (output PRG + XOR value correction; party
+    # negation is the identity for XOR shares).
+    v = aes.mmo_hash(fixed_keys.RK_VALUE, seeds)
+    v = v ^ jnp.where(control[..., None] != 0, last_vc[:, None, :], U32(0))
+    return v[:, :num_blocks, :]
+
+
+def stage_keys(keys: Sequence[DpfKey]):
+    """Stack a batch of dense-PIR DPF keys into device-ready arrays.
+
+    All keys must have the same number of correction words and a single
+    128-bit last-level value correction.
+    """
+    nk = len(keys)
+    num_levels = len(keys[0].correction_words)
+    seeds0 = np.zeros((nk, 4), dtype=np.uint32)
+    control0 = np.zeros((nk,), dtype=np.uint32)
+    cw_seeds = np.zeros((num_levels, nk, 4), dtype=np.uint32)
+    cw_left = np.zeros((num_levels, nk), dtype=np.uint32)
+    cw_right = np.zeros((num_levels, nk), dtype=np.uint32)
+    last_vc = np.zeros((nk, 4), dtype=np.uint32)
+    for k, key in enumerate(keys):
+        if len(key.correction_words) != num_levels:
+            raise ValueError("all keys must have the same number of levels")
+        if len(key.last_level_value_correction) != 1:
+            raise ValueError("dense PIR keys carry exactly one leaf value")
+        seeds0[k] = aes.u128_to_limbs(key.seed)
+        control0[k] = key.party
+        last_vc[k] = aes.u128_to_limbs(
+            int(key.last_level_value_correction[0])
+        )
+        for lvl, cw in enumerate(key.correction_words):
+            cw_seeds[lvl, k] = aes.u128_to_limbs(cw.seed)
+            cw_left[lvl, k] = cw.control_left
+            cw_right[lvl, k] = cw.control_right
+    return (
+        jnp.asarray(seeds0),
+        jnp.asarray(control0),
+        jnp.asarray(cw_seeds),
+        jnp.asarray(cw_left),
+        jnp.asarray(cw_right),
+        jnp.asarray(last_vc),
+    )

@@ -1,0 +1,273 @@
+"""PIR servers: role logic (Plain / Leader / Helper) and the dense server.
+
+`DpfPirServer` reimplements the deployment-role superclass of
+`pir/dpf_pir_server.h:42-65`, `.cc:30-193`:
+
+* **Plain** answers unencrypted requests directly.
+* **Leader** receives a `LeaderRequest`, forwards the encrypted helper
+  request through an injected `sender` callback while computing its own
+  response in the `while_waiting` callback, then XOR-combines both masked
+  responses.
+* **Helper** decrypts its request via an injected `decrypter` callback,
+  computes the response, and masks it with an AES-CTR one-time pad expanded
+  from the client's seed.
+
+Transport and encryption stay injected callbacks (the reference's
+`ForwardHelperRequestFn` / `DecryptHelperRequestFn` seam,
+`pir/dpf_pir_server.h:92-109`), so any RPC stack and hybrid-encryption
+scheme plug in unchanged.
+
+`DenseDpfPirServer` (`pir/dense_dpf_pir_server.h:32-74`) binds the role
+logic to the dense database: each request's DPF keys are evaluated in one
+fused, batched TPU pipeline (`dense_eval.py`) and pushed through the XOR
+inner product.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional
+
+from ..dpf import DistributedPointFunction, DpfParameters
+from ..prng import Aes128CtrSeededPrng, xor_bytes
+from ..value_types import XorType
+from . import messages
+from .database import DenseDpfPirDatabase
+from .dense_eval import evaluate_selection_blocks, stage_keys
+
+# sender(helper_request: PirRequest, while_waiting: Callable[[], None])
+#   -> PirResponse
+ForwardHelperRequestFn = Callable[..., "messages.PirResponse"]
+# decrypter(ciphertext: bytes, context_info: bytes) -> bytes
+DecryptHelperRequestFn = Callable[[bytes, bytes], bytes]
+
+ENCRYPTION_CONTEXT_INFO = b"DpfPirServer"
+
+
+class DpfPirServer:
+    """Role dispatch shared by all DPF-based PIR servers."""
+
+    def __init__(self):
+        self._role = "plain"
+        self._sender: Optional[ForwardHelperRequestFn] = None
+        self._decrypter: Optional[DecryptHelperRequestFn] = None
+        self._encryption_context_info = ENCRYPTION_CONTEXT_INFO
+
+    # -- role setup ---------------------------------------------------------
+
+    def make_leader(self, sender: ForwardHelperRequestFn) -> None:
+        if sender is None:
+            raise ValueError("sender may not be None")
+        self._sender = sender
+        self._role = "leader"
+
+    def make_helper(
+        self,
+        decrypter: DecryptHelperRequestFn,
+        encryption_context_info: bytes = ENCRYPTION_CONTEXT_INFO,
+    ) -> None:
+        if decrypter is None:
+            raise ValueError("decrypter may not be None")
+        self._decrypter = decrypter
+        self._encryption_context_info = encryption_context_info
+        self._role = "helper"
+
+    @property
+    def role(self) -> str:
+        return self._role
+
+    # -- request handling ---------------------------------------------------
+
+    def handle_request(
+        self, request: "messages.PirRequest"
+    ) -> "messages.PirResponse":
+        if self._role == "plain":
+            return self.handle_plain_request(request)
+        if self._role == "leader":
+            return self._handle_leader_request(request)
+        return self._handle_helper_request(request)
+
+    def handle_plain_request(self, request):
+        raise NotImplementedError
+
+    def _parse_helper_request(self, data: bytes) -> "messages.HelperRequest":
+        """Decode the decrypted helper request (subclass knows the DPF)."""
+        raise NotImplementedError
+
+    def _handle_leader_request(self, request):
+        if request.leader_request is None:
+            raise ValueError("request must be a valid LeaderRequest")
+        leader_request = request.leader_request
+        if leader_request.plain_request is None:
+            raise ValueError("plain_request must be set")
+        if leader_request.encrypted_helper_request is None:
+            raise ValueError("encrypted_helper_request must be set")
+
+        plain_request = messages.PirRequest(
+            plain_request=leader_request.plain_request
+        )
+        helper_request = messages.PirRequest(
+            encrypted_helper_request=leader_request.encrypted_helper_request
+        )
+
+        # The sender must invoke while_waiting (which computes the leader's
+        # own share) — detect misbehaving senders like the reference does
+        # (`dpf_pir_server.cc:111-115`).
+        state = {"has_run": False, "response": None, "error": None}
+
+        def while_waiting():
+            try:
+                state["response"] = self.handle_plain_request(plain_request)
+            except Exception as e:  # surfaced after the sender returns
+                state["error"] = e
+            state["has_run"] = True
+
+        helper_response = self._sender(helper_request, while_waiting)
+        if not state["has_run"]:
+            raise RuntimeError(
+                "handle_request: while_waiting was not called from the "
+                "sender passed at construction"
+            )
+        if state["error"] is not None:
+            raise state["error"]
+        leader_response = state["response"]
+
+        hr = helper_response.dpf_pir_response.masked_response
+        lr = leader_response.dpf_pir_response.masked_response
+        if len(hr) != len(lr):
+            raise RuntimeError(
+                f"number of responses from Helper (={len(hr)}) does not "
+                f"match the number of responses from Leader (={len(lr)})"
+            )
+        combined = []
+        for i, (h, l) in enumerate(zip(hr, lr)):
+            if len(h) != len(l):
+                raise RuntimeError(
+                    f"response size mismatch at index {i}: got {len(h)} "
+                    f"(Helper) vs. {len(l)} (Leader)"
+                )
+            combined.append(xor_bytes(h, l))
+        return messages.PirResponse(
+            dpf_pir_response=messages.DpfPirResponse(masked_response=combined)
+        )
+
+    def _handle_helper_request(self, request):
+        if request.encrypted_helper_request is None:
+            raise ValueError("request must be a valid EncryptedHelperRequest")
+        decrypted = self._decrypter(
+            request.encrypted_helper_request.encrypted_request,
+            self._encryption_context_info,
+        )
+        inner = self._parse_helper_request(decrypted)
+        response = self.handle_plain_request(
+            messages.PirRequest(plain_request=inner.plain_request)
+        )
+        prng = Aes128CtrSeededPrng(inner.one_time_pad_seed)
+        masked = [
+            xor_bytes(r, prng.get_random_bytes(len(r)))
+            for r in response.dpf_pir_response.masked_response
+        ]
+        return messages.PirResponse(
+            dpf_pir_response=messages.DpfPirResponse(masked_response=masked)
+        )
+
+
+class DenseDpfPirServer(DpfPirServer):
+    """PIR over a dense index space (`pir/dense_dpf_pir_server.h:32`)."""
+
+    def __init__(self, database: DenseDpfPirDatabase):
+        super().__init__()
+        if database is None:
+            raise ValueError("database cannot be None")
+        if database.size <= 0:
+            raise ValueError("database must not be empty")
+        self._database = database
+        self._log_domain_size = max(
+            0, math.ceil(math.log2(database.size))
+        )
+        self._dpf = DistributedPointFunction.create(
+            DpfParameters(
+                log_domain_size=self._log_domain_size,
+                value_type=XorType(128),
+            )
+        )
+        # Only the first ceil(size/128) leaf blocks carry selection bits;
+        # expand just the covering subtree (see dense_eval.py).
+        self._num_blocks = database.num_selection_blocks
+        k = max(0, (self._num_blocks - 1).bit_length())
+        # Branching levels = number of correction words (the root level in
+        # `_tree_levels_needed` does not branch).
+        total_levels = self._dpf._tree_levels_needed - 1
+        self._expand_levels = min(k, total_levels)
+        self._walk_levels = total_levels - self._expand_levels
+
+    # -- constructors mirroring CreatePlain/Leader/Helper -------------------
+
+    @classmethod
+    def create_plain(
+        cls, database: DenseDpfPirDatabase
+    ) -> "DenseDpfPirServer":
+        return cls(database)
+
+    @classmethod
+    def create_leader(
+        cls, database: DenseDpfPirDatabase, sender: ForwardHelperRequestFn
+    ) -> "DenseDpfPirServer":
+        server = cls(database)
+        server.make_leader(sender)
+        return server
+
+    @classmethod
+    def create_helper(
+        cls,
+        database: DenseDpfPirDatabase,
+        decrypter: DecryptHelperRequestFn,
+    ) -> "DenseDpfPirServer":
+        server = cls(database)
+        server.make_helper(decrypter, ENCRYPTION_CONTEXT_INFO)
+        return server
+
+    @property
+    def dpf(self) -> DistributedPointFunction:
+        return self._dpf
+
+    @property
+    def database(self) -> DenseDpfPirDatabase:
+        return self._database
+
+    def get_public_params(self):
+        return None  # the dense server has no public parameters
+
+    def _parse_helper_request(self, data: bytes) -> "messages.HelperRequest":
+        return messages.parse_helper_request(self._dpf, data)
+
+    def handle_plain_request(
+        self, request: "messages.PirRequest"
+    ) -> "messages.PirResponse":
+        if request.plain_request is None:
+            raise ValueError("request must contain a valid PlainRequest")
+        keys = request.plain_request.dpf_keys
+        if not keys:
+            raise ValueError("dpf_keys must not be empty")
+        expected_cw = self._dpf._tree_levels_needed - 1
+        for key in keys:
+            if key.party not in (0, 1):
+                raise ValueError("key.party must be 0 or 1")
+            if len(key.correction_words) != expected_cw:
+                raise ValueError(
+                    f"key has {len(key.correction_words)} correction words, "
+                    f"expected {expected_cw}"
+                )
+        staged = stage_keys(keys)
+        selections = evaluate_selection_blocks(
+            *staged,
+            walk_levels=self._walk_levels,
+            expand_levels=self._expand_levels,
+            num_blocks=self._num_blocks,
+        )
+        inner_products = self._database.inner_product_with(selections)
+        return messages.PirResponse(
+            dpf_pir_response=messages.DpfPirResponse(
+                masked_response=inner_products
+            )
+        )

@@ -1,0 +1,72 @@
+"""Seeded AES-128-CTR PRNG for the PIR one-time-pad masking.
+
+Equivalent of the reference's `Aes128CtrSeededPrng`
+(`pir/prng/aes_128_ctr_seeded_prng.h:33-85`, `.cc:42-104`): a deterministic
+byte stream from a 16-byte seed (used as the AES key) and an optional 16-byte
+nonce (used as the initial counter). Matches OpenSSL `AES_ctr128_encrypt`
+semantics: the counter is the full 16-byte IV interpreted big-endian and
+incremented once per block, and the stream position is preserved across
+`get_random_bytes` calls of arbitrary lengths.
+
+Runs host-side on the numpy AES oracle — OTP masking touches response bytes
+on the host path anyway; the device path keeps responses masked.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+import numpy as np
+
+from .ops import aes
+
+SEED_SIZE = 16
+
+
+def generate_seed() -> bytes:
+    """Cryptographically random 16-byte seed."""
+    return secrets.token_bytes(SEED_SIZE)
+
+
+class Aes128CtrSeededPrng:
+    """Deterministic AES-128-CTR byte stream from (seed, nonce)."""
+
+    def __init__(self, seed: bytes, nonce: bytes = b"\x00" * SEED_SIZE):
+        if len(seed) != SEED_SIZE:
+            raise ValueError(f"seed must be {SEED_SIZE} bytes")
+        if len(nonce) != SEED_SIZE:
+            raise ValueError(f"nonce must be {SEED_SIZE} bytes")
+        self._round_keys = aes.key_expansion(seed)
+        self._counter = int.from_bytes(nonce, "big")
+        self._partial = b""  # unconsumed tail of the last keystream block
+
+    def get_random_bytes(self, length: int) -> bytes:
+        """Next `length` pseudorandom bytes of the stream."""
+        if length < 0:
+            raise ValueError("length must be non-negative")
+        out = bytearray()
+        if self._partial:
+            take = min(length, len(self._partial))
+            out += self._partial[:take]
+            self._partial = self._partial[take:]
+        remaining = length - len(out)
+        if remaining > 0:
+            num_blocks = (remaining + 15) // 16
+            ctrs = np.zeros((num_blocks, 16), dtype=np.uint8)
+            for i in range(num_blocks):
+                c = (self._counter + i) % (1 << 128)
+                ctrs[i] = np.frombuffer(c.to_bytes(16, "big"), dtype=np.uint8)
+            self._counter = (self._counter + num_blocks) % (1 << 128)
+            stream = aes.aes_encrypt_np(self._round_keys, ctrs).tobytes()
+            out += stream[:remaining]
+            self._partial = stream[remaining:]
+        return bytes(out)
+
+
+def xor_bytes(a: bytes, b: bytes) -> bytes:
+    """Elementwise XOR of two equal-length byte strings."""
+    if len(a) != len(b):
+        raise ValueError("length mismatch")
+    return (
+        np.frombuffer(a, dtype=np.uint8) ^ np.frombuffer(b, dtype=np.uint8)
+    ).tobytes()

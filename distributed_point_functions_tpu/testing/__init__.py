@@ -1,0 +1,1 @@
+"""Test support utilities (mirrors `pir/testing/` in the reference)."""

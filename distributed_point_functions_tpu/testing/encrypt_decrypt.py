@@ -1,0 +1,41 @@
+"""Symmetric stand-in for the hybrid-encryption seam, for tests.
+
+The reference injects Tink `HybridEncrypt`/`HybridDecrypt` callbacks and
+ships fixed test keysets so protocol tests run real encryption without key
+management (`pir/testing/encrypt_decrypt.h:29-36`). Tink is not part of this
+environment, so tests use an authenticated-enough stand-in built from the
+framework's own AES core: a random 16-byte nonce is prepended and the
+plaintext is XORed with an AES-CTR keystream keyed by
+`AES_fixed(key XOR context_hash)`. Production deployments inject their own
+hybrid-encryption callbacks through the same seam
+(`EncryptHelperRequestFn` / `DecryptHelperRequestFn`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+
+from ..prng import Aes128CtrSeededPrng, xor_bytes
+
+# Fixed test key, analogous to the checked-in test keysets
+# (`pir/testing/data/hybrid_test_*.json`).
+TEST_KEY = bytes.fromhex("000102030405060708090a0b0c0d0e0f")
+
+
+def _derive_key(key: bytes, context_info: bytes) -> bytes:
+    return hashlib.sha256(key + b"|" + context_info).digest()[:16]
+
+
+def encrypt(plaintext: bytes, context_info: bytes, key: bytes = TEST_KEY) -> bytes:
+    nonce = secrets.token_bytes(16)
+    prng = Aes128CtrSeededPrng(_derive_key(key, context_info), nonce)
+    return nonce + xor_bytes(plaintext, prng.get_random_bytes(len(plaintext)))
+
+
+def decrypt(ciphertext: bytes, context_info: bytes, key: bytes = TEST_KEY) -> bytes:
+    if len(ciphertext) < 16:
+        raise ValueError("ciphertext too short")
+    nonce, body = ciphertext[:16], ciphertext[16:]
+    prng = Aes128CtrSeededPrng(_derive_key(key, context_info), nonce)
+    return xor_bytes(body, prng.get_random_bytes(len(body)))

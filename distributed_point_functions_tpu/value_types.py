@@ -191,6 +191,18 @@ class ValueType:
         """Extract the host value at batch position `index` (numpy side)."""
         raise NotImplementedError
 
+    # --- wire codec --------------------------------------------------------
+    def value_byte_size(self) -> int:
+        """Fixed byte width of one encoded host value."""
+        raise NotImplementedError
+
+    def value_to_bytes(self, v) -> bytes:
+        """Encode a host value (little-endian, fixed width)."""
+        raise NotImplementedError
+
+    def value_from_bytes(self, data: bytes):
+        raise NotImplementedError
+
 
 class _LimbValueType(ValueType):
     """Shared device plumbing for types whose device value is one limb array.
@@ -211,6 +223,17 @@ class _LimbValueType(ValueType):
     def to_python(self, dev_value, index=()):
         arr = np.asarray(dev_value)[index]
         return sum(int(arr[i]) << (32 * i) for i in range(self.nlimbs))
+
+    def value_byte_size(self) -> int:
+        return 4 * self.nlimbs
+
+    def value_to_bytes(self, v) -> bytes:
+        return int(v).to_bytes(4 * self.nlimbs, "little")
+
+    def value_from_bytes(self, data: bytes):
+        v = int.from_bytes(data[: 4 * self.nlimbs], "little")
+        self.validate(v)
+        return v
 
 
 @dataclasses.dataclass(frozen=True)
@@ -584,3 +607,20 @@ class TupleType(ValueType):
         return tuple(
             e.to_python(x, index) for e, x in zip(self.elements, dev_value)
         )
+
+    def value_byte_size(self) -> int:
+        return sum(e.value_byte_size() for e in self.elements)
+
+    def value_to_bytes(self, v) -> bytes:
+        return b"".join(
+            e.value_to_bytes(x) for e, x in zip(self.elements, v)
+        )
+
+    def value_from_bytes(self, data: bytes):
+        out = []
+        offset = 0
+        for e in self.elements:
+            w = e.value_byte_size()
+            out.append(e.value_from_bytes(data[offset : offset + w]))
+            offset += w
+        return tuple(out)

@@ -1,0 +1,274 @@
+"""Dense PIR tests: inner product, database, servers, client, protocol.
+
+Mirrors the reference's test strategy (SURVEY.md §4): share-correctness of
+the selection vectors, SIMD-vs-scalar differential tests of the inner
+product, end-to-end Plain and Leader/Helper protocol runs with an
+in-process lambda as "the network" (`pir/dpf_pir_server_test.cc:145-196`).
+"""
+
+import secrets
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.ops.inner_product import (
+    pack_selection_bits_np,
+    xor_inner_product,
+    xor_inner_product_np,
+)
+from distributed_point_functions_tpu.pir import (
+    DenseDpfPirClient,
+    DenseDpfPirDatabase,
+    DenseDpfPirServer,
+    messages,
+)
+from distributed_point_functions_tpu.prng import Aes128CtrSeededPrng, xor_bytes
+from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+RNG = np.random.default_rng(42)
+
+
+def random_records(n, size=32, variable=False):
+    return [
+        bytes(RNG.integers(0, 256, RNG.integers(1, size + 1) if variable else size, dtype=np.uint8))
+        for _ in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Inner product kernel
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "num_records,num_words,nq",
+    [(128, 8, 1), (256, 20, 3), (384, 1, 2), (1024, 64, 4)],
+)
+def test_xor_inner_product_matches_oracle(num_records, num_words, nq):
+    db = RNG.integers(0, 1 << 32, (num_records, num_words), dtype=np.uint32)
+    bits = RNG.integers(0, 2, (nq, num_records), dtype=np.uint32)
+    selections = pack_selection_bits_np(bits)
+    got = np.asarray(xor_inner_product(db, selections))
+    want = xor_inner_product_np(db, selections)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_xor_inner_product_chunking_invariance():
+    db = RNG.integers(0, 1 << 32, (896, 4), dtype=np.uint32)
+    bits = RNG.integers(0, 2, (2, 896), dtype=np.uint32)
+    selections = pack_selection_bits_np(bits)
+    a = np.asarray(xor_inner_product(db, selections, chunk=128))
+    b = np.asarray(xor_inner_product(db, selections, chunk=896))
+    c = np.asarray(xor_inner_product(db, selections, chunk=300))
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a, c)
+
+
+# ---------------------------------------------------------------------------
+# PRNG
+# ---------------------------------------------------------------------------
+
+
+def test_prng_deterministic_and_split_invariant():
+    seed = secrets.token_bytes(16)
+    p1 = Aes128CtrSeededPrng(seed)
+    p2 = Aes128CtrSeededPrng(seed)
+    a = p1.get_random_bytes(7) + p1.get_random_bytes(25) + p1.get_random_bytes(0) + p1.get_random_bytes(100)
+    b = p2.get_random_bytes(132)
+    assert a == b
+    assert len(set([bytes(a), p2.get_random_bytes(132)])) == 2
+
+
+def test_prng_nonce_gives_independent_streams():
+    seed = secrets.token_bytes(16)
+    a = Aes128CtrSeededPrng(seed, b"\x00" * 16).get_random_bytes(32)
+    b = Aes128CtrSeededPrng(seed, b"\x01" + b"\x00" * 15).get_random_bytes(32)
+    assert a != b
+
+
+def test_prng_matches_ctr_mode_semantics():
+    # Keystream block i must be AES_seed(nonce + i) with a big-endian counter.
+    from distributed_point_functions_tpu.ops import aes
+
+    seed = bytes(range(16))
+    nonce = (123).to_bytes(16, "big")
+    rk = aes.key_expansion(seed)
+    blocks = np.stack(
+        [
+            np.frombuffer((123 + i).to_bytes(16, "big"), dtype=np.uint8)
+            for i in range(3)
+        ]
+    )
+    want = aes.aes_encrypt_np(rk, blocks).tobytes()
+    got = Aes128CtrSeededPrng(seed, nonce).get_random_bytes(48)
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# Database
+# ---------------------------------------------------------------------------
+
+
+def test_database_basic_properties():
+    records = random_records(10, size=40, variable=True)
+    db = DenseDpfPirDatabase.Builder()
+    for r in records:
+        db.insert(r)
+    db = db.build()
+    assert db.size == 10
+    assert db.max_value_size == max(len(r) for r in records)
+    assert db.num_selection_bits == 128
+    for i, r in enumerate(records):
+        assert db.record(i) == r
+
+
+def test_database_inner_product_single_bits():
+    records = random_records(5, size=16)
+    db = DenseDpfPirDatabase(records)
+    bits = np.zeros((5, db.num_selection_bits), dtype=np.uint32)
+    for q in range(5):
+        bits[q, q] = 1
+    out = db.inner_product_with(
+        np.asarray(pack_selection_bits_np(bits))
+    )
+    for q in range(5):
+        assert out[q][: len(records[q])] == records[q]
+
+
+def test_database_inner_product_xor_of_pair():
+    records = random_records(4, size=8)
+    db = DenseDpfPirDatabase(records)
+    bits = np.zeros((1, db.num_selection_bits), dtype=np.uint32)
+    bits[0, 1] = 1
+    bits[0, 3] = 1
+    out = db.inner_product_with(np.asarray(pack_selection_bits_np(bits)))
+    assert out[0][:8] == xor_bytes(records[1], records[3])
+
+
+# ---------------------------------------------------------------------------
+# Dense server + client, plain protocol
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("num_records", [3, 100, 130, 1000])
+def test_plain_protocol_end_to_end(num_records):
+    records = random_records(num_records, size=24, variable=True)
+    database = DenseDpfPirDatabase(records)
+    server = DenseDpfPirServer.create_plain(database)
+    client = DenseDpfPirClient.create(num_records, encrypt_decrypt.encrypt)
+
+    indices = [0, num_records - 1, num_records // 2]
+    req0, req1 = client.create_plain_requests(indices)
+    resp0 = server.handle_request(req0)
+    resp1 = server.handle_request(req1)
+    for i, idx in enumerate(indices):
+        combined = xor_bytes(
+            resp0.dpf_pir_response.masked_response[i],
+            resp1.dpf_pir_response.masked_response[i],
+        )
+        assert combined[: len(records[idx])] == records[idx]
+        # Bytes beyond the record are zero padding.
+        assert all(b == 0 for b in combined[len(records[idx]) :])
+
+
+def test_plain_request_rejects_malformed_keys():
+    records = random_records(100)
+    server = DenseDpfPirServer.create_plain(DenseDpfPirDatabase(records))
+    client = DenseDpfPirClient.create(100, encrypt_decrypt.encrypt)
+    req0, _ = client.create_plain_requests([5])
+    req0.plain_request.dpf_keys[0].correction_words.pop()
+    with pytest.raises(ValueError, match="correction words"):
+        server.handle_request(req0)
+    with pytest.raises(ValueError, match="not be empty"):
+        server.handle_request(
+            messages.PirRequest(
+                plain_request=messages.PlainRequest(dpf_keys=[])
+            )
+        )
+
+
+# ---------------------------------------------------------------------------
+# Leader/Helper protocol with an in-process "network"
+# ---------------------------------------------------------------------------
+
+
+def make_leader_helper_pair(records):
+    database = DenseDpfPirDatabase(records)
+    helper = DenseDpfPirServer.create_helper(
+        DenseDpfPirDatabase(records), encrypt_decrypt.decrypt
+    )
+
+    def sender(helper_request, while_waiting):
+        # Plays the network: forwards to the helper, runs the callback
+        # "while waiting" like the reference test does
+        # (`pir/dpf_pir_server_test.cc:145-196`).
+        while_waiting()
+        return helper.handle_request(helper_request)
+
+    leader = DenseDpfPirServer.create_leader(database, sender)
+    return leader, helper
+
+
+def test_leader_helper_protocol_end_to_end():
+    num_records = 300
+    records = random_records(num_records, size=16, variable=True)
+    leader, _ = make_leader_helper_pair(records)
+    client = DenseDpfPirClient.create(num_records, encrypt_decrypt.encrypt)
+
+    indices = [7, 0, 299, 131]
+    request, state = client.create_request(indices)
+    response = leader.handle_request(request)
+    results = client.handle_response(response, state)
+    assert len(results) == len(indices)
+    for got, idx in zip(results, indices):
+        assert got[: len(records[idx])] == records[idx]
+
+
+def test_leader_detects_sender_not_calling_while_waiting():
+    records = random_records(100)
+    helper = DenseDpfPirServer.create_helper(
+        DenseDpfPirDatabase(records), encrypt_decrypt.decrypt
+    )
+
+    def bad_sender(helper_request, while_waiting):
+        return helper.handle_request(helper_request)  # never calls back
+
+    leader = DenseDpfPirServer.create_leader(
+        DenseDpfPirDatabase(records), bad_sender
+    )
+    client = DenseDpfPirClient.create(100, encrypt_decrypt.encrypt)
+    request, _ = client.create_request([3])
+    with pytest.raises(RuntimeError, match="while_waiting"):
+        leader.handle_request(request)
+
+
+def test_client_validates_indices():
+    client = DenseDpfPirClient.create(10, encrypt_decrypt.encrypt)
+    with pytest.raises(ValueError):
+        client.create_request([-1])
+    with pytest.raises(ValueError):
+        client.create_request([10])
+
+
+def test_helper_request_roundtrip_serialization():
+    client = DenseDpfPirClient.create(1000, encrypt_decrypt.encrypt)
+    _, helper_keys = client._generate_key_pairs([3, 997])
+    hr = messages.HelperRequest(
+        plain_request=messages.PlainRequest(dpf_keys=helper_keys),
+        one_time_pad_seed=secrets.token_bytes(16),
+    )
+    data = messages.serialize_helper_request(client.dpf, hr)
+    parsed = messages.parse_helper_request(client.dpf, data)
+    assert parsed.one_time_pad_seed == hr.one_time_pad_seed
+    assert len(parsed.plain_request.dpf_keys) == 2
+    for a, b in zip(parsed.plain_request.dpf_keys, helper_keys):
+        assert a.seed == b.seed
+        assert a.party == b.party
+        assert a.last_level_value_correction == b.last_level_value_correction
+        assert len(a.correction_words) == len(b.correction_words)
+        for ca, cb in zip(a.correction_words, b.correction_words):
+            assert (ca.seed, ca.control_left, ca.control_right) == (
+                cb.seed,
+                cb.control_left,
+                cb.control_right,
+            )
