@@ -1,0 +1,13 @@
+"""Multi-chip scale-out over a `jax.sharding.Mesh` (ICI/DCN collectives)."""
+
+from .sharded import (
+    make_mesh,
+    sharded_dense_pir_step,
+    sharded_inner_product,
+)
+
+__all__ = [
+    "make_mesh",
+    "sharded_dense_pir_step",
+    "sharded_inner_product",
+]

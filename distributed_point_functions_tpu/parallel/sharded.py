@@ -1,0 +1,160 @@
+"""Sharded dense-PIR execution over a device mesh.
+
+The reference scales within one server by Highway SIMD and threads
+(SURVEY.md §2.9); the TPU-native scale-out axis is a `jax.sharding.Mesh`
+with XLA collectives over ICI:
+
+* **Query parallelism ("dp")** — each device expands the DPF trees of its
+  slice of the query batch (AES work is embarrassingly parallel across
+  keys).
+* **Database sharding ("tp" analog)** — the record axis of the database is
+  sharded across the same devices; each device XORs its shard against all
+  queries' selection bits, and the per-device partials are XOR-combined
+  with an `all_gather` + bitwise-XOR reduction (XOR has no `psum`
+  equivalent, but an 8-way gather of 128-bit partials is tiny on ICI).
+
+The public entry point builds a `shard_map`-wrapped jitted step:
+queries in → combined inner products out, everything device-resident.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.inner_product import unpack_selection_bits
+from ..pir.dense_eval import evaluate_selection_blocks
+
+U32 = jnp.uint32
+
+
+def make_mesh(n_devices: int | None = None, axis_name: str = "x") -> Mesh:
+    """1-D mesh over the first `n_devices` devices (default: all)."""
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if len(devices) < n_devices:
+        raise ValueError(
+            f"need {n_devices} devices, have {len(devices)}"
+        )
+    return Mesh(np.array(devices[:n_devices]), (axis_name,))
+
+
+def _xor_all_reduce(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Bitwise-XOR all-reduce across a mesh axis (gather + local XOR)."""
+    gathered = lax.all_gather(x, axis_name)  # [ndev, ...]
+    return lax.reduce(
+        gathered, U32(0), lambda a, b: lax.bitwise_xor(a, b), (0,)
+    )
+
+
+def sharded_inner_product(mesh: Mesh, axis_name: str = "x"):
+    """Jitted XOR inner product with the database sharded over records.
+
+    Returns fn(db_words uint32[R, W] sharded on axis 0,
+               selections uint32[nq, B, 4] replicated) -> uint32[nq, W].
+    `R` must be divisible by 128 * mesh size.
+    """
+
+    def local_ip(db_shard, selections, bits_offset):
+        # db_shard: [R/ndev, W]; select this shard's bit range.
+        r_local = db_shard.shape[0]
+        bits = unpack_selection_bits(selections)  # [nq, B*128]
+        bits_local = lax.dynamic_slice_in_dim(
+            bits, bits_offset, r_local, axis=1
+        )
+        mask = (U32(0) - bits_local)[:, :, None]
+        masked = mask & db_shard[None, :, :]
+        return lax.reduce(
+            masked, U32(0), lambda a, b: lax.bitwise_xor(a, b), (1,)
+        )
+
+    def step(db_shard, selections):
+        idx = lax.axis_index(axis_name)
+        partial = local_ip(db_shard, selections, idx * db_shard.shape[0])
+        return _xor_all_reduce(partial, axis_name)
+
+    shard_mapped = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(axis_name, None), P()),
+        out_specs=P(),
+    )
+    return jax.jit(shard_mapped)
+
+
+def sharded_dense_pir_step(
+    mesh: Mesh,
+    *,
+    walk_levels: int,
+    expand_levels: int,
+    num_blocks: int,
+    axis_name: str = "x",
+):
+    """Full dense-PIR step sharded over a mesh.
+
+    Returns fn(seeds0[nq,4], control0[nq], cw_seeds[L,nq,4], cw_left[L,nq],
+    cw_right[L,nq], last_vc[nq,4], db_words[R,W]) -> uint32[nq, W] where
+    `nq` is divisible by the mesh size (query-parallel expansion) and `R`
+    is divisible by 128*mesh size (record-sharded inner product).
+    """
+    ndev = mesh.devices.size
+
+    def step(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc, db_shard):
+        # Phase A (dp): expand this device's query shard.
+        sel_local = evaluate_selection_blocks(
+            seeds0,
+            control0,
+            cw_seeds,
+            cw_left,
+            cw_right,
+            last_vc,
+            walk_levels=walk_levels,
+            expand_levels=expand_levels,
+            num_blocks=num_blocks,
+        )  # [nq/ndev, B, 4]
+        # Gather the full query batch's selections (ICI all-gather).
+        sel_all = lax.all_gather(sel_local, axis_name, tiled=True)  # [nq, B, 4]
+        # Phase B (db shard): partial XOR inner product on own records.
+        idx = lax.axis_index(axis_name)
+        r_local = db_shard.shape[0]
+        bits = unpack_selection_bits(sel_all)  # [nq, B*128]
+        bits_local = lax.dynamic_slice_in_dim(
+            bits, idx * r_local, r_local, axis=1
+        )
+        mask = (U32(0) - bits_local)[:, :, None]
+        masked = mask & db_shard[None, :, :]
+        partial = lax.reduce(
+            masked, U32(0), lambda a, b: lax.bitwise_xor(a, b), (1,)
+        )
+        # Phase C: XOR-combine partials across the mesh.
+        return _xor_all_reduce(partial, axis_name)
+
+    shard_mapped = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(
+            P(axis_name),        # seeds0 over queries
+            P(axis_name),        # control0
+            P(None, axis_name),  # cw_seeds [L, nq, 4]
+            P(None, axis_name),  # cw_left
+            P(None, axis_name),  # cw_right
+            P(axis_name),        # last_vc
+            P(axis_name, None),  # db rows
+        ),
+        out_specs=P(),
+    )
+    return jax.jit(shard_mapped)
+
+
+def shard_database(mesh: Mesh, db_words: jnp.ndarray, axis_name: str = "x"):
+    """Place a database buffer sharded over its record axis."""
+    return jax.device_put(
+        db_words, NamedSharding(mesh, P(axis_name, None))
+    )
