@@ -1,0 +1,113 @@
+"""Headline benchmark: dense PIR queries/sec/chip at a 2^20 x 256B database.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's single-threaded AES-NI CPU path
+(`experiments/README.md`, see BASELINE.md). A dense PIR query over 2^20
+records costs the reference a full-domain expansion of 2^20 128-bit
+selection blocks (~2 fixed-key AES ops per block node, `ExpandSeeds`,
+`dpf/distributed_point_function.cc:289-372`) plus a 256MB XOR inner product
+(`pir/internal/inner_product_hwy.cc`). From the published 2^20-point
+direct-eval time (0.67s, ~20 AES levels/point) the per-AES cost is
+~16ns/hash single-threaded; expansion ~2*2^20 hashes ~= 34ms, inner
+product ~256MB at ~10GB/s ~= 26ms, about 60ms/query => ~16 queries/sec.
+BASELINE_QPS encodes that derived figure.
+
+Our server answers the same queries with a fused batched pipeline that
+expands only the 2^13 selection blocks that carry bits (see
+`distributed_point_functions_tpu/pir/dense_eval.py`) and one database pass
+per query batch.
+
+Environment knobs: BENCH_RECORDS (default 2^20), BENCH_RECORD_BYTES (256),
+BENCH_QUERIES (64), BENCH_ITERS (4).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+
+import numpy as np
+
+BASELINE_QPS = 16.0
+
+
+def main():
+    num_records = int(os.environ.get("BENCH_RECORDS", 1 << 20))
+    record_bytes = int(os.environ.get("BENCH_RECORD_BYTES", 256))
+    num_queries = int(os.environ.get("BENCH_QUERIES", 64))
+    iters = int(os.environ.get("BENCH_ITERS", 4))
+
+    import jax
+
+    from distributed_point_functions_tpu.ops.inner_product import (
+        xor_inner_product,
+    )
+    from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
+    from distributed_point_functions_tpu.pir.dense_eval import (
+        evaluate_selection_blocks,
+        stage_keys,
+    )
+
+    rng = np.random.default_rng(7)
+
+    # Database straight to device (skip host record packing for 256MB).
+    num_padded = ((num_records + 127) // 128) * 128
+    num_words = record_bytes // 4
+    db_host = rng.integers(
+        0, 1 << 32, (num_padded, num_words), dtype=np.uint32
+    )
+    db_words = jax.device_put(db_host)
+
+    num_blocks = num_padded // 128
+    total_levels = max(0, math.ceil(math.log2(num_records)))
+    expand_levels = min(max(0, (num_blocks - 1).bit_length()), total_levels)
+    walk_levels = total_levels - expand_levels
+
+    client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+    indices = [int(i) for i in rng.integers(0, num_records, num_queries)]
+    keys0, _ = client._generate_key_pairs(indices)
+    staged = stage_keys(keys0)
+
+    @jax.jit
+    def pir_step(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc, db):
+        selections = evaluate_selection_blocks(
+            seeds0,
+            control0,
+            cw_seeds,
+            cw_left,
+            cw_right,
+            last_vc,
+            walk_levels=walk_levels,
+            expand_levels=expand_levels,
+            num_blocks=num_blocks,
+        )
+        return xor_inner_product(db, selections)
+
+    # Warmup / compile.
+    out = pir_step(*staged, db_words)
+    out.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = pir_step(*staged, db_words)
+    out.block_until_ready()
+    elapsed = time.perf_counter() - t0
+
+    qps = num_queries * iters / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": f"dense_pir_queries_per_sec_chip_{num_records}x{record_bytes}B",
+                "value": round(qps, 2),
+                "unit": "queries/s",
+                "vs_baseline": round(qps / BASELINE_QPS, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

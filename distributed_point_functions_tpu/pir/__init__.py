@@ -12,8 +12,15 @@ from .messages import (
     PlainRequest,
 )
 from .server import DenseDpfPirServer, DpfPirServer
+from .cuckoo_database import CuckooHashedDpfPirDatabase, CuckooHashingParams
+from .sparse_client import CuckooHashingSparseDpfPirClient
+from .sparse_server import CuckooHashingSparseDpfPirServer
 
 __all__ = [
+    "CuckooHashedDpfPirDatabase",
+    "CuckooHashingParams",
+    "CuckooHashingSparseDpfPirClient",
+    "CuckooHashingSparseDpfPirServer",
     "DenseDpfPirClient",
     "DenseDpfPirDatabase",
     "DenseDpfPirServer",

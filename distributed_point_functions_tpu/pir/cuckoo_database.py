@@ -1,0 +1,125 @@
+"""Cuckoo-hashed sparse PIR database
+(`pir/cuckoo_hashed_dpf_pir_database.{h,cc}`).
+
+The builder cuckoo-hashes all string keys into a `num_buckets`-slot table
+(`cuckoo_hashed_dpf_pir_database.cc:97-146`), then stores keys and values in
+**two parallel dense databases** — empty strings in vacant buckets — so one
+set of selection blocks retrieves `(key, value)` record pairs with two XOR
+inner products (`cuckoo_hashed_dpf_pir_database.cc:164-183`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..hashing import CuckooHashTable, create_hash_family_from_config
+from ..hashing.hash_family import create_hash_functions
+from .database import DenseDpfPirDatabase
+
+
+@dataclasses.dataclass(frozen=True)
+class CuckooHashingParams:
+    """Mirrors `CuckooHashingParams` (`private_information_retrieval.proto:93-100`)."""
+
+    num_buckets: int
+    num_hash_functions: int
+    hash_family_config: "HashFamilyConfig"  # noqa: F821
+
+
+class CuckooHashedDpfPirDatabase:
+    """Sparse (string-keyed) database; build via `.Builder`."""
+
+    class Builder:
+        def __init__(self):
+            self._records: Dict[bytes, bytes] = {}
+            self._params: Optional[CuckooHashingParams] = None
+
+        def set_params(self, params: CuckooHashingParams):
+            self._params = params
+            return self
+
+        def insert(self, key_value: Tuple[bytes, bytes]):
+            key, value = key_value
+            key = key.encode() if isinstance(key, str) else bytes(key)
+            value = value.encode() if isinstance(value, str) else bytes(value)
+            self._records[key] = value
+            return self
+
+        def clone(self):
+            b = CuckooHashedDpfPirDatabase.Builder()
+            b._records = dict(self._records)
+            b._params = self._params
+            return b
+
+        def build(self) -> "CuckooHashedDpfPirDatabase":
+            if self._params is None:
+                raise ValueError("params must be set before build")
+            params = self._params
+            if params.num_buckets <= 0:
+                raise ValueError("num_buckets must be positive")
+            if params.num_hash_functions <= 0:
+                raise ValueError("num_hash_functions must be positive")
+            family = create_hash_family_from_config(params.hash_family_config)
+            hash_functions = create_hash_functions(
+                family, params.num_hash_functions
+            )
+            table = CuckooHashTable(
+                hash_functions,
+                params.num_buckets,
+                max_relocations=max(128, len(self._records)),
+                max_stash_size=0,
+            )
+            for key in self._records:
+                if not key:
+                    raise ValueError("key cannot be empty")
+                table.insert(key)
+            key_builder = DenseDpfPirDatabase.Builder()
+            value_builder = DenseDpfPirDatabase.Builder()
+            for slot in table.get_table():
+                if slot is not None:
+                    key_builder.insert(slot)
+                    value_builder.insert(self._records[slot])
+                else:
+                    key_builder.insert(b"")
+                    value_builder.insert(b"")
+            return CuckooHashedDpfPirDatabase(
+                key_builder.build(),
+                value_builder.build(),
+                size=len(self._records),
+                num_buckets=params.num_buckets,
+            )
+
+    def __init__(
+        self,
+        key_database: DenseDpfPirDatabase,
+        value_database: DenseDpfPirDatabase,
+        size: int,
+        num_buckets: int,
+    ):
+        self._key_database = key_database
+        self._value_database = value_database
+        self._size = size
+        self._num_buckets = num_buckets
+
+    @property
+    def size(self) -> int:
+        """Number of real (non-dummy) records."""
+        return self._size
+
+    @property
+    def num_buckets(self) -> int:
+        return self._num_buckets
+
+    @property
+    def num_selection_blocks(self) -> int:
+        return self._key_database.num_selection_blocks
+
+    def inner_product_with(
+        self, selections: jnp.ndarray
+    ) -> List[Tuple[bytes, bytes]]:
+        keys = self._key_database.inner_product_with(selections)
+        values = self._value_database.inner_product_with(selections)
+        return list(zip(keys, values))

@@ -117,6 +117,25 @@ def evaluate_selection_blocks(
     return v[:, :num_blocks, :]
 
 
+def selection_blocks_for_keys(dpf, keys: Sequence[DpfKey], num_blocks: int):
+    """Evaluate a batch of single-level 128-bit-XOR DPF keys to the first
+    `num_blocks` selection blocks.
+
+    `dpf` supplies the tree depth; the walk/expand split is derived so only
+    the covering subtree is expanded. Returns uint32[nk, num_blocks, 4].
+    """
+    total_levels = dpf._tree_levels_needed - 1
+    expand_levels = min(max(0, (num_blocks - 1).bit_length()), total_levels)
+    walk_levels = total_levels - expand_levels
+    staged = stage_keys(keys)
+    return evaluate_selection_blocks(
+        *staged,
+        walk_levels=walk_levels,
+        expand_levels=expand_levels,
+        num_blocks=num_blocks,
+    )
+
+
 def stage_keys(keys: Sequence[DpfKey]):
     """Stack a batch of dense-PIR DPF keys into device-ready arrays.
 

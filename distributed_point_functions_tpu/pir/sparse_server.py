@@ -1,0 +1,143 @@
+"""Sparse (string-keyed) PIR server over a cuckoo-hashed database
+(`pir/cuckoo_hashing_sparse_dpf_pir_server.{h,cc}`).
+
+`generate_params` draws 3 hash functions over `1.5 * num_elements` buckets
+with a random 16-byte seed (`cuckoo_hashing_sparse_dpf_pir_server.cc:36-65`).
+Requests are dense-PIR requests over the bucket space; each query returns
+**two** masked responses — the bucket's key and its value
+(`cuckoo_hashing_sparse_dpf_pir_server.cc:126-165`).
+"""
+
+from __future__ import annotations
+
+import math
+import secrets
+
+from ..dpf import DistributedPointFunction, DpfParameters
+from ..value_types import XorType
+from . import messages
+from .cuckoo_database import CuckooHashedDpfPirDatabase, CuckooHashingParams
+from .dense_eval import selection_blocks_for_keys
+from .server import (
+    DecryptHelperRequestFn,
+    DpfPirServer,
+    ENCRYPTION_CONTEXT_INFO,
+    ForwardHelperRequestFn,
+)
+from ..hashing.hash_family_config import (
+    HASH_FAMILY_SHA256,
+    HASH_FUNCTION_SEED_LENGTH_BYTES,
+    HashFamilyConfig,
+)
+
+NUM_HASH_FUNCTIONS = 3
+BUCKETS_PER_ELEMENT = 1.5
+
+
+class CuckooHashingSparseDpfPirServer(DpfPirServer):
+    """See module docstring."""
+
+    def __init__(self, params: CuckooHashingParams,
+                 database: CuckooHashedDpfPirDatabase):
+        super().__init__()
+        if params.num_buckets <= 0:
+            raise ValueError("num_buckets must be positive")
+        if params.num_hash_functions <= 0:
+            raise ValueError("num_hash_functions must be positive")
+        if database is None:
+            raise ValueError("database cannot be None")
+        if database.num_buckets != params.num_buckets:
+            raise ValueError(
+                "number of buckets in the database does not match "
+                "params.num_buckets"
+            )
+        self._params = params
+        self._database = database
+        log_domain_size = max(0, math.ceil(math.log2(params.num_buckets)))
+        self._dpf = DistributedPointFunction.create(
+            DpfParameters(
+                log_domain_size=log_domain_size, value_type=XorType(128)
+            )
+        )
+        self._num_blocks = database.num_selection_blocks
+
+    @staticmethod
+    def generate_params(
+        num_elements: int,
+        hash_family: int = HASH_FAMILY_SHA256,
+        seed: bytes | None = None,
+    ) -> CuckooHashingParams:
+        if num_elements <= 0:
+            raise ValueError("num_elements must be positive")
+        if seed is None:
+            seed = secrets.token_bytes(HASH_FUNCTION_SEED_LENGTH_BYTES)
+        return CuckooHashingParams(
+            num_buckets=int(BUCKETS_PER_ELEMENT * num_elements),
+            num_hash_functions=NUM_HASH_FUNCTIONS,
+            hash_family_config=HashFamilyConfig(
+                hash_family=hash_family, seed=seed
+            ),
+        )
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def create_plain(cls, params, database):
+        return cls(params, database)
+
+    @classmethod
+    def create_leader(cls, params, database,
+                      sender: ForwardHelperRequestFn):
+        server = cls(params, database)
+        server.make_leader(sender)
+        return server
+
+    @classmethod
+    def create_helper(cls, params, database,
+                      decrypter: DecryptHelperRequestFn):
+        server = cls(params, database)
+        server.make_helper(decrypter, ENCRYPTION_CONTEXT_INFO)
+        return server
+
+    # -- request handling ---------------------------------------------------
+
+    @property
+    def public_params(self) -> CuckooHashingParams:
+        """The params a client needs (hash config + bucket count)."""
+        return self._params
+
+    @property
+    def dpf(self) -> DistributedPointFunction:
+        return self._dpf
+
+    def _parse_helper_request(self, data: bytes) -> "messages.HelperRequest":
+        return messages.parse_helper_request(self._dpf, data)
+
+    def handle_plain_request(
+        self, request: "messages.PirRequest"
+    ) -> "messages.PirResponse":
+        if request.plain_request is None:
+            raise ValueError("request must contain a valid PlainRequest")
+        keys = request.plain_request.dpf_keys
+        if not keys:
+            raise ValueError("dpf_keys must not be empty")
+        expected_cw = self._dpf._tree_levels_needed - 1
+        for key in keys:
+            if key.party not in (0, 1):
+                raise ValueError("key.party must be 0 or 1")
+            if len(key.correction_words) != expected_cw:
+                raise ValueError(
+                    f"key has {len(key.correction_words)} correction words, "
+                    f"expected {expected_cw}"
+                )
+        selections = selection_blocks_for_keys(
+            self._dpf, keys, self._num_blocks
+        )
+        pairs = self._database.inner_product_with(selections)
+        masked = []
+        for key_bytes, value_bytes in pairs:
+            masked.append(key_bytes)
+            masked.append(value_bytes)
+        return messages.PirResponse(
+            dpf_pir_response=messages.DpfPirResponse(masked_response=masked)
+        )

@@ -1,0 +1,221 @@
+"""Sparse PIR tests: hash families, hash tables, cuckoo database,
+sparse server/client, Leader/Helper protocol.
+
+Mirrors `pir/hashing/*_test.cc` and
+`pir/cuckoo_hashing_sparse_dpf_pir_server_test.cc`.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.hashing import (
+    CuckooHashTable,
+    HashFamilyConfig,
+    HASH_FAMILY_SHA256,
+    MultipleChoiceHashTable,
+    SHA256HashFamily,
+    SimpleHashTable,
+    create_hash_family_from_config,
+    create_hash_functions,
+    wrap_with_seed,
+)
+from distributed_point_functions_tpu.pir import (
+    CuckooHashedDpfPirDatabase,
+    CuckooHashingSparseDpfPirClient,
+    CuckooHashingSparseDpfPirServer,
+)
+from distributed_point_functions_tpu.prng import xor_bytes
+from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+RNG = np.random.default_rng(11)
+
+
+# ---------------------------------------------------------------------------
+# Hashing
+# ---------------------------------------------------------------------------
+
+
+def test_sha256_hash_function_deterministic_and_in_range():
+    fn = SHA256HashFamily()(b"seed")
+    for ub in [1, 2, 7, 1000, 1 << 30]:
+        vals = [fn(f"input{i}".encode(), ub) for i in range(50)]
+        assert all(0 <= v < ub for v in vals)
+        assert vals == [fn(f"input{i}".encode(), ub) for i in range(50)]
+    # Different seeds give different functions.
+    fn2 = SHA256HashFamily()(b"seed2")
+    assert any(
+        fn(f"x{i}".encode(), 1 << 20) != fn2(f"x{i}".encode(), 1 << 20)
+        for i in range(10)
+    )
+
+
+def test_sha256_reduction_matches_digest_interpretation():
+    import hashlib
+
+    fn = SHA256HashFamily()(b"s")
+    digest = hashlib.sha256(b"s" + b"data").digest()
+    lo = int.from_bytes(digest[:16], "little")
+    hi = int.from_bytes(digest[16:], "little")
+    assert fn(b"data", 1000003) == ((hi << 128) | lo) % 1000003
+
+
+def test_wrap_with_seed_and_create_hash_functions():
+    family = wrap_with_seed(SHA256HashFamily(), b"family")
+    fns = create_hash_functions(family, 3)
+    assert len(fns) == 3
+    direct = SHA256HashFamily()(b"family" + b"1")
+    assert fns[1](b"abc", 999) == direct(b"abc", 999)
+
+
+def test_cuckoo_hash_table_inserts_all():
+    fns = create_hash_functions(SHA256HashFamily(), 3)
+    table = CuckooHashTable(fns, num_buckets=150, max_relocations=100)
+    elements = [f"elem{i}".encode() for i in range(100)]
+    for e in elements:
+        table.insert(e)
+    stored = [x for x in table.get_table() if x is not None]
+    assert sorted(stored + table.get_stash()) == sorted(elements)
+    # Each stored element is in one of its hash buckets.
+    for i, slot in enumerate(table.get_table()):
+        if slot is not None:
+            assert i in [fn(slot, 150) for fn in fns]
+
+
+def test_cuckoo_hash_table_stash_overflow():
+    fns = create_hash_functions(SHA256HashFamily(), 2)
+    table = CuckooHashTable(
+        fns, num_buckets=2, max_relocations=5, max_stash_size=0
+    )
+    with pytest.raises(RuntimeError, match="stash"):
+        for i in range(10):
+            table.insert(f"e{i}".encode())
+
+
+def test_multiple_choice_hash_table():
+    fns = create_hash_functions(SHA256HashFamily(), 2)
+    table = MultipleChoiceHashTable(fns, num_buckets=50)
+    for i in range(40):
+        table.insert(f"x{i}".encode())
+    all_stored = [e for bucket in table.get_table() for e in bucket]
+    assert sorted(all_stored) == sorted(f"x{i}".encode() for i in range(40))
+    # Load is balanced: least-loaded choice keeps buckets small.
+    assert max(len(b) for b in table.get_table()) <= 4
+
+
+def test_simple_hash_table_stores_under_all_functions():
+    fns = create_hash_functions(SHA256HashFamily(), 3)
+    table = SimpleHashTable(fns, num_buckets=30)
+    table.insert(b"hello")
+    count = sum(b.count(b"hello") for b in table.get_table())
+    # Stored once per (distinct) hash bucket; duplicates collapse only if
+    # two hash functions collide.
+    assert 1 <= count <= 3
+    buckets = {fn(b"hello", 30) for fn in fns}
+    assert count == len(buckets)
+
+
+def test_hash_family_config_validation():
+    with pytest.raises(ValueError, match="seed"):
+        create_hash_family_from_config(
+            HashFamilyConfig(HASH_FAMILY_SHA256, b"")
+        )
+    with pytest.raises(ValueError, match="unspecified"):
+        create_hash_family_from_config(HashFamilyConfig(0, b"s"))
+
+
+# ---------------------------------------------------------------------------
+# Cuckoo database + sparse PIR end-to-end
+# ---------------------------------------------------------------------------
+
+
+def build_sparse_fixture(num_elements=60, value_size=20):
+    rng = np.random.default_rng(123)
+    pairs = [
+        (
+            f"key_{i}".encode(),
+            bytes(rng.integers(0, 256, value_size, dtype=np.uint8)),
+        )
+        for i in range(num_elements)
+    ]
+    params = CuckooHashingSparseDpfPirServer.generate_params(
+        num_elements, seed=b"0123456789abcdef"
+    )
+    builder = CuckooHashedDpfPirDatabase.Builder().set_params(params)
+    for kv in pairs:
+        builder.insert(kv)
+    return params, builder.build(), dict(pairs)
+
+
+def test_cuckoo_database_layout():
+    params, db, pairs = build_sparse_fixture()
+    assert db.size == len(pairs)
+    assert db.num_buckets == params.num_buckets
+
+
+def test_sparse_pir_plain_protocol():
+    params, db, pairs = build_sparse_fixture()
+    _, db2, _ = build_sparse_fixture()
+    server0 = CuckooHashingSparseDpfPirServer.create_plain(params, db)
+    server1 = CuckooHashingSparseDpfPirServer.create_plain(params, db2)
+    client = CuckooHashingSparseDpfPirClient.create(
+        params, encrypt_decrypt.encrypt
+    )
+
+    queries = [b"key_0", b"key_31", b"missing_key"]
+    req0, req1 = client.create_plain_requests(queries)
+    resp0 = server0.handle_request(req0)
+    resp1 = server1.handle_request(req1)
+    combined = [
+        xor_bytes(a, b)
+        for a, b in zip(
+            resp0.dpf_pir_response.masked_response,
+            resp1.dpf_pir_response.masked_response,
+        )
+    ]
+    # Decode without masking via the sparse client's matching logic.
+    from distributed_point_functions_tpu.pir.sparse_client import (
+        _is_prefix_padded_with_zeros,
+    )
+
+    num_hashes = params.num_hash_functions
+    for i, q in enumerate(queries):
+        found = None
+        for j in range(num_hashes):
+            idx = 2 * (num_hashes * i + j)
+            if found is None and _is_prefix_padded_with_zeros(
+                combined[idx], q
+            ):
+                found = combined[idx + 1]
+        if q in pairs:
+            assert found is not None
+            assert found[: len(pairs[q])] == pairs[q]
+        else:
+            assert found is None or all(b == 0 for b in found)
+
+
+def test_sparse_pir_leader_helper_end_to_end():
+    params, db, pairs = build_sparse_fixture(num_elements=40)
+    _, db2, _ = build_sparse_fixture(num_elements=40)
+    helper = CuckooHashingSparseDpfPirServer.create_helper(
+        params, db2, encrypt_decrypt.decrypt
+    )
+
+    def sender(helper_request, while_waiting):
+        while_waiting()
+        return helper.handle_request(helper_request)
+
+    leader = CuckooHashingSparseDpfPirServer.create_leader(
+        params, db, sender
+    )
+    client = CuckooHashingSparseDpfPirClient.create(
+        params, encrypt_decrypt.encrypt
+    )
+    queries = [b"key_5", b"nope", b"key_39"]
+    request, state = client.create_request(queries)
+    response = leader.handle_request(request)
+    results = client.handle_response(response, state)
+    assert results[1] is None
+    for qi in (0, 2):
+        q = queries[qi]
+        assert results[qi] is not None
+        assert results[qi][: len(pairs[q])] == pairs[q]
