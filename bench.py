@@ -34,6 +34,13 @@ import numpy as np
 BASELINE_QPS = 16.0
 
 
+def _log(msg):
+    import sys
+    import time as _t
+
+    print(f"[bench {_t.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
 def main():
     num_records = int(os.environ.get("BENCH_RECORDS", 1 << 20))
     record_bytes = int(os.environ.get("BENCH_RECORD_BYTES", 256))
@@ -41,6 +48,17 @@ def main():
     iters = int(os.environ.get("BENCH_ITERS", 4))
 
     import jax
+
+    # Persistent compilation cache: repeat bench runs skip the (large)
+    # bitsliced-AES XLA compile.
+    cache_dir = os.environ.get(
+        "BENCH_CACHE_DIR", os.path.expanduser("~/.cache/jax_bench")
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
 
     from distributed_point_functions_tpu.ops.inner_product import (
         xor_inner_product,
@@ -87,8 +105,14 @@ def main():
         return xor_inner_product(db, selections)
 
     # Warmup / compile.
+    _log(
+        f"compiling: {num_records} records x {record_bytes}B, "
+        f"{num_queries} queries, walk={walk_levels} expand={expand_levels}"
+    )
+    t_c = time.perf_counter()
     out = pir_step(*staged, db_words)
     out.block_until_ready()
+    _log(f"compile+first run {time.perf_counter() - t_c:.1f}s")
 
     t0 = time.perf_counter()
     for _ in range(iters):

@@ -126,22 +126,24 @@ def _expand_level(seeds, control, cw_seed, cw_left, cw_right):
     seeds: uint32[n, 4]; control: uint32[n]; cw_seed: uint32[4];
     cw_left/right: uint32 scalars. TPU analog of the reference's
     `ExpandSeeds` inner loop (`distributed_point_function.cc:327-370`).
+    Left and right children come from ONE key-selected AES pass (even lanes
+    left, odd lanes right) to keep the compiled graph small — the analog of
+    the reference's per-lane key masking
+    (`aes_128_fixed_key_hash_hwy.h:123-155`).
     """
-    left = aes.mmo_hash(fixed_keys.RK_LEFT, seeds)
-    right = aes.mmo_hash(fixed_keys.RK_RIGHT, seeds)
-    corr = jnp.where(control[:, None] != 0, cw_seed[None, :], U32(0))
-    left = left ^ corr
-    right = right ^ corr
-    t_left = left[:, 0] & U32(1)
-    t_right = right[:, 0] & U32(1)
-    clear = jnp.asarray(_CLEAR_LSB)
-    left = left & clear
-    right = right & clear
-    t_left = t_left ^ (control * cw_left)
-    t_right = t_right ^ (control * cw_right)
-    seeds_out = jnp.stack([left, right], axis=1).reshape(-1, 4)
-    control_out = jnp.stack([t_left, t_right], axis=1).reshape(-1)
-    return seeds_out, control_out
+    n = seeds.shape[0]
+    doubled = jnp.repeat(seeds, 2, axis=0)  # [2n, 4]
+    sel = jnp.tile(jnp.arange(2, dtype=U32), n)  # [2n]
+    h = aes.mmo_hash_select(
+        fixed_keys.RK_LEFT, fixed_keys.RK_RIGHT, sel, doubled
+    )
+    control2 = jnp.repeat(control, 2, axis=0)
+    h = h ^ jnp.where(control2[:, None] != 0, cw_seed[None, :], U32(0))
+    t_new = h[:, 0] & U32(1)
+    h = h & jnp.asarray(_CLEAR_LSB)
+    cw_dir = jnp.where(sel != 0, cw_right, cw_left)
+    t_new = t_new ^ (control2 * cw_dir)
+    return h, t_new
 
 
 @jax.jit

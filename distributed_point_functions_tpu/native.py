@@ -1,0 +1,161 @@
+"""ctypes bindings to the native C++ CPU kernels (`native/`).
+
+The native library is the framework's CPU oracle — the role the scalar
+`EvaluateSeedsNoHwy` / `InnerProductNoHwy` paths play in the reference
+(`dpf/internal/evaluate_prg_hwy.cc:552-634`,
+`pir/internal/inner_product_hwy.cc:270-296`). The TPU kernels are
+differential-tested against it, and host-side tooling can use it without
+JAX.
+
+The shared library is built on demand with `native/build.sh` (g++); the
+binding is plain ctypes — no pybind11 in this environment.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+from . import keys as fixed_keys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libdpf_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_keys_ctx = None
+
+
+def _build() -> None:
+    subprocess.run(
+        ["sh", os.path.join(_NATIVE_DIR, "build.sh")],
+        check=True,
+        capture_output=True,
+    )
+
+
+def _u8(arr) -> np.ndarray:
+    return np.ascontiguousarray(arr, dtype=np.uint8)
+
+
+def get_lib() -> ctypes.CDLL:
+    """Loads (building if needed) the native library."""
+    global _lib, _keys_ctx
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        _build()
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.dpf_create_keys.restype = ctypes.c_void_p
+    lib.dpf_create_keys.argtypes = [ctypes.c_char_p] * 3
+    lib.dpf_free_keys.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    _keys_ctx = ctypes.c_void_p(
+        lib.dpf_create_keys(
+            bytes(fixed_keys.PRG_KEY_LEFT),
+            bytes(fixed_keys.PRG_KEY_RIGHT),
+            bytes(fixed_keys.PRG_KEY_VALUE),
+        )
+    )
+    return lib
+
+
+def _ctx():
+    get_lib()
+    return _keys_ctx
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def mmo_hash(which: int, blocks: np.ndarray) -> np.ndarray:
+    """MMO hash of uint8[n, 16] blocks; which: 0=left, 1=right, 2=value."""
+    lib = get_lib()
+    blocks = _u8(blocks).reshape(-1, 16)
+    out = np.empty_like(blocks)
+    lib.dpf_mmo_hash(
+        _ctx(), ctypes.c_int(which), _ptr(blocks), _ptr(out),
+        ctypes.c_int64(blocks.shape[0]),
+    )
+    return out
+
+
+def expand_level(seeds: np.ndarray, control: np.ndarray, cw_seed: np.ndarray,
+                 cw_left: int, cw_right: int):
+    """One expansion level: uint8[n,16] -> uint8[2n,16] (interleaved L/R)."""
+    lib = get_lib()
+    seeds = _u8(seeds).reshape(-1, 16)
+    n = seeds.shape[0]
+    control = _u8(control)
+    cw_seed = _u8(cw_seed).reshape(16)
+    seeds_out = np.empty((2 * n, 16), dtype=np.uint8)
+    control_out = np.empty((2 * n,), dtype=np.uint8)
+    lib.dpf_expand_level(
+        _ctx(), _ptr(seeds), _ptr(control), _ptr(cw_seed),
+        ctypes.c_uint8(cw_left), ctypes.c_uint8(cw_right),
+        _ptr(seeds_out), _ptr(control_out), ctypes.c_int64(n),
+    )
+    return seeds_out, control_out
+
+
+def evaluate_seeds(seeds: np.ndarray, control: np.ndarray, paths: np.ndarray,
+                   cw_seeds: np.ndarray, cw_left: np.ndarray,
+                   cw_right: np.ndarray, per_seed_cw: bool,
+                   paths_rightshift: int = 0):
+    """Walk levels for a batch of seeds (in-place on copies; returns them).
+
+    seeds/paths: uint8[n, 16]; cw_seeds: uint8[L, m, 16] with m == 1
+    (shared) or m == n (per-seed); cw_left/right: uint8[L, m].
+    """
+    lib = get_lib()
+    seeds = _u8(seeds).reshape(-1, 16).copy()
+    n = seeds.shape[0]
+    control = _u8(control).copy()
+    paths = _u8(paths).reshape(-1, 16)
+    cw_seeds = _u8(cw_seeds)
+    num_levels = cw_seeds.shape[0] if cw_seeds.ndim == 3 else 0
+    stride = n if per_seed_cw else 1
+    cw_seeds_flat = _u8(cw_seeds).reshape(-1, 16)
+    cw_left = _u8(cw_left).reshape(-1)
+    cw_right = _u8(cw_right).reshape(-1)
+    lib.dpf_evaluate_seeds(
+        _ctx(), _ptr(seeds), _ptr(control), _ptr(paths),
+        _ptr(cw_seeds_flat), _ptr(cw_left), _ptr(cw_right),
+        ctypes.c_int64(n), ctypes.c_int(num_levels),
+        ctypes.c_int64(stride), ctypes.c_int(paths_rightshift),
+    )
+    return seeds, control
+
+
+def value_hash(seeds: np.ndarray, num_blocks: int) -> np.ndarray:
+    """uint8[n, 16] -> uint8[n, num_blocks, 16] output PRG."""
+    lib = get_lib()
+    seeds = _u8(seeds).reshape(-1, 16)
+    n = seeds.shape[0]
+    out = np.empty((n, num_blocks, 16), dtype=np.uint8)
+    lib.dpf_value_hash(
+        _ctx(), _ptr(seeds), _ptr(out), ctypes.c_int64(n),
+        ctypes.c_int(num_blocks),
+    )
+    return out
+
+
+def inner_product(db_words: np.ndarray, selections: np.ndarray) -> np.ndarray:
+    """XOR inner product: uint32[R, W] x uint8[nq, B, 16] -> uint32[nq, W]."""
+    lib = get_lib()
+    db_words = np.ascontiguousarray(db_words, dtype=np.uint32)
+    num_records, record_words = db_words.shape
+    selections = _u8(selections)
+    nq, num_blocks = selections.shape[0], selections.shape[1]
+    out = np.empty((nq, record_words), dtype=np.uint32)
+    lib.dpf_inner_product(
+        _ptr(db_words), ctypes.c_int64(num_records),
+        ctypes.c_int64(record_words), _ptr(selections),
+        ctypes.c_int64(nq), ctypes.c_int64(num_blocks), _ptr(out),
+    )
+    return out

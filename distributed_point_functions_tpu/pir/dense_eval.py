@@ -88,27 +88,32 @@ def evaluate_selection_blocks(
         )
 
     # Phase 2: width-doubling expansion of the subtree, all keys batched.
+    # Left and right children are produced by ONE key-selected AES pass per
+    # level (even lanes pick the left PRG key, odd lanes the right), halving
+    # the compiled graph size vs. two separate hashes — the TPU analog of
+    # the reference's per-lane key masking
+    # (`aes_128_fixed_key_hash_hwy.h:123-155`).
     seeds = seeds[:, None, :]  # [nk, w, 4]
     control = control[:, None]  # [nk, w]
     for i in range(expand_levels):
         lvl = walk_levels + i
+        nk, w = seeds.shape[:2]
         cw_s = cw_seeds[lvl][:, None, :]  # [nk, 1, 4]
         cw_l = cw_left[lvl][:, None]
         cw_r = cw_right[lvl][:, None]
-        left = aes.mmo_hash(fixed_keys.RK_LEFT, seeds)
-        right = aes.mmo_hash(fixed_keys.RK_RIGHT, seeds)
-        corr = jnp.where(control[..., None] != 0, cw_s, U32(0))
-        left = left ^ corr
-        right = right ^ corr
-        t_left = left[..., 0] & U32(1)
-        t_right = right[..., 0] & U32(1)
-        left = left & clear
-        right = right & clear
-        t_left = t_left ^ (control * cw_l)
-        t_right = t_right ^ (control * cw_r)
-        nk, w = seeds.shape[:2]
-        seeds = jnp.stack([left, right], axis=2).reshape(nk, 2 * w, 4)
-        control = jnp.stack([t_left, t_right], axis=2).reshape(nk, 2 * w)
+        doubled = jnp.repeat(seeds, 2, axis=1)  # [nk, 2w, 4]
+        sel = jnp.tile(jnp.arange(2, dtype=U32), w)[None, :]  # [1, 2w]
+        h = aes.mmo_hash_select(
+            fixed_keys.RK_LEFT, fixed_keys.RK_RIGHT, sel, doubled
+        )
+        control2 = jnp.repeat(control, 2, axis=1)  # [nk, 2w]
+        h = h ^ jnp.where(control2[..., None] != 0, cw_s, U32(0))
+        t_new = h[..., 0] & U32(1)
+        h = h & clear
+        cw_dir = jnp.where(sel != 0, cw_r, cw_l)  # [nk, 2w]
+        t_new = t_new ^ (control2 * cw_dir)
+        seeds = h
+        control = t_new
 
     # Phase 3: leaf value blocks (output PRG + XOR value correction; party
     # negation is the identity for XOR shares).

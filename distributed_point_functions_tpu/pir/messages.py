@@ -11,20 +11,19 @@ Mirrors the message structure of the reference's
 * `PirResponse` carries one masked response byte-string per query
   (`:69-74`).
 
-The codec is a compact deterministic binary format (length-prefixed,
-little-endian); the proto-compatible serialization lives in
-`distributed_point_functions_tpu.protos`. The helper request must be *bytes*
-on the wire because the encryption seam (`EncryptHelperRequestFn`,
-`dpf_pir_client.h:43-45`) operates on serialized messages.
+The wire codec is the proto schema itself (wire-compatible with the
+reference; see `../serialization.py` and `../protos/`). The helper request
+must be *bytes* on the wire because the encryption seam
+(`EncryptHelperRequestFn`, `dpf_pir_client.h:43-45`) operates on serialized
+messages.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import struct
-from typing import List, Optional, Sequence
+from typing import List, Optional
 
-from ..dpf import CorrectionWord, DistributedPointFunction, DpfKey
+from ..dpf import DistributedPointFunction, DpfKey
 
 # ---------------------------------------------------------------------------
 # Message dataclasses
@@ -78,125 +77,32 @@ class DenseDpfPirRequestClientState:
 
 
 # ---------------------------------------------------------------------------
-# Binary codec
+# Wire codec (proto-based; see ../serialization.py)
 # ---------------------------------------------------------------------------
-
-
-def _pack_bytes(b: bytes) -> bytes:
-    return struct.pack("<I", len(b)) + b
-
-
-class _Reader:
-    def __init__(self, data: bytes):
-        self.data = data
-        self.pos = 0
-
-    def take(self, n: int) -> bytes:
-        if self.pos + n > len(self.data):
-            raise ValueError("truncated message")
-        out = self.data[self.pos : self.pos + n]
-        self.pos += n
-        return out
-
-    def u32(self) -> int:
-        return struct.unpack("<I", self.take(4))[0]
-
-    def bytes_field(self) -> bytes:
-        return self.take(self.u32())
-
-
-def _serialize_values(dpf: DistributedPointFunction, hierarchy_level: int,
-                      values: Sequence) -> bytes:
-    vt = dpf.parameters[hierarchy_level].value_type
-    out = struct.pack("<I", len(values))
-    for v in values:
-        out += vt.value_to_bytes(v)
-    return out
-
-
-def _parse_values(dpf: DistributedPointFunction, hierarchy_level: int,
-                  r: _Reader) -> list:
-    vt = dpf.parameters[hierarchy_level].value_type
-    n = r.u32()
-    return [vt.value_from_bytes(r.take(vt.value_byte_size())) for _ in range(n)]
-
-
-def serialize_dpf_key(dpf: DistributedPointFunction, key: DpfKey) -> bytes:
-    """Encode a DpfKey for the given DPF's parameters."""
-    out = [key.seed.to_bytes(16, "little"), bytes([key.party])]
-    out.append(struct.pack("<I", len(key.correction_words)))
-    for i, cw in enumerate(key.correction_words):
-        out.append(cw.seed.to_bytes(16, "little"))
-        out.append(bytes([cw.control_left | (cw.control_right << 1)]))
-        if cw.value_correction is None:
-            out.append(struct.pack("<I", 0xFFFFFFFF))
-        else:
-            hl = dpf._tree_to_hierarchy[i]
-            out.append(_serialize_values(dpf, hl, cw.value_correction))
-    out.append(
-        _serialize_values(
-            dpf, len(dpf.parameters) - 1, key.last_level_value_correction
-        )
-    )
-    return b"".join(out)
-
-
-def parse_dpf_key(dpf: DistributedPointFunction, r: _Reader) -> DpfKey:
-    seed = int.from_bytes(r.take(16), "little")
-    party = r.take(1)[0]
-    ncw = r.u32()
-    cws = []
-    for i in range(ncw):
-        cw_seed = int.from_bytes(r.take(16), "little")
-        ctl = r.take(1)[0]
-        marker = struct.unpack("<I", r.data[r.pos : r.pos + 4])[0]
-        if marker == 0xFFFFFFFF:
-            r.take(4)
-            vc = None
-        else:
-            hl = dpf._tree_to_hierarchy.get(i)
-            if hl is None:
-                raise ValueError(
-                    f"value correction present at tree level {i} which is "
-                    "not an output level"
-                )
-            vc = _parse_values(dpf, hl, r)
-        cws.append(
-            CorrectionWord(
-                seed=cw_seed,
-                control_left=bool(ctl & 1),
-                control_right=bool(ctl & 2),
-                value_correction=vc,
-            )
-        )
-    last_vc = _parse_values(dpf, len(dpf.parameters) - 1, r)
-    return DpfKey(
-        seed=seed,
-        party=party,
-        correction_words=cws,
-        last_level_value_correction=last_vc,
-    )
 
 
 def serialize_helper_request(
     dpf: DistributedPointFunction, request: HelperRequest
 ) -> bytes:
-    out = [struct.pack("<I", len(request.plain_request.dpf_keys))]
-    for key in request.plain_request.dpf_keys:
-        out.append(_pack_bytes(serialize_dpf_key(dpf, key)))
-    out.append(_pack_bytes(request.one_time_pad_seed))
-    return b"".join(out)
+    """Proto wire format (`DpfPirRequest.HelperRequest`) — what travels
+    encrypted from the client to the helper, byte-compatible with the
+    reference (`dense_dpf_pir_client.cc:109-113`)."""
+    from .. import serialization
+
+    return serialization.helper_request_to_proto(
+        dpf, request
+    ).SerializeToString()
 
 
 def parse_helper_request(
     dpf: DistributedPointFunction, data: bytes
 ) -> HelperRequest:
-    r = _Reader(data)
-    nkeys = r.u32()
-    keys = []
-    for _ in range(nkeys):
-        keys.append(parse_dpf_key(dpf, _Reader(r.bytes_field())))
-    seed = r.bytes_field()
-    return HelperRequest(
-        plain_request=PlainRequest(dpf_keys=keys), one_time_pad_seed=seed
-    )
+    from .. import serialization
+    from ..protos import pir_pb2
+
+    proto = pir_pb2.DpfPirRequest.HelperRequest()
+    if not proto.ParseFromString(data):
+        # ParseFromString returns bytes consumed; zero-length data is valid
+        # proto3 (all defaults) but an empty helper request is not useful.
+        raise ValueError("request does not encrypt a valid HelperRequest")
+    return serialization.helper_request_from_proto(dpf, proto)

@@ -1,0 +1,148 @@
+"""Validation of wire protos (`dpf/internal/proto_validator.{h,cc}`).
+
+Validates `DpfParameters`, `ValueType`, `Value`, `DpfKey`, and
+`EvaluationContext` protos before they touch the evaluation engine,
+mirroring the reference's rules (`proto_validator.cc:160-333`):
+
+* parameters: non-empty, `log_domain_size` in [0, 128] strictly ascending,
+  value type present/valid, `security_parameter` in [0, 128] and not NaN;
+* keys: seed + last-level value correction present, exactly
+  `tree_levels_needed - 1` correction words, a value correction at every
+  intermediate output level;
+* contexts: parameters match, key valid, not already fully evaluated,
+  `partial_evaluations_level <= previous_hierarchy_level`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+from .dpf import DistributedPointFunction
+from .protos import dpf_pb2
+from .serialization import parameters_from_proto, value_type_from_proto
+
+_ALLOWED_BITSIZES = (8, 16, 32, 64, 128)
+
+
+class ProtoValidator:
+    """Validator bound to one parameter vector."""
+
+    def __init__(self, parameters_protos: Sequence):
+        self.validate_parameters(parameters_protos)
+        # Reuse the framework's level mapping by constructing the DPF.
+        self.dpf = DistributedPointFunction.create_incremental(
+            [parameters_from_proto(p) for p in parameters_protos]
+        )
+        self.parameters = list(parameters_protos)
+
+    @classmethod
+    def create(cls, parameters_protos: Sequence) -> "ProtoValidator":
+        return cls(parameters_protos)
+
+    # -- static message validation ------------------------------------------
+
+    @staticmethod
+    def validate_value_type(value_type) -> None:
+        kind = value_type.WhichOneof("type")
+        if kind == "integer":
+            bitsize = value_type.integer.bitsize
+            if bitsize not in _ALLOWED_BITSIZES:
+                raise ValueError(
+                    f"integer bitsize must be one of {_ALLOWED_BITSIZES}"
+                )
+        elif kind == "xor_wrapper":
+            if value_type.xor_wrapper.bitsize not in _ALLOWED_BITSIZES:
+                raise ValueError(
+                    f"xor_wrapper bitsize must be one of {_ALLOWED_BITSIZES}"
+                )
+        elif kind == "int_mod_n":
+            base = value_type.int_mod_n.base_integer.bitsize
+            if base not in _ALLOWED_BITSIZES:
+                raise ValueError(
+                    f"int_mod_n base bitsize must be one of {_ALLOWED_BITSIZES}"
+                )
+            value_type_from_proto(value_type)  # range-checks the modulus
+        elif kind == "tuple":
+            for e in value_type.tuple.elements:
+                ProtoValidator.validate_value_type(e)
+        else:
+            raise ValueError("ValueType must have its type set")
+
+    @staticmethod
+    def validate_parameters(parameters: Sequence) -> None:
+        if not parameters:
+            raise ValueError("parameters must not be empty")
+        previous_lds = 0
+        for i, p in enumerate(parameters):
+            lds = p.log_domain_size
+            if lds < 0:
+                raise ValueError("log_domain_size must be non-negative")
+            if lds > 128:
+                raise ValueError("log_domain_size must be <= 128")
+            if i > 0 and lds <= previous_lds:
+                raise ValueError(
+                    "log_domain_size fields must be in ascending order"
+                )
+            previous_lds = lds
+            if not p.HasField("value_type"):
+                raise ValueError("value_type is required")
+            ProtoValidator.validate_value_type(p.value_type)
+            sec = p.security_parameter
+            if math.isnan(sec):
+                raise ValueError("security_parameter must not be NaN")
+            if sec < 0 or sec > 128:
+                raise ValueError("security_parameter must be in [0, 128]")
+
+    # -- bound validation ---------------------------------------------------
+
+    def validate_dpf_key(self, key) -> None:
+        if not key.HasField("seed"):
+            raise ValueError("key.seed must be present")
+        if len(key.last_level_value_correction) == 0:
+            raise ValueError("key.last_level_value_correction must be present")
+        expected = self.dpf._tree_levels_needed - 1
+        if len(key.correction_words) != expected:
+            raise ValueError(
+                f"malformed DpfKey: expected {expected} correction words, "
+                f"but got {len(key.correction_words)}"
+            )
+        for i, tree_level in enumerate(self.dpf._hierarchy_to_tree):
+            if tree_level == self.dpf._tree_levels_needed - 1:
+                continue  # stored in last_level_value_correction
+            if len(key.correction_words[tree_level].value_correction) == 0:
+                raise ValueError(
+                    f"malformed DpfKey: expected correction_words"
+                    f"[{tree_level}] to contain the value correction of "
+                    f"hierarchy level {i}"
+                )
+
+    def validate_evaluation_context(self, ctx) -> None:
+        if len(ctx.parameters) != len(self.parameters):
+            raise ValueError("number of parameters in ctx doesn't match")
+        for i, (a, b) in enumerate(zip(self.parameters, ctx.parameters)):
+            pa = parameters_from_proto(a)
+            pb = parameters_from_proto(b)
+            # Default the security parameter like the reference does before
+            # comparing (`proto_validator.cc:117-125`).
+            sa = pa.security_parameter or (40 + pa.log_domain_size)
+            sb = pb.security_parameter or (40 + pb.log_domain_size)
+            if (
+                pa.log_domain_size != pb.log_domain_size
+                or pa.value_type != pb.value_type
+                or abs(sa - sb) > 1e-9
+            ):
+                raise ValueError(f"parameter {i} in ctx doesn't match")
+        if not ctx.HasField("key"):
+            raise ValueError("ctx.key must be present")
+        self.validate_dpf_key(ctx.key)
+        if ctx.previous_hierarchy_level >= len(ctx.parameters) - 1:
+            raise ValueError("this context has already been fully evaluated")
+        if (
+            len(ctx.partial_evaluations) > 0
+            and ctx.partial_evaluations_level > ctx.previous_hierarchy_level
+        ):
+            raise ValueError(
+                "ctx.partial_evaluations_level must be less than or equal "
+                "to ctx.previous_hierarchy_level"
+            )

@@ -1,0 +1,27 @@
+"""Generated proto modules (wire-compatible with the reference schemas).
+
+Regenerate with `protos/generate.sh`. The generated modules import each
+other by flat module name, so this package directory is put on `sys.path`
+before loading them.
+"""
+
+import os as _os
+import sys as _sys
+
+_here = _os.path.dirname(_os.path.abspath(__file__))
+if _here not in _sys.path:
+    _sys.path.insert(0, _here)
+
+import distributed_point_function_pb2 as dpf_pb2  # noqa: E402
+import hash_family_config_pb2 as hash_family_config_pb2  # noqa: E402
+import distributed_comparison_function_pb2 as dcf_pb2  # noqa: E402
+import multiple_interval_containment_pb2 as mic_pb2  # noqa: E402
+import private_information_retrieval_pb2 as pir_pb2  # noqa: E402
+
+__all__ = [
+    "dpf_pb2",
+    "hash_family_config_pb2",
+    "dcf_pb2",
+    "mic_pb2",
+    "pir_pb2",
+]

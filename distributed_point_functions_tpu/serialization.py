@@ -1,0 +1,340 @@
+"""Converters between framework objects and the wire protos.
+
+The proto schema is wire-compatible with the reference
+(`dpf/distributed_point_function.proto`,
+`pir/private_information_retrieval.proto`): keys, evaluation contexts, and
+PIR requests/responses produced here parse in the reference implementation
+and vice versa.
+
+Value encoding follows the reference's `value_type_helpers` conventions:
+integers of <= 64 bits go into `Value.Integer.value_uint64`, 128-bit values
+into a `Block{high, low}`; IntModN values are represented by their base
+integer; tuples recurse (`value_type_helpers.h:182-461`).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from . import value_types as vt_mod
+from .dpf import (
+    CorrectionWord,
+    DistributedPointFunction,
+    DpfKey,
+    DpfParameters,
+    EvaluationContext,
+)
+from .pir import messages
+from .protos import dpf_pb2, pir_pb2
+
+# ---------------------------------------------------------------------------
+# Blocks and integers
+# ---------------------------------------------------------------------------
+
+
+def block_to_proto(x: int, out=None):
+    out = out if out is not None else dpf_pb2.Block()
+    out.high = (x >> 64) & 0xFFFFFFFFFFFFFFFF
+    out.low = x & 0xFFFFFFFFFFFFFFFF
+    return out
+
+
+def block_from_proto(b) -> int:
+    return (b.high << 64) | b.low
+
+
+def _integer_to_proto(value: int, bits: int, out):
+    if bits <= 64:
+        out.value_uint64 = value
+    else:
+        block_to_proto(value, out.value_uint128)
+    return out
+
+
+def _integer_from_proto(p) -> int:
+    if p.WhichOneof("value") == "value_uint128":
+        return block_from_proto(p.value_uint128)
+    return p.value_uint64
+
+
+# ---------------------------------------------------------------------------
+# ValueType
+# ---------------------------------------------------------------------------
+
+
+def value_type_to_proto(vt, out=None):
+    out = out if out is not None else dpf_pb2.ValueType()
+    if isinstance(vt, vt_mod.IntType):
+        out.integer.bitsize = vt.bits
+    elif isinstance(vt, vt_mod.XorType):
+        out.xor_wrapper.bitsize = vt.bits
+    elif isinstance(vt, vt_mod.IntModNType):
+        out.int_mod_n.base_integer.bitsize = vt.base_bits
+        _integer_to_proto(vt.modulus, vt.base_bits, out.int_mod_n.modulus)
+    elif isinstance(vt, vt_mod.TupleType):
+        for e in vt.elements:
+            value_type_to_proto(e, out.tuple.elements.add())
+    else:
+        raise ValueError(f"unsupported value type {vt!r}")
+    return out
+
+
+def value_type_from_proto(p):
+    kind = p.WhichOneof("type")
+    if kind == "integer":
+        return vt_mod.IntType(p.integer.bitsize)
+    if kind == "xor_wrapper":
+        return vt_mod.XorType(p.xor_wrapper.bitsize)
+    if kind == "int_mod_n":
+        return vt_mod.IntModNType(
+            base_bits=p.int_mod_n.base_integer.bitsize,
+            modulus=_integer_from_proto(p.int_mod_n.modulus),
+        )
+    if kind == "tuple":
+        return vt_mod.TupleType(
+            [value_type_from_proto(e) for e in p.tuple.elements]
+        )
+    raise ValueError("ValueType proto has no type set")
+
+
+# ---------------------------------------------------------------------------
+# Values
+# ---------------------------------------------------------------------------
+
+
+def value_to_proto(vt, value, out=None):
+    out = out if out is not None else dpf_pb2.Value()
+    if isinstance(vt, vt_mod.IntType):
+        _integer_to_proto(value, vt.bits, out.integer)
+    elif isinstance(vt, vt_mod.XorType):
+        _integer_to_proto(value, vt.bits, out.xor_wrapper)
+    elif isinstance(vt, vt_mod.IntModNType):
+        _integer_to_proto(value, vt.base_bits, out.int_mod_n)
+    elif isinstance(vt, vt_mod.TupleType):
+        for e, v in zip(vt.elements, value):
+            value_to_proto(e, v, out.tuple.elements.add())
+    else:
+        raise ValueError(f"unsupported value type {vt!r}")
+    return out
+
+
+def value_from_proto(vt, p):
+    if isinstance(vt, vt_mod.IntType):
+        return _integer_from_proto(p.integer)
+    if isinstance(vt, vt_mod.XorType):
+        return _integer_from_proto(p.xor_wrapper)
+    if isinstance(vt, vt_mod.IntModNType):
+        return _integer_from_proto(p.int_mod_n)
+    if isinstance(vt, vt_mod.TupleType):
+        return tuple(
+            value_from_proto(e, x)
+            for e, x in zip(vt.elements, p.tuple.elements)
+        )
+    raise ValueError(f"unsupported value type {vt!r}")
+
+
+# ---------------------------------------------------------------------------
+# Parameters / keys / evaluation contexts
+# ---------------------------------------------------------------------------
+
+
+def parameters_to_proto(p: DpfParameters, out=None):
+    out = out if out is not None else dpf_pb2.DpfParameters()
+    out.log_domain_size = p.log_domain_size
+    value_type_to_proto(p.value_type, out.value_type)
+    out.security_parameter = p.security_parameter
+    return out
+
+
+def parameters_from_proto(p) -> DpfParameters:
+    return DpfParameters(
+        log_domain_size=p.log_domain_size,
+        value_type=value_type_from_proto(p.value_type),
+        security_parameter=p.security_parameter,
+    )
+
+
+def key_to_proto(dpf: DistributedPointFunction, key: DpfKey, out=None):
+    out = out if out is not None else dpf_pb2.DpfKey()
+    block_to_proto(key.seed, out.seed)
+    out.party = key.party
+    last_vt = dpf.parameters[-1].value_type
+    for i, cw in enumerate(key.correction_words):
+        cw_proto = out.correction_words.add()
+        block_to_proto(cw.seed, cw_proto.seed)
+        cw_proto.control_left = cw.control_left
+        cw_proto.control_right = cw.control_right
+        if cw.value_correction is not None:
+            hl = dpf._tree_to_hierarchy[i]
+            vt = dpf.parameters[hl].value_type
+            for v in cw.value_correction:
+                value_to_proto(vt, v, cw_proto.value_correction.add())
+    for v in key.last_level_value_correction:
+        value_to_proto(last_vt, v, out.last_level_value_correction.add())
+    return out
+
+
+def key_from_proto(dpf: DistributedPointFunction, p) -> DpfKey:
+    cws: List[CorrectionWord] = []
+    for i, cw_proto in enumerate(p.correction_words):
+        vc = None
+        if len(cw_proto.value_correction) > 0:
+            hl = dpf._tree_to_hierarchy.get(i)
+            if hl is None:
+                raise ValueError(
+                    f"value correction present at tree level {i} which is "
+                    "not an output level"
+                )
+            vt = dpf.parameters[hl].value_type
+            vc = [value_from_proto(vt, v) for v in cw_proto.value_correction]
+        cws.append(
+            CorrectionWord(
+                seed=block_from_proto(cw_proto.seed),
+                control_left=cw_proto.control_left,
+                control_right=cw_proto.control_right,
+                value_correction=vc,
+            )
+        )
+    last_vt = dpf.parameters[-1].value_type
+    return DpfKey(
+        seed=block_from_proto(p.seed),
+        party=p.party,
+        correction_words=cws,
+        last_level_value_correction=[
+            value_from_proto(last_vt, v)
+            for v in p.last_level_value_correction
+        ],
+    )
+
+
+def evaluation_context_to_proto(
+    dpf: DistributedPointFunction, ctx: EvaluationContext, out=None
+):
+    out = out if out is not None else dpf_pb2.EvaluationContext()
+    for p in dpf.parameters:
+        parameters_to_proto(p, out.parameters.add())
+    key_to_proto(dpf, ctx.key, out.key)
+    out.previous_hierarchy_level = ctx.previous_hierarchy_level
+    out.partial_evaluations_level = ctx.partial_evaluations_level
+    for prefix, (seed, control) in sorted(ctx.partial_evaluations.items()):
+        pe = out.partial_evaluations.add()
+        block_to_proto(prefix, pe.prefix)
+        block_to_proto(seed, pe.seed)
+        pe.control_bit = bool(control)
+    return out
+
+
+def evaluation_context_from_proto(p) -> Tuple[DistributedPointFunction, EvaluationContext]:
+    """Rebuilds the DPF from the embedded parameters plus the context."""
+    dpf = DistributedPointFunction.create_incremental(
+        [parameters_from_proto(q) for q in p.parameters]
+    )
+    ctx = EvaluationContext(
+        key=key_from_proto(dpf, p.key),
+        previous_hierarchy_level=p.previous_hierarchy_level,
+        partial_evaluations={
+            block_from_proto(pe.prefix): (
+                block_from_proto(pe.seed),
+                int(pe.control_bit),
+            )
+            for pe in p.partial_evaluations
+        },
+        partial_evaluations_level=p.partial_evaluations_level,
+    )
+    return dpf, ctx
+
+
+# ---------------------------------------------------------------------------
+# PIR messages
+# ---------------------------------------------------------------------------
+
+
+def pir_request_to_proto(
+    dpf: DistributedPointFunction, request: "messages.PirRequest", out=None
+):
+    out = out if out is not None else pir_pb2.PirRequest()
+    inner = out.dpf_pir_request
+    if request.plain_request is not None:
+        for k in request.plain_request.dpf_keys:
+            key_to_proto(dpf, k, inner.plain_request.dpf_key.add())
+    elif request.leader_request is not None:
+        lr = request.leader_request
+        for k in lr.plain_request.dpf_keys:
+            key_to_proto(dpf, k, inner.leader_request.plain_request.dpf_key.add())
+        inner.leader_request.encrypted_helper_request.encrypted_request = (
+            lr.encrypted_helper_request.encrypted_request
+        )
+    elif request.encrypted_helper_request is not None:
+        inner.encrypted_helper_request.encrypted_request = (
+            request.encrypted_helper_request.encrypted_request
+        )
+    else:
+        raise ValueError("PirRequest has no request set")
+    return out
+
+
+def pir_request_from_proto(dpf: DistributedPointFunction, p) -> "messages.PirRequest":
+    inner = p.dpf_pir_request
+    kind = inner.WhichOneof("wrapped_request")
+    if kind == "plain_request":
+        return messages.PirRequest(
+            plain_request=messages.PlainRequest(
+                dpf_keys=[key_from_proto(dpf, k) for k in inner.plain_request.dpf_key]
+            )
+        )
+    if kind == "leader_request":
+        lr = inner.leader_request
+        return messages.PirRequest(
+            leader_request=messages.LeaderRequest(
+                plain_request=messages.PlainRequest(
+                    dpf_keys=[
+                        key_from_proto(dpf, k)
+                        for k in lr.plain_request.dpf_key
+                    ]
+                ),
+                encrypted_helper_request=messages.EncryptedHelperRequest(
+                    encrypted_request=lr.encrypted_helper_request.encrypted_request
+                ),
+            )
+        )
+    if kind == "encrypted_helper_request":
+        return messages.PirRequest(
+            encrypted_helper_request=messages.EncryptedHelperRequest(
+                encrypted_request=inner.encrypted_helper_request.encrypted_request
+            )
+        )
+    raise ValueError("DpfPirRequest has no request set")
+
+
+def helper_request_to_proto(
+    dpf: DistributedPointFunction, hr: "messages.HelperRequest", out=None
+):
+    out = out if out is not None else pir_pb2.DpfPirRequest.HelperRequest()
+    for k in hr.plain_request.dpf_keys:
+        key_to_proto(dpf, k, out.plain_request.dpf_key.add())
+    out.one_time_pad_seed = hr.one_time_pad_seed
+    return out
+
+
+def helper_request_from_proto(dpf: DistributedPointFunction, p) -> "messages.HelperRequest":
+    return messages.HelperRequest(
+        plain_request=messages.PlainRequest(
+            dpf_keys=[key_from_proto(dpf, k) for k in p.plain_request.dpf_key]
+        ),
+        one_time_pad_seed=p.one_time_pad_seed,
+    )
+
+
+def pir_response_to_proto(response: "messages.PirResponse", out=None):
+    out = out if out is not None else pir_pb2.PirResponse()
+    for r in response.dpf_pir_response.masked_response:
+        out.dpf_pir_response.masked_response.append(r)
+    return out
+
+
+def pir_response_from_proto(p) -> "messages.PirResponse":
+    return messages.PirResponse(
+        dpf_pir_response=messages.DpfPirResponse(
+            masked_response=list(p.dpf_pir_response.masked_response)
+        )
+    )

@@ -1,0 +1,6 @@
+#!/bin/sh
+# Build libdpf_native.so (the CPU oracle kernels + ctypes C API).
+set -e
+cd "$(dirname "$0")"
+g++ -O2 -fPIC -shared -std=c++17 -o libdpf_native.so aes128.cc dpf_kernels.cc
+echo "built $(pwd)/libdpf_native.so"

@@ -1,0 +1,11 @@
+#!/bin/sh
+# Regenerate the Python proto modules into distributed_point_functions_tpu/protos/.
+set -e
+cd "$(dirname "$0")"
+protoc -I . --python_out=../distributed_point_functions_tpu/protos \
+  distributed_point_function.proto \
+  hash_family_config.proto \
+  distributed_comparison_function.proto \
+  multiple_interval_containment.proto \
+  private_information_retrieval.proto
+echo "generated into ../distributed_point_functions_tpu/protos"

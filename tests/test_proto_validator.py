@@ -1,0 +1,161 @@
+"""Negative/validation tests for the proto validator.
+
+Mirrors the reference's exhaustive malformed-proto rejection
+(`dpf/internal/proto_validator_test.cc`).
+"""
+
+import math
+
+import pytest
+
+from distributed_point_functions_tpu import serialization as ser
+from distributed_point_functions_tpu.dpf import (
+    DistributedPointFunction,
+    DpfParameters,
+)
+from distributed_point_functions_tpu.proto_validator import ProtoValidator
+from distributed_point_functions_tpu.protos import dpf_pb2
+from distributed_point_functions_tpu.value_types import IntType
+
+
+def make_params(*lds, bits=32):
+    out = []
+    for d in lds:
+        p = dpf_pb2.DpfParameters()
+        p.log_domain_size = d
+        p.value_type.integer.bitsize = bits
+        out.append(p)
+    return out
+
+
+def test_valid_parameters_accepted():
+    ProtoValidator.create(make_params(5))
+    ProtoValidator.create(make_params(3, 6, 10))
+
+
+def test_rejects_empty_parameters():
+    with pytest.raises(ValueError, match="must not be empty"):
+        ProtoValidator.validate_parameters([])
+
+
+def test_rejects_negative_and_oversized_domain():
+    p = make_params(5)[0]
+    p.log_domain_size = -1
+    with pytest.raises(ValueError, match="non-negative"):
+        ProtoValidator.validate_parameters([p])
+    p.log_domain_size = 129
+    with pytest.raises(ValueError, match="<= 128"):
+        ProtoValidator.validate_parameters([p])
+
+
+def test_rejects_non_ascending_domains():
+    with pytest.raises(ValueError, match="ascending"):
+        ProtoValidator.validate_parameters(make_params(6, 6))
+    with pytest.raises(ValueError, match="ascending"):
+        ProtoValidator.validate_parameters(make_params(6, 3))
+
+
+def test_rejects_missing_value_type():
+    p = dpf_pb2.DpfParameters()
+    p.log_domain_size = 4
+    with pytest.raises(ValueError, match="value_type is required"):
+        ProtoValidator.validate_parameters([p])
+
+
+def test_rejects_bad_bitsize():
+    p = make_params(4)[0]
+    p.value_type.integer.bitsize = 12
+    with pytest.raises(ValueError, match="bitsize"):
+        ProtoValidator.validate_parameters([p])
+
+
+def test_rejects_bad_security_parameter():
+    p = make_params(4)[0]
+    p.security_parameter = float("nan")
+    with pytest.raises(ValueError, match="NaN"):
+        ProtoValidator.validate_parameters([p])
+    p.security_parameter = 129.0
+    with pytest.raises(ValueError, match=r"\[0, 128\]"):
+        ProtoValidator.validate_parameters([p])
+
+
+def make_key_proto(lds=6, alpha=3, beta=42):
+    dpf = DistributedPointFunction.create(
+        DpfParameters(log_domain_size=lds, value_type=IntType(32))
+    )
+    k0, _ = dpf.generate_keys(alpha, beta)
+    return dpf, ser.key_to_proto(dpf, k0)
+
+
+def test_validate_key_accepts_valid():
+    dpf, key = make_key_proto()
+    v = ProtoValidator.create(
+        [ser.parameters_to_proto(p) for p in dpf.parameters]
+    )
+    v.validate_dpf_key(key)
+
+
+def test_validate_key_rejects_malformed():
+    dpf, key = make_key_proto()
+    v = ProtoValidator.create(
+        [ser.parameters_to_proto(p) for p in dpf.parameters]
+    )
+    bad = dpf_pb2.DpfKey.FromString(key.SerializeToString())
+    bad.ClearField("seed")
+    with pytest.raises(ValueError, match="seed"):
+        v.validate_dpf_key(bad)
+
+    bad = dpf_pb2.DpfKey.FromString(key.SerializeToString())
+    bad.ClearField("last_level_value_correction")
+    with pytest.raises(ValueError, match="last_level_value_correction"):
+        v.validate_dpf_key(bad)
+
+    bad = dpf_pb2.DpfKey.FromString(key.SerializeToString())
+    del bad.correction_words[-1]
+    with pytest.raises(ValueError, match="correction words"):
+        v.validate_dpf_key(bad)
+
+
+def test_validate_key_requires_intermediate_value_correction():
+    dpf = DistributedPointFunction.create_incremental(
+        [
+            DpfParameters(log_domain_size=3, value_type=IntType(32)),
+            DpfParameters(log_domain_size=9, value_type=IntType(32)),
+        ]
+    )
+    k0, _ = dpf.generate_keys_incremental(100, [1, 2])
+    proto = ser.key_to_proto(dpf, k0)
+    v = ProtoValidator.create(
+        [ser.parameters_to_proto(p) for p in dpf.parameters]
+    )
+    v.validate_dpf_key(proto)
+    vc_index = dpf._hierarchy_to_tree[0]
+    proto.correction_words[vc_index].ClearField("value_correction")
+    with pytest.raises(ValueError, match="value correction"):
+        v.validate_dpf_key(proto)
+
+
+def test_validate_evaluation_context():
+    dpf = DistributedPointFunction.create_incremental(
+        [
+            DpfParameters(log_domain_size=3, value_type=IntType(32)),
+            DpfParameters(log_domain_size=9, value_type=IntType(32)),
+        ]
+    )
+    k0, _ = dpf.generate_keys_incremental(100, [1, 2])
+    ctx = dpf.create_evaluation_context(k0)
+    proto = ser.evaluation_context_to_proto(dpf, ctx)
+    v = ProtoValidator.create(
+        [ser.parameters_to_proto(p) for p in dpf.parameters]
+    )
+    v.validate_evaluation_context(proto)
+
+    exhausted = dpf_pb2.EvaluationContext.FromString(proto.SerializeToString())
+    exhausted.previous_hierarchy_level = 1
+    with pytest.raises(ValueError, match="fully evaluated"):
+        v.validate_evaluation_context(exhausted)
+
+    mismatched = dpf_pb2.EvaluationContext.FromString(proto.SerializeToString())
+    mismatched.parameters[0].log_domain_size = 4
+    with pytest.raises(ValueError, match="doesn't match"):
+        v.validate_evaluation_context(mismatched)
