@@ -1,0 +1,1 @@
+"""Benchmark suite mirroring the reference's benchmark binaries (SURVEY.md §2.7)."""

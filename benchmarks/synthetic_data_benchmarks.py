@@ -1,0 +1,143 @@
+"""End-to-end hierarchical-evaluation benchmark on synthetic sparse
+histograms — the equivalent of the reference's experiments harness
+(`experiments/synthetic_data_benchmarks.cc:45-308`).
+
+One DPF key for a random nonzero index is expanded hierarchically: at each
+configured hierarchy level only the prefixes that are "live" in the
+synthetic workload (plus the expansion-factor cap) are evaluated, mirroring
+the heavy-hitters evaluation strategy of `experiments/README.md:18-24`.
+
+Flags mirror the reference's absl flags:
+  --distribution {uniform,powerlaw10,powerlaw50}  (replaces --input CSVs,
+    which are git-lfs stubs in the reference)
+  --log_domain_size N        total domain bits (default 32)
+  --log_num_nonzeros N       synthetic workload size (default 14)
+  --levels_to_evaluate a,b,c hierarchy levels (default auto: every 2 bits
+                             from log_num_nonzeros+1)
+  --max_expansion_factor F   cap on per-level expansion (default 4)
+  --num_iterations N
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def synthesize_nonzeros(distribution: str, log_domain_size: int, n: int,
+                        rng: np.random.Generator) -> np.ndarray:
+    """Random nonzero indices with the reference's workload shapes
+    (`experiments/README.md:35-48`): uniform, or power-law with 90% of mass
+    in the first 10%/50% of the domain."""
+    domain = 1 << log_domain_size
+    if distribution == "uniform":
+        vals = rng.integers(0, domain, n, dtype=np.uint64)
+    else:
+        frac = 0.1 if distribution == "powerlaw10" else 0.5
+        head = rng.random(n) < 0.9
+        vals = np.where(
+            head,
+            rng.integers(0, max(1, int(domain * frac)), n, dtype=np.uint64),
+            rng.integers(0, domain, n, dtype=np.uint64),
+        )
+    return np.unique(vals)
+
+
+def main():
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--distribution", default="powerlaw10",
+                        choices=["uniform", "powerlaw10", "powerlaw50"])
+    parser.add_argument("--log_domain_size", type=int, default=32)
+    parser.add_argument("--log_num_nonzeros", type=int, default=14)
+    parser.add_argument("--levels_to_evaluate", default="")
+    parser.add_argument("--max_expansion_factor", type=float, default=4.0)
+    parser.add_argument("--num_iterations", type=int, default=1)
+    args = parser.parse_args()
+
+    import jax
+
+    from distributed_point_functions_tpu.dpf import (
+        DistributedPointFunction,
+        DpfParameters,
+    )
+    from distributed_point_functions_tpu.value_types import IntType
+
+    lds = args.log_domain_size
+    if args.levels_to_evaluate:
+        levels = [int(x) for x in args.levels_to_evaluate.split(",")]
+    else:
+        levels = list(range(args.log_num_nonzeros + 1, lds, 2)) + [lds]
+    assert levels[-1] == lds, "last level must be the full domain"
+
+    rng = np.random.default_rng(42)
+    nonzeros = synthesize_nonzeros(
+        args.distribution, lds, 1 << args.log_num_nonzeros, rng
+    )
+
+    params = [
+        DpfParameters(log_domain_size=l, value_type=IntType(32))
+        for l in levels
+    ]
+    dpf = DistributedPointFunction.create_incremental(params)
+    alpha = int(nonzeros[len(nonzeros) // 2])
+    k0, _ = dpf.generate_keys_incremental(alpha, [1] * len(levels))
+
+    max_prefixes = int(args.max_expansion_factor * len(nonzeros))
+
+    def one_iteration():
+        ctx = dpf.create_evaluation_context(k0)
+        total_evaluated = 0
+        prefixes: list = []
+        for i, level_bits in enumerate(levels):
+            out = dpf.evaluate_until(i, prefixes, ctx)
+            jax.tree_util.tree_map(lambda x: x.block_until_ready(), out)
+            size = int(np.asarray(out).shape[0])
+            total_evaluated += size
+            if i + 1 < len(levels):
+                # Keep the live prefixes of the workload at this level
+                # (the server knows which buckets are nonzero), capped at
+                # the expansion factor like the reference harness.
+                shift = lds - level_bits
+                live = np.unique(nonzeros >> np.uint64(shift)).astype(
+                    np.uint64
+                )
+                if len(live) > max_prefixes:
+                    live = live[:max_prefixes]
+                prefixes = [int(x) for x in live]
+        return total_evaluated
+
+    total = one_iteration()  # warmup + size probe
+    t0 = time.perf_counter()
+    for _ in range(args.num_iterations):
+        one_iteration()
+    elapsed = (time.perf_counter() - t0) / args.num_iterations
+
+    print(
+        json.dumps(
+            {
+                "benchmark": "synthetic_hierarchical_eval",
+                "distribution": args.distribution,
+                "log_domain_size": lds,
+                "num_nonzeros": len(nonzeros),
+                "levels": levels,
+                "leaves_evaluated": total,
+                "time_s": round(elapsed, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -20,7 +20,6 @@ The DCF value type is a 128-bit integer, exactly like the reference.
 from __future__ import annotations
 
 import dataclasses
-import secrets
 from typing import List, Sequence, Tuple
 
 import numpy as np
@@ -79,9 +78,18 @@ class MultipleIntervalContainmentGate:
     def create(cls, parameters: MicParameters):
         return cls(parameters)
 
-    def gen(self, r_in: int, r_out: Sequence[int]) -> Tuple[MicKey, MicKey]:
+    def gen(self, r_in: int, r_out: Sequence[int],
+            prng=None) -> Tuple[MicKey, MicKey]:
         """Generate the two parties' MIC keys for input mask r_in and
-        per-interval output masks r_out."""
+        per-interval output masks r_out.
+
+        `prng` is an optional `SecurePrng` (defaults to the OS CSPRNG,
+        mirroring `BasicRng`, `multiple_interval_containment.cc:186-191`).
+        """
+        if prng is None:
+            from .prng import BasicRng
+
+            prng = BasicRng()
         if len(r_out) != len(self.parameters.intervals):
             raise ValueError(
                 "count of output masks should be equal to the number of "
@@ -116,7 +124,7 @@ class MultipleIntervalContainmentGate:
                 + (1 if alpha_q_prime > q_prime else 0)
                 + (1 if alpha_q == n - 1 else 0)
             ) % n
-            z0 = secrets.randbits(128) % n
+            z0 = prng.rand128() % n
             z1 = (z - z0) % n
             k0.output_mask_share.append(z0)
             k1.output_mask_share.append(z1)

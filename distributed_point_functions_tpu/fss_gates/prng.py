@@ -1,0 +1,62 @@
+"""Secure PRNG interface for FSS gate key generation
+(`dcf/fss_gates/prng/prng.h:24-45`, `basic_rng.h:36-74`).
+
+`SecurePrng` is the abstract sampling interface (8/64/128-bit draws);
+`BasicRng` draws from the OS CSPRNG, the role OpenSSL `RAND_bytes` plays in
+the reference. Gate key generation takes any `SecurePrng`, so tests can
+inject a deterministic one.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+
+class SecurePrng:
+    """Abstract secure PRNG."""
+
+    def rand8(self) -> int:
+        raise NotImplementedError
+
+    def rand64(self) -> int:
+        raise NotImplementedError
+
+    def rand128(self) -> int:
+        raise NotImplementedError
+
+
+class BasicRng(SecurePrng):
+    """OS-CSPRNG-backed PRNG (the reference's `BasicRng`)."""
+
+    def __init__(self, seed: bytes = b""):
+        # The reference's BasicRng ignores its seed parameter and always
+        # draws fresh OS randomness (`basic_rng.h:47-52`); kept for API
+        # compatibility.
+        del seed
+
+    def rand8(self) -> int:
+        return secrets.randbits(8)
+
+    def rand64(self) -> int:
+        return secrets.randbits(64)
+
+    def rand128(self) -> int:
+        return secrets.randbits(128)
+
+
+class CounterPrng(SecurePrng):
+    """Deterministic PRNG over the framework's AES-CTR stream — for tests."""
+
+    def __init__(self, seed: bytes = b"\x00" * 16):
+        from ..prng import Aes128CtrSeededPrng
+
+        self._prng = Aes128CtrSeededPrng(seed)
+
+    def rand8(self) -> int:
+        return self._prng.get_random_bytes(1)[0]
+
+    def rand64(self) -> int:
+        return int.from_bytes(self._prng.get_random_bytes(8), "little")
+
+    def rand128(self) -> int:
+        return int.from_bytes(self._prng.get_random_bytes(16), "little")

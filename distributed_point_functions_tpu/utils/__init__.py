@@ -1,0 +1,5 @@
+"""Cross-cutting utilities."""
+
+from .runtime import get_backend_mode_string
+
+__all__ = ["get_backend_mode_string"]

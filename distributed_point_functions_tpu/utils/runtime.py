@@ -1,0 +1,37 @@
+"""Runtime introspection — the analog of the reference's one-shot SIMD-mode
+log (`dpf/internal/get_hwy_mode.{h,cc}`, logged at
+`dpf/distributed_point_function.cc:592-594`).
+
+Where the reference reports which Highway SIMD target is active, the TPU
+framework reports the active JAX backend, device kind, and device count.
+"""
+
+from __future__ import annotations
+
+import logging
+
+_logged = False
+
+
+def get_backend_mode_string() -> str:
+    import jax
+
+    devices = jax.devices()
+    kinds = sorted({d.device_kind for d in devices})
+    return (
+        f"backend={jax.default_backend()} devices={len(devices)} "
+        f"kinds={','.join(kinds)}"
+    )
+
+
+def log_backend_mode_once(logger: logging.Logger | None = None) -> None:
+    """Logs the execution mode once per process, like the reference's
+    `LOG_FIRST_N(INFO, 1)`."""
+    global _logged
+    if _logged:
+        return
+    _logged = True
+    (logger or logging.getLogger(__name__)).info(
+        "distributed_point_functions_tpu is in mode %s",
+        get_backend_mode_string(),
+    )
