@@ -1,6 +1,7 @@
 """Hashing sublibrary (reference: `pir/hashing/`)."""
 
 from .hash_family import HashFamily, create_hash_functions, wrap_with_seed
+from .farm_hash_family import FarmHashFunction, farm_hash_family
 from .sha256_hash_family import SHA256HashFamily, sha256_hash_function
 from .hash_family_config import (
     HASH_FAMILY_SHA256,
@@ -16,6 +17,8 @@ __all__ = [
     "HashFamily",
     "create_hash_functions",
     "wrap_with_seed",
+    "FarmHashFunction",
+    "farm_hash_family",
     "SHA256HashFamily",
     "sha256_hash_function",
     "HashFamilyConfig",

@@ -1,1 +1,31 @@
 """Test support utilities (mirrors `pir/testing/` in the reference)."""
+
+from .pir_generators import (
+    MockPirDatabase,
+    create_fake_database,
+    generate_counting_strings,
+    generate_random_strings,
+    generate_random_strings_equal_size,
+    generate_random_strings_variable_size,
+)
+from .pir_selection_bits import (
+    generate_random_packed_selection_bits,
+    inner_product_with_unpacked,
+    pack_selection_bits,
+    unpack_selection_bits_np,
+)
+from .request_generator import RequestGenerator
+
+__all__ = [
+    "MockPirDatabase",
+    "RequestGenerator",
+    "create_fake_database",
+    "generate_counting_strings",
+    "generate_random_strings",
+    "generate_random_strings_equal_size",
+    "generate_random_strings_variable_size",
+    "generate_random_packed_selection_bits",
+    "inner_product_with_unpacked",
+    "pack_selection_bits",
+    "unpack_selection_bits_np",
+]

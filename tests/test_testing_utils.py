@@ -1,0 +1,140 @@
+"""Tests for the `testing/` sublibrary (mirrors the reference's
+`pir/testing/` — mock database generators, request generator, selection
+bits; `pir/testing/mock_pir_database.h`, `request_generator.h`,
+`pir_selection_bits.h`)."""
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu import testing as pt
+from distributed_point_functions_tpu.pir import messages
+from distributed_point_functions_tpu.pir.database import DenseDpfPirDatabase
+from distributed_point_functions_tpu.pir.server import DenseDpfPirServer
+from distributed_point_functions_tpu.prng import Aes128CtrSeededPrng, xor_bytes
+from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+
+class TestGenerators:
+    def test_counting_strings(self):
+        elems = pt.generate_counting_strings(3, "Element ")
+        assert elems == [b"Element 0", b"Element 1", b"Element 2"]
+
+    def test_counting_strings_negative(self):
+        with pytest.raises(ValueError):
+            pt.generate_counting_strings(-1, "x")
+
+    def test_random_strings_sizes(self):
+        elems = pt.generate_random_strings([0, 1, 5, 16])
+        assert [len(e) for e in elems] == [0, 1, 5, 16]
+
+    def test_random_strings_equal_size(self):
+        elems = pt.generate_random_strings_equal_size(10, 8)
+        assert len(elems) == 10
+        assert all(len(e) == 8 for e in elems)
+        # Overwhelmingly likely all distinct.
+        assert len(set(elems)) > 1
+
+    def test_random_strings_variable_size(self):
+        elems = pt.generate_random_strings_variable_size(100, 10, 3)
+        assert len(elems) == 100
+        assert all(7 <= len(e) <= 13 for e in elems)
+
+    def test_variable_size_rejects_bad_diff(self):
+        with pytest.raises(ValueError):
+            pt.generate_random_strings_variable_size(1, 4, 5)
+
+    def test_create_fake_database(self):
+        elems = pt.generate_counting_strings(5, "r")
+        db = pt.create_fake_database(DenseDpfPirDatabase, elems)
+        assert db.size == 5
+        assert db.record(2) == b"r2"
+
+    def test_mock_database(self):
+        mock = pt.MockPirDatabase()
+        mock.records = [b"a", b"b"]
+        mock.on_inner_product = lambda sel: [b"fake"]
+        assert mock.size == 2
+        assert mock.inner_product_with("sel") == [b"fake"]
+        assert mock.inner_product_calls == ["sel"]
+
+
+class TestSelectionBits:
+    def test_pack_matches_manual(self):
+        bits = [False] * 200
+        bits[0] = bits[31] = bits[32] = bits[127] = bits[128] = bits[199] = True
+        packed = pt.pack_selection_bits(bits)
+        assert packed.shape == (2, 4)
+        assert packed[0, 0] == (1 | (1 << 31))
+        assert packed[0, 1] == 1
+        assert packed[0, 3] == (1 << 31)
+        assert packed[1, 0] == 1
+        assert packed[1, 2] == (1 << (199 - 128 - 64))
+
+    def test_pack_unpack_roundtrip(self):
+        rng = np.random.default_rng(3)
+        bits = rng.integers(0, 2, 500).astype(bool)
+        packed = pt.pack_selection_bits(bits)
+        assert np.array_equal(
+            pt.unpack_selection_bits_np(packed, 500), bits.astype(np.uint8)
+        )
+
+    def test_random_packed_shape(self):
+        packed = pt.generate_random_packed_selection_bits(
+            300, np.random.default_rng(0)
+        )
+        assert packed.shape == (3, 4)
+
+    def test_unpacked_oracle_vs_database(self):
+        rng = np.random.default_rng(7)
+        records = pt.generate_random_strings_equal_size(150, 12)
+        bits = rng.integers(0, 2, 150).astype(bool)
+        db = DenseDpfPirDatabase(records)
+        packed = pt.pack_selection_bits(
+            np.concatenate([bits, np.zeros(db.num_selection_bits - 150, bool)])
+        )
+        got = db.inner_product_with(packed[None])[0]
+        want = pt.inner_product_with_unpacked(bits, records)
+        assert got == want
+
+
+class TestRequestGenerator:
+    def test_plain_requests_answer_queries(self):
+        records = pt.generate_counting_strings(70, "Record ")
+        db = pt.create_fake_database(DenseDpfPirDatabase, records)
+        server = DenseDpfPirServer.create_plain(db)
+        gen = pt.RequestGenerator.create(len(records), "test ctx")
+        indices = [0, 13, 69]
+        plain0, plain1 = gen.create_plain_requests(indices)
+        resp0 = server.handle_plain_request(
+            messages.PirRequest(plain_request=plain0)
+        )
+        resp1 = server.handle_plain_request(
+            messages.PirRequest(plain_request=plain1)
+        )
+        for i, idx in enumerate(indices):
+            got = xor_bytes(
+                resp0.dpf_pir_response.masked_response[i],
+                resp1.dpf_pir_response.masked_response[i],
+            )
+            assert got.rstrip(b"\x00") == records[idx]
+
+    def test_rejects_out_of_range(self):
+        gen = pt.RequestGenerator.create(10, "ctx")
+        with pytest.raises(ValueError):
+            gen.create_plain_requests([10])
+        with pytest.raises(ValueError):
+            gen.create_plain_requests([-1])
+
+    def test_leader_request_decrypts_to_helper_leg(self):
+        records = pt.generate_counting_strings(40, "v")
+        gen = pt.RequestGenerator.create(len(records), "ctx info")
+        leader = gen.create_leader_request([5, 17])
+        plaintext = encrypt_decrypt.decrypt(
+            leader.encrypted_helper_request.encrypted_request, b"ctx info"
+        )
+        helper = messages.parse_helper_request(gen._dpf, plaintext)
+        assert helper.one_time_pad_seed == gen.otp_seed
+        assert len(helper.plain_request.dpf_keys) == 2
+        # OTP seed regenerates the helper's mask stream.
+        prng = Aes128CtrSeededPrng(gen.otp_seed)
+        assert len(prng.get_random_bytes(16)) == 16
