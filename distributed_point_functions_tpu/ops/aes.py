@@ -225,8 +225,13 @@ def _gf_mul_planes(a, b):
     return acc
 
 
-def _sbox_planes(x):
-    """AES S-box on bit-planes: inv = x^254, then the affine map."""
+def _sbox_planes(x, one=1):
+    """AES S-box on bit-planes: inv = x^254, then the affine map.
+
+    `one` is the affine constant's per-plane XOR value (plain Python int so
+    import stays device-free): 1 for the single-bit-per-lane layout here,
+    0xFFFFFFFF for the packed 32-blocks-per-word layout of `aes_bitslice`."""
+    one = jnp.uint32(one)
     a2 = _gf_square_planes(x)  # x^2
     a3 = _gf_mul_planes(a2, x)  # x^3
     a12 = _gf_square_planes(_gf_square_planes(a3))  # x^12
@@ -237,7 +242,6 @@ def _sbox_planes(x):
     a252 = _gf_mul_planes(a240, a12)  # x^252
     a254 = _gf_mul_planes(a252, a2)  # x^254 = x^-1
     out = []
-    one = jnp.uint32(1)
     for i in range(8):
         v = (
             a254[i]
@@ -359,15 +363,23 @@ def sigma_np(blocks: np.ndarray) -> np.ndarray:
 
 
 def mmo_hash(round_keys: np.ndarray, blocks: jnp.ndarray) -> jnp.ndarray:
-    """H(x) = AES_k(sigma(x)) ^ sigma(x) on uint32[..., 4] limbs."""
+    """H(x) = AES_k(sigma(x)) ^ sigma(x) on uint32[..., 4] limbs.
+
+    Dispatches to the fully-bitsliced kernel (32 blocks per word,
+    `aes_bitslice.py`); the byte-lane `aes_encrypt` here remains as a
+    second implementation for differential testing."""
+    from . import aes_bitslice
+
     s = sigma(blocks)
-    return aes_encrypt(round_keys, s) ^ s
+    return aes_bitslice.aes_encrypt_bs(round_keys, s) ^ s
 
 
 def mmo_hash_select(rk0, rk1, select, blocks):
     """Per-block key-selected MMO hash (see aes_encrypt_select)."""
+    from . import aes_bitslice
+
     s = sigma(blocks)
-    return aes_encrypt_select(rk0, rk1, select, s) ^ s
+    return aes_bitslice.aes_encrypt_select_bs(rk0, rk1, select, s) ^ s
 
 
 def mmo_hash_np(round_keys: np.ndarray, blocks: np.ndarray) -> np.ndarray:
