@@ -1,6 +1,7 @@
 """Test support utilities (mirrors `pir/testing/` in the reference)."""
 
 from .pir_generators import (
+    MockPirClient,
     MockPirDatabase,
     create_fake_database,
     generate_counting_strings,
@@ -17,6 +18,7 @@ from .pir_selection_bits import (
 from .request_generator import RequestGenerator
 
 __all__ = [
+    "MockPirClient",
     "MockPirDatabase",
     "RequestGenerator",
     "create_fake_database",

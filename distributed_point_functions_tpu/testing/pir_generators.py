@@ -73,6 +73,35 @@ def create_fake_database(database_cls, elements: Sequence, builder=None):
     return builder.build()
 
 
+class MockPirClient:
+    """Programmable client fake (analog of the reference's gMock
+    `MockPirClient`, `pir/testing/mock_pir_client.h:30-41`): overwrite
+    `on_create_request` / `on_handle_response` per test and inspect the
+    recorded calls."""
+
+    def __init__(self):
+        self.create_request_calls: List = []
+        self.handle_response_calls: List = []
+        self.on_create_request: Optional[Callable] = None
+        self.on_handle_response: Optional[Callable] = None
+
+    def create_request(self, query_indices):
+        self.create_request_calls.append(list(query_indices))
+        if self.on_create_request is not None:
+            return self.on_create_request(query_indices)
+        raise NotImplementedError(
+            "set `on_create_request` to fake request creation"
+        )
+
+    def handle_response(self, response, client_state):
+        self.handle_response_calls.append((response, client_state))
+        if self.on_handle_response is not None:
+            return self.on_handle_response(response, client_state)
+        raise NotImplementedError(
+            "set `on_handle_response` to fake response handling"
+        )
+
+
 class MockPirDatabase:
     """Programmable database fake (Python analog of the gMock mock).
 

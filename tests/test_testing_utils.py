@@ -49,6 +49,16 @@ class TestGenerators:
         assert db.size == 5
         assert db.record(2) == b"r2"
 
+    def test_mock_client(self):
+        mock = pt.MockPirClient()
+        mock.on_create_request = lambda idx: ("req", "state")
+        assert mock.create_request([1, 2]) == ("req", "state")
+        assert mock.create_request_calls == [[1, 2]]
+        mock.on_handle_response = lambda r, s: [b"rec"]
+        assert mock.handle_response("resp", "state") == [b"rec"]
+        with pytest.raises(NotImplementedError):
+            pt.MockPirClient().create_request([0])
+
     def test_mock_database(self):
         mock = pt.MockPirDatabase()
         mock.records = [b"a", b"b"]
