@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import aes as _aes
+from . import aes_sbox_tower as _tower
 
 U32 = jnp.uint32
 
@@ -93,9 +94,11 @@ def planes_to_limbs(planes: jnp.ndarray) -> jnp.ndarray:
 
 
 def _sub_bytes_planes(state: jnp.ndarray) -> jnp.ndarray:
-    """S-box circuit on [16, 8, G] planes (vectorized over the byte axis)."""
+    """S-box on [16, 8, G] planes via the tower-field circuit
+    (`aes_sbox_tower.py`, ~4x fewer ops than the x^254 chain), vectorized
+    over the byte axis."""
     planes = [state[:, i] for i in range(8)]
-    out = _aes._sbox_planes(planes, one=0xFFFFFFFF)
+    out = _tower.sbox_planes_tower(planes, U32(0xFFFFFFFF))
     return jnp.stack(out, axis=1)
 
 

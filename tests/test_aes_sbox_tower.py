@@ -1,0 +1,66 @@
+"""Tests for the derived composite-field S-box circuit
+(`ops/aes_sbox_tower.py`)."""
+
+import numpy as np
+
+from distributed_point_functions_tpu.ops import aes, aes_sbox_tower as tw
+
+
+def test_tower_params_irreducible():
+    # z^2 + z + nu has no root in GF(4); w^2 + w + lam none in GF(16).
+    assert all(tw._gf4_mul(z, z) ^ z ^ tw._NU for z in range(4))
+    assert all(tw._gf16_mul(w, w, tw._NU) ^ w ^ tw._LAM for w in range(16))
+
+
+def test_basis_change_is_field_isomorphism():
+    rng = np.random.default_rng(0)
+    M = tw._M_IN
+
+    def phi(x):
+        bits = np.array([(x >> i) & 1 for i in range(8)], dtype=np.uint8)
+        out = (M @ bits) % 2
+        return int(sum(int(b) << i for i, b in enumerate(out)))
+
+    for _ in range(200):
+        a, b = int(rng.integers(256)), int(rng.integers(256))
+        # phi(a*b) == phi(a)*phi(b) in the tower
+        lhs = phi(aes._gf_mul(a, b))
+        rhs = tw._gf256_mul_tower(phi(a), phi(b), tw._NU, tw._LAM)
+        assert lhs == rhs
+        assert phi(a ^ b) == phi(a) ^ phi(b)
+
+
+def test_plane_circuit_full_truth_table():
+    xs = np.arange(256, dtype=np.uint32)
+    planes = [(xs >> i) & 1 for i in range(8)]
+    out = tw.sbox_planes_tower(planes, np.uint32(1))
+    got = np.zeros(256, dtype=np.uint32)
+    for i in range(8):
+        got |= (out[i] & 1) << i
+    assert np.array_equal(got, aes.SBOX[xs].astype(np.uint32))
+
+
+def test_plane_circuit_packed_words():
+    # Packed convention: 32 independent bytes per word position, `one` =
+    # all-ones. Evaluate byte value k in bit lane k%32 of word k//32.
+    rng = np.random.default_rng(1)
+    vals = rng.integers(0, 256, 64).astype(np.uint32)
+    planes = []
+    for i in range(8):
+        bits = (vals >> i) & 1
+        planes.append(
+            np.array(
+                [
+                    int((bits[w * 32 : (w + 1) * 32] << np.arange(32)).sum())
+                    for w in range(2)
+                ],
+                dtype=np.uint32,
+            )
+        )
+    out = tw.sbox_planes_tower(planes, np.uint32(0xFFFFFFFF))
+    got = np.zeros(64, dtype=np.uint64)
+    for i in range(8):
+        for w in range(2):
+            bits = (int(out[i][w]) >> np.arange(32)) & 1
+            got[w * 32 : (w + 1) * 32] |= (bits << i).astype(np.uint64)
+    assert np.array_equal(got, aes.SBOX[vals].astype(np.uint32))

@@ -272,3 +272,47 @@ def test_helper_request_roundtrip_serialization():
                 cb.control_left,
                 cb.control_right,
             )
+
+
+def test_concurrent_plain_requests():
+    """Regression test mirroring the reference's concurrency hammer
+    (`pir/dense_dpf_pir_server_test.cc:307-326`): the server is stateless,
+    so parallel `handle_plain_request` calls must all answer correctly."""
+    import threading
+
+    records = random_records(96, size=16)
+    database = DenseDpfPirDatabase(records)
+    server = DenseDpfPirServer.create_plain(database)
+    client = DenseDpfPirClient.create(len(records), lambda pt, ci: pt)
+
+    results = {}
+    errors = []
+
+    def worker(tid, indices):
+        try:
+            req0, req1 = client.create_plain_requests(indices)
+            r0 = server.handle_plain_request(req0)
+            r1 = server.handle_plain_request(req1)
+            out = [
+                xor_bytes(a, b)[:16]
+                for a, b in zip(
+                    r0.dpf_pir_response.masked_response,
+                    r1.dpf_pir_response.masked_response,
+                )
+            ]
+            results[tid] = (indices, out)
+        except Exception as e:  # surfaced below
+            errors.append((tid, e))
+
+    threads = [
+        threading.Thread(target=worker, args=(t, [(7 * t + k) % 96 for k in range(3)]))
+        for t in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert len(results) == 8
+    for indices, out in results.values():
+        assert out == [records[i] for i in indices]
