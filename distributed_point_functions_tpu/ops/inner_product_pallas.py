@@ -1,17 +1,23 @@
 """Pallas TPU kernel for the packed-XOR database inner product.
 
 One pass over the database serves the whole query batch: the grid walks
-record tiles; each step DMAs a `[TILE_RECORDS, W]` database tile into VMEM,
-masks it with every query's selection bits, XOR-reduces over the tile's
-record axis, and folds the partial into a VMEM-resident `[nq, W]`
-accumulator (the revisiting-output accumulation pattern). This fuses the
-bit-unpacking, masking, and reduction into a single HBM read of the
-database — the kernel is purely HBM-bandwidth-bound, which is the design
-target for the reference's hot loop
+(query tile, record tile) pairs with the record axis innermost; each step
+DMAs a `[TILE_RECORDS, W]` database tile into VMEM, expands the *packed*
+selection bits for that tile in-register (broadcast against a 32-lane
+iota), masks the tile with every query's bits, XOR-reduces over the tile's
+record axis by tree halving, and folds the partial into a VMEM-resident
+`[TILE_QUERIES, W]` accumulator (the revisiting-output pattern).
+
+Unlike the jnp path, the selection bits stay packed in HBM
+(`uint32[nq, R/32]`, 32 records per word) — no `[nq, R]` mask is ever
+materialized in HBM, so HBM traffic is one read of the database plus the
+(negligible) packed bits. This matches the design of the reference's hot
+loop, which also keeps bits packed 128/block
 (`pir/internal/inner_product_hwy.cc:157-258`).
 
 Differentially tested against the jnp implementation and the numpy/native
-oracles (tests/test_pallas.py).
+oracles (tests/test_pallas.py); bit-identity vs the jnp path is re-checked
+on hardware by bench.py before the kernel serves the measured run.
 """
 
 from __future__ import annotations
@@ -22,59 +28,83 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
-
-from .inner_product import unpack_selection_bits
 
 U32 = jnp.uint32
 
 
-def _ip_kernel(bits_ref, db_ref, out_ref):
-    """bits_ref: uint32[nq, TR]; db_ref: uint32[TR, W]; out_ref: uint32[nq, W]."""
+def _ip_kernel(sel_ref, db_ref, out_ref):
+    """sel_ref: uint32[TQ, TR//32] packed; db_ref: uint32[TR, W]; out: [TQ, W].
 
-    @pl.when(pl.program_id(0) == 0)
+    Grid is (query_tiles, record_tiles) with records innermost, so out_ref
+    is revisited consecutively and accumulates across record tiles.
+    """
+
+    @pl.when(pl.program_id(1) == 0)
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    mask = (U32(0) - bits_ref[:])[:, :, None]  # 0 or 0xFFFFFFFF
-    masked = mask & db_ref[:][None, :, :]  # [nq, TR, W]
-    partial = lax.reduce(
-        masked, U32(0), lambda a, b: lax.bitwise_xor(a, b), (1,)
-    )
-    out_ref[:] = out_ref[:] ^ partial
+    words = sel_ref[:]  # [TQ, TW]
+    tq, tw = words.shape
+    tr = tw * 32
+    # Expand packed bits in-register: record r's bit is bit r%32 of word
+    # r//32. repeat-32 along the word axis, then shift by (lane % 32).
+    expanded = jnp.repeat(words, 32, axis=1)  # [TQ, TR]
+    shifts = lax.broadcasted_iota(U32, (tq, tr), 1) & U32(31)
+    bits = (expanded >> shifts) & U32(1)
+    mask = (U32(0) - bits)[:, :, None]  # 0 or 0xFFFFFFFF per (q, r)
+    masked = mask & db_ref[:][None, :, :]  # [TQ, TR, W]
+    # XOR-reduce over the record axis by tree halving (Mosaic-friendly:
+    # every step is a plain elementwise XOR of two halves).
+    while masked.shape[1] > 1:
+        half = masked.shape[1] // 2
+        masked = masked[:, :half] ^ masked[:, half:]
+    out_ref[:] = out_ref[:] ^ masked[:, 0]
 
 
 @functools.partial(
-    jax.jit, static_argnames=("tile_records", "interpret")
+    jax.jit, static_argnames=("tile_records", "tile_queries", "interpret")
 )
 def xor_inner_product_pallas(
     db_words: jnp.ndarray,
     selections: jnp.ndarray,
-    tile_records: int = 1024,
+    tile_records: int = 256,
+    tile_queries: int = 64,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """XOR inner product on TPU via Pallas.
+    """XOR inner product on TPU via Pallas, bits kept packed in HBM.
 
     db_words: uint32[R, W], R a multiple of 128; selections:
     uint32[nq, B, 4] with B*128 >= R. Returns uint32[nq, W].
+
+    The VMEM working set per grid step is ~tile_queries * tile_records * W
+    * 4 bytes (the masked intermediate); the defaults keep it ~4 MB for
+    W=64 (256-byte records) against the ~16 MB/core budget.
     """
     num_records, num_words = db_words.shape
     if num_records % 128 != 0:
         raise ValueError("record count must be padded to a multiple of 128")
     nq = selections.shape[0]
-    bits = unpack_selection_bits(selections)[:, :num_records]  # [nq, R]
-    tr = min(tile_records, num_records)
-    while num_records % tr != 0:  # R is a multiple of 128, so this ends
+    # Flatten packed blocks [nq, B, 4] -> words [nq, B*4]; word w covers
+    # records 32w..32w+31 (the XorWrapper<uint128> bit order).
+    packed = selections.reshape(nq, -1)[:, : num_records // 32]
+
+    # Record tile: power of two (the kernel's tree reduction halves it) and
+    # a divisor of R; R is a multiple of 128 so this reaches 128 at worst.
+    tr = 1 << (min(tile_records, num_records).bit_length() - 1)
+    while num_records % tr != 0:
         tr //= 2
-    grid = (num_records // tr,)
+    tq = min(tile_queries, nq)
+    while nq % tq != 0:
+        tq -= 1
+    grid = (nq // tq, num_records // tr)
     return pl.pallas_call(
         _ip_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((nq, tr), lambda i: (0, i)),
-            pl.BlockSpec((tr, num_words), lambda i: (i, 0)),
+            pl.BlockSpec((tq, tr // 32), lambda q, r: (q, r)),
+            pl.BlockSpec((tr, num_words), lambda q, r: (r, 0)),
         ],
-        out_specs=pl.BlockSpec((nq, num_words), lambda i: (0, 0)),
+        out_specs=pl.BlockSpec((tq, num_words), lambda q, r: (q, 0)),
         out_shape=jax.ShapeDtypeStruct((nq, num_words), jnp.uint32),
         interpret=interpret,
-    )(bits, db_words)
+    )(packed, db_words)
