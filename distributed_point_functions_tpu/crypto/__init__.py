@@ -1,0 +1,6 @@
+from .hybrid import (  # noqa: F401
+    HybridDecrypt,
+    HybridEncrypt,
+    generate_keypair,
+    keypair_from_private_bytes,
+)

@@ -1,0 +1,128 @@
+"""Public-key hybrid encryption for the Leader->Helper request leg.
+
+The reference encrypts the helper request with Tink's hybrid encryption
+(ECIES / HPKE) and injects the primitives as callbacks
+(`pir/dpf_pir_server.h:92-109`, `pir/dpf_pir_server.cc:147-193`); its tests
+run real asymmetric encryption from fixed checked-in keysets
+(`pir/testing/encrypt_decrypt.h:29-36`).
+
+This module is the framework's equivalent: an HPKE-style KEM/DEM scheme
+built from the `cryptography` package's primitives —
+
+  KEM:  X25519 ephemeral-static Diffie-Hellman
+  KDF:  HKDF-SHA256, salt = enc || pk_receiver, info = suite id || context
+  DEM:  AES-128-GCM with the context info as associated data
+
+Ciphertext layout: ``enc (32 bytes) || nonce (12 bytes) || aead_ct``.
+The scheme is IND-CCA2 in the same sense as Tink's ECIES-AEAD-HKDF: the
+GCM tag authenticates both the payload and the context info, and the
+ephemeral public key is bound into the KDF salt so ciphertexts cannot be
+re-targeted between keys or contexts.
+
+`HybridEncrypt.__call__` / `HybridDecrypt.__call__` match the seam
+signature ``(data: bytes, context_info: bytes) -> bytes`` used by
+`EncryptHelperRequestFn` / `DecryptHelperRequestFn`, so instances plug
+directly into `DenseDpfPirClient.create` and `DpfPirServer.make_helper`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+_SUITE_ID = b"dpf-tpu-hybrid-v1:X25519+HKDF-SHA256+AES-128-GCM"
+_ENC_LEN = 32  # X25519 public key
+_NONCE_LEN = 12
+_KEY_LEN = 16  # AES-128
+
+
+def generate_keypair() -> Tuple[bytes, bytes]:
+    """Returns ``(private_bytes, public_bytes)``, each 32 raw bytes."""
+    sk = X25519PrivateKey.generate()
+    return _private_bytes(sk), _public_bytes(sk.public_key())
+
+
+def keypair_from_private_bytes(private_bytes: bytes) -> Tuple[bytes, bytes]:
+    sk = X25519PrivateKey.from_private_bytes(private_bytes)
+    return private_bytes, _public_bytes(sk.public_key())
+
+
+def _private_bytes(sk: X25519PrivateKey) -> bytes:
+    return sk.private_bytes(
+        serialization.Encoding.Raw,
+        serialization.PrivateFormat.Raw,
+        serialization.NoEncryption(),
+    )
+
+
+def _public_bytes(pk: X25519PublicKey) -> bytes:
+    return pk.public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+
+
+def _derive_key(
+    shared_secret: bytes, enc: bytes, receiver_pk: bytes, context_info: bytes
+) -> bytes:
+    return HKDF(
+        algorithm=hashes.SHA256(),
+        length=_KEY_LEN,
+        salt=enc + receiver_pk,
+        info=_SUITE_ID + b"|" + context_info,
+    ).derive(shared_secret)
+
+
+class HybridEncrypt:
+    """Encrypts to a receiver public key; usable as `EncryptHelperRequestFn`."""
+
+    def __init__(self, receiver_public_bytes: bytes):
+        if len(receiver_public_bytes) != _ENC_LEN:
+            raise ValueError(
+                f"receiver public key must be {_ENC_LEN} raw bytes"
+            )
+        self._pk_bytes = bytes(receiver_public_bytes)
+        self._pk = X25519PublicKey.from_public_bytes(self._pk_bytes)
+
+    def __call__(self, plaintext: bytes, context_info: bytes = b"") -> bytes:
+        eph = X25519PrivateKey.generate()
+        enc = _public_bytes(eph.public_key())
+        key = _derive_key(
+            eph.exchange(self._pk), enc, self._pk_bytes, context_info
+        )
+        nonce = os.urandom(_NONCE_LEN)
+        ct = AESGCM(key).encrypt(nonce, plaintext, context_info)
+        return enc + nonce + ct
+
+
+class HybridDecrypt:
+    """Decrypts with a receiver private key; usable as `DecryptHelperRequestFn`."""
+
+    def __init__(self, receiver_private_bytes: bytes):
+        if len(receiver_private_bytes) != _ENC_LEN:
+            raise ValueError(
+                f"receiver private key must be {_ENC_LEN} raw bytes"
+            )
+        self._sk = X25519PrivateKey.from_private_bytes(receiver_private_bytes)
+        self._pk_bytes = _public_bytes(self._sk.public_key())
+
+    @property
+    def public_bytes(self) -> bytes:
+        return self._pk_bytes
+
+    def __call__(self, ciphertext: bytes, context_info: bytes = b"") -> bytes:
+        if len(ciphertext) < _ENC_LEN + _NONCE_LEN + 16:
+            raise ValueError("ciphertext too short")
+        enc = ciphertext[:_ENC_LEN]
+        nonce = ciphertext[_ENC_LEN : _ENC_LEN + _NONCE_LEN]
+        body = ciphertext[_ENC_LEN + _NONCE_LEN :]
+        shared = self._sk.exchange(X25519PublicKey.from_public_bytes(enc))
+        key = _derive_key(shared, enc, self._pk_bytes, context_info)
+        return AESGCM(key).decrypt(nonce, body, context_info)

@@ -1,41 +1,36 @@
-"""Symmetric stand-in for the hybrid-encryption seam, for tests.
+"""Real hybrid encryption for protocol tests, from a checked-in keyset.
 
-The reference injects Tink `HybridEncrypt`/`HybridDecrypt` callbacks and
-ships fixed test keysets so protocol tests run real encryption without key
-management (`pir/testing/encrypt_decrypt.h:29-36`). Tink is not part of this
-environment, so tests use an authenticated-enough stand-in built from the
-framework's own AES core: a random 16-byte nonce is prepended and the
-plaintext is XORed with an AES-CTR keystream keyed by
-`AES_fixed(key XOR context_hash)`. Production deployments inject their own
-hybrid-encryption callbacks through the same seam
-(`EncryptHelperRequestFn` / `DecryptHelperRequestFn`).
+Mirrors the reference's test helper, which runs true Tink hybrid
+(asymmetric) encryption from fixed checked-in keysets so protocol tests
+exercise real cryptography without key management
+(`pir/testing/encrypt_decrypt.h:29-36`, `pir/testing/data/
+hybrid_test_{private,public}_keyset.json`).
+
+Here the scheme is the framework's own X25519 + HKDF-SHA256 + AES-128-GCM
+hybrid (`distributed_point_functions_tpu/crypto/hybrid.py`) and the fixed
+keyset lives in `testing/data/hybrid_test_keyset.json`. The module-level
+`encrypt` / `decrypt` callables match the `EncryptHelperRequestFn` /
+`DecryptHelperRequestFn` seam signatures, so tests pass them straight to
+`DenseDpfPirClient.create` and `DpfPirServer.make_helper`.
 """
 
 from __future__ import annotations
 
-import hashlib
-import secrets
+import json
+import os
 
-from ..prng import Aes128CtrSeededPrng, xor_bytes
+from ..crypto import HybridDecrypt, HybridEncrypt
 
-# Fixed test key, analogous to the checked-in test keysets
-# (`pir/testing/data/hybrid_test_*.json`).
-TEST_KEY = bytes.fromhex("000102030405060708090a0b0c0d0e0f")
+_KEYSET_PATH = os.path.join(
+    os.path.dirname(__file__), "data", "hybrid_test_keyset.json"
+)
 
+with open(_KEYSET_PATH) as _f:
+    _keyset = json.load(_f)
 
-def _derive_key(key: bytes, context_info: bytes) -> bytes:
-    return hashlib.sha256(key + b"|" + context_info).digest()[:16]
+TEST_PRIVATE_KEY = bytes.fromhex(_keyset["private_key_hex"])
+TEST_PUBLIC_KEY = bytes.fromhex(_keyset["public_key_hex"])
 
-
-def encrypt(plaintext: bytes, context_info: bytes, key: bytes = TEST_KEY) -> bytes:
-    nonce = secrets.token_bytes(16)
-    prng = Aes128CtrSeededPrng(_derive_key(key, context_info), nonce)
-    return nonce + xor_bytes(plaintext, prng.get_random_bytes(len(plaintext)))
-
-
-def decrypt(ciphertext: bytes, context_info: bytes, key: bytes = TEST_KEY) -> bytes:
-    if len(ciphertext) < 16:
-        raise ValueError("ciphertext too short")
-    nonce, body = ciphertext[:16], ciphertext[16:]
-    prng = Aes128CtrSeededPrng(_derive_key(key, context_info), nonce)
-    return xor_bytes(body, prng.get_random_bytes(len(body)))
+# Fixed-keyset primitives, analogous to CreateFakeHybridEncrypt/Decrypt.
+encrypt = HybridEncrypt(TEST_PUBLIC_KEY)
+decrypt = HybridDecrypt(TEST_PRIVATE_KEY)

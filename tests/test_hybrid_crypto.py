@@ -1,0 +1,68 @@
+"""Tests for the X25519+HKDF+AES-GCM hybrid encryption
+(`distributed_point_functions_tpu/crypto/hybrid.py`), the framework's
+equivalent of the reference's Tink hybrid primitives
+(`pir/testing/encrypt_decrypt.h:29-36`)."""
+
+import pytest
+
+from distributed_point_functions_tpu.crypto import (
+    HybridDecrypt,
+    HybridEncrypt,
+    generate_keypair,
+    keypair_from_private_bytes,
+)
+from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+
+def test_roundtrip():
+    sk, pk = generate_keypair()
+    enc, dec = HybridEncrypt(pk), HybridDecrypt(sk)
+    for msg in (b"", b"x", b"hello helper", bytes(range(256)) * 10):
+        ct = enc(msg, b"ctx")
+        assert dec(ct, b"ctx") == msg
+
+
+def test_ciphertexts_are_randomized():
+    sk, pk = generate_keypair()
+    enc = HybridEncrypt(pk)
+    assert enc(b"same message", b"ctx") != enc(b"same message", b"ctx")
+
+
+def test_wrong_context_info_rejected():
+    sk, pk = generate_keypair()
+    ct = HybridEncrypt(pk)(b"secret", b"DpfPirServer")
+    with pytest.raises(Exception):
+        HybridDecrypt(sk)(ct, b"OtherContext")
+
+
+def test_wrong_key_rejected():
+    _, pk = generate_keypair()
+    sk2, _ = generate_keypair()
+    ct = HybridEncrypt(pk)(b"secret", b"ctx")
+    with pytest.raises(Exception):
+        HybridDecrypt(sk2)(ct, b"ctx")
+
+
+def test_tampered_ciphertext_rejected():
+    sk, pk = generate_keypair()
+    ct = bytearray(HybridEncrypt(pk)(b"secret", b"ctx"))
+    ct[-1] ^= 1  # flip a tag bit
+    with pytest.raises(Exception):
+        HybridDecrypt(sk)(bytes(ct), b"ctx")
+    with pytest.raises(ValueError):
+        HybridDecrypt(sk)(b"short", b"ctx")
+
+
+def test_keypair_from_private_bytes():
+    sk, pk = generate_keypair()
+    sk2, pk2 = keypair_from_private_bytes(sk)
+    assert (sk2, pk2) == (sk, pk)
+
+
+def test_checked_in_keyset_consistent():
+    """testing/data/hybrid_test_keyset.json must be a matching pair."""
+    _, pk = keypair_from_private_bytes(encrypt_decrypt.TEST_PRIVATE_KEY)
+    assert pk == encrypt_decrypt.TEST_PUBLIC_KEY
+    ct = encrypt_decrypt.encrypt(b"payload", b"DpfPirServer")
+    assert encrypt_decrypt.decrypt(ct, b"DpfPirServer") == b"payload"
+    assert encrypt_decrypt.decrypt.public_bytes == pk
