@@ -163,11 +163,11 @@ def _ns_per_leaf(jax, extra):
         DistributedPointFunction,
         DpfParameters,
     )
-    from distributed_point_functions_tpu.value_types import Integer
+    from distributed_point_functions_tpu.value_types import IntType
 
     log_domain = 20
     dpf = DistributedPointFunction.create(
-        DpfParameters(log_domain_size=log_domain, value_type=Integer(64))
+        DpfParameters(log_domain_size=log_domain, value_type=IntType(64))
     )
     key0, _ = dpf.generate_keys(12345, 42)
 
@@ -229,7 +229,9 @@ def main():
         xor_inner_product,
     )
     from distributed_point_functions_tpu.ops.inner_product_pallas import (
+        permute_db_bitmajor,
         xor_inner_product_pallas,
+        xor_inner_product_pallas_staged,
     )
     from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
     from distributed_point_functions_tpu.pir.dense_eval import (
@@ -279,9 +281,12 @@ def main():
                 "inner product: falling back to jnp "
                 f"({str(e).splitlines()[0]})"
             )
-    inner_product = (
-        xor_inner_product_pallas if use_pallas else xor_inner_product
-    )
+    if use_pallas:
+        # Stage the bit-major layout once (the serving path does the same).
+        db_words = jax.block_until_ready(permute_db_bitmajor(db_words))
+        inner_product = xor_inner_product_pallas_staged
+    else:
+        inner_product = xor_inner_product
 
     @jax.jit
     def pir_step(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc, db):
@@ -318,6 +323,34 @@ def main():
         return
     _log(f"latency {latency * 1e3:.1f} ms, per-batch {per_batch * 1e3:.3f} ms")
 
+    # Split timing: the inner product alone on precomputed selections, so
+    # the log shows how the batch divides between DPF expansion and the
+    # database pass.
+    ip_ms = None
+    try:
+        expand_only = jax.jit(
+            lambda s0, c0, cs, cl, cr, vc: evaluate_selection_blocks(
+                s0, c0, cs, cl, cr, vc,
+                walk_levels=walk_levels,
+                expand_levels=expand_levels,
+                num_blocks=num_blocks,
+            )
+        )
+        sel_fixed = jax.block_until_ready(expand_only(*staged))
+        jax.block_until_ready(inner_product(db_words, sel_fixed))
+        per_ip, _ = _slope_time(
+            lambda: inner_product(db_words, sel_fixed), iters
+        )
+        if per_ip is not None:
+            ip_ms = per_ip * 1e3
+            _log(
+                f"split: inner product {ip_ms:.2f} ms "
+                f"({num_padded * num_words * 4 / per_ip / 1e9:.0f} GB/s), "
+                f"expansion ~{per_batch * 1e3 - ip_ms:.2f} ms"
+            )
+    except Exception as e:  # noqa: BLE001
+        _log(f"split timing failed: {e}")
+
     qps = num_queries / per_batch
     db_gb = num_padded * num_words * 4 / 1e9
     gbps = db_gb / per_batch
@@ -330,6 +363,7 @@ def main():
         "inner_product_effective_gbps": round(gbps, 2),
         "inner_product_path": "pallas" if use_pallas else "jnp",
         "per_batch_ms": round(per_batch * 1e3, 3),
+        "inner_product_only_ms": round(ip_ms, 3) if ip_ms else None,
         "num_queries": num_queries,
     }
     if os.environ.get("BENCH_SKIP_NSLEAF", "") != "1":

@@ -1,19 +1,36 @@
-"""Pallas TPU kernel for the packed-XOR database inner product.
+"""Pallas TPU kernel for the packed-XOR database inner product — on the MXU.
 
-One pass over the database serves the whole query batch: the grid walks
-(query tile, record tile) pairs with the record axis innermost; each step
-DMAs a `[TILE_RECORDS, W]` database tile into VMEM, expands the *packed*
-selection bits for that tile in-register (broadcast against a 32-lane
-iota), masks the tile with every query's bits, XOR-reduces over the tile's
-record axis by tree halving, and folds the partial into a VMEM-resident
-`[TILE_QUERIES, W]` accumulator (the revisiting-output pattern).
+The XOR inner product (for each query, XOR of all database records whose
+selection bit is 1 — `pir/internal/inner_product_hwy.cc:157-258`) is a
+GF(2) matrix product: output bit j of word w is the *parity* of
+``sum_r sel[q, r] * db_bit_j[r, w]``. That sum is an ordinary integer
+matmul — exactly what the MXU does — so instead of VPU-style mask-and-XOR
+(memory-layout hostile on TPU: measured 6 GB/s), the kernel computes
+per-bit-plane bf16 matmuls with exact f32 accumulation (counts <= number
+of records <= 2^24, all integers exact in f32) and takes parities at the
+end.
 
-Unlike the jnp path, the selection bits stay packed in HBM
-(`uint32[nq, R/32]`, 32 records per word) — no `[nq, R]` mask is ever
-materialized in HBM, so HBM traffic is one read of the database plus the
-(negligible) packed bits. This matches the design of the reference's hot
-loop, which also keeps bits packed 128/block
-(`pir/internal/inner_product_hwy.cc:157-258`).
+Both operands stay **packed** in HBM; no `[nq, R]` mask is ever
+materialized there:
+
+* selections: ``uint32[nq, G]``, bit b of word g selects record 32g+b;
+* database: staged once into bit-major order ``db_perm[b, g, w] =
+  db_words[32g + b, w]`` (shape ``[32, G, W]``), so the kernel's
+  fori-loop over the 32 bit-classes b only ever indexes the *leading*
+  axis dynamically — the record class's selection bits fall out of the
+  packed words as ``(words >> b) & 1`` with no lane reshuffle (Mosaic
+  cannot lower minor-dim reshapes/repeats, which sank the VPU designs).
+
+Grid: (query tiles, record-group tiles), record axis innermost; the f32
+``[TQ, 32, W]`` count accumulator lives in VMEM across record tiles (the
+revisiting-output pattern). Per step and bit-class, the DB tile's 32
+value-bit-planes are peeled in VMEM (`(dbb >> j) & 1`) and hit the MXU as
+``[TQ, TG] x [TG, W]`` bf16 dots. One database pass serves the whole
+query batch.
+
+Exactness bound: counts accumulate in f32, so the kernel requires
+R <= 2^24 records (far above the 2^22 headline config); the caller falls
+back to the jnp path beyond that.
 
 Differentially tested against the jnp implementation and the numpy/native
 oracles (tests/test_pallas.py); bit-identity vs the jnp path is re-checked
@@ -30,81 +47,176 @@ from jax import lax
 from jax.experimental import pallas as pl
 
 U32 = jnp.uint32
+BF16 = jnp.bfloat16
+F32 = jnp.float32
+
+# Record-group tile (32 records per group): 128 groups = 4096 records per
+# grid step; the packed-selection block's lane dim is then 128, the TPU
+# lane width.
+_TILE_GROUPS = 128
+# f32 holds integers exactly up to 2^24 — the parity trick's hard cap.
+MAX_RECORDS_EXACT = 1 << 24
 
 
-def _ip_kernel(sel_ref, db_ref, out_ref):
-    """sel_ref: uint32[TQ, TR//32] packed; db_ref: uint32[TR, W]; out: [TQ, W].
+def permute_db_bitmajor(db_words: jnp.ndarray) -> jnp.ndarray:
+    """uint32[R, W] row-major -> uint32[32, G, W] bit-major.
 
-    Grid is (query_tiles, record_tiles) with records innermost, so out_ref
-    is revisited consecutively and accumulates across record tiles.
+    Row 32g+b lands at [b, g, :]: all records selected by bit b of a
+    packed selection word are contiguous along axis 1. The record count
+    is zero-padded to a multiple of 32*_TILE_GROUPS (= 4096) so the group
+    axis always tiles evenly at the full 128-lane width (zero rows never
+    contribute to a XOR). One XLA pad+transpose, done once when the
+    database is staged.
     """
+    num_records, num_words = db_words.shape
+    chunk = 32 * _TILE_GROUPS
+    padded = ((num_records + chunk - 1) // chunk) * chunk
+    if padded != num_records:
+        db_words = jnp.pad(db_words, ((0, padded - num_records), (0, 0)))
+    return jnp.transpose(
+        db_words.reshape(padded // 32, 32, num_words), (1, 0, 2)
+    )
+
+
+def _ip_kernel(sel_ref, db_ref, out_ref, *, num_value_bits: int):
+    """sel_ref: uint32[TQ, TG] packed; db_ref: uint32[32, TG, W] bit-major;
+    out_ref: float32[TQ, 32, W] per-value-bit selection counts."""
 
     @pl.when(pl.program_id(1) == 0)
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    words = sel_ref[:]  # [TQ, TW]
-    tq, tw = words.shape
-    tr = tw * 32
-    # Expand packed bits in-register: record r's bit is bit r%32 of word
-    # r//32. repeat-32 along the word axis, then shift by (lane % 32).
-    expanded = jnp.repeat(words, 32, axis=1)  # [TQ, TR]
-    shifts = lax.broadcasted_iota(U32, (tq, tr), 1) & U32(31)
-    bits = (expanded >> shifts) & U32(1)
-    mask = (U32(0) - bits)[:, :, None]  # 0 or 0xFFFFFFFF per (q, r)
-    masked = mask & db_ref[:][None, :, :]  # [TQ, TR, W]
-    # XOR-reduce over the record axis by tree halving (Mosaic-friendly:
-    # every step is a plain elementwise XOR of two halves).
-    while masked.shape[1] > 1:
-        half = masked.shape[1] // 2
-        masked = masked[:, :half] ^ masked[:, half:]
-    out_ref[:] = out_ref[:] ^ masked[:, 0]
+    def body(b, carry):
+        # Selection bits of record class b (records 32g+b), ready for the
+        # MXU: [TQ, TG] bf16 of 0/1.
+        sel_b = ((sel_ref[:] >> b.astype(U32)) & U32(1)).astype(BF16)
+        dbb = db_ref[b]  # [TG, W] u32 — dynamic index on the leading axis
+        for j in range(num_value_bits):
+            bits_j = ((dbb >> U32(j)) & U32(1)).astype(BF16)  # [TG, W]
+            out_ref[:, j, :] += lax.dot_general(
+                sel_b,
+                bits_j,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=F32,
+            )
+        return carry
+
+    lax.fori_loop(0, 32, body, 0)
+
+
+def _pick_group_tile(num_groups: int) -> int:
+    """Largest tile <= _TILE_GROUPS that divides num_groups and is a
+    multiple of 8 (TPU sublane), or the full axis for small databases.
+
+    `permute_db_bitmajor` pads so num_groups % _TILE_GROUPS == 0; the
+    search only matters for hand-built layouts. A large layout with no
+    legal tile is rejected rather than compiled as one giant VMEM block.
+    """
+    tg = min(_TILE_GROUPS, num_groups)
+    while tg >= 8:
+        if num_groups % tg == 0 and tg % 8 == 0:
+            return tg
+        tg -= 8
+    if num_groups > _TILE_GROUPS:
+        raise ValueError(
+            f"no legal group tile for {num_groups} groups; stage the "
+            "database with permute_db_bitmajor (which pads)"
+        )
+    return num_groups
 
 
 @functools.partial(
-    jax.jit, static_argnames=("tile_records", "tile_queries", "interpret")
+    jax.jit, static_argnames=("tile_queries", "interpret")
 )
-def xor_inner_product_pallas(
-    db_words: jnp.ndarray,
-    selections: jnp.ndarray,
-    tile_records: int = 256,
+def _ip_pallas_staged(
+    db_perm: jnp.ndarray,
+    packed: jnp.ndarray,
     tile_queries: int = 64,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """XOR inner product on TPU via Pallas, bits kept packed in HBM.
+    _, num_groups, num_words = db_perm.shape
+    nq = packed.shape[0]
+    tg = _pick_group_tile(num_groups)
+    # Query tile: a multiple of 8 (TPU sublane) dividing the padded batch
+    # (callers pad nq to a multiple of 8), or the whole batch if smaller.
+    tq = min(tile_queries, nq)
+    while tq > 8 and (nq % tq != 0 or tq % 8 != 0):
+        tq -= 8 if tq % 8 == 0 else tq % 8
+    if nq % tq != 0:
+        tq = nq
+
+    counts = pl.pallas_call(
+        functools.partial(_ip_kernel, num_value_bits=32),
+        grid=(nq // tq, num_groups // tg),
+        in_specs=[
+            pl.BlockSpec((tq, tg), lambda q, r: (q, r)),
+            pl.BlockSpec((32, tg, num_words), lambda q, r: (0, r, 0)),
+        ],
+        out_specs=pl.BlockSpec((tq, 32, num_words), lambda q, r: (q, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((nq, 32, num_words), F32),
+        interpret=interpret,
+    )(packed, db_perm)
+    # Parity of each count is the output bit; recombine the 32 bit-planes.
+    parity = counts.astype(jnp.int32).astype(U32) & U32(1)
+    return (parity << jnp.arange(32, dtype=U32)[None, :, None]).sum(
+        axis=1, dtype=U32
+    )
+
+
+def xor_inner_product_pallas_staged(
+    db_perm: jnp.ndarray,
+    selections: jnp.ndarray,
+    tile_queries: int = 64,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Serving-path entry: bit-major staged database, packed selections.
+
+    db_perm: uint32[32, G, W] from `permute_db_bitmajor` (R = 32*G
+    records, R % 128 == 0); selections: uint32[nq, B, 4] packed blocks
+    with 128*B >= R. Returns uint32[nq, W].
+    """
+    _, num_groups, _ = db_perm.shape
+    num_records = 32 * num_groups
+    if num_records > MAX_RECORDS_EXACT:
+        raise ValueError(
+            f"pallas inner product supports at most {MAX_RECORDS_EXACT} "
+            f"records (f32-exact parity counts); got {num_records}"
+        )
+    nq = selections.shape[0]
+    packed = selections.reshape(nq, -1)
+    if packed.shape[1] > num_groups:
+        packed = packed[:, :num_groups]
+    elif packed.shape[1] < num_groups:
+        # The staged layout is padded with zero records; zero selection
+        # words for them contribute nothing to the XOR.
+        packed = jnp.pad(packed, ((0, 0), (0, num_groups - packed.shape[1])))
+    nq_pad = ((nq + 7) // 8) * 8
+    if nq_pad != nq:
+        packed = jnp.pad(packed, ((0, nq_pad - nq), (0, 0)))
+    out = _ip_pallas_staged(
+        db_perm, packed, tile_queries=tile_queries, interpret=interpret
+    )
+    return out[:nq] if nq_pad != nq else out
+
+
+def xor_inner_product_pallas(
+    db_words: jnp.ndarray,
+    selections: jnp.ndarray,
+    tile_queries: int = 64,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Convenience entry from a row-major database (permutes per call;
+    the serving path stages `permute_db_bitmajor` once instead).
 
     db_words: uint32[R, W], R a multiple of 128; selections:
-    uint32[nq, B, 4] with B*128 >= R. Returns uint32[nq, W].
-
-    The VMEM working set per grid step is ~tile_queries * tile_records * W
-    * 4 bytes (the masked intermediate); the defaults keep it ~4 MB for
-    W=64 (256-byte records) against the ~16 MB/core budget.
+    uint32[nq, B, 4]. Returns uint32[nq, W].
     """
-    num_records, num_words = db_words.shape
+    num_records, _ = db_words.shape
     if num_records % 128 != 0:
         raise ValueError("record count must be padded to a multiple of 128")
-    nq = selections.shape[0]
-    # Flatten packed blocks [nq, B, 4] -> words [nq, B*4]; word w covers
-    # records 32w..32w+31 (the XorWrapper<uint128> bit order).
-    packed = selections.reshape(nq, -1)[:, : num_records // 32]
-
-    # Record tile: power of two (the kernel's tree reduction halves it) and
-    # a divisor of R; R is a multiple of 128 so this reaches 128 at worst.
-    tr = 1 << (min(tile_records, num_records).bit_length() - 1)
-    while num_records % tr != 0:
-        tr //= 2
-    tq = min(tile_queries, nq)
-    while nq % tq != 0:
-        tq -= 1
-    grid = (nq // tq, num_records // tr)
-    return pl.pallas_call(
-        _ip_kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((tq, tr // 32), lambda q, r: (q, r)),
-            pl.BlockSpec((tr, num_words), lambda q, r: (r, 0)),
-        ],
-        out_specs=pl.BlockSpec((tq, num_words), lambda q, r: (q, 0)),
-        out_shape=jax.ShapeDtypeStruct((nq, num_words), jnp.uint32),
+    return xor_inner_product_pallas_staged(
+        permute_db_bitmajor(db_words),
+        selections,
+        tile_queries=tile_queries,
         interpret=interpret,
-    )(packed, db_words)
+    )

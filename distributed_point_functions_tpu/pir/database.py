@@ -6,18 +6,31 @@ XORs with Highway SIMD (`pir/dense_dpf_pir_database.h:101-111`,
 `uint32[num_records_padded, record_words]` array resident in HBM: every
 record is zero-padded to the maximum record size, and the record count is
 padded to a multiple of 128 so whole selection blocks line up with rows.
-`inner_product_with` runs the jitted XOR-reduction kernel
-(`ops/inner_product.py`) over the entire query batch in one database pass.
+
+`inner_product_with` serves the whole query batch in one database pass.
+On TPU it routes through the Pallas MXU kernel
+(`ops/inner_product_pallas.py`), staging the bit-major database layout
+once on first use; elsewhere (CPU tests) or on any kernel failure it
+falls back to the jitted jnp XOR-reduction (`ops/inner_product.py`).
+Set ``DPF_TPU_INNER_PRODUCT=jnp`` (or ``pallas``) to force a path.
 """
 
 from __future__ import annotations
 
+import os
+import warnings
 from typing import List, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..ops.inner_product import xor_inner_product
+from ..ops.inner_product_pallas import (
+    MAX_RECORDS_EXACT,
+    permute_db_bitmajor,
+    xor_inner_product_pallas_staged,
+)
 
 
 class DenseDpfPirDatabase:
@@ -50,9 +63,14 @@ class DenseDpfPirDatabase:
         buf = np.zeros((self._num_padded, record_bytes), dtype=np.uint8)
         for i, r in enumerate(self._records):
             buf[i, : len(r)] = np.frombuffer(r, dtype=np.uint8)
-        self._db_words = jnp.asarray(
-            np.ascontiguousarray(buf).view("<u4").astype(np.uint32)
+        # Host copy; device staging is lazy so the Pallas path only ever
+        # holds the bit-major layout in HBM (not both layouts).
+        self._host_words = np.ascontiguousarray(buf).view("<u4").astype(
+            np.uint32
         )
+        self._db_words = None  # row-major device copy (jnp fallback path)
+        self._db_perm = None  # bit-major layout, staged on first pallas use
+        self._pallas_failed = False
 
     @property
     def size(self) -> int:
@@ -74,11 +92,48 @@ class DenseDpfPirDatabase:
 
     @property
     def db_words(self) -> jnp.ndarray:
-        """uint32[num_records_padded, record_words] HBM-resident buffer."""
+        """uint32[num_records_padded, record_words] device buffer."""
+        if self._db_words is None:
+            self._db_words = jnp.asarray(self._host_words)
         return self._db_words
 
     def record(self, i: int) -> bytes:
         return self._records[i]
+
+    def _use_pallas(self) -> bool:
+        mode = os.environ.get("DPF_TPU_INNER_PRODUCT", "auto")
+        if mode == "pallas":
+            return True
+        if mode == "jnp":
+            return False
+        return (
+            not self._pallas_failed
+            and jax.default_backend() == "tpu"
+            and self._num_padded <= MAX_RECORDS_EXACT
+        )
+
+    def _inner_product_device(self, selections: jnp.ndarray) -> jnp.ndarray:
+        if self._use_pallas():
+            try:
+                if self._db_perm is None:
+                    self._db_perm = jax.block_until_ready(
+                        permute_db_bitmajor(jnp.asarray(self._host_words))
+                    )
+                return xor_inner_product_pallas_staged(
+                    self._db_perm, selections
+                )
+            except Exception as e:
+                if os.environ.get("DPF_TPU_INNER_PRODUCT") == "pallas":
+                    raise
+                # Remember the failure: a failed trace/compile is not
+                # cached by jit, so retrying would pay it on every batch.
+                self._pallas_failed = True
+                self._db_perm = None
+                warnings.warn(
+                    "pallas inner-product kernel failed; serving via the "
+                    f"jnp path ({str(e).splitlines()[0][:200]})"
+                )
+        return xor_inner_product(self.db_words, selections)
 
     def inner_product_with(self, selections: jnp.ndarray) -> List[bytes]:
         """XOR of all records whose selection bit is 1, per query.
@@ -101,7 +156,7 @@ class DenseDpfPirDatabase:
         elif selections.shape[1] < needed:
             pad = needed - selections.shape[1]
             selections = jnp.pad(selections, ((0, 0), (0, pad), (0, 0)))
-        out = np.asarray(xor_inner_product(self._db_words, selections))
+        out = np.asarray(self._inner_product_device(selections))
         raw = np.ascontiguousarray(out.astype("<u4")).view(np.uint8)
         return [
             raw[q, : self._max_value_size].tobytes()

@@ -14,33 +14,53 @@ from distributed_point_functions_tpu.ops.inner_product import (
     xor_inner_product_np,
 )
 from distributed_point_functions_tpu.ops.inner_product_pallas import (
+    permute_db_bitmajor,
     xor_inner_product_pallas,
+    xor_inner_product_pallas_staged,
 )
 
 RNG = np.random.default_rng(17)
 
 
 @pytest.mark.parametrize(
-    "num_records,num_words,nq,tile",
-    [(256, 8, 1, 128), (1024, 64, 4, 256), (384, 5, 2, 128)],
+    "num_records,num_words,nq",
+    [(256, 8, 1), (1024, 64, 4), (384, 5, 2), (8192, 16, 16)],
 )
-def test_pallas_inner_product_matches_oracles(num_records, num_words, nq, tile):
+def test_pallas_inner_product_matches_oracles(num_records, num_words, nq):
     db = RNG.integers(0, 1 << 32, (num_records, num_words), dtype=np.uint32)
     bits = RNG.integers(0, 2, (nq, num_records), dtype=np.uint32)
     sel = pack_selection_bits_np(bits)
-    got = np.asarray(
-        xor_inner_product_pallas(db, sel, tile_records=tile, interpret=True)
-    )
+    got = np.asarray(xor_inner_product_pallas(db, sel, interpret=True))
     np.testing.assert_array_equal(got, xor_inner_product_np(db, sel))
     np.testing.assert_array_equal(
         got, np.asarray(xor_inner_product(db, sel))
     )
 
 
-def test_pallas_inner_product_non_pow2_tile_fallback():
-    # R=128*3: tile 1024 -> halved until it divides (128 works).
-    db = RNG.integers(0, 1 << 32, (384, 4), dtype=np.uint32)
-    bits = RNG.integers(0, 2, (2, 384), dtype=np.uint32)
+def test_pallas_inner_product_staged_bitmajor():
+    # The serving path stages the bit-major permutation once; staged and
+    # per-call entries must agree with the oracle.
+    db = RNG.integers(0, 1 << 32, (1152, 4), dtype=np.uint32)
+    bits = RNG.integers(0, 2, (3, 1152), dtype=np.uint32)
+    sel = pack_selection_bits_np(bits)
+    db_perm = np.asarray(permute_db_bitmajor(db))
+    # 1152 records pad to 4096 = 128 groups of 32 (full-lane tiles).
+    assert db_perm.shape == (32, 128, 4)
+    # Spot-check the permutation: record 32g+b lands at [b, g].
+    np.testing.assert_array_equal(db_perm[5, 7], db[32 * 7 + 5])
+    assert not db_perm[:, 36:].any()  # zero padding
+    got = np.asarray(
+        xor_inner_product_pallas_staged(db_perm, sel, interpret=True)
+    )
+    np.testing.assert_array_equal(got, xor_inner_product_np(db, sel))
+
+
+@pytest.mark.parametrize("nq", [1, 3, 65, 100])
+def test_pallas_inner_product_odd_query_counts(nq):
+    # Regression: query counts with no multiple-of-8 divisor used to drive
+    # the tile search to zero (ZeroDivisionError). Queries are now padded.
+    db = RNG.integers(0, 1 << 32, (256, 4), dtype=np.uint32)
+    bits = RNG.integers(0, 2, (nq, 256), dtype=np.uint32)
     sel = pack_selection_bits_np(bits)
     got = np.asarray(xor_inner_product_pallas(db, sel, interpret=True))
     np.testing.assert_array_equal(got, xor_inner_product_np(db, sel))
