@@ -76,6 +76,15 @@ class DpfPirServer:
     def role(self) -> str:
         return self._role
 
+    def get_public_params(self):
+        """`PirServerPublicParams` proto to send to a client before any
+        queries (`pir/pir_server.h:31`, `dense_dpf_pir_server.cc:87-89`).
+        The dense server has none, so the base returns the empty message;
+        the sparse server fills in its cuckoo params."""
+        from .. import serialization
+
+        return serialization.public_params_to_proto(None)
+
     # -- request handling ---------------------------------------------------
 
     def handle_request(
@@ -234,9 +243,6 @@ class DenseDpfPirServer(DpfPirServer):
     @property
     def database(self) -> DenseDpfPirDatabase:
         return self._database
-
-    def get_public_params(self):
-        return None  # the dense server has no public parameters
 
     def _parse_helper_request(self, data: bytes) -> "messages.HelperRequest":
         return messages.parse_helper_request(self._dpf, data)

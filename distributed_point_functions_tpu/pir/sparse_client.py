@@ -64,6 +64,30 @@ class CuckooHashingSparseDpfPirClient:
                encryption_context_info=ENCRYPTION_CONTEXT_INFO):
         return cls(params, encrypter, encryption_context_info)
 
+    @classmethod
+    def create_from_public_params(
+        cls,
+        public_params,
+        encrypter,
+        encryption_context_info=ENCRYPTION_CONTEXT_INFO,
+    ):
+        """Construct from the server's wire-format public params — a
+        `PirServerPublicParams` proto or its serialized bytes
+        (`cuckoo_hashing_sparse_dpf_pir_client_test.cc:170`)."""
+        from .. import serialization
+        from ..protos import pir_pb2
+
+        if isinstance(public_params, (bytes, bytearray)):
+            proto = pir_pb2.PirServerPublicParams()
+            proto.ParseFromString(bytes(public_params))
+            public_params = proto
+        params = serialization.public_params_from_proto(public_params)
+        if params is None:
+            raise ValueError(
+                "public params do not contain cuckoo hashing parameters"
+            )
+        return cls(params, encrypter, encryption_context_info)
+
     def _bucket_indices(self, query: Sequence[bytes]) -> List[int]:
         indices = []
         for q in query:

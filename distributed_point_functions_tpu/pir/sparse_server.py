@@ -106,6 +106,13 @@ class CuckooHashingSparseDpfPirServer(DpfPirServer):
         """The params a client needs (hash config + bucket count)."""
         return self._params
 
+    def get_public_params(self):
+        """Wire-format params (`cuckoo_hashing_sparse_dpf_pir_server.h:99`):
+        a `PirServerPublicParams` proto the client consumes remotely."""
+        from .. import serialization
+
+        return serialization.public_params_to_proto(self._params)
+
     @property
     def dpf(self) -> DistributedPointFunction:
         return self._dpf

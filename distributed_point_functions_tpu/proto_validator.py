@@ -43,31 +43,110 @@ class ProtoValidator:
     # -- static message validation ------------------------------------------
 
     @staticmethod
+    def _validate_integer_type(integer) -> None:
+        """Mirrors `ValidateIntegerType` (`proto_validator.cc:74-87`) with
+        the framework's supported-width restriction on top."""
+        bitsize = integer.bitsize
+        if bitsize < 1:
+            raise ValueError("bitsize must be positive")
+        if bitsize > 128:
+            raise ValueError("bitsize must be less than or equal to 128")
+        if bitsize & (bitsize - 1):
+            raise ValueError("bitsize must be a power of 2")
+        if bitsize not in _ALLOWED_BITSIZES:
+            raise ValueError(
+                f"integer bitsize must be one of {_ALLOWED_BITSIZES}"
+            )
+
+    @staticmethod
+    def _integer_value_as_int(value_integer) -> int:
+        kind = value_integer.WhichOneof("value")
+        if kind == "value_uint64":
+            return value_integer.value_uint64
+        if kind == "value_uint128":
+            b = value_integer.value_uint128
+            return (b.high << 64) | b.low
+        raise ValueError("Unknown value case for Value.Integer")
+
+    @staticmethod
+    def _validate_integer_value(value_integer, integer_type) -> None:
+        """Mirrors `ValidateIntegerValue` (`proto_validator.cc:89-100`)."""
+        v = ProtoValidator._integer_value_as_int(value_integer)
+        if integer_type.bitsize < 128 and v >= 1 << integer_type.bitsize:
+            raise ValueError(
+                f"Value (= {v}) too large for ValueType with bitsize = "
+                f"{integer_type.bitsize}"
+            )
+
+    @staticmethod
     def validate_value_type(value_type) -> None:
         kind = value_type.WhichOneof("type")
         if kind == "integer":
-            bitsize = value_type.integer.bitsize
-            if bitsize not in _ALLOWED_BITSIZES:
-                raise ValueError(
-                    f"integer bitsize must be one of {_ALLOWED_BITSIZES}"
-                )
+            ProtoValidator._validate_integer_type(value_type.integer)
         elif kind == "xor_wrapper":
-            if value_type.xor_wrapper.bitsize not in _ALLOWED_BITSIZES:
-                raise ValueError(
-                    f"xor_wrapper bitsize must be one of {_ALLOWED_BITSIZES}"
-                )
+            ProtoValidator._validate_integer_type(value_type.xor_wrapper)
         elif kind == "int_mod_n":
-            base = value_type.int_mod_n.base_integer.bitsize
-            if base not in _ALLOWED_BITSIZES:
-                raise ValueError(
-                    f"int_mod_n base bitsize must be one of {_ALLOWED_BITSIZES}"
-                )
+            ProtoValidator._validate_integer_type(
+                value_type.int_mod_n.base_integer
+            )
+            ProtoValidator._validate_integer_value(
+                value_type.int_mod_n.modulus,
+                value_type.int_mod_n.base_integer,
+            )
             value_type_from_proto(value_type)  # range-checks the modulus
         elif kind == "tuple":
             for e in value_type.tuple.elements:
                 ProtoValidator.validate_value_type(e)
         else:
             raise ValueError("ValueType must have its type set")
+
+    @staticmethod
+    def validate_value(value, value_type) -> None:
+        """Value-vs-type check (`proto_validator.cc:289-333`): the value's
+        oneof case must match the type, integers must fit the bitsize,
+        tuples must match element-wise, IntModN values must be reduced."""
+        kind = value_type.WhichOneof("type")
+        if kind == "integer":
+            if value.WhichOneof("value") != "integer":
+                raise ValueError("Expected integer value")
+            ProtoValidator._validate_integer_value(
+                value.integer, value_type.integer
+            )
+        elif kind == "tuple":
+            if value.WhichOneof("value") != "tuple":
+                raise ValueError("Expected tuple value")
+            want = len(value_type.tuple.elements)
+            got = len(value.tuple.elements)
+            if got != want:
+                raise ValueError(
+                    f"Expected tuple value of size {want} but got size {got}"
+                )
+            for v, t in zip(value.tuple.elements, value_type.tuple.elements):
+                ProtoValidator.validate_value(v, t)
+        elif kind == "int_mod_n":
+            if value.WhichOneof("value") != "int_mod_n":
+                raise ValueError("Expected IntModN value")
+            ProtoValidator._validate_integer_value(
+                value.int_mod_n, value_type.int_mod_n.base_integer
+            )
+            v = ProtoValidator._integer_value_as_int(value.int_mod_n)
+            m = ProtoValidator._integer_value_as_int(
+                value_type.int_mod_n.modulus
+            )
+            if v >= m:
+                raise ValueError(
+                    f"Value (= {v}) is too large for modulus (= {m})"
+                )
+        elif kind == "xor_wrapper":
+            if value.WhichOneof("value") != "xor_wrapper":
+                raise ValueError("Expected XorWrapper value")
+            ProtoValidator._validate_integer_value(
+                value.xor_wrapper, value_type.xor_wrapper
+            )
+        else:
+            raise ValueError(
+                f"ValidateValue: Unsupported ValueType: {value_type}"
+            )
 
     @staticmethod
     def validate_parameters(parameters: Sequence) -> None:

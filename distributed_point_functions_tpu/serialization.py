@@ -338,3 +338,39 @@ def pir_response_from_proto(p) -> "messages.PirResponse":
             masked_response=list(p.dpf_pir_response.masked_response)
         )
     )
+
+
+def public_params_to_proto(params=None, out=None):
+    """CuckooHashingParams (or None for the dense server) ->
+    `PirServerPublicParams` (`private_information_retrieval.proto:55-60`).
+    The dense server has no parameters; like the reference it returns the
+    empty message (`dense_dpf_pir_server.cc:87-89`)."""
+    out = out if out is not None else pir_pb2.PirServerPublicParams()
+    if params is not None:
+        dst = out.cuckoo_hashing_sparse_dpf_pir_server_params
+        dst.num_buckets = params.num_buckets
+        dst.num_hash_functions = params.num_hash_functions
+        dst.hash_family_config.hash_family = (
+            params.hash_family_config.hash_family
+        )
+        dst.hash_family_config.seed = params.hash_family_config.seed
+    return out
+
+
+def public_params_from_proto(p):
+    """Returns CuckooHashingParams, or None for dense-server params."""
+    from .hashing.hash_family_config import HashFamilyConfig
+    from .pir.cuckoo_database import CuckooHashingParams
+
+    which = p.WhichOneof("wrapped_pir_server_public_params")
+    if which is None:
+        return None
+    src = p.cuckoo_hashing_sparse_dpf_pir_server_params
+    return CuckooHashingParams(
+        num_buckets=src.num_buckets,
+        num_hash_functions=src.num_hash_functions,
+        hash_family_config=HashFamilyConfig(
+            hash_family=src.hash_family_config.hash_family,
+            seed=src.hash_family_config.seed,
+        ),
+    )

@@ -159,3 +159,303 @@ def test_validate_evaluation_context():
     mismatched.parameters[0].log_domain_size = 4
     with pytest.raises(ValueError, match="doesn't match"):
         v.validate_evaluation_context(mismatched)
+
+
+# ---------------------------------------------------------------------------
+# Malformed-message corpus (ports the reference's exhaustive sweep,
+# `dpf/internal/proto_validator_test.cc` + proto_validator_test.textproto).
+# Each entry mutates a valid message and must be rejected.
+# ---------------------------------------------------------------------------
+
+
+def _integer_value(v):
+    out = dpf_pb2.Value()
+    if v < 1 << 64:
+        out.integer.value_uint64 = v
+    else:
+        out.integer.value_uint128.high = v >> 64
+        out.integer.value_uint128.low = v & ((1 << 64) - 1)
+    return out
+
+
+BAD_PARAMETER_MUTATIONS = [
+    ("domain_negative", lambda p: setattr(p, "log_domain_size", -1),
+     "non-negative"),
+    ("domain_too_large", lambda p: setattr(p, "log_domain_size", 129),
+     "<= 128"),
+    ("bitsize_zero", lambda p: setattr(p.value_type.integer, "bitsize", 0),
+     "positive"),
+    ("bitsize_negative",
+     lambda p: setattr(p.value_type.integer, "bitsize", -2), "positive"),
+    ("bitsize_too_large",
+     lambda p: setattr(p.value_type.integer, "bitsize", 256),
+     "less than or equal to 128"),
+    ("bitsize_not_pow2",
+     lambda p: setattr(p.value_type.integer, "bitsize", 23), "power of 2"),
+    ("bitsize_unsupported_small_pow2",
+     lambda p: setattr(p.value_type.integer, "bitsize", 4), "one of"),
+    ("xor_bitsize_not_pow2",
+     lambda p: setattr(p.value_type.xor_wrapper, "bitsize", 33),
+     "power of 2"),
+    ("xor_bitsize_zero",
+     lambda p: setattr(p.value_type.xor_wrapper, "bitsize", 0), "positive"),
+    ("tuple_member_bad_bitsize",
+     lambda p: p.value_type.tuple.elements.add().integer.__setattr__(
+         "bitsize", 7), "power of 2"),
+    ("security_nan",
+     lambda p: setattr(p, "security_parameter", float("nan")), "NaN"),
+    ("security_negative",
+     lambda p: setattr(p, "security_parameter", -1.0), r"\[0, 128\]"),
+    ("security_too_large",
+     lambda p: setattr(p, "security_parameter", 160.0), r"\[0, 128\]"),
+]
+
+
+@pytest.mark.parametrize(
+    "name,mutate,msg", BAD_PARAMETER_MUTATIONS,
+    ids=[m[0] for m in BAD_PARAMETER_MUTATIONS],
+)
+def test_rejects_malformed_parameters(name, mutate, msg):
+    p = make_params(5)[0]
+    mutate(p)
+    with pytest.raises(ValueError, match=msg):
+        ProtoValidator.validate_parameters([p])
+
+
+def test_rejects_int_mod_n_bad_base_and_modulus():
+    p = make_params(5)[0]
+    p.value_type.Clear()
+    p.value_type.int_mod_n.base_integer.bitsize = 9
+    p.value_type.int_mod_n.modulus.value_uint64 = 3
+    with pytest.raises(ValueError, match="power of 2"):
+        ProtoValidator.validate_parameters([p])
+
+    p.value_type.Clear()
+    p.value_type.int_mod_n.base_integer.bitsize = 32
+    # Modulus doesn't fit the base integer.
+    p.value_type.int_mod_n.modulus.value_uint64 = 1 << 33
+    with pytest.raises(ValueError, match="too large"):
+        ProtoValidator.validate_parameters([p])
+
+
+def test_rejects_value_type_not_set():
+    vt = dpf_pb2.ValueType()
+    with pytest.raises(ValueError, match="type set"):
+        ProtoValidator.validate_value_type(vt)
+
+
+# -- ValidateValue corpus (`proto_validator_test.cc:287-380`) ---------------
+
+
+def _vt_integer(bits=32):
+    vt = dpf_pb2.ValueType()
+    vt.integer.bitsize = bits
+    return vt
+
+
+def test_validate_value_accepts_valid():
+    ProtoValidator.validate_value(_integer_value(23), _vt_integer(32))
+    big = dpf_pb2.ValueType()
+    big.integer.bitsize = 128
+    ProtoValidator.validate_value(_integer_value(1 << 100), big)
+
+
+def test_validate_value_fails_if_type_not_integer():
+    value = dpf_pb2.Value()
+    value.tuple.elements.add().integer.value_uint64 = 23
+    with pytest.raises(ValueError, match="Expected integer value"):
+        ProtoValidator.validate_value(value, _vt_integer(32))
+
+
+def test_validate_value_fails_if_integer_too_large():
+    with pytest.raises(ValueError, match="too large for ValueType"):
+        ProtoValidator.validate_value(_integer_value(1 << 32), _vt_integer(32))
+    # 128-bit encoding of a value too large for a 64-bit type.
+    with pytest.raises(ValueError, match="too large for ValueType"):
+        ProtoValidator.validate_value(_integer_value(1 << 70), _vt_integer(64))
+
+
+def test_validate_value_fails_if_integer_value_case_unset():
+    value = dpf_pb2.Value()
+    value.integer.SetInParent()
+    with pytest.raises(ValueError, match="Unknown value case"):
+        ProtoValidator.validate_value(value, _vt_integer(32))
+
+
+def test_validate_value_fails_if_type_not_tuple():
+    vt = dpf_pb2.ValueType()
+    vt.tuple.elements.add().integer.bitsize = 32
+    with pytest.raises(ValueError, match="Expected tuple value"):
+        ProtoValidator.validate_value(_integer_value(23), vt)
+
+
+def test_validate_value_fails_if_tuple_size_doesnt_match():
+    vt = dpf_pb2.ValueType()
+    vt.tuple.elements.add().integer.bitsize = 32
+    value = dpf_pb2.Value()
+    value.tuple.elements.add().integer.value_uint64 = 23
+    value.tuple.elements.add().integer.value_uint64 = 42
+    with pytest.raises(ValueError, match="size 1 but got size 2"):
+        ProtoValidator.validate_value(value, vt)
+
+
+def test_validate_value_fails_inside_nested_tuple():
+    vt = dpf_pb2.ValueType()
+    vt.tuple.elements.add().integer.bitsize = 8
+    value = dpf_pb2.Value()
+    value.tuple.elements.add().integer.value_uint64 = 300  # > 2^8
+    with pytest.raises(ValueError, match="too large for ValueType"):
+        ProtoValidator.validate_value(value, vt)
+
+
+def test_validate_value_fails_if_value_larger_than_modulus():
+    vt = dpf_pb2.ValueType()
+    vt.int_mod_n.base_integer.bitsize = 64
+    vt.int_mod_n.modulus.value_uint64 = 3
+    value = dpf_pb2.Value()
+    value.int_mod_n.value_uint64 = 3
+    with pytest.raises(ValueError, match=r"too large for modulus \(= 3\)"):
+        ProtoValidator.validate_value(value, vt)
+
+
+def test_validate_value_fails_if_int_mod_n_case_mismatch():
+    vt = dpf_pb2.ValueType()
+    vt.int_mod_n.base_integer.bitsize = 64
+    vt.int_mod_n.modulus.value_uint64 = 1000
+    with pytest.raises(ValueError, match="Expected IntModN value"):
+        ProtoValidator.validate_value(_integer_value(23), vt)
+
+
+def test_validate_value_fails_if_type_not_xor_wrapper():
+    vt = dpf_pb2.ValueType()
+    vt.xor_wrapper.bitsize = 32
+    with pytest.raises(ValueError, match="Expected XorWrapper value"):
+        ProtoValidator.validate_value(_integer_value(23), vt)
+
+
+def test_validate_value_fails_if_xor_wrapper_too_large():
+    vt = dpf_pb2.ValueType()
+    vt.xor_wrapper.bitsize = 8
+    value = dpf_pb2.Value()
+    value.xor_wrapper.value_uint64 = 256
+    with pytest.raises(ValueError, match="too large for ValueType"):
+        ProtoValidator.validate_value(value, vt)
+
+
+def test_validate_value_fails_if_type_unknown():
+    with pytest.raises(ValueError, match="Unsupported ValueType"):
+        ProtoValidator.validate_value(dpf_pb2.Value(), dpf_pb2.ValueType())
+
+
+# -- DpfKey corpus ----------------------------------------------------------
+
+
+def _key_fixture():
+    dpf, key = make_key_proto()
+    v = ProtoValidator.create(
+        [ser.parameters_to_proto(p) for p in dpf.parameters]
+    )
+    return v, key
+
+
+BAD_KEY_MUTATIONS = [
+    ("seed_missing", lambda k: k.ClearField("seed"), "seed"),
+    ("last_level_vc_missing",
+     lambda k: k.ClearField("last_level_value_correction"),
+     "last_level_value_correction"),
+    ("correction_word_removed",
+     lambda k: k.correction_words.pop(), "correction words"),
+    ("correction_word_added",
+     lambda k: k.correction_words.add(), "correction words"),
+    ("all_correction_words_cleared",
+     lambda k: k.ClearField("correction_words"), "correction words"),
+]
+
+
+@pytest.mark.parametrize(
+    "name,mutate,msg", BAD_KEY_MUTATIONS,
+    ids=[m[0] for m in BAD_KEY_MUTATIONS],
+)
+def test_rejects_malformed_keys(name, mutate, msg):
+    v, key = _key_fixture()
+    bad = dpf_pb2.DpfKey.FromString(key.SerializeToString())
+    mutate(bad)
+    with pytest.raises(ValueError, match=msg):
+        v.validate_dpf_key(bad)
+
+
+# -- EvaluationContext corpus -----------------------------------------------
+
+
+def _ctx_fixture():
+    dpf = DistributedPointFunction.create_incremental(
+        [
+            DpfParameters(log_domain_size=3, value_type=IntType(32)),
+            DpfParameters(log_domain_size=9, value_type=IntType(32)),
+        ]
+    )
+    k0, _ = dpf.generate_keys_incremental(100, [1, 2])
+    ctx = dpf.create_evaluation_context(k0)
+    proto = ser.evaluation_context_to_proto(dpf, ctx)
+    v = ProtoValidator.create(
+        [ser.parameters_to_proto(p) for p in dpf.parameters]
+    )
+    return v, proto
+
+
+def _clone_ctx(proto):
+    return dpf_pb2.EvaluationContext.FromString(proto.SerializeToString())
+
+
+BAD_CTX_MUTATIONS = [
+    ("key_missing", lambda c: c.ClearField("key"), "key must be present"),
+    ("key_seed_missing", lambda c: c.key.ClearField("seed"), "seed"),
+    ("parameters_removed", lambda c: c.parameters.pop(), "doesn't match"),
+    ("parameters_added",
+     lambda c: c.parameters.add(), "doesn't match"),
+    ("log_domain_size_mismatch",
+     lambda c: setattr(c.parameters[0], "log_domain_size", 4),
+     "doesn't match"),
+    ("value_type_mismatch",
+     lambda c: setattr(c.parameters[0].value_type.integer, "bitsize", 64),
+     "doesn't match"),
+    ("security_parameter_mismatch",
+     lambda c: setattr(c.parameters[0], "security_parameter", 100.0),
+     "doesn't match"),
+    ("fully_evaluated",
+     lambda c: setattr(c, "previous_hierarchy_level", 1),
+     "fully evaluated"),
+]
+
+
+@pytest.mark.parametrize(
+    "name,mutate,msg", BAD_CTX_MUTATIONS,
+    ids=[m[0] for m in BAD_CTX_MUTATIONS],
+)
+def test_rejects_malformed_contexts(name, mutate, msg):
+    v, proto = _ctx_fixture()
+    bad = _clone_ctx(proto)
+    mutate(bad)
+    with pytest.raises(ValueError, match=msg):
+        v.validate_evaluation_context(bad)
+
+
+def test_ctx_accepts_default_security_parameter_as_equal():
+    """Explicit default and 0 security parameters compare equal
+    (`proto_validator_test.cc:244-254`)."""
+    v, proto = _ctx_fixture()
+    ok = _clone_ctx(proto)
+    ok.parameters[0].security_parameter = 0.0
+    v.validate_evaluation_context(ok)
+    ok.parameters[0].security_parameter = 43.0  # 40 + log_domain_size(3)
+    v.validate_evaluation_context(ok)
+
+
+def test_ctx_rejects_partial_evaluations_level_too_large():
+    v, proto = _ctx_fixture()
+    bad = _clone_ctx(proto)
+    bad.previous_hierarchy_level = 0
+    bad.partial_evaluations_level = 1
+    bad.partial_evaluations.add()
+    with pytest.raises(ValueError, match="partial_evaluations_level"):
+        v.validate_evaluation_context(bad)

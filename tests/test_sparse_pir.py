@@ -219,3 +219,50 @@ def test_sparse_pir_leader_helper_end_to_end():
         q = queries[qi]
         assert results[qi] is not None
         assert results[qi][: len(pairs[q])] == pairs[q]
+
+
+def test_client_from_serialized_public_params_completes_query():
+    """A client constructed ONLY from the server's serialized
+    `PirServerPublicParams` wire message must complete a real query
+    (`pir/pir_server.h:31`, `cuckoo_hashing_sparse_dpf_pir_client_test.cc:170`)."""
+    params, db, pairs = build_sparse_fixture(num_elements=30)
+    _, db2, _ = build_sparse_fixture(num_elements=30)
+    helper = CuckooHashingSparseDpfPirServer.create_helper(
+        params, db2, encrypt_decrypt.decrypt
+    )
+
+    def sender(helper_request, while_waiting):
+        while_waiting()
+        return helper.handle_request(helper_request)
+
+    leader = CuckooHashingSparseDpfPirServer.create_leader(params, db, sender)
+
+    # The client sees nothing but the wire bytes from the leader.
+    wire = leader.get_public_params().SerializeToString()
+    assert isinstance(wire, bytes) and len(wire) > 0
+    client = CuckooHashingSparseDpfPirClient.create_from_public_params(
+        wire, encrypt_decrypt.encrypt
+    )
+    queries = [b"key_3", b"key_29", b"nope"]
+    request, state = client.create_request(queries)
+    results = client.handle_response(leader.handle_request(request), state)
+    for qi, q in enumerate(queries):
+        if q in pairs:
+            assert results[qi] is not None
+            assert results[qi][: len(pairs[q])] == pairs[q]
+        else:
+            assert results[qi] is None
+
+
+def test_dense_server_public_params_empty_message():
+    from distributed_point_functions_tpu.pir.database import (
+        DenseDpfPirDatabase,
+    )
+    from distributed_point_functions_tpu.pir.server import DenseDpfPirServer
+
+    server = DenseDpfPirServer.create_plain(
+        DenseDpfPirDatabase([b"a", b"b", b"c"])
+    )
+    proto = server.get_public_params()
+    assert proto.WhichOneof("wrapped_pir_server_public_params") is None
+    assert proto.SerializeToString() == b""
