@@ -76,17 +76,28 @@ class DistributedComparisonFunction:
         out = self.batch_evaluate([key], [x])
         return self.value_type.to_python(out, (0,))
 
+    def stage_keys(self, keys: Sequence[DcfKey]):
+        """Stage a key batch to device once; reusable across many
+        `batch_evaluate` calls (MIC evaluates every key at two points per
+        interval — staging per call would dominate)."""
+        return self.dpf.stage_key_batch([k.key for k in keys])
+
     def batch_evaluate(self, keys: Sequence[DcfKey],
-                       evaluation_points: Sequence[int]):
+                       evaluation_points: Sequence[int], staged=None):
         """Evaluate each key at its own point.
 
         Returns a device value pytree with leading dim `len(keys)`.
         """
-        if len(keys) != len(evaluation_points):
-            raise ValueError(
-                "keys and evaluation_points must have the same size"
-            )
-        n = len(keys)
+        if keys is None:
+            if staged is None:
+                raise ValueError("either keys or staged must be provided")
+            n = staged.n
+        else:
+            if len(keys) != len(evaluation_points):
+                raise ValueError(
+                    "keys and evaluation_points must have the same size"
+                )
+            n = len(keys)
         vt = self.value_type
         lds = self.log_domain_size
         for x in evaluation_points:
@@ -114,9 +125,10 @@ class DistributedComparisonFunction:
             return True
 
         self.dpf.evaluate_and_apply(
-            [k.key for k in keys],
+            None if keys is None else [k.key for k in keys],
             list(evaluation_points),
             accumulator,
             evaluation_points_rightshift=1,
+            staged=staged,
         )
         return acc[0]

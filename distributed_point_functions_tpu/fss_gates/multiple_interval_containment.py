@@ -22,7 +22,9 @@ from __future__ import annotations
 import dataclasses
 from typing import List, Sequence, Tuple
 
+import jax.numpy as jnp
 import numpy as np
+from jax import tree_util
 
 from ..dcf import DcfKey, DistributedComparisonFunction
 from ..value_types import IntType
@@ -156,17 +158,35 @@ class MultipleIntervalContainmentGate:
         p = [iv.lower_bound for iv in intervals]
         q_prime = [(iv.upper_bound + 1) % n for iv in intervals]
 
-        dcf_keys: List[DcfKey] = []
         x_p: List[int] = []
         x_q_prime: List[int] = []
         for i, x in enumerate(evaluation_points):
             for j in range(ni):
                 x_p.append((x + n - 1 - p[j]) % n)
                 x_q_prime.append((x + n - 1 - q_prime[j]) % n)
-                dcf_keys.append(keys[i].dcf_key)
 
-        s_p = np.asarray(self.dcf.batch_evaluate(dcf_keys, x_p))
-        s_q_prime = np.asarray(self.dcf.batch_evaluate(dcf_keys, x_q_prime))
+        # Stage each DCF key once, then tile per interval on device
+        # (reference duplicates keys host-side per (key, interval) pair,
+        # `multiple_interval_containment.cc:260-282`); the staged batch is
+        # shared by both shifted evaluations.
+        base = self.dcf.stage_keys([k.dcf_key for k in keys])
+        staged = dataclasses.replace(
+            base,
+            n=base.n * ni,
+            seeds=jnp.repeat(base.seeds, ni, axis=0),
+            parties=jnp.repeat(base.parties, ni, axis=0),
+            cw_seeds=jnp.repeat(base.cw_seeds, ni, axis=1),
+            cw_left=jnp.repeat(base.cw_left, ni, axis=1),
+            cw_right=jnp.repeat(base.cw_right, ni, axis=1),
+            value_corrections=[
+                tree_util.tree_map(lambda a: jnp.repeat(a, ni, axis=0), vc)
+                for vc in base.value_corrections
+            ],
+        )
+        s_p = np.asarray(self.dcf.batch_evaluate(None, x_p, staged=staged))
+        s_q_prime = np.asarray(
+            self.dcf.batch_evaluate(None, x_q_prime, staged=staged)
+        )
 
         def u128(limbs) -> int:
             return sum(int(limbs[k]) << (32 * k) for k in range(4))

@@ -139,6 +139,14 @@ class ValueType:
         """Broadcast a host value to a device pytree with batch `shape`."""
         raise NotImplementedError
 
+    def host_const(self, host_value):
+        """Host value -> numpy pytree (no batch dims, no device transfer).
+
+        Same leaf structure as `dev_const(v, ())`; lets batch staging
+        assemble one big numpy array and do a single device_put instead of
+        per-value device ops."""
+        raise NotImplementedError
+
     def dev_add(self, a, b):
         raise NotImplementedError
 
@@ -216,6 +224,9 @@ class _LimbValueType(ValueType):
     def dev_const(self, host_value, shape):
         c = jnp.asarray(limb.to_const(host_value, self.nlimbs))
         return jnp.broadcast_to(c, tuple(shape) + (self.nlimbs,))
+
+    def host_const(self, host_value):
+        return limb.to_const(host_value, self.nlimbs)
 
     def dev_where(self, mask, a, b):
         return jnp.where(mask[..., None], a, b)
@@ -575,6 +586,11 @@ class TupleType(ValueType):
     def dev_const(self, host_value, shape):
         return tuple(
             e.dev_const(v, shape) for e, v in zip(self.elements, host_value)
+        )
+
+    def host_const(self, host_value):
+        return tuple(
+            e.host_const(v) for e, v in zip(self.elements, host_value)
         )
 
     def dev_add(self, a, b):
