@@ -6,20 +6,25 @@ with XLA collectives over ICI:
 
 * **Query parallelism ("dp")** — each device expands the DPF trees of its
   slice of the query batch (AES work is embarrassingly parallel across
-  keys).
+  keys), then `all_gather`s the packed selection blocks so every device
+  sees the whole batch.
 * **Database sharding ("tp" analog)** — the record axis of the database is
   sharded across the same devices; each device XORs its shard against all
-  queries' selection bits, and the per-device partials are XOR-combined
-  with an `all_gather` + bitwise-XOR reduction (XOR has no `psum`
-  equivalent, but an 8-way gather of 128-bit partials is tiny on ICI).
+  queries' selection bits.
+* **Combine** — the per-device partials leave the `shard_map` still
+  sharded over the mesh axis; the XOR reduction over that axis is written
+  as a plain `jnp` reduce in the enclosing jit, and XLA lowers it to the
+  collective (XOR has no `psum` twin, so rather than hand-rolling a
+  gather+reduce inside the manual region — which the varying-manual-axes
+  checker cannot prove replicated — the partition pass places it).
 
-The public entry point builds a `shard_map`-wrapped jitted step:
-queries in → combined inner products out, everything device-resident.
+The public entry points build `shard_map`-wrapped jitted steps:
+queries in → combined inner products out, everything device-resident, with
+the sharding checker (`check_vma`) at its default (on).
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Tuple
 
 import jax
@@ -46,12 +51,48 @@ def make_mesh(n_devices: int | None = None, axis_name: str = "x") -> Mesh:
     return Mesh(np.array(devices[:n_devices]), (axis_name,))
 
 
-def _xor_all_reduce(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
-    """Bitwise-XOR all-reduce across a mesh axis (gather + local XOR)."""
-    gathered = lax.all_gather(x, axis_name)  # [ndev, ...]
-    return lax.reduce(
-        gathered, U32(0), lambda a, b: lax.bitwise_xor(a, b), (0,)
+def _local_partial_ip(db_shard, selections, idx):
+    """This device's XOR partial: its record rows against all queries.
+
+    Slices the *packed* selection blocks to the local range before
+    unpacking, so each device only materializes bits for its own records
+    (r_local is a multiple of 128, so the range is whole blocks).
+    """
+    r_local = db_shard.shape[0]
+    blocks_local = r_local // 128
+    packed_local = lax.dynamic_slice_in_dim(
+        selections, idx * blocks_local, blocks_local, axis=1
     )
+    bits_local = unpack_selection_bits(packed_local)  # [nq, r_local]
+    mask = (U32(0) - bits_local)[:, :, None]
+    masked = mask & db_shard[None, :, :]
+    return lax.reduce(
+        masked, U32(0), lambda a, b: lax.bitwise_xor(a, b), (1,)
+    )
+
+
+def _xor_combine(partials: jnp.ndarray, mesh: Mesh) -> jnp.ndarray:
+    """XOR-reduce `partials` (sharded over its leading axis) to a
+    replicated result.
+
+    The sharding constraint makes XLA all-gather the partials (supported
+    on every backend) before a *local* XOR reduce — a plain reduce over a
+    sharded axis would be partitioned into an XOR all-reduce, which e.g.
+    the CPU backend rejects as an unsupported reduction computation.
+    """
+    partials = jax.lax.with_sharding_constraint(
+        partials, NamedSharding(mesh, P())
+    )
+    return lax.reduce(
+        partials, U32(0), lambda a, b: lax.bitwise_xor(a, b), (0,)
+    )
+
+
+def _check_divisible(name: str, value: int, divisor: int) -> None:
+    if value % divisor != 0:
+        raise ValueError(
+            f"{name} (= {value}) must be divisible by {divisor}"
+        )
 
 
 def sharded_inner_product(mesh: Mesh, axis_name: str = "x"):
@@ -61,35 +102,27 @@ def sharded_inner_product(mesh: Mesh, axis_name: str = "x"):
                selections uint32[nq, B, 4] replicated) -> uint32[nq, W].
     `R` must be divisible by 128 * mesh size.
     """
-
-    def local_ip(db_shard, selections, bits_offset):
-        # db_shard: [R/ndev, W]; select this shard's bit range.
-        r_local = db_shard.shape[0]
-        bits = unpack_selection_bits(selections)  # [nq, B*128]
-        bits_local = lax.dynamic_slice_in_dim(
-            bits, bits_offset, r_local, axis=1
-        )
-        mask = (U32(0) - bits_local)[:, :, None]
-        masked = mask & db_shard[None, :, :]
-        return lax.reduce(
-            masked, U32(0), lambda a, b: lax.bitwise_xor(a, b), (1,)
-        )
+    ndev = mesh.devices.size
 
     def step(db_shard, selections):
         idx = lax.axis_index(axis_name)
-        partial = local_ip(db_shard, selections, idx * db_shard.shape[0])
-        return _xor_all_reduce(partial, axis_name)
+        partial = _local_partial_ip(db_shard, selections, idx)
+        return partial[None]  # [1, nq, W], sharded over the mesh axis
 
     shard_mapped = jax.shard_map(
         step,
         mesh=mesh,
         in_specs=(P(axis_name, None), P()),
-        out_specs=P(),
-        # The XOR all-reduce (gather + local reduce) is numerically
-        # replicated but opaque to the varying-manual-axes checker.
-        check_vma=False,
+        out_specs=P(axis_name),
     )
-    return jax.jit(shard_mapped)
+
+    @jax.jit
+    def run(db_words, selections):
+        _check_divisible("num_records", db_words.shape[0], 128 * ndev)
+        partials = shard_mapped(db_words, selections)  # [ndev, nq, W]
+        return _xor_combine(partials, mesh)
+
+    return run
 
 
 def sharded_dense_pir_step(
@@ -123,21 +156,11 @@ def sharded_dense_pir_step(
             num_blocks=num_blocks,
         )  # [nq/ndev, B, 4]
         # Gather the full query batch's selections (ICI all-gather).
-        sel_all = lax.all_gather(sel_local, axis_name, tiled=True)  # [nq, B, 4]
+        sel_all = lax.all_gather(sel_local, axis_name, tiled=True)
         # Phase B (db shard): partial XOR inner product on own records.
         idx = lax.axis_index(axis_name)
-        r_local = db_shard.shape[0]
-        bits = unpack_selection_bits(sel_all)  # [nq, B*128]
-        bits_local = lax.dynamic_slice_in_dim(
-            bits, idx * r_local, r_local, axis=1
-        )
-        mask = (U32(0) - bits_local)[:, :, None]
-        masked = mask & db_shard[None, :, :]
-        partial = lax.reduce(
-            masked, U32(0), lambda a, b: lax.bitwise_xor(a, b), (1,)
-        )
-        # Phase C: XOR-combine partials across the mesh.
-        return _xor_all_reduce(partial, axis_name)
+        partial = _local_partial_ip(db_shard, sel_all, idx)
+        return partial[None]  # sharded over the mesh axis
 
     shard_mapped = jax.shard_map(
         step,
@@ -151,10 +174,20 @@ def sharded_dense_pir_step(
             P(axis_name),        # last_vc
             P(axis_name, None),  # db rows
         ),
-        out_specs=P(),
-        check_vma=False,
+        out_specs=P(axis_name),
     )
-    return jax.jit(shard_mapped)
+
+    @jax.jit
+    def run(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc, db_words):
+        _check_divisible("num_queries", seeds0.shape[0], ndev)
+        _check_divisible("num_records", db_words.shape[0], 128 * ndev)
+        partials = shard_mapped(
+            seeds0, control0, cw_seeds, cw_left, cw_right, last_vc, db_words
+        )  # [ndev, nq, W]
+        # Phase C: XOR-combine the partials.
+        return _xor_combine(partials, mesh)
+
+    return run
 
 
 def shard_database(mesh: Mesh, db_words: jnp.ndarray, axis_name: str = "x"):
