@@ -143,8 +143,7 @@ _CLEAR_LSB = np.array(
 )
 
 
-@jax.jit
-def _expand_level(seeds, control, cw_seed, cw_left, cw_right):
+def _expand_level_body(seeds, control, cw_seed, cw_left, cw_right):
     """One breadth-first expansion level: [n] seeds -> [2n] seeds.
 
     seeds: uint32[n, 4]; control: uint32[n]; cw_seed: uint32[4];
@@ -168,6 +167,27 @@ def _expand_level(seeds, control, cw_seed, cw_left, cw_right):
     cw_dir = jnp.where(sel != 0, cw_right, cw_left)
     t_new = t_new ^ (control2 * cw_dir)
     return h, t_new
+
+
+_expand_level = jax.jit(_expand_level_body)
+
+
+@functools.lru_cache(maxsize=None)
+def _expand_levels_fn(num_levels: int):
+    """One jitted program running `num_levels` width-doubling expansion
+    levels (the whole `ExpandSeeds` loop fused; widths double per level so
+    a scan cannot carry them — the unroll specializes per level count,
+    cached across calls)."""
+
+    @jax.jit
+    def run(seeds, control, cw_seeds, cw_left, cw_right):
+        for i in range(num_levels):
+            seeds, control = _expand_level_body(
+                seeds, control, cw_seeds[i], cw_left[i], cw_right[i]
+            )
+        return seeds, control
+
+    return run
 
 
 @jax.jit
@@ -549,24 +569,30 @@ class DistributedPointFunction:
 
     def _expand(self, seeds: jnp.ndarray, control: jnp.ndarray,
                 key: DpfKey, start: int, stop: int):
-        """Expand seeds from tree level `start` to `stop` (width-doubling)."""
+        """Expand seeds from tree level `start` to `stop` (width-doubling).
+
+        All levels run in ONE jitted program (specialized per level count
+        via `_expand_levels_fn`): a per-level Python loop of `_expand_level`
+        jits would pay one dispatch per level and a fresh compile per
+        distinct width.
+        """
         if stop - start > 62:
             raise ValueError(
                 "trying to expand more than 62 tree levels at once; insert "
                 "intermediate hierarchy levels"
             )
+        if stop == start:
+            return seeds, control
         cw_seeds, cw_left, cw_right = self._stage_correction_words(
             key, start, stop
         )
-        for i in range(stop - start):
-            seeds, control = _expand_level(
-                seeds,
-                control,
-                jnp.asarray(cw_seeds[i]),
-                U32(cw_left[i]),
-                U32(cw_right[i]),
-            )
-        return seeds, control
+        return _expand_levels_fn(stop - start)(
+            seeds,
+            control,
+            jnp.asarray(cw_seeds),
+            jnp.asarray(cw_left),
+            jnp.asarray(cw_right),
+        )
 
     def _walk_paths(self, seeds, control, paths_np, key_or_keys, start: int,
                     stop: int, rightshift: int):
