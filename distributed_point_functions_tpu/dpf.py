@@ -361,6 +361,146 @@ class DistributedPointFunction:
             )
         return self.generate_keys_incremental(alpha, [beta])
 
+    def generate_keys_batch(
+        self, alphas: Sequence[int], betas: Sequence
+    ) -> Tuple[List[DpfKey], List[DpfKey]]:
+        """Generate key pairs for many (alpha, beta) points at once.
+
+        The `GenerateNext` recurrence (`distributed_point_function.cc:
+        121-222`) is sequential in tree depth but embarrassingly parallel
+        across keys; the per-key Python loop costs ~ms/key, which dominates
+        client time at the 1024-query benchmark config. This path runs all
+        keys' levels in lockstep with the batched numpy AES oracle — one
+        AES call per (level, PRG key) for the whole batch.
+
+        Vectorized for the dense-PIR key shape (single hierarchy level,
+        128-bit XOR value type, domain <= 2^63); other shapes fall back to
+        per-key `generate_keys_incremental`.
+        """
+        from .value_types import XorType
+
+        n = len(alphas)
+        if len(betas) != n:
+            raise ValueError("alphas and betas must have the same length")
+        vt = self.parameters[0].value_type
+        lds = self.parameters[-1].log_domain_size
+        fast = (
+            len(self.parameters) == 1
+            and isinstance(vt, XorType)
+            and vt.bits == 128
+            and self._blocks_needed[0] == 1
+            and lds <= 63
+        )
+        if not fast:
+            pairs = [
+                self.generate_keys_incremental(a, [b])
+                for a, b in zip(alphas, betas)
+            ]
+            return [p[0] for p in pairs], [p[1] for p in pairs]
+
+        alphas_np = np.asarray(list(alphas), dtype=np.uint64)
+        if n and int(alphas_np.max()) >= (1 << lds):
+            raise ValueError("alpha out of domain range")
+        for b in betas:
+            vt.validate(b)
+
+        # Root seeds: cryptographically random, both parties.
+        raw = np.frombuffer(
+            secrets.token_bytes(16 * 2 * n), dtype="<u4"
+        ).reshape(2, n, 4).copy()
+        seeds = [raw[0], raw[1]]  # per party: uint32[n, 4]
+        control = [
+            np.zeros(n, dtype=np.uint32),
+            np.ones(n, dtype=np.uint32),
+        ]
+
+        num_cw = self._tree_levels_needed - 1
+        cw_seeds = np.zeros((num_cw, n, 4), dtype=np.uint32)
+        cw_lefts = np.zeros((num_cw, n), dtype=np.uint32)
+        cw_rights = np.zeros((num_cw, n), dtype=np.uint32)
+
+        for tree_level in range(1, self._tree_levels_needed):
+            both = np.concatenate(seeds, axis=0)  # [2n, 4]
+            l = aes.mmo_hash_np(fixed_keys.RK_LEFT, both)
+            r = aes.mmo_hash_np(fixed_keys.RK_RIGHT, both)
+            t_l = l[:, 0] & 1
+            t_r = r[:, 0] & 1
+            l[:, 0] &= 0xFFFFFFFE
+            r[:, 0] &= 0xFFFFFFFE
+            l0, l1 = l[:n], l[n:]
+            r0, r1 = r[:n], r[n:]
+
+            bit_pos = lds - tree_level
+            bit = ((alphas_np >> np.uint64(bit_pos)) & np.uint64(1)).astype(
+                np.uint32
+            )
+            bitc = bit[:, None]
+            # lose = 1 - bit: the branch alpha does NOT take.
+            cw_seed = np.where(bitc != 0, l0 ^ l1, r0 ^ r1)
+            cw_left = t_l[:n] ^ t_l[n:] ^ bit ^ 1
+            cw_right = t_r[:n] ^ t_r[n:] ^ bit
+            cw_keep = np.where(bit != 0, cw_right, cw_left)
+
+            new_seeds = []
+            new_control = []
+            for b in (0, 1):
+                keep_seed = np.where(bitc != 0, (r0, r1)[b], (l0, l1)[b])
+                keep_t = np.where(bit != 0, t_r[b * n : (b + 1) * n],
+                                  t_l[b * n : (b + 1) * n])
+                new_seeds.append(
+                    keep_seed ^ (control[b][:, None] * cw_seed)
+                )
+                new_control.append(keep_t ^ (control[b] & cw_keep))
+            seeds, control = new_seeds, new_control
+            lvl = tree_level - 1
+            cw_seeds[lvl], cw_lefts[lvl], cw_rights[lvl] = (
+                cw_seed, cw_left, cw_right,
+            )
+
+        # Last-level value correction: H_value(s1) - H_value(s0) + beta at
+        # alpha's block position; for 128-bit XOR shares both group ops are
+        # XOR and party negation is the identity
+        # (`ComputeValueCorrection`, `distributed_point_function.cc:81-117`).
+        ha = aes.mmo_hash_np(fixed_keys.RK_VALUE, seeds[0])
+        hb = aes.mmo_hash_np(fixed_keys.RK_VALUE, seeds[1])
+        beta_limbs = np.zeros((n, 4), dtype=np.uint32)
+        for i, b in enumerate(betas):
+            beta_limbs[i] = aes.u128_to_limbs(int(b))
+        vc = ha ^ hb ^ beta_limbs
+
+        keys0: List[DpfKey] = []
+        keys1: List[DpfKey] = []
+        for i in range(n):
+            cws = [
+                CorrectionWord(
+                    seed=aes.limbs_to_u128(cw_seeds[lvl, i]),
+                    control_left=bool(cw_lefts[lvl, i]),
+                    control_right=bool(cw_rights[lvl, i]),
+                    value_correction=None,
+                )
+                for lvl in range(num_cw)
+            ]
+            last_vc = [aes.limbs_to_u128(vc[i])]
+            keys0.append(
+                DpfKey(
+                    seed=aes.limbs_to_u128(raw[0, i]),
+                    party=0,
+                    correction_words=cws,
+                    last_level_value_correction=last_vc,
+                )
+            )
+            keys1.append(
+                DpfKey(
+                    seed=aes.limbs_to_u128(raw[1, i]),
+                    party=1,
+                    correction_words=[
+                        dataclasses.replace(cw) for cw in cws
+                    ],
+                    last_level_value_correction=list(last_vc),
+                )
+            )
+        return keys0, keys1
+
     def generate_keys_incremental(
         self, alpha: int, betas: Sequence
     ) -> Tuple[DpfKey, DpfKey]:

@@ -62,18 +62,17 @@ class DenseDpfPirClient:
         return self._dpf
 
     def _generate_key_pairs(self, query_indices: Sequence[int]):
-        leader_keys, helper_keys = [], []
+        alphas, betas = [], []
         for query in query_indices:
             if query < 0:
                 raise ValueError("all query_indices must be non-negative")
             if query >= self._database_size:
                 raise ValueError("all query_indices must be in bounds")
-            alpha = query // BITS_PER_BLOCK
-            beta = 1 << (query % BITS_PER_BLOCK)
-            k0, k1 = self._dpf.generate_keys(alpha, beta)
-            leader_keys.append(k0)
-            helper_keys.append(k1)
-        return leader_keys, helper_keys
+            alphas.append(query // BITS_PER_BLOCK)
+            betas.append(1 << (query % BITS_PER_BLOCK))
+        # Batched: all keys' tree levels in lockstep (one AES batch per
+        # level instead of a per-key Python recurrence).
+        return self._dpf.generate_keys_batch(alphas, betas)
 
     def create_request(
         self, query_indices: Sequence[int]

@@ -278,3 +278,33 @@ def test_128bit_domain_point_eval():
     for i, x in enumerate(points):
         s = vt.add(vt.to_python(e0, (i,)), vt.to_python(e1, (i,)))
         assert s == (beta if x == alpha else 0), f"x={x}"
+
+
+def test_generate_keys_batch_share_correctness():
+    """Batched keygen must produce valid shares: full-domain XOR of the two
+    parties equals beta at alpha and 0 elsewhere (dense-PIR key shape)."""
+    dpf = DPF.create(Params(6, XorType(128)))
+    rng = np.random.default_rng(5)
+    alphas = [int(a) for a in rng.integers(0, 64, 17)]  # odd batch size
+    betas = [1 << int(b) for b in rng.integers(0, 128, 17)]
+    keys0, keys1 = dpf.generate_keys_batch(alphas, betas)
+    assert len(keys0) == len(keys1) == 17
+    for a, b, k0, k1 in zip(alphas, betas, keys0, keys1):
+        ctx0 = dpf.create_evaluation_context(k0)
+        ctx1 = dpf.create_evaluation_context(k1)
+        v0 = np.asarray(dpf.evaluate_next([], ctx0))
+        v1 = np.asarray(dpf.evaluate_next([], ctx1))
+        combined = v0 ^ v1
+        for x in range(64):
+            got = sum(int(combined[x, i]) << (32 * i) for i in range(4))
+            want = b if x == a else 0
+            assert got == want, f"alpha={a} x={x}"
+
+
+def test_generate_keys_batch_falls_back_for_other_types():
+    dpf = DPF.create(Params(4, IntType(32)))
+    keys0, keys1 = dpf.generate_keys_batch([3, 5], [7, 9])
+    out0 = np.asarray(dpf.evaluate_next([], dpf.create_evaluation_context(keys0[0])))
+    out1 = np.asarray(dpf.evaluate_next([], dpf.create_evaluation_context(keys1[0])))
+    combined = (out0.astype(np.uint64) + out1.astype(np.uint64)) % (1 << 32)
+    assert int(combined[3]) == 7 and int(combined.sum()) == 7
