@@ -43,6 +43,10 @@ U32 = jnp.uint32
 _U128_MASK = (1 << 128) - 1
 
 
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
 # ---------------------------------------------------------------------------
 # Data model
 # ---------------------------------------------------------------------------
@@ -398,9 +402,12 @@ class DistributedPointFunction:
             ]
             return [p[0] for p in pairs], [p[1] for p in pairs]
 
-        alphas_np = np.asarray(list(alphas), dtype=np.uint64)
-        if n and int(alphas_np.max()) >= (1 << lds):
-            raise ValueError("alpha out of domain range")
+        for a in alphas:
+            if not isinstance(a, (int, np.integer)):
+                raise TypeError(f"alpha must be an integer, got {type(a)}")
+            if not (0 <= a < (1 << lds)):
+                raise ValueError("alpha out of domain range")
+        alphas_np = np.asarray([int(a) for a in alphas], dtype=np.uint64)
         for b in betas:
             vt.validate(b)
 
@@ -907,13 +914,19 @@ class DistributedPointFunction:
         stop_level = self._hierarchy_to_tree[hierarchy_level]
         start_level = self._hierarchy_to_tree[ctx.partial_evaluations_level]
         n = len(tree_indices)
-        paths_np = np.stack(
+        # Bucket the batch to the next power of two: distinct prefix counts
+        # would otherwise each compile a fresh XLA program (padding rows
+        # carry zero seeds/paths and are ignored downstream — real rows are
+        # always the leading ones).
+        n_pad = _next_pow2(n)
+        paths_np = np.zeros((n_pad, 4), dtype=np.uint32)
+        paths_np[:n] = np.stack(
             [aes.u128_to_limbs(t) for t in tree_indices]
         ).astype(np.uint32)
 
+        seeds_np = np.zeros((n_pad, 4), dtype=np.uint32)
+        control_np = np.zeros((n_pad,), dtype=np.uint32)
         if ctx.partial_evaluations and start_level <= stop_level:
-            seeds_np = np.zeros((n, 4), dtype=np.uint32)
-            control_np = np.zeros((n,), dtype=np.uint32)
             shift = stop_level - start_level
             for i, ti in enumerate(tree_indices):
                 prev_prefix = ti >> shift if shift < 128 else 0
@@ -927,10 +940,8 @@ class DistributedPointFunction:
                 seeds_np[i] = aes.u128_to_limbs(seed)
                 control_np[i] = t
         else:
-            seeds_np = np.broadcast_to(
-                aes.u128_to_limbs(key.seed), (n, 4)
-            ).copy()
-            control_np = np.full((n,), key.party, dtype=np.uint32)
+            seeds_np[:n] = aes.u128_to_limbs(key.seed)
+            control_np[:n] = key.party
             start_level = 0
 
         seeds, control = self._walk_paths(
@@ -982,15 +993,19 @@ class DistributedPointFunction:
             for pt in evaluation_points
         ]
         stop_level = self._hierarchy_to_tree[hierarchy_level]
-        paths_np = np.stack(
-            [aes.u128_to_limbs(t) for t in tree_indices]
-        ).astype(np.uint32)
+        # Bucketed batch (see _compute_partial_evaluations): pad the point
+        # count to a power of two so point-eval shapes recur.
+        n_pad = _next_pow2(n)
 
         if ctx is None:
-            seeds_np = np.broadcast_to(
-                aes.u128_to_limbs(key.seed), (n, 4)
-            ).copy()
-            control_np = np.full((n,), key.party, dtype=np.uint32)
+            paths_np = np.zeros((n_pad, 4), dtype=np.uint32)
+            paths_np[:n] = np.stack(
+                [aes.u128_to_limbs(t) for t in tree_indices]
+            ).astype(np.uint32)
+            seeds_np = np.zeros((n_pad, 4), dtype=np.uint32)
+            seeds_np[:n] = aes.u128_to_limbs(key.seed)
+            control_np = np.zeros((n_pad,), dtype=np.uint32)
+            control_np[:n] = key.party
             seeds, control = self._walk_paths(
                 jnp.asarray(seeds_np),
                 jnp.asarray(control_np),
@@ -1007,25 +1022,24 @@ class DistributedPointFunction:
             ctx.previous_hierarchy_level = hierarchy_level
 
         vc_dev = self._stage_value_correction(key, hierarchy_level)
-        block_indices = jnp.asarray(
-            np.array(
-                [
-                    self._domain_to_block_index(pt, hierarchy_level)
-                    for pt in evaluation_points
-                ],
-                dtype=np.int32,
-            )
-        )
+        block_indices_np = np.zeros((n_pad,), dtype=np.int32)
+        block_indices_np[:n] = [
+            self._domain_to_block_index(pt, hierarchy_level)
+            for pt in evaluation_points
+        ]
         vc_dev = jax.tree_util.tree_map(lambda x: x[None], vc_dev)
-        return _leaf_stage_at(
+        out = _leaf_stage_at(
             seeds,
             control,
             vc_dev,
-            block_indices,
+            jnp.asarray(block_indices_np),
             self.parameters[hierarchy_level].value_type,
             self._blocks_needed[hierarchy_level],
             key.party,
         )
+        if n_pad == n:
+            return out
+        return jax.tree_util.tree_map(lambda x: x[:n], out)
 
     def evaluate_and_apply(self, keys: Sequence[DpfKey],
                            evaluation_points: Sequence[int],

@@ -308,3 +308,13 @@ def test_generate_keys_batch_falls_back_for_other_types():
     out1 = np.asarray(dpf.evaluate_next([], dpf.create_evaluation_context(keys1[0])))
     combined = (out0.astype(np.uint64) + out1.astype(np.uint64)) % (1 << 32)
     assert int(combined[3]) == 7 and int(combined.sum()) == 7
+
+
+def test_generate_keys_batch_validates_alphas():
+    dpf = DPF.create(Params(6, XorType(128)))
+    with pytest.raises(ValueError, match="out of domain"):
+        dpf.generate_keys_batch([-1], [1])
+    with pytest.raises(ValueError, match="out of domain"):
+        dpf.generate_keys_batch([64], [1])
+    with pytest.raises(TypeError, match="integer"):
+        dpf.generate_keys_batch([1.5], [1])
