@@ -182,15 +182,25 @@ class DpfPirServer:
 
 
 class DenseDpfPirServer(DpfPirServer):
-    """PIR over a dense index space (`pir/dense_dpf_pir_server.h:32`)."""
+    """PIR over a dense index space (`pir/dense_dpf_pir_server.h:32`).
 
-    def __init__(self, database: DenseDpfPirDatabase):
+    Pass a `jax.sharding.Mesh` to serve across chips: the database is
+    record-sharded over the mesh and every request runs the sharded
+    expand+inner-product step (`parallel/sharded.py`) with XLA collectives
+    over ICI; without a mesh, requests run the single-device fused
+    pipeline (with the Pallas MXU inner product on TPU).
+    """
+
+    def __init__(self, database: DenseDpfPirDatabase, mesh=None):
         super().__init__()
         if database is None:
             raise ValueError("database cannot be None")
         if database.size <= 0:
             raise ValueError("database must not be empty")
         self._database = database
+        self._mesh = mesh
+        self._sharded_step = None
+        self._sharded_db = None
         self._log_domain_size = max(
             0, math.ceil(math.log2(database.size))
         )
@@ -214,15 +224,18 @@ class DenseDpfPirServer(DpfPirServer):
 
     @classmethod
     def create_plain(
-        cls, database: DenseDpfPirDatabase
+        cls, database: DenseDpfPirDatabase, mesh=None
     ) -> "DenseDpfPirServer":
-        return cls(database)
+        return cls(database, mesh=mesh)
 
     @classmethod
     def create_leader(
-        cls, database: DenseDpfPirDatabase, sender: ForwardHelperRequestFn
+        cls,
+        database: DenseDpfPirDatabase,
+        sender: ForwardHelperRequestFn,
+        mesh=None,
     ) -> "DenseDpfPirServer":
-        server = cls(database)
+        server = cls(database, mesh=mesh)
         server.make_leader(sender)
         return server
 
@@ -231,8 +244,9 @@ class DenseDpfPirServer(DpfPirServer):
         cls,
         database: DenseDpfPirDatabase,
         decrypter: DecryptHelperRequestFn,
+        mesh=None,
     ) -> "DenseDpfPirServer":
-        server = cls(database)
+        server = cls(database, mesh=mesh)
         server.make_helper(decrypter, ENCRYPTION_CONTEXT_INFO)
         return server
 
@@ -265,15 +279,78 @@ class DenseDpfPirServer(DpfPirServer):
                     f"expected {expected_cw}"
                 )
         staged = stage_keys(keys)
-        selections = evaluate_selection_blocks(
-            *staged,
-            walk_levels=self._walk_levels,
-            expand_levels=self._expand_levels,
-            num_blocks=self._num_blocks,
-        )
-        inner_products = self._database.inner_product_with(selections)
+        if self._mesh is not None:
+            inner_products = self._inner_products_sharded(staged, len(keys))
+        else:
+            selections = evaluate_selection_blocks(
+                *staged,
+                walk_levels=self._walk_levels,
+                expand_levels=self._expand_levels,
+                num_blocks=self._num_blocks,
+            )
+            inner_products = self._database.inner_product_with(selections)
         return messages.PirResponse(
             dpf_pir_response=messages.DpfPirResponse(
                 masked_response=inner_products
             )
         )
+
+    # -- multi-chip serving ---------------------------------------------------
+
+    def _ensure_sharded(self):
+        """Build the sharded step and place the record-sharded database
+        (once): rows pad to 128 * mesh size, and the expansion produces the
+        padded block count so every device's bit range is covered."""
+        if self._sharded_step is not None:
+            return
+        import jax.numpy as jnp
+
+        from ..parallel.sharded import (
+            shard_database,
+            sharded_dense_pir_step,
+        )
+
+        ndev = self._mesh.devices.size
+        db = self._database.db_words
+        pad = (-db.shape[0]) % (128 * ndev)
+        if pad:
+            db = jnp.concatenate(
+                [db, jnp.zeros((pad, db.shape[1]), db.dtype)]
+            )
+        num_blocks = db.shape[0] // 128
+        total_levels = self._dpf._tree_levels_needed - 1
+        expand_levels = min(
+            max(0, (num_blocks - 1).bit_length()), total_levels
+        )
+        self._sharded_step = sharded_dense_pir_step(
+            self._mesh,
+            walk_levels=total_levels - expand_levels,
+            expand_levels=expand_levels,
+            num_blocks=num_blocks,
+        )
+        self._sharded_db = shard_database(self._mesh, db)
+
+    def _inner_products_sharded(self, staged, num_keys: int):
+        import numpy as np
+
+        self._ensure_sharded()
+        ndev = self._mesh.devices.size
+        pad = (-num_keys) % ndev
+        if pad:
+            # staged layout: seeds0[nq,4], control0[nq], cw_seeds[L,nq,4],
+            # cw_left[L,nq], cw_right[L,nq], last_vc[nq,4] — pad the query
+            # axis with zero (inert) keys.
+            s0, c0, cs, cl, cr, vc = (np.asarray(a) for a in staged)
+            s0 = np.pad(s0, ((0, pad), (0, 0)))
+            c0 = np.pad(c0, ((0, pad),))
+            cs = np.pad(cs, ((0, 0), (0, pad), (0, 0)))
+            cl = np.pad(cl, ((0, 0), (0, pad)))
+            cr = np.pad(cr, ((0, 0), (0, pad)))
+            vc = np.pad(vc, ((0, pad), (0, 0)))
+            staged = (s0, c0, cs, cl, cr, vc)
+        out = np.asarray(
+            self._sharded_step(*staged, self._sharded_db)
+        )[:num_keys]
+        raw = np.ascontiguousarray(out.astype("<u4")).view(np.uint8)
+        size = self._database.max_value_size
+        return [raw[q, :size].tobytes() for q in range(num_keys)]

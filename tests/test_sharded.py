@@ -157,3 +157,48 @@ def test_sharded_step_rejects_bad_query_count():
             jnp.zeros((nq, 4), jnp.uint32),
             jnp.zeros((1024, 4), jnp.uint32),
         )
+
+
+def test_mesh_server_matches_single_device_server():
+    """DenseDpfPirServer with a mesh must answer byte-identically to the
+    single-device server, including non-divisible query counts."""
+    from distributed_point_functions_tpu.pir.database import (
+        DenseDpfPirDatabase,
+    )
+    from distributed_point_functions_tpu.pir.server import DenseDpfPirServer
+    from distributed_point_functions_tpu.pir import messages
+
+    mesh = require_mesh()
+    num_records = 2000  # pads to 2048 = 128*8*2
+    records = [RNG.bytes(24) for _ in range(num_records)]
+    plain = DenseDpfPirServer.create_plain(DenseDpfPirDatabase(records))
+    sharded = DenseDpfPirServer.create_plain(
+        DenseDpfPirDatabase(records), mesh=mesh
+    )
+
+    client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+    indices = [3, 1999, 777]  # 3 queries: not divisible by 8 devices
+    keys0, keys1 = client._generate_key_pairs(indices)
+    for keys in (keys0, keys1):
+        req = messages.PirRequest(
+            plain_request=messages.PlainRequest(dpf_keys=list(keys))
+        )
+        a = plain.handle_request(req).dpf_pir_response.masked_response
+        b = sharded.handle_request(req).dpf_pir_response.masked_response
+        assert a == b
+
+    # And the two parties' sharded responses combine to the records.
+    from distributed_point_functions_tpu.prng import xor_bytes
+
+    r0 = sharded.handle_request(
+        messages.PirRequest(
+            plain_request=messages.PlainRequest(dpf_keys=list(keys0))
+        )
+    ).dpf_pir_response.masked_response
+    r1 = sharded.handle_request(
+        messages.PirRequest(
+            plain_request=messages.PlainRequest(dpf_keys=list(keys1))
+        )
+    ).dpf_pir_response.masked_response
+    for q, idx in enumerate(indices):
+        assert xor_bytes(r0[q], r1[q]) == records[idx]
