@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# One-shot TPU perf capture for the round: headline bench (+ns/leaf +
+# expansion/IP split), BASELINE large configs, and the DCF/MIC/dpf sweeps.
+# Results land in benchmarks/results/.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p benchmarks/results
+stamp=$(date +%Y%m%d_%H%M%S)
+
+echo "=== headline bench (2^20 x 256B) ==="
+python bench.py 2>benchmarks/results/bench_${stamp}.log \
+    | tee benchmarks/results/bench_${stamp}.json
+tail -20 benchmarks/results/bench_${stamp}.log
+
+echo "=== BASELINE large configs ==="
+python benchmarks/baseline_suite.py --scale full --suite dense_big \
+    2>&1 | tee benchmarks/results/dense_big_${stamp}.json
+python benchmarks/baseline_suite.py --scale full --suite sparse_big \
+    2>&1 | tee benchmarks/results/sparse_big_${stamp}.json
+
+echo "=== reference-mirroring sweeps (big) ==="
+python benchmarks/run_benchmarks.py --suite dcf,mic,inner_product --big \
+    2>&1 | tee benchmarks/results/sweeps_${stamp}.json
+
+echo "=== synthetic hierarchical eval (reference experiments config) ==="
+python benchmarks/synthetic_data_benchmarks.py --log_domain_size 32 \
+    --log_num_nonzeros 20 --num_iterations 3 \
+    2>&1 | tee benchmarks/results/synthetic_${stamp}.json
+
+echo "done: benchmarks/results/*_${stamp}.*"
