@@ -22,7 +22,7 @@ with one slot per key, updated with masked group additions.
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

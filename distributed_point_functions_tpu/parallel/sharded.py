@@ -25,7 +25,7 @@ the sharding checker (`check_vma`) at its default (on).
 
 from __future__ import annotations
 
-from typing import Tuple
+
 
 import jax
 import jax.numpy as jnp

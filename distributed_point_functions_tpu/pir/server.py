@@ -26,7 +26,7 @@ inner product.
 from __future__ import annotations
 
 import math
-from typing import Callable, List, Optional
+from typing import Callable, Optional
 
 from ..dpf import DistributedPointFunction, DpfParameters
 from ..prng import Aes128CtrSeededPrng, xor_bytes

@@ -1,0 +1,61 @@
+"""Profiling hooks — the TPU framework's tracing story (SURVEY.md §5).
+
+The reference's performance introspection is google/benchmark binaries
+plus wall-clock timing in `experiments/synthetic_data_benchmarks.cc`; on
+TPU the equivalent first-class tool is the JAX profiler (xprof traces
+viewable in TensorBoard/Perfetto). This module wraps it so servers and
+benchmarks can capture device traces without importing profiler
+internals:
+
+    with trace("/tmp/dpf-trace"):
+        server.handle_request(request)
+
+    with annotate("expand"):          # named region inside a trace
+        selections = evaluate_selection_blocks(...)
+
+Both are no-ops (with a one-time log) if the profiler is unavailable,
+so library code can call them unconditionally.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+
+logger = logging.getLogger(__name__)
+_warned = False
+
+
+def _profiler():
+    global _warned
+    try:
+        import jax.profiler as prof
+
+        return prof
+    except Exception:  # pragma: no cover - profiler always ships with jax
+        if not _warned:
+            _warned = True
+            logger.info("jax.profiler unavailable; tracing disabled")
+        return None
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_trace: bool = False):
+    """Capture a device trace of the enclosed block into `log_dir`."""
+    prof = _profiler()
+    if prof is None:
+        yield
+        return
+    prof.start_trace(log_dir, create_perfetto_trace=create_perfetto_trace)
+    try:
+        yield
+    finally:
+        prof.stop_trace()
+
+
+def annotate(name: str):
+    """Named sub-region (TraceAnnotation) inside an active trace."""
+    prof = _profiler()
+    if prof is None:
+        return contextlib.nullcontext()
+    return prof.TraceAnnotation(name)

@@ -12,7 +12,10 @@ import secrets
 import numpy as np
 import pytest
 
-from distributed_point_functions_tpu.dcf import DistributedComparisonFunction
+from distributed_point_functions_tpu.dcf import (
+    DcfKey,
+    DistributedComparisonFunction,
+)
 from distributed_point_functions_tpu.fss_gates import (
     Interval,
     MicKey,
@@ -167,3 +170,18 @@ def test_mic_rejects_invalid():
         MultipleIntervalContainmentGate.create(MicParameters_bad)
     with pytest.raises(ValueError):
         gate.batch_eval([gate.gen(0, [0])[0]], [99])
+
+
+def test_dcf_staged_batch_reuse_matches_fresh():
+    """A staged key batch must be reusable across batch_evaluate calls
+    with different points, matching per-call staging bit-for-bit."""
+    dcf = DistributedComparisonFunction.create(8, IntType(32))
+    k0, k1 = dcf.generate_keys(100, 7)
+    keys = [DcfKey(k0.key), DcfKey(k1.key), DcfKey(k0.key)]
+    staged = dcf.stage_keys(keys)
+    for points in ([5, 99, 200], [0, 255, 100]):
+        fresh = np.asarray(dcf.batch_evaluate(keys, points))
+        reused = np.asarray(dcf.batch_evaluate(None, points, staged=staged))
+        np.testing.assert_array_equal(fresh, reused)
+    with pytest.raises(ValueError, match="either keys or staged"):
+        dcf.batch_evaluate(None, [1])
