@@ -78,7 +78,7 @@ class _InitTimeout(RuntimeError):
     pass
 
 
-def _ensure_backend(jax, attempts=3, per_attempt_secs=300):
+def _ensure_backend(jax, attempts=5, per_attempt_secs=240):
     """Initialize the JAX backend with bounded retries and a watchdog.
 
     Round-1 failure mode (BENCH_r01.json): the axon TPU backend raised
@@ -88,7 +88,7 @@ def _ensure_backend(jax, attempts=3, per_attempt_secs=300):
     Returns (devices, None) or (None, last_error).
     """
     last_err = None
-    delay = 15
+    delay = 30
     for attempt in range(1, attempts + 1):
         def _on_alarm(signum, frame):
             raise _InitTimeout(
