@@ -177,3 +177,133 @@ def stage_keys(keys: Sequence[DpfKey]):
         jnp.asarray(cw_right),
         jnp.asarray(last_vc),
     )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "walk_levels", "chunk_bits", "chunk_expand_levels", "num_chunks"
+    ),
+)
+def chunked_pir_inner_products(
+    seeds0: jnp.ndarray,
+    control0: jnp.ndarray,
+    cw_seeds: jnp.ndarray,
+    cw_left: jnp.ndarray,
+    cw_right: jnp.ndarray,
+    last_vc: jnp.ndarray,
+    db_words: jnp.ndarray,
+    *,
+    walk_levels: int,
+    chunk_bits: int,
+    chunk_expand_levels: int,
+    num_chunks: int,
+) -> jnp.ndarray:
+    """Dense-PIR inner products with chunked expansion (long-context mode).
+
+    For databases whose full selection tensor would outgrow HBM
+    (`nq * num_blocks * 16` bytes), the covering subtree is processed in
+    `num_chunks` chunks of `2^chunk_expand_levels` blocks: one `lax.scan`
+    step walks the chunk root's path bits (`chunk_bits` levels), expands
+    only that chunk's subtree, hashes its leaves, and XOR-accumulates the
+    partial inner product against the chunk's record rows — so only one
+    chunk's selections are ever live (the TPU analog of SURVEY.md §5's
+    chunked/blockwise expansion sized to HBM).
+
+    db_words: uint32[num_chunks * 2^chunk_expand_levels * 128, W] (zero
+    rows beyond the real record count). Tree depth must satisfy
+    walk_levels + chunk_bits + chunk_expand_levels == total levels.
+    Returns uint32[nk, W].
+    """
+    clear = jnp.asarray(_CLEAR_LSB)
+    seeds, control = seeds0, control0
+
+    # Phase 1: walk the all-zeros shared prefix (identical to
+    # evaluate_selection_blocks).
+    if walk_levels > 0:
+        def walk_body(carry, x):
+            s, t = carry
+            cw_s, cw_l = x
+            h = aes.mmo_hash(fixed_keys.RK_LEFT, s)
+            h = h ^ jnp.where(t[:, None] != 0, cw_s, U32(0))
+            t_new = h[:, 0] & U32(1)
+            h = h & clear
+            t_new = t_new ^ (t * cw_l)
+            return (h, t_new), None
+
+        (seeds, control), _ = lax.scan(
+            walk_body,
+            (seeds, control),
+            (cw_seeds[:walk_levels], cw_left[:walk_levels]),
+        )
+
+    chunk_records = (1 << chunk_expand_levels) * 128
+    num_words = db_words.shape[1]
+    db_chunks = db_words.reshape(num_chunks, chunk_records, num_words)
+    nk = seeds0.shape[0]
+
+    def chunk_step(acc, xs):
+        c, db_chunk = xs
+        s, t = seeds, control
+
+        # Phase 2a: walk this chunk root's path (bit j of c, MSB first).
+        for j in range(chunk_bits):
+            lvl = walk_levels + j
+            bit = ((c >> (chunk_bits - 1 - j)) & 1).astype(U32)
+            pbit = jnp.broadcast_to(bit, (nk,))
+            h = aes.mmo_hash_select(
+                fixed_keys.RK_LEFT, fixed_keys.RK_RIGHT, pbit, s
+            )
+            h = h ^ jnp.where(t[:, None] != 0, cw_seeds[lvl], U32(0))
+            t_new = h[:, 0] & U32(1)
+            h = h & clear
+            cw_dir = jnp.where(pbit != 0, cw_right[lvl], cw_left[lvl])
+            s, t = h, t_new ^ (t * cw_dir)
+
+        # Phase 2b: expand the chunk subtree (width-doubling, as in
+        # evaluate_selection_blocks phase 2).
+        s = s[:, None, :]
+        t = t[:, None]
+        for i in range(chunk_expand_levels):
+            lvl = walk_levels + chunk_bits + i
+            w = s.shape[1]
+            cw_s = cw_seeds[lvl][:, None, :]
+            cw_l = cw_left[lvl][:, None]
+            cw_r = cw_right[lvl][:, None]
+            doubled = jnp.repeat(s, 2, axis=1)
+            sel = jnp.tile(jnp.arange(2, dtype=U32), w)[None, :]
+            h = aes.mmo_hash_select(
+                fixed_keys.RK_LEFT, fixed_keys.RK_RIGHT, sel, doubled
+            )
+            t2 = jnp.repeat(t, 2, axis=1)
+            h = h ^ jnp.where(t2[..., None] != 0, cw_s, U32(0))
+            t_new = h[..., 0] & U32(1)
+            h = h & clear
+            cw_dir = jnp.where(sel != 0, cw_r, cw_l)
+            s, t = h, t_new ^ (t2 * cw_dir)
+
+        # Phase 3: leaf value blocks -> packed selection bits.
+        v = aes.mmo_hash(fixed_keys.RK_VALUE, s)
+        v = v ^ jnp.where(t[..., None] != 0, last_vc[:, None, :], U32(0))
+        # [nk, chunk_blocks, 4] packed -> bits [nk, chunk_records].
+        words = v.reshape(nk, -1)
+        expanded = jnp.repeat(words, 32, axis=1)
+        shifts = lax.broadcasted_iota(U32, expanded.shape, 1) & U32(31)
+        bits = (expanded >> shifts) & U32(1)
+        # Phase 4: partial XOR inner product against this chunk's rows.
+        mask = (U32(0) - bits)[:, :, None]
+        partial = lax.reduce(
+            mask & db_chunk[None, :, :],
+            U32(0),
+            lambda a, b: lax.bitwise_xor(a, b),
+            (1,),
+        )
+        return acc ^ partial, None
+
+    acc0 = jnp.zeros((nk, num_words), dtype=U32)
+    acc, _ = lax.scan(
+        chunk_step,
+        acc0,
+        (jnp.arange(num_chunks, dtype=jnp.uint32), db_chunks),
+    )
+    return acc

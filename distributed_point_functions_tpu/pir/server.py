@@ -201,6 +201,7 @@ class DenseDpfPirServer(DpfPirServer):
         self._mesh = mesh
         self._sharded_step = None
         self._sharded_db = None
+        self._chunked_db = None
         self._log_domain_size = max(
             0, math.ceil(math.log2(database.size))
         )
@@ -281,6 +282,8 @@ class DenseDpfPirServer(DpfPirServer):
         staged = stage_keys(keys)
         if self._mesh is not None:
             inner_products = self._inner_products_sharded(staged, len(keys))
+        elif self._needs_chunking(len(keys)):
+            inner_products = self._inner_products_chunked(staged, len(keys))
         else:
             selections = evaluate_selection_blocks(
                 *staged,
@@ -294,6 +297,69 @@ class DenseDpfPirServer(DpfPirServer):
                 masked_response=inner_products
             )
         )
+
+    # -- chunked serving (selection tensor larger than the HBM budget) -------
+
+    def _selection_budget_bytes(self) -> int:
+        import os
+
+        return int(
+            os.environ.get("DPF_TPU_SELECTION_BYTES_BUDGET", 1 << 30)
+        )
+
+    def _needs_chunking(self, num_keys: int) -> bool:
+        return (
+            num_keys * self._num_blocks * 16 > self._selection_budget_bytes()
+            and self._expand_levels > 0
+        )
+
+    def _inner_products_chunked(self, staged, num_keys: int):
+        """Serve via `chunked_pir_inner_products`: only one chunk's
+        selection blocks are ever live (SURVEY.md §5 long-context mode)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from .dense_eval import chunked_pir_inner_products
+
+        budget = self._selection_budget_bytes()
+        cel = self._expand_levels
+        while cel > 0 and num_keys * (1 << cel) * 16 > budget:
+            cel -= 1
+        chunk_bits = self._expand_levels - cel
+        chunk_blocks = 1 << cel
+        num_chunks = -(-self._num_blocks // chunk_blocks)
+        # chunk roots are walked with chunk_bits path bits, so the chunk
+        # count cannot exceed 2^chunk_bits.
+        num_chunks = min(num_chunks, 1 << chunk_bits)
+
+        need_rows = num_chunks * chunk_blocks * 128
+        if (
+            self._chunked_db is None
+            or self._chunked_db[0] != need_rows
+        ):
+            db = self._database.db_words
+            pad = need_rows - db.shape[0]
+            if pad > 0:
+                db = jnp.concatenate(
+                    [db, jnp.zeros((pad, db.shape[1]), db.dtype)]
+                )
+            elif pad < 0:
+                db = db[:need_rows]
+            self._chunked_db = (need_rows, db)
+
+        out = np.asarray(
+            chunked_pir_inner_products(
+                *staged,
+                self._chunked_db[1],
+                walk_levels=self._walk_levels,
+                chunk_bits=chunk_bits,
+                chunk_expand_levels=cel,
+                num_chunks=num_chunks,
+            )
+        )
+        raw = np.ascontiguousarray(out.astype("<u4")).view(np.uint8)
+        size = self._database.max_value_size
+        return [raw[q, :size].tobytes() for q in range(num_keys)]
 
     # -- multi-chip serving ---------------------------------------------------
 

@@ -316,3 +316,40 @@ def test_concurrent_plain_requests():
     assert len(results) == 8
     for indices, out in results.values():
         assert out == [records[i] for i in indices]
+
+
+def test_chunked_serving_matches_unchunked(monkeypatch):
+    """With a tiny selection budget the server switches to chunked
+    expansion (`chunked_pir_inner_products`); responses must be
+    byte-identical to the unchunked pipeline."""
+    import numpy as np
+
+    rng = np.random.default_rng(9)
+    records = [rng.bytes(20) for _ in range(1500)]  # 12 blocks, pads oddly
+    plain = DenseDpfPirServer.create_plain(DenseDpfPirDatabase(records))
+    chunked = DenseDpfPirServer.create_plain(DenseDpfPirDatabase(records))
+    # 5 queries x 12 blocks x 16B = 960B > 256B budget -> chunking kicks in.
+    monkeypatch.setenv("DPF_TPU_SELECTION_BYTES_BUDGET", "256")
+    assert chunked._needs_chunking(5)
+
+    client = DenseDpfPirClient.create(1500, encrypt_decrypt.encrypt)
+    indices = [0, 77, 1499, 640, 1024]
+    keys0, keys1 = client._generate_key_pairs(indices)
+    req = messages.PirRequest(
+        plain_request=messages.PlainRequest(dpf_keys=list(keys0))
+    )
+    got = chunked.handle_request(req).dpf_pir_response.masked_response
+    monkeypatch.delenv("DPF_TPU_SELECTION_BYTES_BUDGET")
+    want = plain.handle_request(req).dpf_pir_response.masked_response
+    assert got == want
+
+    # Share correctness through the chunked path for both parties.
+    monkeypatch.setenv("DPF_TPU_SELECTION_BYTES_BUDGET", "256")
+    r0 = chunked.handle_request(req).dpf_pir_response.masked_response
+    r1 = chunked.handle_request(
+        messages.PirRequest(
+            plain_request=messages.PlainRequest(dpf_keys=list(keys1))
+        )
+    ).dpf_pir_response.masked_response
+    for q, idx in enumerate(indices):
+        assert xor_bytes(r0[q], r1[q]) == records[idx]
