@@ -66,38 +66,57 @@ def evaluate_selection_blocks(
     Returns uint32[nk, num_blocks, 4] selection blocks (the first
     `num_blocks` leaves of each key's tree).
     """
+    seeds, control = _walk_zeros(
+        seeds0, control0, cw_seeds[:walk_levels], cw_left[:walk_levels]
+    )
+    seeds, control = _expand_subtree(
+        seeds, control, cw_seeds, cw_left, cw_right,
+        first_level=walk_levels, num_levels=expand_levels,
+    )
+    return _leaf_blocks(seeds, control, last_vc)[:, :num_blocks, :]
+
+
+def _walk_zeros(seeds, control, cw_seeds_w, cw_left_w):
+    """Walk the all-zeros prefix (left child each level): one `lax.scan`
+    over the leading `walk_levels` correction words."""
+    if cw_seeds_w.shape[0] == 0:
+        return seeds, control
     clear = jnp.asarray(_CLEAR_LSB)
-    seeds, control = seeds0, control0
 
-    # Phase 1: walk the all-zeros prefix (left child each level).
-    if walk_levels > 0:
-        def walk_body(carry, x):
-            s, t = carry
-            cw_s, cw_l = x  # [nk, 4], [nk]
-            h = aes.mmo_hash(fixed_keys.RK_LEFT, s)
-            h = h ^ jnp.where(t[:, None] != 0, cw_s, U32(0))
-            t_new = h[:, 0] & U32(1)
-            h = h & clear
-            t_new = t_new ^ (t * cw_l)
-            return (h, t_new), None
+    def walk_body(carry, x):
+        s, t = carry
+        cw_s, cw_l = x  # [nk, 4], [nk]
+        h = aes.mmo_hash(fixed_keys.RK_LEFT, s)
+        h = h ^ jnp.where(t[:, None] != 0, cw_s, U32(0))
+        t_new = h[:, 0] & U32(1)
+        h = h & clear
+        t_new = t_new ^ (t * cw_l)
+        return (h, t_new), None
 
-        (seeds, control), _ = lax.scan(
-            walk_body,
-            (seeds, control),
-            (cw_seeds[:walk_levels], cw_left[:walk_levels]),
-        )
+    (seeds, control), _ = lax.scan(
+        walk_body, (seeds, control), (cw_seeds_w, cw_left_w)
+    )
+    return seeds, control
 
-    # Phase 2: width-doubling expansion of the subtree, all keys batched.
-    # Left and right children are produced by ONE key-selected AES pass per
-    # level (even lanes pick the left PRG key, odd lanes the right), halving
-    # the compiled graph size vs. two separate hashes — the TPU analog of
-    # the reference's per-lane key masking
-    # (`aes_128_fixed_key_hash_hwy.h:123-155`).
+
+def _expand_subtree(
+    seeds, control, cw_seeds, cw_left, cw_right, *, first_level, num_levels
+):
+    """Width-doubling expansion of the subtree, all keys batched.
+
+    seeds: uint32[nk, 4] subtree roots -> uint32[nk, 2^num_levels, 4].
+    Left and right children are produced by ONE key-selected AES pass per
+    level (even lanes pick the left PRG key, odd lanes the right), halving
+    the compiled graph size vs. two separate hashes — the TPU analog of
+    the reference's per-lane key masking
+    (`aes_128_fixed_key_hash_hwy.h:123-155`).
+    """
+    clear = jnp.asarray(_CLEAR_LSB)
     seeds = seeds[:, None, :]  # [nk, w, 4]
     control = control[:, None]  # [nk, w]
-    for i in range(expand_levels):
-        lvl = walk_levels + i
-        nk, w = seeds.shape[:2]
+    for i in range(num_levels):
+        lvl = first_level + i
+        w = seeds.shape[1]
         cw_s = cw_seeds[lvl][:, None, :]  # [nk, 1, 4]
         cw_l = cw_left[lvl][:, None]
         cw_r = cw_right[lvl][:, None]
@@ -114,12 +133,14 @@ def evaluate_selection_blocks(
         t_new = t_new ^ (control2 * cw_dir)
         seeds = h
         control = t_new
+    return seeds, control
 
-    # Phase 3: leaf value blocks (output PRG + XOR value correction; party
-    # negation is the identity for XOR shares).
+
+def _leaf_blocks(seeds, control, last_vc):
+    """Leaf value blocks (output PRG + XOR value correction; party negation
+    is the identity for XOR shares)."""
     v = aes.mmo_hash(fixed_keys.RK_VALUE, seeds)
-    v = v ^ jnp.where(control[..., None] != 0, last_vc[:, None, :], U32(0))
-    return v[:, :num_blocks, :]
+    return v ^ jnp.where(control[..., None] != 0, last_vc[:, None, :], U32(0))
 
 
 def selection_blocks_for_keys(dpf, keys: Sequence[DpfKey], num_blocks: int):
@@ -215,27 +236,13 @@ def chunked_pir_inner_products(
     walk_levels + chunk_bits + chunk_expand_levels == total levels.
     Returns uint32[nk, W].
     """
+    from ..ops.inner_product import xor_inner_product
+
     clear = jnp.asarray(_CLEAR_LSB)
-    seeds, control = seeds0, control0
-
-    # Phase 1: walk the all-zeros shared prefix (identical to
-    # evaluate_selection_blocks).
-    if walk_levels > 0:
-        def walk_body(carry, x):
-            s, t = carry
-            cw_s, cw_l = x
-            h = aes.mmo_hash(fixed_keys.RK_LEFT, s)
-            h = h ^ jnp.where(t[:, None] != 0, cw_s, U32(0))
-            t_new = h[:, 0] & U32(1)
-            h = h & clear
-            t_new = t_new ^ (t * cw_l)
-            return (h, t_new), None
-
-        (seeds, control), _ = lax.scan(
-            walk_body,
-            (seeds, control),
-            (cw_seeds[:walk_levels], cw_left[:walk_levels]),
-        )
+    # Phase 1: walk the all-zeros shared prefix.
+    seeds, control = _walk_zeros(
+        seeds0, control0, cw_seeds[:walk_levels], cw_left[:walk_levels]
+    )
 
     chunk_records = (1 << chunk_expand_levels) * 128
     num_words = db_words.shape[1]
@@ -260,45 +267,17 @@ def chunked_pir_inner_products(
             cw_dir = jnp.where(pbit != 0, cw_right[lvl], cw_left[lvl])
             s, t = h, t_new ^ (t * cw_dir)
 
-        # Phase 2b: expand the chunk subtree (width-doubling, as in
-        # evaluate_selection_blocks phase 2).
-        s = s[:, None, :]
-        t = t[:, None]
-        for i in range(chunk_expand_levels):
-            lvl = walk_levels + chunk_bits + i
-            w = s.shape[1]
-            cw_s = cw_seeds[lvl][:, None, :]
-            cw_l = cw_left[lvl][:, None]
-            cw_r = cw_right[lvl][:, None]
-            doubled = jnp.repeat(s, 2, axis=1)
-            sel = jnp.tile(jnp.arange(2, dtype=U32), w)[None, :]
-            h = aes.mmo_hash_select(
-                fixed_keys.RK_LEFT, fixed_keys.RK_RIGHT, sel, doubled
-            )
-            t2 = jnp.repeat(t, 2, axis=1)
-            h = h ^ jnp.where(t2[..., None] != 0, cw_s, U32(0))
-            t_new = h[..., 0] & U32(1)
-            h = h & clear
-            cw_dir = jnp.where(sel != 0, cw_r, cw_l)
-            s, t = h, t_new ^ (t2 * cw_dir)
-
-        # Phase 3: leaf value blocks -> packed selection bits.
-        v = aes.mmo_hash(fixed_keys.RK_VALUE, s)
-        v = v ^ jnp.where(t[..., None] != 0, last_vc[:, None, :], U32(0))
-        # [nk, chunk_blocks, 4] packed -> bits [nk, chunk_records].
-        words = v.reshape(nk, -1)
-        expanded = jnp.repeat(words, 32, axis=1)
-        shifts = lax.broadcasted_iota(U32, expanded.shape, 1) & U32(31)
-        bits = (expanded >> shifts) & U32(1)
-        # Phase 4: partial XOR inner product against this chunk's rows.
-        mask = (U32(0) - bits)[:, :, None]
-        partial = lax.reduce(
-            mask & db_chunk[None, :, :],
-            U32(0),
-            lambda a, b: lax.bitwise_xor(a, b),
-            (1,),
+        # Phase 2b/3: expand the chunk subtree and hash its leaves.
+        s, t = _expand_subtree(
+            s, t, cw_seeds, cw_left, cw_right,
+            first_level=walk_levels + chunk_bits,
+            num_levels=chunk_expand_levels,
         )
-        return acc ^ partial, None
+        v = _leaf_blocks(s, t, last_vc)  # [nk, chunk_blocks, 4]
+        # Phase 4: partial XOR inner product against this chunk's rows —
+        # via the row-chunked kernel so the masked intermediate stays
+        # bounded (256 rows at a time) regardless of chunk size.
+        return acc ^ xor_inner_product(db_chunk, v), None
 
     acc0 = jnp.zeros((nk, num_words), dtype=U32)
     acc, _ = lax.scan(

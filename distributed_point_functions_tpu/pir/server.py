@@ -26,6 +26,7 @@ inner product.
 from __future__ import annotations
 
 import math
+import threading
 from typing import Callable, Optional
 
 from ..dpf import DistributedPointFunction, DpfParameters
@@ -202,6 +203,7 @@ class DenseDpfPirServer(DpfPirServer):
         self._sharded_step = None
         self._sharded_db = None
         self._chunked_db = None
+        self._chunked_db_lock = threading.Lock()
         self._log_domain_size = max(
             0, math.ceil(math.log2(database.size))
         )
@@ -313,44 +315,57 @@ class DenseDpfPirServer(DpfPirServer):
             and self._expand_levels > 0
         )
 
+    # Chunk-granule cap: the chunked database is padded to a multiple of
+    # 2^_CHUNK_GRANULE_LEVELS blocks once, so the padded buffer (and with
+    # it the scan's chunk arithmetic) is independent of the request's
+    # batch size — alternating batch sizes must not re-pad the database.
+    _CHUNK_GRANULE_LEVELS = 10  # 1024 blocks = 2^17 records per granule
+
+    def _chunked_database(self):
+        """The padded chunked-db buffer (built once, under a lock —
+        handle_plain_request supports concurrent callers)."""
+        with self._chunked_db_lock:
+            if self._chunked_db is None:
+                import jax.numpy as jnp
+
+                granule = 1 << min(
+                    self._expand_levels, self._CHUNK_GRANULE_LEVELS
+                )
+                padded_blocks = -(-self._num_blocks // granule) * granule
+                db = self._database.db_words
+                pad = padded_blocks * 128 - db.shape[0]
+                if pad > 0:
+                    db = jnp.concatenate(
+                        [db, jnp.zeros((pad, db.shape[1]), db.dtype)]
+                    )
+                self._chunked_db = (padded_blocks, db)
+        return self._chunked_db
+
     def _inner_products_chunked(self, staged, num_keys: int):
         """Serve via `chunked_pir_inner_products`: only one chunk's
-        selection blocks are ever live (SURVEY.md §5 long-context mode)."""
-        import jax.numpy as jnp
+        selection blocks are ever live (SURVEY.md §5 long-context mode).
+
+        The budget bounds the live *packed* leaf tensor
+        (nq * chunk_blocks * 16 bytes); the inner product itself runs
+        through the row-chunked kernel, so its intermediates are bounded
+        independently of chunk size.
+        """
         import numpy as np
 
         from .dense_eval import chunked_pir_inner_products
 
+        padded_blocks, db = self._chunked_database()
         budget = self._selection_budget_bytes()
-        cel = self._expand_levels
+        cel = min(self._expand_levels, self._CHUNK_GRANULE_LEVELS)
         while cel > 0 and num_keys * (1 << cel) * 16 > budget:
             cel -= 1
         chunk_bits = self._expand_levels - cel
-        chunk_blocks = 1 << cel
-        num_chunks = -(-self._num_blocks // chunk_blocks)
-        # chunk roots are walked with chunk_bits path bits, so the chunk
-        # count cannot exceed 2^chunk_bits.
-        num_chunks = min(num_chunks, 1 << chunk_bits)
-
-        need_rows = num_chunks * chunk_blocks * 128
-        if (
-            self._chunked_db is None
-            or self._chunked_db[0] != need_rows
-        ):
-            db = self._database.db_words
-            pad = need_rows - db.shape[0]
-            if pad > 0:
-                db = jnp.concatenate(
-                    [db, jnp.zeros((pad, db.shape[1]), db.dtype)]
-                )
-            elif pad < 0:
-                db = db[:need_rows]
-            self._chunked_db = (need_rows, db)
+        num_chunks = padded_blocks >> cel
 
         out = np.asarray(
             chunked_pir_inner_products(
                 *staged,
-                self._chunked_db[1],
+                db,
                 walk_levels=self._walk_levels,
                 chunk_bits=chunk_bits,
                 chunk_expand_levels=cel,
