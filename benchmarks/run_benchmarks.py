@@ -123,10 +123,13 @@ def bench_dcf(big: bool):
     # (`dcf/distributed_comparison_function_benchmark.cc:31-74`).
     for lds in [32, 64] if big else [16, 32]:
         for batch in [64, 256, 1024] if big else [16, 256]:
+            import random as _random
+
             dcf = DistributedComparisonFunction.create(lds, IntType(64))
             k0, k1 = dcf.generate_keys(3, 1)
-            rng = np.random.default_rng(0)
-            xs = [int(x) for x in rng.integers(0, 1 << lds, batch)]
+            # Python randrange: domains beyond 2^63 overflow numpy int64.
+            _r = _random.Random(0)
+            xs = [_r.randrange(1 << lds) for _ in range(batch)]
             keys = [k0 if i % 2 == 0 else k1 for i in range(batch)]
 
             # Key staging is a one-time cost per batch; report it
