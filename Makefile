@@ -1,0 +1,35 @@
+# One-command entries for the TPU-native DPF framework.
+#
+# The reference drives everything through Bazel + .bazelci/presubmit.yml;
+# here the equivalents are pytest (hermetic CPU, 8 virtual devices),
+# protoc codegen, the native C++ oracle build, and the benchmark suites.
+
+PY ?= python
+
+.PHONY: test test-fast protos native bench bench-tpu sweeps dryrun lint
+
+test:          ## full hermetic suite (CPU, virtual 8-device mesh)
+	$(PY) -m pytest tests/ -q
+
+test-fast:     ## quick signal: kernels + protocol smoke
+	$(PY) -m pytest tests/test_aes.py tests/test_pallas.py \
+	    tests/test_proto_validator.py tests/test_hybrid_crypto.py -q
+
+protos:        ## regenerate *_pb2.py from protos/*.proto
+	cd protos && ./generate.sh
+
+native:        ## build the C++ oracle kernels (ctypes-loaded)
+	cd native && ./build.sh
+
+bench:         ## headline benchmark (real TPU; emits one JSON line)
+	$(PY) bench.py
+
+bench-tpu:     ## full hardware capture into benchmarks/results/
+	bash benchmarks/capture_tpu.sh
+
+sweeps:        ## reference-mirroring benchmark sweeps (small shapes)
+	$(PY) benchmarks/run_benchmarks.py
+
+dryrun:        ## driver-style multichip dryrun on 8 virtual CPU devices
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	    $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
