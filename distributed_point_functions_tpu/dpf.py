@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import secrets
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -448,7 +449,7 @@ class DistributedPointFunction:
         return self.generate_keys_incremental(alpha, [beta])
 
     def generate_keys_batch(
-        self, alphas: Sequence[int], betas: Sequence
+        self, alphas: Sequence[int], betas: Sequence, _root_seeds=None
     ) -> Tuple[List[DpfKey], List[DpfKey]]:
         """Generate key pairs for many (alpha, beta) points at once.
 
@@ -493,17 +494,46 @@ class DistributedPointFunction:
         for b in betas:
             vt.validate(b)
 
-        # Root seeds: cryptographically random, both parties.
-        raw = np.frombuffer(
-            secrets.token_bytes(16 * 2 * n), dtype="<u4"
-        ).reshape(2, n, 4).copy()
+        # Root seeds: cryptographically random, both parties (injectable
+        # for the native-vs-numpy differential tests).
+        if _root_seeds is not None:
+            raw = np.ascontiguousarray(_root_seeds, dtype=np.uint32)
+        else:
+            raw = np.frombuffer(
+                secrets.token_bytes(16 * 2 * n), dtype="<u4"
+            ).reshape(2, n, 4).copy()
+        num_cw = self._tree_levels_needed - 1
+        beta_limbs = np.zeros((n, 4), dtype=np.uint32)
+        for i, b in enumerate(betas):
+            beta_limbs[i] = aes.u128_to_limbs(int(b))
+
+        engine = self._keygen_engine()
+        if engine == "native":
+            from . import native as native_mod
+
+            cw8, ctrl8, vc8 = native_mod.keygen_batch_dense(
+                np.ascontiguousarray(raw).view(np.uint8).reshape(2, n, 16),
+                alphas_np,
+                np.ascontiguousarray(beta_limbs).view(np.uint8),
+                num_cw,
+            )
+            cw_seeds = np.ascontiguousarray(cw8).view("<u4").reshape(
+                num_cw, n, 4
+            ).astype(np.uint32)
+            cw_lefts = ctrl8[..., 0].astype(np.uint32)
+            cw_rights = ctrl8[..., 1].astype(np.uint32)
+            vc = np.ascontiguousarray(vc8).view("<u4").reshape(n, 4).astype(
+                np.uint32
+            )
+            return self._assemble_dense_keys(
+                raw, cw_seeds, cw_lefts, cw_rights, vc
+            )
+
         seeds = [raw[0], raw[1]]  # per party: uint32[n, 4]
         control = [
             np.zeros(n, dtype=np.uint32),
             np.ones(n, dtype=np.uint32),
         ]
-
-        num_cw = self._tree_levels_needed - 1
         cw_seeds = np.zeros((num_cw, n, 4), dtype=np.uint32)
         cw_lefts = np.zeros((num_cw, n), dtype=np.uint32)
         cw_rights = np.zeros((num_cw, n), dtype=np.uint32)
@@ -552,11 +582,33 @@ class DistributedPointFunction:
         # (`ComputeValueCorrection`, `distributed_point_function.cc:81-117`).
         ha = aes.mmo_hash_np(fixed_keys.RK_VALUE, seeds[0])
         hb = aes.mmo_hash_np(fixed_keys.RK_VALUE, seeds[1])
-        beta_limbs = np.zeros((n, 4), dtype=np.uint32)
-        for i, b in enumerate(betas):
-            beta_limbs[i] = aes.u128_to_limbs(int(b))
         vc = ha ^ hb ^ beta_limbs
+        return self._assemble_dense_keys(
+            raw, cw_seeds, cw_lefts, cw_rights, vc
+        )
 
+    def _keygen_engine(self) -> str:
+        """'native' (C++ AES-NI batch keygen) or 'numpy'.
+
+        DPF_NATIVE_KEYGEN=0 forces numpy; =1 requires native (raising if
+        the library cannot load); default tries native, falls back quietly.
+        """
+        mode = os.environ.get("DPF_NATIVE_KEYGEN", "auto")
+        if mode == "0":
+            return "numpy"
+        try:
+            from . import native as native_mod
+
+            native_mod.get_lib()
+            return "native"
+        except Exception:
+            if mode == "1":
+                raise
+            return "numpy"
+
+    def _assemble_dense_keys(self, raw, cw_seeds, cw_lefts, cw_rights, vc):
+        n = raw.shape[1]
+        num_cw = cw_seeds.shape[0]
         keys0: List[DpfKey] = []
         keys1: List[DpfKey] = []
         for i in range(n):

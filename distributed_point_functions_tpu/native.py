@@ -159,3 +159,55 @@ def inner_product(db_words: np.ndarray, selections: np.ndarray) -> np.ndarray:
         ctypes.c_int64(nq), ctypes.c_int64(num_blocks), _ptr(out),
     )
     return out
+
+
+def keygen_batch_dense(
+    root_seeds: np.ndarray,
+    alphas: np.ndarray,
+    betas: np.ndarray,
+    levels: int,
+):
+    """Native batched dense-PIR keygen (`native/keygen.cc`).
+
+    root_seeds: uint8[2, n, 16] party-major; alphas: uint64[n];
+    betas: uint8[n, 16]. Returns (cw_seeds uint8[levels, n, 16],
+    cw_ctrl uint8[levels, n, 2], last_vc uint8[n, 16]) — bit-identical
+    to `DistributedPointFunction.generate_keys_batch` on the same seeds.
+    """
+    lib = get_lib()
+    if not hasattr(lib.dpf_keygen_batch_dense, "_configured"):
+        lib.dpf_keygen_batch_dense.argtypes = [
+            ctypes.c_char_p,  # key_left
+            ctypes.c_char_p,  # key_right
+            ctypes.c_char_p,  # key_value
+            ctypes.c_void_p,  # root_seeds
+            ctypes.c_void_p,  # alphas
+            ctypes.c_void_p,  # betas
+            ctypes.c_int,  # levels
+            ctypes.c_int64,  # n
+            ctypes.c_void_p,  # cw_seeds out
+            ctypes.c_void_p,  # cw_ctrl out
+            ctypes.c_void_p,  # last_vc out
+        ]
+        lib.dpf_keygen_batch_dense._configured = True
+    n = alphas.shape[0]
+    root_seeds = _u8(root_seeds)
+    alphas = np.ascontiguousarray(alphas, dtype=np.uint64)
+    betas = _u8(betas)
+    cw_seeds = np.zeros((levels, n, 16), dtype=np.uint8)
+    cw_ctrl = np.zeros((levels, n, 2), dtype=np.uint8)
+    last_vc = np.zeros((n, 16), dtype=np.uint8)
+    lib.dpf_keygen_batch_dense(
+        bytes(fixed_keys.PRG_KEY_LEFT),
+        bytes(fixed_keys.PRG_KEY_RIGHT),
+        bytes(fixed_keys.PRG_KEY_VALUE),
+        _ptr(root_seeds),
+        _ptr(alphas),
+        _ptr(betas),
+        levels,
+        n,
+        _ptr(cw_seeds),
+        _ptr(cw_ctrl),
+        _ptr(last_vc),
+    )
+    return cw_seeds, cw_ctrl, last_vc

@@ -1,4 +1,5 @@
 #include "aes128.h"
+#include <vector>
 
 namespace dpf_native {
 namespace {
@@ -125,6 +126,17 @@ void Aes128EncryptBlocks(const Aes128Key& key, const uint8_t* in, uint8_t* out,
 
 void Aes128MmoHash(const Aes128Key& key, const uint8_t* in, uint8_t* out,
                    int64_t num_blocks) {
+  // Hardware AES when available (cached probe); the bytewise path below is
+  // the oracle/fallback. Batched: sigma all blocks, one pipelined encrypt
+  // pass, then the feed-forward XOR.
+  static const bool have_ni = AesNiSupported();
+  if (have_ni && num_blocks > 1) {
+    std::vector<uint8_t> sig(16 * num_blocks);
+    for (int64_t b = 0; b < num_blocks; ++b) Sigma(in + 16 * b, sig.data() + 16 * b);
+    Aes128EncryptBlocksNi(key, sig.data(), out, num_blocks);
+    for (int64_t i = 0; i < 16 * num_blocks; ++i) out[i] ^= sig[i];
+    return;
+  }
   for (int64_t b = 0; b < num_blocks; ++b) {
     uint8_t sig[16];
     Sigma(in + 16 * b, sig);

@@ -44,6 +44,13 @@ inline void Sigma(const uint8_t in[16], uint8_t out[16]) {
 void Aes128MmoHash(const Aes128Key& key, const uint8_t* in, uint8_t* out,
                    int64_t num_blocks);
 
+
+// AES-NI fast path (aesni.cc, compiled with -maes). Gate on
+// AesNiSupported() before calling.
+bool AesNiSupported();
+void Aes128EncryptBlocksNi(const Aes128Key& key, const uint8_t* in,
+                           uint8_t* out, int64_t num_blocks);
+
 }  // namespace dpf_native
 
 #endif  // DPF_NATIVE_AES128_H_

@@ -2,5 +2,12 @@
 # Build libdpf_native.so (the CPU oracle kernels + ctypes C API).
 set -e
 cd "$(dirname "$0")"
-g++ -O2 -fPIC -shared -std=c++17 -o libdpf_native.so aes128.cc dpf_kernels.cc
+# aesni.cc is the only unit built with -maes; callers gate on
+# AesNiSupported() so the library still loads on machines without AES-NI.
+g++ -O2 -fPIC -maes -std=c++17 -c aesni.cc -o aesni.o
+g++ -O2 -fPIC -std=c++17 -c aes128.cc -o aes128.o
+g++ -O2 -fPIC -std=c++17 -c dpf_kernels.cc -o dpf_kernels.o
+g++ -O2 -fPIC -std=c++17 -c keygen.cc -o keygen.o
+g++ -shared -o libdpf_native.so aes128.o aesni.o dpf_kernels.o keygen.o
+rm -f aes128.o aesni.o dpf_kernels.o keygen.o
 echo "built $(pwd)/libdpf_native.so"
