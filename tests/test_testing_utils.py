@@ -148,3 +148,25 @@ class TestRequestGenerator:
         # OTP seed regenerates the helper's mask stream.
         prng = Aes128CtrSeededPrng(gen.otp_seed)
         assert len(prng.get_random_bytes(16)) == 16
+
+
+def test_profiling_hooks_are_safe_no_ops():
+    """trace/annotate must not require an active profiler backend."""
+    import tempfile
+
+    from distributed_point_functions_tpu.utils import profiling
+
+    with tempfile.TemporaryDirectory() as d:
+        with profiling.trace(d):
+            with profiling.annotate("region"):
+                x = sum(range(10))
+    assert x == 45
+
+
+def test_backend_mode_string():
+    from distributed_point_functions_tpu.utils.runtime import (
+        get_backend_mode_string,
+    )
+
+    s = get_backend_mode_string()
+    assert "backend=" in s and "devices=" in s
