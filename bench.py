@@ -41,6 +41,7 @@ import math
 import os
 import signal
 import sys
+import threading
 import time
 
 import numpy as np
@@ -54,6 +55,9 @@ BASELINE_NS_PER_LEAF = 50.0
 def _log(msg):
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
           flush=True)
+
+
+_EMIT_LOCK = threading.Lock()
 
 
 def _metric_name():
@@ -71,11 +75,57 @@ def _emit(value, vs_baseline, error=None):
     }
     if error:
         line["error"] = str(error)[:400]
-    print(json.dumps(line), flush=True)
+    # Single-shot under a lock: the watchdog thread and the main thread
+    # both funnel through here, and exactly one JSON line may print.
+    with _EMIT_LOCK:
+        if _PROGRESS["done"]:
+            return
+        _PROGRESS["done"] = True
+        print(json.dumps(line), flush=True)
 
 
 class _InitTimeout(RuntimeError):
     pass
+
+
+# Shared progress state for the global watchdog: the main thread records
+# the current stage (and the headline figure once measured); if the TPU
+# tunnel stalls mid-run — observed 2026-07-30: an execution that normally
+# takes 30 ms simply never returns, stuck inside block_until_ready where
+# no Python signal handler can fire — a daemon thread emits the JSON line
+# (best-known value, error noting the stage) and hard-exits the process.
+_PROGRESS = {"stage": "startup", "qps": None, "done": False}
+
+
+def _start_watchdog():
+    # Default must exceed _ensure_backend's worst case (5 x 240s attempts
+    # + 450s of backoff ~= 1650s) so a legitimately-retrying init still
+    # reports its own, more specific, error.
+    timeout = float(os.environ.get("BENCH_TIMEOUT", 2400))
+
+    def watch():
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            time.sleep(5)
+            if _PROGRESS["done"]:
+                return
+        if _PROGRESS["done"]:
+            return
+        qps = _PROGRESS["qps"]
+        _log(
+            f"WATCHDOG: no completion after {timeout:.0f}s "
+            f"(stage: {_PROGRESS['stage']}); emitting and exiting"
+        )
+        _emit(
+            qps or 0.0,
+            (qps or 0.0) / BASELINE_QPS,
+            error=f"watchdog timeout after {timeout:.0f}s during "
+            f"stage '{_PROGRESS['stage']}' (TPU tunnel stall?)",
+        )
+        os._exit(1 if qps is None else 0)
+
+    t = threading.Thread(target=watch, daemon=True, name="bench-watchdog")
+    t.start()
 
 
 def _ensure_backend(jax, attempts=5, per_attempt_secs=240):
@@ -205,7 +255,18 @@ def main():
     num_queries = int(os.environ.get("BENCH_QUERIES", 64))
     iters = max(1, int(os.environ.get("BENCH_ITERS", 16)))
 
+    _start_watchdog()
+    _PROGRESS["stage"] = "backend-init"
+
     import jax
+
+    # The environment's sitecustomize forces jax_platforms="axon,cpu" at
+    # interpreter startup, overriding a plain JAX_PLATFORMS=cpu env var.
+    # BENCH_PLATFORM wins over both (config updates after import do), so a
+    # hermetic CPU run is possible while the tunnel is down.
+    platform = os.environ.get("BENCH_PLATFORM", "")
+    if platform:
+        jax.config.update("jax_platforms", platform)
 
     # Persistent compilation cache: repeat bench runs skip the (large)
     # bitsliced-AES XLA compile.
@@ -240,6 +301,7 @@ def main():
     )
 
     rng = np.random.default_rng(7)
+    _PROGRESS["stage"] = "build-db"
 
     # Database straight to device (skip host record packing for 256MB).
     num_padded = ((num_records + 127) // 128) * 128
@@ -262,6 +324,7 @@ def main():
     # Choose the inner-product path: the Pallas packed-bits kernel if it
     # compiles and is bit-identical to the jnp path on this device.
     use_pallas = os.environ.get("BENCH_NO_PALLAS", "") != "1"
+    _PROGRESS["stage"] = "pallas-check"
     if use_pallas:
         try:
             check_db = jax.device_put(
@@ -304,6 +367,7 @@ def main():
         return inner_product(db, selections)
 
     # Warmup / compile.
+    _PROGRESS["stage"] = "compile"
     _log(
         f"compiling: {num_records} records x {record_bytes}B, "
         f"{num_queries} queries, walk={walk_levels} expand={expand_levels}"
@@ -313,6 +377,7 @@ def main():
     out.block_until_ready()
     _log(f"compile+first run {time.perf_counter() - t_c:.1f}s")
 
+    _PROGRESS["stage"] = "measure"
     per_batch, latency = _slope_time(
         lambda: pir_step(*staged, db_words), iters
     )
@@ -322,6 +387,8 @@ def main():
         _emit(0.0, 0.0, error="degenerate timing slope")
         return
     _log(f"latency {latency * 1e3:.1f} ms, per-batch {per_batch * 1e3:.3f} ms")
+    _PROGRESS["qps"] = num_queries / per_batch
+    _PROGRESS["stage"] = "split-timing"
 
     # Split timing: the inner product alone on precomputed selections, so
     # the log shows how the batch divides between DPF expansion and the
@@ -367,6 +434,7 @@ def main():
         "num_queries": num_queries,
     }
     if os.environ.get("BENCH_SKIP_NSLEAF", "") != "1":
+        _PROGRESS["stage"] = "ns-leaf"
         try:
             _ns_per_leaf(jax, extra)
         except Exception as e:  # noqa: BLE001

@@ -89,10 +89,22 @@ def _ip_kernel(sel_ref, db_ref, out_ref, *, num_value_bits: int):
     def body(b, carry):
         # Selection bits of record class b (records 32g+b), ready for the
         # MXU: [TQ, TG] bf16 of 0/1.
-        sel_b = ((sel_ref[:] >> b.astype(U32)) & U32(1)).astype(BF16)
+        # Mosaic has no direct u32->bf16 cast; hop via i32 -> f32 (values
+        # are 0/1, so every step is exact).
+        sel_b = (
+            ((sel_ref[:] >> b.astype(U32)) & U32(1))
+            .astype(jnp.int32)
+            .astype(F32)
+            .astype(BF16)
+        )
         dbb = db_ref[b]  # [TG, W] u32 — dynamic index on the leading axis
         for j in range(num_value_bits):
-            bits_j = ((dbb >> U32(j)) & U32(1)).astype(BF16)  # [TG, W]
+            bits_j = (
+                ((dbb >> U32(j)) & U32(1))
+                .astype(jnp.int32)
+                .astype(F32)
+                .astype(BF16)
+            )  # [TG, W]
             out_ref[:, j, :] += lax.dot_general(
                 sel_b,
                 bits_j,
