@@ -40,13 +40,19 @@ timeout 3600 python benchmarks/baseline_suite.py --scale full \
 
 echo "=== reference-mirroring sweeps (big) ==="
 timeout 3600 python benchmarks/run_benchmarks.py \
-    --suite dcf,mic,inner_product --big \
+    --suite dpf,dcf,mic,inner_product,int_mod_n --big \
     2>&1 | tee benchmarks/results/sweeps_${stamp}.json || fail=1
 
 echo "=== synthetic hierarchical eval (reference experiments config) ==="
 timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
     --log_domain_size 32 --log_num_nonzeros 20 --num_iterations 3 \
     2>&1 | tee benchmarks/results/synthetic_${stamp}.json || fail=1
+
+echo "=== synthetic direct eval at 2^20 nonzeros (CPU baseline: 0.67s) ==="
+timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
+    --log_domain_size 32 --log_num_nonzeros 20 --only_nonzeros \
+    --num_iterations 3 \
+    2>&1 | tee benchmarks/results/only_nonzeros_${stamp}.json || fail=1
 
 echo "done (fail=$fail): benchmarks/results/*_${stamp}.*"
 exit $fail

@@ -185,6 +185,10 @@ def bench_inner_product(big: bool):
     from distributed_point_functions_tpu.ops.inner_product import (
         xor_inner_product,
     )
+    from distributed_point_functions_tpu.ops.inner_product_pallas import (
+        permute_db_bitmajor,
+        xor_inner_product_pallas_staged,
+    )
 
     rng = np.random.default_rng(0)
     configs = (
@@ -192,23 +196,46 @@ def bench_inner_product(big: bool):
         if big
         else [(1 << 16, 80), (1 << 16, 256)]
     )
+    # The reference benches batch 1-2 (`dense_dpf_pir_database_benchmark
+    # .cc:92-135`); the TPU design amortizes the database pass over a
+    # whole query batch, so the 64-query point is the one that matters.
     for num_records, record_bytes in configs:
         num_padded = ((num_records + 127) // 128) * 128
         words = (record_bytes + 3) // 4
         db = jax.device_put(
             rng.integers(0, 1 << 32, (num_padded, words), dtype=np.uint32)
         )
-        sels = jax.device_put(
-            rng.integers(
-                0, 1 << 32, (1, num_padded // 128, 4), dtype=np.uint32
+        try:
+            db_perm = jax.block_until_ready(permute_db_bitmajor(db))
+        except Exception as e:  # noqa: BLE001
+            db_perm = None
+            print(f"# pallas staging skipped: {e}", flush=True)
+        for nq in [1, 64] if big else [1]:
+            sels = jax.device_put(
+                rng.integers(
+                    0, 1 << 32, (nq, num_padded // 128, 4), dtype=np.uint32
+                )
             )
-        )
 
-        run_timed(
-            f"inner_product_{num_records}x{record_bytes}B",
-            lambda: xor_inner_product(db, sels).block_until_ready(),
-            items=num_records,
-        )
+            run_timed(
+                f"inner_product_jnp_{num_records}x{record_bytes}B_q{nq}",
+                lambda: xor_inner_product(db, sels).block_until_ready(),
+                items=num_records * nq,
+            )
+            if db_perm is None:
+                continue
+            try:
+                run_timed(
+                    f"inner_product_pallas_{num_records}x{record_bytes}B"
+                    f"_q{nq}",
+                    lambda: xor_inner_product_pallas_staged(
+                        db_perm, sels
+                    ).block_until_ready(),
+                    items=num_records * nq,
+                )
+            except Exception as e:  # noqa: BLE001 - CPU backend has no Mosaic
+                print(f"# pallas inner product skipped: {e}", flush=True)
+        del db_perm
 
 
 def bench_int_mod_n(big: bool):

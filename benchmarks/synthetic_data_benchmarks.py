@@ -8,13 +8,20 @@ synthetic workload (plus the expansion-factor cap) are evaluated, mirroring
 the heavy-hitters evaluation strategy of `experiments/README.md:18-24`.
 
 Flags mirror the reference's absl flags:
-  --distribution {uniform,powerlaw10,powerlaw50}  (replaces --input CSVs,
-    which are git-lfs stubs in the reference)
+  --input PATH               CSV whose first column holds the nonzero
+                             indices (`synthetic_data_benchmarks.cc:121-144`;
+                             the reference's checked-in CSVs are git-lfs
+                             stubs, so --distribution synthesizes equivalent
+                             workloads when no file is given)
+  --distribution {uniform,powerlaw10,powerlaw50}
   --log_domain_size N        total domain bits (default 32)
   --log_num_nonzeros N       synthetic workload size (default 14)
   --levels_to_evaluate a,b,c hierarchy levels (default auto: every 2 bits
                              from log_num_nonzeros+1)
   --max_expansion_factor F   cap on per-level expansion (default 4)
+  --only_nonzeros            batched single-point evaluation at the nonzero
+                             indices instead of hierarchical evaluation
+                             (`synthetic_data_benchmarks.cc:55-58,299-302`)
   --num_iterations N
 """
 
@@ -49,6 +56,19 @@ def synthesize_nonzeros(distribution: str, log_domain_size: int, n: int,
     return np.unique(vals)
 
 
+def read_unique_values_from_file(path: str) -> np.ndarray:
+    """Unique integers in the first CSV column, like the reference's
+    `ReadUniqueValuesFromFile` (`synthetic_data_benchmarks.cc:121-144`)."""
+    values = set()
+    with open(path) as f:
+        for line_number, line in enumerate(f):
+            fields = [x.strip() for x in line.split(",") if x.strip()]
+            if not fields:
+                raise ValueError(f"Line {line_number} is empty")
+            values.add(int(fields[0]))
+    return np.array(sorted(values), dtype=np.uint64)
+
+
 def main():
     import os
 
@@ -57,12 +77,18 @@ def main():
 
         jax.config.update("jax_platforms", "cpu")
     parser = argparse.ArgumentParser()
+    parser.add_argument("--input", default="",
+                        help="CSV of nonzero indices (first column)")
     parser.add_argument("--distribution", default="powerlaw10",
                         choices=["uniform", "powerlaw10", "powerlaw50"])
     parser.add_argument("--log_domain_size", type=int, default=32)
     parser.add_argument("--log_num_nonzeros", type=int, default=14)
     parser.add_argument("--levels_to_evaluate", default="")
     parser.add_argument("--max_expansion_factor", type=float, default=4.0)
+    parser.add_argument("--only_nonzeros", action="store_true",
+                        help="batched point eval at the nonzeros instead of "
+                        "hierarchical evaluation (requires --input or "
+                        "--distribution synthesis)")
     parser.add_argument("--num_iterations", type=int, default=1)
     args = parser.parse_args()
 
@@ -82,9 +108,19 @@ def main():
     assert levels[-1] == lds, "last level must be the full domain"
 
     rng = np.random.default_rng(42)
-    nonzeros = synthesize_nonzeros(
-        args.distribution, lds, 1 << args.log_num_nonzeros, rng
-    )
+    if args.input:
+        nonzeros = read_unique_values_from_file(args.input)
+        if not len(nonzeros):
+            raise ValueError(f"--input {args.input} contains no values")
+        if int(nonzeros[-1]) >= (1 << lds):
+            raise ValueError(
+                f"nonzero {int(nonzeros[-1])} out of range for domain "
+                f"2^{lds}"
+            )
+    else:
+        nonzeros = synthesize_nonzeros(
+            args.distribution, lds, 1 << args.log_num_nonzeros, rng
+        )
 
     params = [
         DpfParameters(log_domain_size=l, value_type=IntType(32))
@@ -118,6 +154,18 @@ def main():
                 prefixes = [int(x) for x in live]
         return total_evaluated
 
+    if args.only_nonzeros:
+        # Batched single-point evaluation at the nonzero indices
+        # (`RunBatchedSinglePointEvaluation`,
+        # `synthetic_data_benchmarks.cc:299-302`).
+        points = [int(x) for x in nonzeros]
+        last_level = len(levels) - 1
+
+        def one_iteration():
+            out = dpf.evaluate_at(k0, last_level, points)
+            jax.tree_util.tree_map(lambda x: x.block_until_ready(), out)
+            return len(points)
+
     total = one_iteration()  # warmup + size probe
     t0 = time.perf_counter()
     for _ in range(args.num_iterations):
@@ -127,8 +175,12 @@ def main():
     print(
         json.dumps(
             {
-                "benchmark": "synthetic_hierarchical_eval",
-                "distribution": args.distribution,
+                "benchmark": (
+                    "synthetic_only_nonzeros"
+                    if args.only_nonzeros
+                    else "synthetic_hierarchical_eval"
+                ),
+                "distribution": "file" if args.input else args.distribution,
                 "log_domain_size": lds,
                 "num_nonzeros": len(nonzeros),
                 "levels": levels,

@@ -140,10 +140,62 @@ def sharded_dense_pir_step(
     `nq` is divisible by the mesh size (query-parallel expansion) and `R`
     is divisible by 128*mesh size (record-sharded inner product).
     """
-    ndev = mesh.devices.size
+    multi = sharded_dense_pir_step_multi(
+        mesh,
+        walk_levels=walk_levels,
+        expand_levels=expand_levels,
+        num_blocks=num_blocks,
+        num_databases=1,
+        axis_name=axis_name,
+    )
 
-    def step(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc, db_shard):
-        # Phase A (dp): expand this device's query shard.
+    def run(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
+            db_words):
+        return multi(
+            seeds0, control0, cw_seeds, cw_left, cw_right, last_vc, db_words
+        )[0]
+
+    return run
+
+
+def sharded_dense_pir_step_multi(
+    mesh: Mesh,
+    *,
+    walk_levels: int,
+    expand_levels: int,
+    num_blocks: int,
+    num_databases: int,
+    axis_name: str = "x",
+):
+    """Like `sharded_dense_pir_step`, but one expansion feeds XOR inner
+    products against `num_databases` parallel databases sharing the record
+    axis — the cuckoo-hashed sparse layout, where each bucket has a key
+    row and a value row in two parallel dense databases
+    (`cuckoo_hashed_dpf_pir_database.cc:164-183`): the DPF trees are
+    expanded once per query, not once per sub-database.
+
+    Returns fn(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
+    *db_words) -> tuple of uint32[nq, W_i], with the same divisibility
+    contract as `sharded_dense_pir_step`.
+    """
+    ndev = mesh.devices.size
+    if (1 << expand_levels) < num_blocks:
+        # evaluate_selection_blocks truncates its 2^expand_levels leaves
+        # to num_blocks; a shortfall would silently misalign every
+        # device's record slice (clamped dynamic_slice) — reachable when
+        # mesh padding grows the block count past the DPF tree's leaf
+        # capacity (e.g. 9 padded blocks on a 3-device mesh over a
+        # 2^3-leaf tree).
+        raise ValueError(
+            f"DPF tree produces 2^{expand_levels} = {1 << expand_levels} "
+            f"selection blocks but the (mesh-padded) database needs "
+            f"{num_blocks}; the record count padded to 128*{ndev} devices "
+            "exceeds the tree's leaf capacity — use a mesh size whose "
+            "padding stays within 2^ceil(log2(num_blocks)) blocks"
+        )
+
+    def step(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
+             *db_shards):
         sel_local = evaluate_selection_blocks(
             seeds0,
             control0,
@@ -154,38 +206,49 @@ def sharded_dense_pir_step(
             walk_levels=walk_levels,
             expand_levels=expand_levels,
             num_blocks=num_blocks,
-        )  # [nq/ndev, B, 4]
-        # Gather the full query batch's selections (ICI all-gather).
+        )
         sel_all = lax.all_gather(sel_local, axis_name, tiled=True)
-        # Phase B (db shard): partial XOR inner product on own records.
         idx = lax.axis_index(axis_name)
-        partial = _local_partial_ip(db_shard, sel_all, idx)
-        return partial[None]  # sharded over the mesh axis
+        return tuple(
+            _local_partial_ip(db_shard, sel_all, idx)[None]
+            for db_shard in db_shards
+        )
 
     shard_mapped = jax.shard_map(
         step,
         mesh=mesh,
         in_specs=(
-            P(axis_name),        # seeds0 over queries
-            P(axis_name),        # control0
-            P(None, axis_name),  # cw_seeds [L, nq, 4]
-            P(None, axis_name),  # cw_left
-            P(None, axis_name),  # cw_right
-            P(axis_name),        # last_vc
-            P(axis_name, None),  # db rows
-        ),
-        out_specs=P(axis_name),
+            P(axis_name),
+            P(axis_name),
+            P(None, axis_name),
+            P(None, axis_name),
+            P(None, axis_name),
+            P(axis_name),
+        ) + (P(axis_name, None),) * num_databases,
+        out_specs=(P(axis_name),) * num_databases,
     )
 
     @jax.jit
-    def run(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc, db_words):
+    def run(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
+            *db_words):
+        if len(db_words) != num_databases:
+            raise ValueError(
+                f"expected {num_databases} databases, got {len(db_words)}"
+            )
         _check_divisible("num_queries", seeds0.shape[0], ndev)
-        _check_divisible("num_records", db_words.shape[0], 128 * ndev)
+        for db in db_words:
+            _check_divisible("num_records", db.shape[0], 128 * ndev)
+            if db.shape[0] != num_blocks * 128:
+                raise ValueError(
+                    f"database has {db.shape[0]} rows but the step was "
+                    f"built for num_blocks={num_blocks} "
+                    f"({num_blocks * 128} rows)"
+                )
         partials = shard_mapped(
-            seeds0, control0, cw_seeds, cw_left, cw_right, last_vc, db_words
-        )  # [ndev, nq, W]
-        # Phase C: XOR-combine the partials.
-        return _xor_combine(partials, mesh)
+            seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
+            *db_words,
+        )
+        return tuple(_xor_combine(p, mesh) for p in partials)
 
     return run
 
@@ -194,4 +257,37 @@ def shard_database(mesh: Mesh, db_words: jnp.ndarray, axis_name: str = "x"):
     """Place a database buffer sharded over its record axis."""
     return jax.device_put(
         db_words, NamedSharding(mesh, P(axis_name, None))
+    )
+
+
+def pad_rows_to_mesh(db_words: jnp.ndarray, ndev: int) -> jnp.ndarray:
+    """Zero-pad record rows to a multiple of 128 * ndev (zero rows never
+    contribute to a XOR inner product)."""
+    pad = (-db_words.shape[0]) % (128 * ndev)
+    if pad:
+        db_words = jnp.concatenate(
+            [db_words, jnp.zeros((pad, db_words.shape[1]), db_words.dtype)]
+        )
+    return db_words
+
+
+def pad_staged_queries(staged, ndev: int):
+    """Zero-pad a `stage_keys` tuple's query axis to a multiple of ndev.
+
+    Layout: seeds0[nq,4], control0[nq], cw_seeds[L,nq,4], cw_left[L,nq],
+    cw_right[L,nq], last_vc[nq,4]. Zero keys are inert (their expansion
+    selects nothing real and the caller drops the padded outputs).
+    """
+    nq = np.asarray(staged[0]).shape[0]
+    pad = (-nq) % ndev
+    if not pad:
+        return staged
+    s0, c0, cs, cl, cr, vc = (np.asarray(a) for a in staged)
+    return (
+        np.pad(s0, ((0, pad), (0, 0))),
+        np.pad(c0, ((0, pad),)),
+        np.pad(cs, ((0, 0), (0, pad), (0, 0))),
+        np.pad(cl, ((0, 0), (0, pad))),
+        np.pad(cr, ((0, 0), (0, pad))),
+        np.pad(vc, ((0, pad), (0, 0))),
     )

@@ -384,20 +384,14 @@ class DenseDpfPirServer(DpfPirServer):
         padded block count so every device's bit range is covered."""
         if self._sharded_step is not None:
             return
-        import jax.numpy as jnp
-
         from ..parallel.sharded import (
+            pad_rows_to_mesh,
             shard_database,
             sharded_dense_pir_step,
         )
 
         ndev = self._mesh.devices.size
-        db = self._database.db_words
-        pad = (-db.shape[0]) % (128 * ndev)
-        if pad:
-            db = jnp.concatenate(
-                [db, jnp.zeros((pad, db.shape[1]), db.dtype)]
-            )
+        db = pad_rows_to_mesh(self._database.db_words, ndev)
         num_blocks = db.shape[0] // 128
         total_levels = self._dpf._tree_levels_needed - 1
         expand_levels = min(
@@ -414,21 +408,10 @@ class DenseDpfPirServer(DpfPirServer):
     def _inner_products_sharded(self, staged, num_keys: int):
         import numpy as np
 
+        from ..parallel.sharded import pad_staged_queries
+
         self._ensure_sharded()
-        ndev = self._mesh.devices.size
-        pad = (-num_keys) % ndev
-        if pad:
-            # staged layout: seeds0[nq,4], control0[nq], cw_seeds[L,nq,4],
-            # cw_left[L,nq], cw_right[L,nq], last_vc[nq,4] — pad the query
-            # axis with zero (inert) keys.
-            s0, c0, cs, cl, cr, vc = (np.asarray(a) for a in staged)
-            s0 = np.pad(s0, ((0, pad), (0, 0)))
-            c0 = np.pad(c0, ((0, pad),))
-            cs = np.pad(cs, ((0, 0), (0, pad), (0, 0)))
-            cl = np.pad(cl, ((0, 0), (0, pad)))
-            cr = np.pad(cr, ((0, 0), (0, pad)))
-            vc = np.pad(vc, ((0, pad), (0, 0)))
-            staged = (s0, c0, cs, cl, cr, vc)
+        staged = pad_staged_queries(staged, self._mesh.devices.size)
         out = np.asarray(
             self._sharded_step(*staged, self._sharded_db)
         )[:num_keys]

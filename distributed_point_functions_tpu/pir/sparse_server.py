@@ -38,7 +38,7 @@ class CuckooHashingSparseDpfPirServer(DpfPirServer):
     """See module docstring."""
 
     def __init__(self, params: CuckooHashingParams,
-                 database: CuckooHashedDpfPirDatabase):
+                 database: CuckooHashedDpfPirDatabase, mesh=None):
         super().__init__()
         if params.num_buckets <= 0:
             raise ValueError("num_buckets must be positive")
@@ -53,6 +53,12 @@ class CuckooHashingSparseDpfPirServer(DpfPirServer):
             )
         self._params = params
         self._database = database
+        # Multi-chip serving: bucket rows of BOTH parallel dense databases
+        # sharded over the mesh, one expansion per query batch
+        # (`parallel/sharded.py:sharded_dense_pir_step_multi`).
+        self._mesh = mesh
+        self._sharded_step = None
+        self._sharded_dbs = None
         log_domain_size = max(0, math.ceil(math.log2(params.num_buckets)))
         self._dpf = DistributedPointFunction.create(
             DpfParameters(
@@ -82,20 +88,20 @@ class CuckooHashingSparseDpfPirServer(DpfPirServer):
     # -- constructors -------------------------------------------------------
 
     @classmethod
-    def create_plain(cls, params, database):
-        return cls(params, database)
+    def create_plain(cls, params, database, mesh=None):
+        return cls(params, database, mesh=mesh)
 
     @classmethod
     def create_leader(cls, params, database,
-                      sender: ForwardHelperRequestFn):
-        server = cls(params, database)
+                      sender: ForwardHelperRequestFn, mesh=None):
+        server = cls(params, database, mesh=mesh)
         server.make_leader(sender)
         return server
 
     @classmethod
     def create_helper(cls, params, database,
-                      decrypter: DecryptHelperRequestFn):
-        server = cls(params, database)
+                      decrypter: DecryptHelperRequestFn, mesh=None):
+        server = cls(params, database, mesh=mesh)
         server.make_helper(decrypter, ENCRYPTION_CONTEXT_INFO)
         return server
 
@@ -137,10 +143,13 @@ class CuckooHashingSparseDpfPirServer(DpfPirServer):
                     f"key has {len(key.correction_words)} correction words, "
                     f"expected {expected_cw}"
                 )
-        selections = selection_blocks_for_keys(
-            self._dpf, keys, self._num_blocks
-        )
-        pairs = self._database.inner_product_with(selections)
+        if self._mesh is not None:
+            pairs = self._inner_products_sharded(keys)
+        else:
+            selections = selection_blocks_for_keys(
+                self._dpf, keys, self._num_blocks
+            )
+            pairs = self._database.inner_product_with(selections)
         masked = []
         for key_bytes, value_bytes in pairs:
             masked.append(key_bytes)
@@ -148,3 +157,67 @@ class CuckooHashingSparseDpfPirServer(DpfPirServer):
         return messages.PirResponse(
             dpf_pir_response=messages.DpfPirResponse(masked_response=masked)
         )
+
+    # -- multi-chip serving -------------------------------------------------
+
+    def _ensure_sharded(self):
+        """Build the two-database sharded step once: bucket rows pad to
+        128 * mesh size; the expansion covers the padded block count so
+        every device's bit range is defined."""
+        if self._sharded_step is not None:
+            return
+        from ..parallel.sharded import (
+            pad_rows_to_mesh,
+            shard_database,
+            sharded_dense_pir_step_multi,
+        )
+
+        ndev = self._mesh.devices.size
+        dbs = [
+            pad_rows_to_mesh(dense.db_words, ndev)
+            for dense in (self._database._key_database,
+                          self._database._value_database)
+        ]
+        padded_blocks = dbs[0].shape[0] // 128
+        total_levels = self._dpf._tree_levels_needed - 1
+        expand_levels = min(
+            max(0, (padded_blocks - 1).bit_length()), total_levels
+        )
+        self._sharded_step = sharded_dense_pir_step_multi(
+            self._mesh,
+            walk_levels=total_levels - expand_levels,
+            expand_levels=expand_levels,
+            num_blocks=padded_blocks,
+            num_databases=2,
+        )
+        self._sharded_dbs = tuple(
+            shard_database(self._mesh, db) for db in dbs
+        )
+
+    def _inner_products_sharded(self, keys):
+        import numpy as np
+
+        from ..parallel.sharded import pad_staged_queries
+        from .dense_eval import stage_keys
+
+        self._ensure_sharded()
+        num_keys = len(keys)
+        staged = pad_staged_queries(
+            stage_keys(keys), self._mesh.devices.size
+        )
+        out_keys, out_values = self._sharded_step(
+            *staged, *self._sharded_dbs
+        )
+        results = []
+        for dense, out in (
+            (self._database._key_database, out_keys),
+            (self._database._value_database, out_values),
+        ):
+            raw = np.ascontiguousarray(
+                np.asarray(out)[:num_keys].astype("<u4")
+            ).view(np.uint8)
+            size = dense.max_value_size
+            results.append(
+                [raw[q, :size].tobytes() for q in range(num_keys)]
+            )
+        return list(zip(results[0], results[1]))

@@ -202,3 +202,83 @@ def test_mesh_server_matches_single_device_server():
     ).dpf_pir_response.masked_response
     for q, idx in enumerate(indices):
         assert xor_bytes(r0[q], r1[q]) == records[idx]
+
+
+def test_mesh_sparse_server_matches_single_device_server():
+    """CuckooHashingSparseDpfPirServer with a mesh: one expansion feeds
+    both bucket databases (`sharded_dense_pir_step_multi`), and responses
+    are byte-identical to the single-device server."""
+    from distributed_point_functions_tpu.pir.cuckoo_database import (
+        CuckooHashedDpfPirDatabase,
+    )
+    from distributed_point_functions_tpu.pir.sparse_client import (
+        CuckooHashingSparseDpfPirClient,
+    )
+    from distributed_point_functions_tpu.pir.sparse_server import (
+        CuckooHashingSparseDpfPirServer,
+    )
+    from distributed_point_functions_tpu.pir import messages
+
+    mesh = require_mesh()
+    num_keys = 700
+    pairs = [
+        (b"key-%04d" % i, b"value-%04d" % i) for i in range(num_keys)
+    ]
+    params = CuckooHashingSparseDpfPirServer.generate_params(
+        num_keys, seed=b"0123456789abcdef"
+    )
+    builder = CuckooHashedDpfPirDatabase.Builder().set_params(params)
+    for kv in pairs:
+        builder.insert(kv)
+    db = builder.build()
+
+    plain = CuckooHashingSparseDpfPirServer.create_plain(params, db)
+    sharded = CuckooHashingSparseDpfPirServer.create_plain(
+        params, db, mesh=mesh
+    )
+
+    client = CuckooHashingSparseDpfPirClient.create_from_public_params(
+        plain.get_public_params().SerializeToString(), lambda pt, ci: pt
+    )
+    queries = [b"key-0003", b"key-0699", b"no-such-key"]
+    req0, req1 = client.create_plain_requests(queries)
+
+    a = plain.handle_request(req0).dpf_pir_response.masked_response
+    b = sharded.handle_request(req0).dpf_pir_response.masked_response
+    assert a == b
+
+    # Combining both parties' sharded responses answers the queries.
+    from distributed_point_functions_tpu.pir.sparse_client import (
+        _is_prefix_padded_with_zeros,
+    )
+    from distributed_point_functions_tpu.prng import xor_bytes
+
+    r0 = sharded.handle_request(req0).dpf_pir_response.masked_response
+    r1 = sharded.handle_request(req1).dpf_pir_response.masked_response
+    combined = [xor_bytes(x, y) for x, y in zip(r0, r1)]
+    expected = {queries[0]: b"value-0003", queries[1]: b"value-0699"}
+    num_hashes = params.num_hash_functions
+    for i, q in enumerate(queries):
+        found = None
+        for j in range(num_hashes):
+            idx = 2 * (num_hashes * i + j)
+            if found is None and _is_prefix_padded_with_zeros(
+                combined[idx], q
+            ):
+                found = combined[idx + 1]
+        if q in expected:
+            assert found is not None
+            assert found[: len(expected[q])] == expected[q]
+        else:
+            assert found is None or all(b == 0 for b in found)
+
+
+def test_sharded_step_rejects_block_capacity_shortfall():
+    """If mesh padding pushes the block count past the DPF tree's leaf
+    capacity (2^expand_levels), the step must refuse loudly instead of
+    silently misaligning record slices (clamped dynamic_slice)."""
+    mesh = require_mesh()
+    with pytest.raises(ValueError, match="leaf capacity"):
+        sharded_dense_pir_step(
+            mesh, walk_levels=0, expand_levels=3, num_blocks=9
+        )
