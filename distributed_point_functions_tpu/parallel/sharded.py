@@ -179,20 +179,10 @@ def sharded_dense_pir_step_multi(
     contract as `sharded_dense_pir_step`.
     """
     ndev = mesh.devices.size
-    if (1 << expand_levels) < num_blocks:
-        # evaluate_selection_blocks truncates its 2^expand_levels leaves
-        # to num_blocks; a shortfall would silently misalign every
-        # device's record slice (clamped dynamic_slice) — reachable when
-        # mesh padding grows the block count past the DPF tree's leaf
-        # capacity (e.g. 9 padded blocks on a 3-device mesh over a
-        # 2^3-leaf tree).
-        raise ValueError(
-            f"DPF tree produces 2^{expand_levels} = {1 << expand_levels} "
-            f"selection blocks but the (mesh-padded) database needs "
-            f"{num_blocks}; the record count padded to 128*{ndev} devices "
-            "exceeds the tree's leaf capacity — use a mesh size whose "
-            "padding stays within 2^ceil(log2(num_blocks)) blocks"
-        )
+    # num_blocks beyond the tree's 2^expand_levels leaf capacity is served
+    # by zero selection blocks (evaluate_selection_blocks pads): only
+    # guaranteed-zero padding rows live there, e.g. a small database
+    # mesh-padded to 128*ndev rows.
 
     def step(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
              *db_shards):
@@ -278,7 +268,7 @@ def pad_staged_queries(staged, ndev: int):
     cw_right[L,nq], last_vc[nq,4]. Zero keys are inert (their expansion
     selects nothing real and the caller drops the padded outputs).
     """
-    nq = np.asarray(staged[0]).shape[0]
+    nq = staged[0].shape[0]
     pad = (-nq) % ndev
     if not pad:
         return staged

@@ -114,6 +114,17 @@ class CuckooHashedDpfPirDatabase:
         return self._num_buckets
 
     @property
+    def key_database(self) -> DenseDpfPirDatabase:
+        """The parallel dense database of bucket keys (mesh serving and
+        diagnostics; treat as read-only)."""
+        return self._key_database
+
+    @property
+    def value_database(self) -> DenseDpfPirDatabase:
+        """The parallel dense database of bucket values."""
+        return self._value_database
+
+    @property
     def num_selection_blocks(self) -> int:
         return self._key_database.num_selection_blocks
 

@@ -33,6 +33,20 @@ from ..ops.inner_product_pallas import (
 )
 
 
+def words_to_record_bytes(
+    out: np.ndarray, num_keys: int, size: int
+) -> List[bytes]:
+    """uint32[nq, W] inner products -> per-query record byte strings.
+
+    Little-endian words, truncated to the database's record size (the
+    reference's result convention, `inner_product_hwy.cc:271-272`). The
+    single home of this codec — the servers' sharded/chunked paths and the
+    database all share it.
+    """
+    raw = np.ascontiguousarray(out[:num_keys].astype("<u4")).view(np.uint8)
+    return [raw[q, :size].tobytes() for q in range(num_keys)]
+
+
 class DenseDpfPirDatabase:
     """Immutable dense database; construct via `DenseDpfPirDatabase.Builder`."""
 
@@ -174,8 +188,4 @@ class DenseDpfPirDatabase:
             pad = needed - selections.shape[1]
             selections = jnp.pad(selections, ((0, 0), (0, pad), (0, 0)))
         out = np.asarray(self._inner_product_device(selections))
-        raw = np.ascontiguousarray(out.astype("<u4")).view(np.uint8)
-        return [
-            raw[q, : self._max_value_size].tobytes()
-            for q in range(out.shape[0])
-        ]
+        return words_to_record_bytes(out, out.shape[0], self._max_value_size)

@@ -73,7 +73,16 @@ def evaluate_selection_blocks(
         seeds, control, cw_seeds, cw_left, cw_right,
         first_level=walk_levels, num_levels=expand_levels,
     )
-    return _leaf_blocks(seeds, control, last_vc)[:, :num_blocks, :]
+    sel = _leaf_blocks(seeds, control, last_vc)[:, :num_blocks, :]
+    if sel.shape[1] < num_blocks:
+        # num_blocks beyond the tree's 2^expand_levels leaf capacity can
+        # only arise from padding the database rows (e.g. to a mesh-size
+        # multiple): those rows are guaranteed all-zero, so zero selection
+        # blocks serve them correctly.
+        sel = jnp.pad(
+            sel, ((0, 0), (0, num_blocks - sel.shape[1]), (0, 0))
+        )
+    return sel
 
 
 def _walk_zeros(seeds, control, cw_seeds_w, cw_left_w):

@@ -362,6 +362,8 @@ class DenseDpfPirServer(DpfPirServer):
         chunk_bits = self._expand_levels - cel
         num_chunks = padded_blocks >> cel
 
+        from .database import words_to_record_bytes
+
         out = np.asarray(
             chunked_pir_inner_products(
                 *staged,
@@ -372,9 +374,9 @@ class DenseDpfPirServer(DpfPirServer):
                 num_chunks=num_chunks,
             )
         )
-        raw = np.ascontiguousarray(out.astype("<u4")).view(np.uint8)
-        size = self._database.max_value_size
-        return [raw[q, :size].tobytes() for q in range(num_keys)]
+        return words_to_record_bytes(
+            out, num_keys, self._database.max_value_size
+        )
 
     # -- multi-chip serving ---------------------------------------------------
 
@@ -409,12 +411,11 @@ class DenseDpfPirServer(DpfPirServer):
         import numpy as np
 
         from ..parallel.sharded import pad_staged_queries
+        from .database import words_to_record_bytes
 
         self._ensure_sharded()
         staged = pad_staged_queries(staged, self._mesh.devices.size)
-        out = np.asarray(
-            self._sharded_step(*staged, self._sharded_db)
-        )[:num_keys]
-        raw = np.ascontiguousarray(out.astype("<u4")).view(np.uint8)
-        size = self._database.max_value_size
-        return [raw[q, :size].tobytes() for q in range(num_keys)]
+        out = np.asarray(self._sharded_step(*staged, self._sharded_db))
+        return words_to_record_bytes(
+            out, num_keys, self._database.max_value_size
+        )

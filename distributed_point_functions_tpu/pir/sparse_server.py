@@ -175,8 +175,8 @@ class CuckooHashingSparseDpfPirServer(DpfPirServer):
         ndev = self._mesh.devices.size
         dbs = [
             pad_rows_to_mesh(dense.db_words, ndev)
-            for dense in (self._database._key_database,
-                          self._database._value_database)
+            for dense in (self._database.key_database,
+                          self._database.value_database)
         ]
         padded_blocks = dbs[0].shape[0] // 128
         total_levels = self._dpf._tree_levels_needed - 1
@@ -208,16 +208,15 @@ class CuckooHashingSparseDpfPirServer(DpfPirServer):
         out_keys, out_values = self._sharded_step(
             *staged, *self._sharded_dbs
         )
-        results = []
-        for dense, out in (
-            (self._database._key_database, out_keys),
-            (self._database._value_database, out_values),
-        ):
-            raw = np.ascontiguousarray(
-                np.asarray(out)[:num_keys].astype("<u4")
-            ).view(np.uint8)
-            size = dense.max_value_size
-            results.append(
-                [raw[q, :size].tobytes() for q in range(num_keys)]
+        from .database import words_to_record_bytes
+
+        results = [
+            words_to_record_bytes(
+                np.asarray(out), num_keys, dense.max_value_size
             )
+            for dense, out in (
+                (self._database.key_database, out_keys),
+                (self._database.value_database, out_values),
+            )
+        ]
         return list(zip(results[0], results[1]))

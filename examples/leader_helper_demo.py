@@ -1,0 +1,323 @@
+"""Two-server dense PIR over real TCP sockets — the deployment model.
+
+The reference keeps the Leader->Helper transport abstract behind an
+injected callback (`pir/dpf_pir_server.h:92-109`: "transport-agnostic; no
+RPC stack in-repo"); its tests play the network with in-process lambdas.
+This demo runs the same protocol across OS processes, with the proto wire
+format (`protos/private_information_retrieval.proto`) framed over TCP:
+
+    client ──LeaderRequest──> leader ──EncryptedHelperRequest──> helper
+           <─masked response─        <──masked helper response──
+
+The helper leg is encrypted end-to-end (client -> helper) with the
+framework's X25519 + HKDF + AES-GCM hybrid scheme; the leader only ever
+sees ciphertext. Responses are one-time-pad masked with the client's
+AES-CTR seed, so the leader cannot read the helper's share either
+(`pir/dpf_pir_server.cc:147-193` semantics).
+
+Run it in one command (spawns helper + leader subprocesses, queries them,
+checks the answers):
+
+    python examples/leader_helper_demo.py --demo
+
+or run the roles by hand in three terminals:
+
+    python examples/leader_helper_demo.py --role helper --port 9001
+    python examples/leader_helper_demo.py --role leader --port 9000 \
+        --helper ::1:9001
+    python examples/leader_helper_demo.py --role client --leader ::1:9000 \
+        --indices 3,42,99
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import socketserver
+import struct
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+NUM_RECORDS = 512
+RECORD_BYTES = 32
+
+
+# ---------------------------------------------------------------------------
+# Message framing: 4-byte big-endian length prefix per proto message.
+# ---------------------------------------------------------------------------
+
+
+def send_msg(sock: socket.socket, data: bytes) -> None:
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def recv_msg(sock: socket.socket) -> bytes:
+    header = _recv_exact(sock, 4)
+    (length,) = struct.unpack(">I", header)
+    if length > (1 << 30):
+        raise ValueError(f"unreasonable message length {length}")
+    return _recv_exact(sock, length)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed the connection")
+        buf += chunk
+    return buf
+
+
+def _parse_hostport(s: str) -> tuple[str, int]:
+    host, _, port = s.rpartition(":")
+    return host or "localhost", int(port)
+
+
+# ---------------------------------------------------------------------------
+# Shared fixture: every role derives the same database deterministically
+# (a real deployment would load it from storage).
+# ---------------------------------------------------------------------------
+
+
+def build_database():
+    from distributed_point_functions_tpu.pir.database import (
+        DenseDpfPirDatabase,
+    )
+
+    records = [
+        (b"record-%04d:" % i).ljust(RECORD_BYTES, b".")
+        for i in range(NUM_RECORDS)
+    ]
+    builder = DenseDpfPirDatabase.Builder()
+    for r in records:
+        builder.insert(r)
+    return builder.build(), records
+
+
+def serve(port: int, handle, name: str):
+    """Framed request->response loop; one message per connection round."""
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            while True:
+                try:
+                    data = recv_msg(self.request)
+                except (ConnectionError, struct.error):
+                    return
+                send_msg(self.request, handle(data))
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    with Server(("", port), Handler) as server:
+        print(f"[{name}] listening on :{port}", flush=True)
+        server.serve_forever()
+
+
+# ---------------------------------------------------------------------------
+# Roles
+# ---------------------------------------------------------------------------
+
+
+def run_helper(port: int) -> None:
+    from distributed_point_functions_tpu import serialization
+    from distributed_point_functions_tpu.pir.server import DenseDpfPirServer
+    from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+    db, _ = build_database()
+    server = DenseDpfPirServer.create_helper(db, encrypt_decrypt.decrypt)
+
+    def handle(data: bytes) -> bytes:
+        from distributed_point_functions_tpu.protos import (
+            private_information_retrieval_pb2 as pir_pb2,
+        )
+
+        req_proto = pir_pb2.PirRequest.FromString(data)
+        request = serialization.pir_request_from_proto(server.dpf, req_proto)
+        response = server.handle_request(request)
+        return serialization.pir_response_to_proto(
+            response
+        ).SerializeToString()
+
+    serve(port, handle, "helper")
+
+
+def run_leader(port: int, helper_addr: str) -> None:
+    from distributed_point_functions_tpu import serialization
+    from distributed_point_functions_tpu.pir import messages
+    from distributed_point_functions_tpu.pir.server import DenseDpfPirServer
+
+    db, _ = build_database()
+    helper_host, helper_port = _parse_hostport(helper_addr)
+
+    def sender(helper_request, while_waiting):
+        """Forward the encrypted request over TCP; compute the leader's own
+        share while the helper works (`dpf_pir_server.cc:108-110`)."""
+        wire = serialization.pir_request_to_proto(
+            server.dpf, helper_request
+        ).SerializeToString()
+        with socket.create_connection((helper_host, helper_port)) as s:
+            send_msg(s, wire)
+            while_waiting()
+            data = recv_msg(s)
+        from distributed_point_functions_tpu.protos import (
+            private_information_retrieval_pb2 as pir_pb2,
+        )
+
+        return serialization.pir_response_from_proto(
+            pir_pb2.PirResponse.FromString(data)
+        )
+
+    server = DenseDpfPirServer.create_leader(db, sender)
+
+    def handle(data: bytes) -> bytes:
+        from distributed_point_functions_tpu.protos import (
+            private_information_retrieval_pb2 as pir_pb2,
+        )
+
+        req_proto = pir_pb2.PirRequest.FromString(data)
+        request = serialization.pir_request_from_proto(server.dpf, req_proto)
+        response = server.handle_request(request)
+        return serialization.pir_response_to_proto(
+            response
+        ).SerializeToString()
+
+    _ = messages  # imported for side-effect-free type reference
+    serve(port, handle, "leader")
+
+
+def run_client(leader_addr: str, indices: list[int]) -> list[bytes]:
+    from distributed_point_functions_tpu import serialization
+    from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
+    from distributed_point_functions_tpu.protos import (
+        private_information_retrieval_pb2 as pir_pb2,
+    )
+    from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+    client = DenseDpfPirClient.create(NUM_RECORDS, encrypt_decrypt.encrypt)
+    request, state = client.create_request(indices)
+    wire = serialization.pir_request_to_proto(
+        client.dpf, request
+    ).SerializeToString()
+
+    host, port = _parse_hostport(leader_addr)
+    with socket.create_connection((host, port)) as s:
+        send_msg(s, wire)
+        data = recv_msg(s)
+    response = serialization.pir_response_from_proto(
+        pir_pb2.PirResponse.FromString(data)
+    )
+    return client.handle_response(response, state)
+
+
+# ---------------------------------------------------------------------------
+# One-command demo
+# ---------------------------------------------------------------------------
+
+
+def wait_listening(port: int, proc: subprocess.Popen, timeout: float = 300):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"subprocess exited early with rc={proc.returncode}"
+            )
+        try:
+            with socket.create_connection(("localhost", port), timeout=1):
+                return
+        except OSError:
+            time.sleep(0.5)
+    raise TimeoutError(f"port {port} never came up")
+
+
+def run_demo(base_port: int, platform: str) -> None:
+    helper_port, leader_port = base_port + 1, base_port
+    env = dict(os.environ)
+    me = os.path.abspath(__file__)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, me, "--role", "helper",
+             "--port", str(helper_port), "--platform", platform],
+            env=env,
+        ),
+        subprocess.Popen(
+            [sys.executable, me, "--role", "leader",
+             "--port", str(leader_port),
+             "--helper", f"localhost:{helper_port}",
+             "--platform", platform],
+            env=env,
+        ),
+    ]
+    try:
+        wait_listening(helper_port, procs[0])
+        wait_listening(leader_port, procs[1])
+        indices = [3, 42, NUM_RECORDS - 1]
+        t0 = time.perf_counter()
+        got = run_client(f"localhost:{leader_port}", indices)
+        dt = time.perf_counter() - t0
+        _, records = build_database()
+        for idx, rec in zip(indices, got):
+            status = "OK" if rec == records[idx] else "MISMATCH"
+            print(f"index {idx}: {rec!r}  [{status}]")
+        if [records[i] for i in indices] != got:
+            raise SystemExit("demo FAILED: responses do not match records")
+        print(f"demo OK: {len(indices)} private queries in {dt:.2f}s "
+              "across three processes")
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--role", choices=["helper", "leader", "client"])
+    ap.add_argument("--port", type=int, default=9000)
+    ap.add_argument("--helper", default="localhost:9001",
+                    help="helper host:port (leader role)")
+    ap.add_argument("--leader", default="localhost:9000",
+                    help="leader host:port (client role)")
+    ap.add_argument("--indices", default="3,42,99")
+    ap.add_argument("--demo", action="store_true",
+                    help="spawn helper+leader and run a client against them")
+    ap.add_argument("--platform", default="",
+                    help="force a JAX platform (e.g. cpu); the demo "
+                    "defaults to cpu — the environment's sitecustomize "
+                    "would otherwise dial the TPU tunnel in every role "
+                    "process")
+    args = ap.parse_args()
+
+    platform = args.platform or ("cpu" if args.demo else "")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+    if args.demo:
+        run_demo(args.port, platform)
+    elif args.role == "helper":
+        run_helper(args.port)
+    elif args.role == "leader":
+        run_leader(args.port, args.helper)
+    elif args.role == "client":
+        indices = [int(x) for x in args.indices.split(",")]
+        for i, rec in enumerate(
+            run_client(args.leader, indices)
+        ):
+            print(f"index {indices[i]}: {rec!r}")
+    else:
+        raise SystemExit("pass --demo or --role")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Cross-process protocol test: the Leader/Helper deployment running over
+real TCP sockets in three OS processes (examples/leader_helper_demo.py).
+
+The reference tests the two-party protocol in-process with lambdas as the
+network (`pir/dpf_pir_server_test.cc:145-196`); this goes one step further
+and exercises the serialized wire path end-to-end across processes.
+"""
+
+import importlib.util
+import os
+import sys
+
+
+def _load_demo():
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "examples", "leader_helper_demo.py"
+    )
+    spec = importlib.util.spec_from_file_location("leader_helper_demo", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_leader_helper_demo_over_tcp():
+    demo = _load_demo()
+    # run_demo raises (SystemExit / RuntimeError) on any mismatch, early
+    # subprocess death, or port timeout.
+    demo.run_demo(19750, "cpu")

@@ -273,12 +273,39 @@ def test_mesh_sparse_server_matches_single_device_server():
             assert found is None or all(b == 0 for b in found)
 
 
-def test_sharded_step_rejects_block_capacity_shortfall():
-    """If mesh padding pushes the block count past the DPF tree's leaf
-    capacity (2^expand_levels), the step must refuse loudly instead of
-    silently misaligning record slices (clamped dynamic_slice)."""
+def test_mesh_server_small_database_beyond_tree_capacity():
+    """A small database mesh-padded past the DPF tree's leaf capacity
+    (300 records -> 4-block tree, padded to 8 blocks on 8 devices) must be
+    served correctly: selection blocks beyond 2^expand_levels are
+    zero-padded and can only meet guaranteed-zero padding rows."""
+    from distributed_point_functions_tpu.pir.database import (
+        DenseDpfPirDatabase,
+    )
+    from distributed_point_functions_tpu.pir.server import DenseDpfPirServer
+    from distributed_point_functions_tpu.pir import messages
+    from distributed_point_functions_tpu.prng import xor_bytes
+
     mesh = require_mesh()
-    with pytest.raises(ValueError, match="leaf capacity"):
-        sharded_dense_pir_step(
-            mesh, walk_levels=0, expand_levels=3, num_blocks=9
+    num_records = 300  # tree capacity 4 blocks < 8 padded blocks
+    records = [RNG.bytes(16) for _ in range(num_records)]
+    plain = DenseDpfPirServer.create_plain(DenseDpfPirDatabase(records))
+    sharded = DenseDpfPirServer.create_plain(
+        DenseDpfPirDatabase(records), mesh=mesh
+    )
+    client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+    indices = [0, 150, 299]
+    keys0, keys1 = client._generate_key_pairs(indices)
+    reqs = [
+        messages.PirRequest(
+            plain_request=messages.PlainRequest(dpf_keys=list(k))
         )
+        for k in (keys0, keys1)
+    ]
+    for req in reqs:
+        a = plain.handle_request(req).dpf_pir_response.masked_response
+        b = sharded.handle_request(req).dpf_pir_response.masked_response
+        assert a == b
+    r0 = sharded.handle_request(reqs[0]).dpf_pir_response.masked_response
+    r1 = sharded.handle_request(reqs[1]).dpf_pir_response.masked_response
+    for q, idx in enumerate(indices):
+        assert xor_bytes(r0[q], r1[q]) == records[idx]
