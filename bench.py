@@ -351,42 +351,97 @@ def main():
     else:
         inner_product = xor_inner_product
 
-    @jax.jit
-    def pir_step(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc, db):
-        selections = evaluate_selection_blocks(
-            seeds0,
-            control0,
-            cw_seeds,
-            cw_left,
-            cw_right,
-            last_vc,
-            walk_levels=walk_levels,
-            expand_levels=expand_levels,
-            num_blocks=num_blocks,
-        )
-        return inner_product(db, selections)
+    def make_pir_step(expand_fn):
+        @jax.jit
+        def pir_step(s0, c0, cw_s, cw_l, cw_r, vc, db):
+            selections = expand_fn(
+                s0, c0, cw_s, cw_l, cw_r, vc,
+                walk_levels=walk_levels,
+                expand_levels=expand_levels,
+                num_blocks=num_blocks,
+            )
+            return inner_product(db, selections)
 
-    # Warmup / compile.
+        return pir_step
+
+    # Expansion A/B: the per-level limb kernel vs the plane-resident
+    # expansion (BENCH_EXPANSION={both,limb,planes}); both are timed and
+    # the faster serves the headline. Outputs are verified identical on
+    # device before either is trusted.
+    from distributed_point_functions_tpu.pir.dense_eval_planes import (
+        evaluate_selection_blocks_planes,
+    )
+
+    expand_mode = os.environ.get("BENCH_EXPANSION", "both")
+    if expand_mode not in ("both", "limb", "planes"):
+        _emit(0.0, 0.0, error=f"invalid BENCH_EXPANSION={expand_mode!r} "
+              "(expected both|limb|planes)")
+        return
+    candidates = {}
+    if expand_mode in ("both", "limb"):
+        candidates["limb"] = make_pir_step(evaluate_selection_blocks)
+    if expand_mode in ("both", "planes"):
+        candidates["planes"] = make_pir_step(
+            evaluate_selection_blocks_planes
+        )
+
     _PROGRESS["stage"] = "compile"
     _log(
         f"compiling: {num_records} records x {record_bytes}B, "
         f"{num_queries} queries, walk={walk_levels} expand={expand_levels}"
     )
-    t_c = time.perf_counter()
-    out = pir_step(*staged, db_words)
-    out.block_until_ready()
-    _log(f"compile+first run {time.perf_counter() - t_c:.1f}s")
+    timings = {}
+    outputs = {}
+    for name, step in list(candidates.items()):
+        t_c = time.perf_counter()
+        try:
+            out = step(*staged, db_words)
+            outputs[name] = np.asarray(out)
+        except Exception as e:  # noqa: BLE001
+            _log(f"expansion[{name}] failed to compile/run: "
+                 f"{str(e).splitlines()[0]}")
+            del candidates[name]
+            continue
+        _log(
+            f"expansion[{name}]: compile+first run "
+            f"{time.perf_counter() - t_c:.1f}s"
+        )
+    if not candidates:
+        _emit(0.0, 0.0, error="no expansion path compiled")
+        return
+    if len(outputs) == 2 and not np.array_equal(
+        outputs["limb"], outputs["planes"]
+    ):
+        _log("WARNING: planes/limb outputs differ on device; "
+             "dropping planes")
+        del candidates["planes"]
 
     _PROGRESS["stage"] = "measure"
-    per_batch, latency = _slope_time(
-        lambda: pir_step(*staged, db_words), iters
-    )
-    if per_batch is None:
+    latencies = {}
+    for name, step in candidates.items():
+        per, lat = _slope_time(lambda s=step: s(*staged, db_words), iters)
+        if per is not None:
+            timings[name] = per
+            latencies[name] = lat
+            _log(f"expansion[{name}]: per-batch {per * 1e3:.3f} ms")
+    if not timings:
         # Refuse to report an inflated figure from a degenerate slope.
         _log("ERROR: slope still non-positive; reporting value 0")
         _emit(0.0, 0.0, error="degenerate timing slope")
         return
-    _log(f"latency {latency * 1e3:.1f} ms, per-batch {per_batch * 1e3:.3f} ms")
+    best = min(timings, key=timings.get)
+    per_batch = timings[best]
+    latency = latencies[best]
+    pir_step = candidates[best]
+    evaluate_selection_blocks_best = (
+        evaluate_selection_blocks_planes
+        if best == "planes"
+        else evaluate_selection_blocks
+    )
+    _log(
+        f"latency {latency * 1e3:.1f} ms, per-batch {per_batch * 1e3:.3f} "
+        f"ms (expansion: {best})"
+    )
     _PROGRESS["qps"] = num_queries / per_batch
     _PROGRESS["stage"] = "split-timing"
 
@@ -396,7 +451,7 @@ def main():
     ip_ms = None
     try:
         expand_only = jax.jit(
-            lambda s0, c0, cs, cl, cr, vc: evaluate_selection_blocks(
+            lambda s0, c0, cs, cl, cr, vc: evaluate_selection_blocks_best(
                 s0, c0, cs, cl, cr, vc,
                 walk_levels=walk_levels,
                 expand_levels=expand_levels,
@@ -429,6 +484,10 @@ def main():
     extra = {
         "inner_product_effective_gbps": round(gbps, 2),
         "inner_product_path": "pallas" if use_pallas else "jnp",
+        "expansion_path": best,
+        "expansion_per_batch_ms": {
+            k: round(v * 1e3, 3) for k, v in timings.items()
+        },
         "per_batch_ms": round(per_batch * 1e3, 3),
         "inner_product_only_ms": round(ip_ms, 3) if ip_ms else None,
         "num_queries": num_queries,

@@ -127,15 +127,12 @@ def _rk_bits(round_keys: np.ndarray) -> np.ndarray:
     return (rk[..., None] >> np.arange(8)) & 1
 
 
-def aes_encrypt_bs(round_keys: np.ndarray, blocks: jnp.ndarray) -> jnp.ndarray:
-    """Bitsliced AES-128 ECB on uint32[..., 4] limb blocks (fixed key)."""
-    shape = blocks.shape
-    flat = blocks.reshape(-1, 4)
-    n = flat.shape[0]
-    pad = (-n) % 32
-    if pad:
-        flat = jnp.pad(flat, ((0, pad), (0, 0)))
-    state = limbs_to_planes(flat)
+def aes_rounds_planes(
+    round_keys: np.ndarray, state: jnp.ndarray
+) -> jnp.ndarray:
+    """The AES-128 round function on plane-layout state [16, 8, G]
+    (fixed key): the transpose-free core shared by `aes_encrypt_bs` and
+    the plane-resident DPF expansion (`pir/dense_eval_planes.py`)."""
     bits = _rk_bits(round_keys)
     ones = jnp.full(state.shape[-1:], 0xFFFFFFFF, dtype=U32)
 
@@ -151,7 +148,35 @@ def aes_encrypt_bs(round_keys: np.ndarray, blocks: jnp.ndarray) -> jnp.ndarray:
         state = ark(state, rnd)
     state = _sub_bytes_planes(state)
     state = state[_SHIFT_ROWS]
-    state = ark(state, 10)
+    return ark(state, 10)
+
+
+def sigma_planes(state: jnp.ndarray) -> jnp.ndarray:
+    """sigma(x) = (hi ^ lo, hi) on plane layout: bytes 0-7 are limbs 0-1
+    (lo), bytes 8-15 limbs 2-3 (hi) — pure byte-axis rewiring + XOR
+    (`aes.sigma` semantics, `dpf/aes_128_fixed_key_hash.h:28-39`)."""
+    lo = state[:8]
+    hi = state[8:]
+    return jnp.concatenate([hi, hi ^ lo], axis=0)
+
+
+def mmo_hash_planes(
+    round_keys: np.ndarray, state: jnp.ndarray
+) -> jnp.ndarray:
+    """H(x) = AES_k(sigma(x)) ^ sigma(x), entirely in plane layout."""
+    s = sigma_planes(state)
+    return aes_rounds_planes(round_keys, s) ^ s
+
+
+def aes_encrypt_bs(round_keys: np.ndarray, blocks: jnp.ndarray) -> jnp.ndarray:
+    """Bitsliced AES-128 ECB on uint32[..., 4] limb blocks (fixed key)."""
+    shape = blocks.shape
+    flat = blocks.reshape(-1, 4)
+    n = flat.shape[0]
+    pad = (-n) % 32
+    if pad:
+        flat = jnp.pad(flat, ((0, pad), (0, 0)))
+    state = aes_rounds_planes(round_keys, limbs_to_planes(flat))
     out = planes_to_limbs(state)
     if pad:
         out = out[:n]

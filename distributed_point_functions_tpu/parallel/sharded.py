@@ -132,6 +132,7 @@ def sharded_dense_pir_step(
     expand_levels: int,
     num_blocks: int,
     axis_name: str = "x",
+    real_num_blocks: int | None = None,
 ):
     """Full dense-PIR step sharded over a mesh.
 
@@ -147,6 +148,7 @@ def sharded_dense_pir_step(
         num_blocks=num_blocks,
         num_databases=1,
         axis_name=axis_name,
+        real_num_blocks=real_num_blocks,
     )
 
     def run(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
@@ -166,6 +168,7 @@ def sharded_dense_pir_step_multi(
     num_blocks: int,
     num_databases: int,
     axis_name: str = "x",
+    real_num_blocks: int | None = None,
 ):
     """Like `sharded_dense_pir_step`, but one expansion feeds XOR inner
     products against `num_databases` parallel databases sharing the record
@@ -177,12 +180,24 @@ def sharded_dense_pir_step_multi(
     Returns fn(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
     *db_words) -> tuple of uint32[nq, W_i], with the same divisibility
     contract as `sharded_dense_pir_step`.
+
+    `num_blocks` beyond the tree's 2^expand_levels leaf capacity is served
+    by zero selection blocks (evaluate_selection_blocks pads) — correct
+    only when every block past the capacity holds mesh-padding zero rows.
+    Callers that know the real (pre-padding) block count pass it as
+    `real_num_blocks` so a genuinely undersized tree errors instead of
+    answering real records with zero shares.
     """
     ndev = mesh.devices.size
-    # num_blocks beyond the tree's 2^expand_levels leaf capacity is served
-    # by zero selection blocks (evaluate_selection_blocks pads): only
-    # guaranteed-zero padding rows live there, e.g. a small database
-    # mesh-padded to 128*ndev rows.
+    if (
+        real_num_blocks is not None
+        and real_num_blocks > (1 << expand_levels)
+    ):
+        raise ValueError(
+            f"DPF tree leaf capacity 2^{expand_levels} cannot cover the "
+            f"{real_num_blocks} real record blocks; only mesh-padding "
+            "blocks may lie beyond the tree"
+        )
 
     def step(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
              *db_shards):

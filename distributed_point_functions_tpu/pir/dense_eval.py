@@ -152,6 +152,28 @@ def _leaf_blocks(seeds, control, last_vc):
     return v ^ jnp.where(control[..., None] != 0, last_vc[:, None, :], U32(0))
 
 
+def expansion_impl():
+    """The selection-block expansion implementation for the serving path.
+
+    `DPF_TPU_EXPANSION`: `limb` — the per-level kernel re-entry above;
+    `planes` — the plane-resident expansion
+    (`dense_eval_planes.evaluate_selection_blocks_planes`, bit-identical,
+    no per-level transposes); `auto` (default) — planes on TPU, limb
+    elsewhere (the plane path's win is VPU work; CPU compile times favor
+    the limb path in the hermetic suite).
+    """
+    import os
+
+    mode = os.environ.get("DPF_TPU_EXPANSION", "auto")
+    if mode == "planes" or (
+        mode == "auto" and jax.default_backend() == "tpu"
+    ):
+        from .dense_eval_planes import evaluate_selection_blocks_planes
+
+        return evaluate_selection_blocks_planes
+    return evaluate_selection_blocks
+
+
 def selection_blocks_for_keys(dpf, keys: Sequence[DpfKey], num_blocks: int):
     """Evaluate a batch of single-level 128-bit-XOR DPF keys to the first
     `num_blocks` selection blocks.
@@ -163,7 +185,7 @@ def selection_blocks_for_keys(dpf, keys: Sequence[DpfKey], num_blocks: int):
     expand_levels = min(max(0, (num_blocks - 1).bit_length()), total_levels)
     walk_levels = total_levels - expand_levels
     staged = stage_keys(keys)
-    return evaluate_selection_blocks(
+    return expansion_impl()(
         *staged,
         walk_levels=walk_levels,
         expand_levels=expand_levels,

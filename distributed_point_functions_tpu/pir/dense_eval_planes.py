@@ -1,0 +1,199 @@
+"""Plane-resident dense-PIR expansion: the whole subtree walk stays in
+bitsliced plane layout.
+
+`dense_eval.evaluate_selection_blocks` re-enters the bitsliced AES kernel
+per level, paying a 32x32 bit-transpose into plane layout and another one
+back out for every level's hash, plus per-level `repeat`/select-mask
+round-key composition. But every *other* operation of the DPF expansion
+recurrence is linear over GF(2):
+
+* seed correction is an XOR under a control mask,
+* sigma is a byte-axis rewiring (`aes_bitslice.sigma_planes`),
+* the control bit is bit-plane (0, 0); clearing the seed LSB zeroes it,
+* child doubling becomes concatenation when children are ordered
+  [all-left; all-right] instead of interleaved.
+
+So the expansion can stay in plane layout end to end: transpose the nk
+subtree roots in once, run `expand_levels` levels of two fixed-key
+plane-space hashes (left/right children of every node — same AES work as
+the one-pass key-selected hash, with no per-level transposes, no
+`repeat`, and plain all-ones round-key planes), hash the leaves with the
+value key, and transpose out once.
+
+The price is leaf order: appending [all-left; all-right] per level makes
+the final node order the **bit-reversal** of the natural block index
+(position of leaf with path bits b1..be is be..b1). The serving path
+compensates for free by bit-reversal-permuting the database's record
+*blocks* once at staging (`bitrev_permutation`); the drop-in wrapper
+`evaluate_selection_blocks_planes` instead gathers leaves back to natural
+order for bit-identity with `evaluate_selection_blocks`.
+
+Lane layout: flattened node-major/key-minor (lane = node * nk + key) with
+nk padded to a multiple of 32, so each packed uint32 word holds 32 keys
+of one node and per-key correction words broadcast to [G] words by a
+plain `tile` (`pack_key_planes` / `pack_key_bits`).
+
+Reference semantics: `ExpandSeeds`
+(`dpf/distributed_point_function.cc:289-372`) restricted to the covering
+subtree, as in `dense_eval.py`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import keys as fixed_keys
+from ..ops import aes
+from ..ops.aes_bitslice import (
+    aes_rounds_planes,
+    limbs_to_planes,
+    mmo_hash_planes,
+    planes_to_limbs,
+    sigma_planes,
+)
+from .dense_eval import _walk_zeros
+
+U32 = jnp.uint32
+
+
+def bitrev_permutation(levels: int) -> np.ndarray:
+    """perm[g] = bit-reversal of g over `levels` bits (an involution)."""
+    n = 1 << levels
+    perm = np.zeros(n, dtype=np.int64)
+    for g in range(n):
+        r = 0
+        x = g
+        for _ in range(levels):
+            r = (r << 1) | (x & 1)
+            x >>= 1
+        perm[g] = r
+    return perm
+
+
+def pack_key_planes(cw: jnp.ndarray) -> jnp.ndarray:
+    """uint32[nk, 4] per-key 128-bit words -> uint32[16, 8, nk/32] planes
+    packed over the key axis (word m bit i = key 32m+i's bit).
+
+    Plane (byte j, bit i) of limb l bit b sits at flat index 32l + b —
+    the limb-little-endian bit order (`aes_bitslice.limbs_to_planes`).
+    """
+    nk = cw.shape[0]
+    if nk % 32:
+        raise ValueError("key count must be padded to a multiple of 32")
+    shifts = jnp.arange(32, dtype=U32)
+    bits = (cw[:, :, None] >> shifts) & U32(1)  # [nk, 4, 32]
+    bits = bits.reshape(nk // 32, 32, 128)
+    words = (bits << shifts[None, :, None]).sum(axis=1, dtype=U32)
+    return jnp.moveaxis(words, 0, -1).reshape(16, 8, -1)
+
+
+def pack_key_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """uint32[nk] 0/1 -> uint32[nk/32] packed (word m bit i = key 32m+i)."""
+    nk = bits.shape[0]
+    if nk % 32:
+        raise ValueError("key count must be padded to a multiple of 32")
+    shifts = jnp.arange(32, dtype=U32)
+    return ((bits.reshape(-1, 32) & U32(1)) << shifts).sum(
+        axis=-1, dtype=U32
+    )
+
+
+def _tile_keys(words: jnp.ndarray, num_groups: int) -> jnp.ndarray:
+    """Broadcast per-key packed words over the node axis: [..., nk/32] ->
+    [..., num_groups] (node-major lanes: group g covers keys of node
+    g // (nk/32))."""
+    reps = num_groups // words.shape[-1]
+    return jnp.tile(words, (1,) * (words.ndim - 1) + (reps,))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "walk_levels", "expand_levels", "num_blocks", "bitrev_leaves"
+    ),
+)
+def evaluate_selection_blocks_planes(
+    seeds0: jnp.ndarray,
+    control0: jnp.ndarray,
+    cw_seeds: jnp.ndarray,
+    cw_left: jnp.ndarray,
+    cw_right: jnp.ndarray,
+    last_vc: jnp.ndarray,
+    *,
+    walk_levels: int,
+    expand_levels: int,
+    num_blocks: int,
+    bitrev_leaves: bool = False,
+) -> jnp.ndarray:
+    """Drop-in for `dense_eval.evaluate_selection_blocks` (bit-identical
+    output), computed with the plane-resident expansion.
+
+    With `bitrev_leaves=True` the leaf axis stays in plane order (natural
+    block g at position bitrev(g)) and is NOT truncated to `num_blocks` —
+    for serving paths that bit-reverse the database instead.
+    """
+    nk = seeds0.shape[0]
+    pad_keys = (-nk) % 32
+    if pad_keys:
+        seeds0 = jnp.pad(seeds0, ((0, pad_keys), (0, 0)))
+        control0 = jnp.pad(control0, ((0, pad_keys),))
+        cw_seeds = jnp.pad(cw_seeds, ((0, 0), (0, pad_keys), (0, 0)))
+        cw_left = jnp.pad(cw_left, ((0, 0), (0, pad_keys)))
+        cw_right = jnp.pad(cw_right, ((0, 0), (0, pad_keys)))
+        last_vc = jnp.pad(last_vc, ((0, pad_keys), (0, 0)))
+    nkp = nk + pad_keys
+    key_groups = nkp // 32
+
+    # Phase 1 (limb space, [nk, 4] only): walk the all-zeros prefix.
+    seeds, control = _walk_zeros(
+        seeds0, control0, cw_seeds[:walk_levels], cw_left[:walk_levels]
+    )
+
+    # Enter plane space once.
+    state = limbs_to_planes(seeds)  # [16, 8, key_groups]
+    ctrl = pack_key_bits(control.astype(U32))  # [key_groups]
+
+    for i in range(expand_levels):
+        lvl = walk_levels + i
+        sig = sigma_planes(state)
+        left = aes_rounds_planes(fixed_keys.RK_LEFT, sig) ^ sig
+        right = aes_rounds_planes(fixed_keys.RK_RIGHT, sig) ^ sig
+        state = jnp.concatenate([left, right], axis=-1)  # [16, 8, 2G]
+        ctrl2 = jnp.concatenate([ctrl, ctrl])  # parent bit, both halves
+        groups = state.shape[-1]
+        cw_p = _tile_keys(pack_key_planes(cw_seeds[lvl]), groups)
+        state = state ^ (cw_p & ctrl2[None, None, :])
+        t_new = state[0, 0]  # LSB plane = control bits
+        state = state.at[0, 0].set(jnp.zeros_like(t_new))
+        cw_dir = jnp.concatenate(
+            [
+                _tile_keys(pack_key_bits(cw_left[lvl]), groups // 2),
+                _tile_keys(pack_key_bits(cw_right[lvl]), groups // 2),
+            ]
+        )
+        ctrl = t_new ^ (ctrl2 & cw_dir)
+
+    # Leaf value blocks: output PRG + XOR value correction (party
+    # negation is the identity for XOR shares).
+    values = mmo_hash_planes(fixed_keys.RK_VALUE, state)
+    vc_p = _tile_keys(pack_key_planes(last_vc), values.shape[-1])
+    values = values ^ (vc_p & ctrl[None, None, :])
+
+    # Leave plane space once: [w * nkp, 4] node-major -> [nkp, w, 4].
+    w = 1 << expand_levels
+    out = planes_to_limbs(values).reshape(w, nkp, 4)
+    out = jnp.moveaxis(out, 0, 1)
+    if not bitrev_leaves:
+        perm = jnp.asarray(bitrev_permutation(expand_levels))
+        out = out[:, perm, :][:, :num_blocks, :]
+        if out.shape[1] < num_blocks:
+            # Blocks beyond the tree's capacity (mesh-padded databases)
+            # can only select guaranteed-zero rows.
+            out = jnp.pad(
+                out, ((0, 0), (0, num_blocks - out.shape[1]), (0, 0))
+            )
+    return out[:nk]

@@ -33,8 +33,8 @@ from ..dpf import DistributedPointFunction, DpfParameters
 from ..prng import Aes128CtrSeededPrng, xor_bytes
 from ..value_types import XorType
 from . import messages
-from .database import DenseDpfPirDatabase
-from .dense_eval import evaluate_selection_blocks, stage_keys
+from .database import DenseDpfPirDatabase, words_to_record_bytes
+from .dense_eval import expansion_impl, stage_keys
 
 # sender(helper_request: PirRequest, while_waiting: Callable[[], None])
 #   -> PirResponse
@@ -287,7 +287,7 @@ class DenseDpfPirServer(DpfPirServer):
         elif self._needs_chunking(len(keys)):
             inner_products = self._inner_products_chunked(staged, len(keys))
         else:
-            selections = evaluate_selection_blocks(
+            selections = expansion_impl()(
                 *staged,
                 walk_levels=self._walk_levels,
                 expand_levels=self._expand_levels,
@@ -362,8 +362,6 @@ class DenseDpfPirServer(DpfPirServer):
         chunk_bits = self._expand_levels - cel
         num_chunks = padded_blocks >> cel
 
-        from .database import words_to_record_bytes
-
         out = np.asarray(
             chunked_pir_inner_products(
                 *staged,
@@ -404,6 +402,7 @@ class DenseDpfPirServer(DpfPirServer):
             walk_levels=total_levels - expand_levels,
             expand_levels=expand_levels,
             num_blocks=num_blocks,
+            real_num_blocks=self._database.num_selection_blocks,
         )
         self._sharded_db = shard_database(self._mesh, db)
 
@@ -411,7 +410,6 @@ class DenseDpfPirServer(DpfPirServer):
         import numpy as np
 
         from ..parallel.sharded import pad_staged_queries
-        from .database import words_to_record_bytes
 
         self._ensure_sharded()
         staged = pad_staged_queries(staged, self._mesh.devices.size)

@@ -17,6 +17,7 @@ from ..dpf import DistributedPointFunction, DpfParameters
 from ..value_types import XorType
 from . import messages
 from .cuckoo_database import CuckooHashedDpfPirDatabase, CuckooHashingParams
+from .database import words_to_record_bytes
 from .dense_eval import selection_blocks_for_keys
 from .server import (
     DecryptHelperRequestFn,
@@ -189,6 +190,7 @@ class CuckooHashingSparseDpfPirServer(DpfPirServer):
             expand_levels=expand_levels,
             num_blocks=padded_blocks,
             num_databases=2,
+            real_num_blocks=self._num_blocks,
         )
         self._sharded_dbs = tuple(
             shard_database(self._mesh, db) for db in dbs
@@ -208,8 +210,6 @@ class CuckooHashingSparseDpfPirServer(DpfPirServer):
         out_keys, out_values = self._sharded_step(
             *staged, *self._sharded_dbs
         )
-        from .database import words_to_record_bytes
-
         results = [
             words_to_record_bytes(
                 np.asarray(out), num_keys, dense.max_value_size

@@ -24,9 +24,9 @@ or run the roles by hand in three terminals:
 
     python examples/leader_helper_demo.py --role helper --port 9001
     python examples/leader_helper_demo.py --role leader --port 9000 \
-        --helper ::1:9001
-    python examples/leader_helper_demo.py --role client --leader ::1:9000 \
-        --indices 3,42,99
+        --helper 127.0.0.1:9001
+    python examples/leader_helper_demo.py --role client \
+        --leader 127.0.0.1:9000 --indices 3,42,99
 """
 
 from __future__ import annotations

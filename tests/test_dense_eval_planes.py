@@ -1,0 +1,138 @@
+"""Differential tests for the plane-resident dense-PIR expansion
+(`pir/dense_eval_planes.py`) against the per-level limb kernel — the two
+implementations must be bit-identical for both parties across shapes,
+including non-multiple-of-32 key counts and databases mesh-padded past
+the tree's leaf capacity.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
+from distributed_point_functions_tpu.pir.dense_eval import (
+    evaluate_selection_blocks,
+    stage_keys,
+)
+from distributed_point_functions_tpu.pir.dense_eval_planes import (
+    bitrev_permutation,
+    evaluate_selection_blocks_planes,
+)
+
+RNG = np.random.default_rng(99)
+
+
+def _split(client, num_blocks):
+    total = client._dpf._tree_levels_needed - 1
+    el = min(max(0, (num_blocks - 1).bit_length()), total)
+    return total - el, el
+
+
+@pytest.mark.parametrize(
+    "num_records,nq",
+    [
+        (4096, 7),    # walk > 0, keys need padding to 32
+        (2048, 64),   # exact key-group multiple
+        (300, 3),     # tiny: 3 blocks, expand < 2 levels
+        (128, 1),     # single block, expand_levels == 0
+    ],
+)
+def test_planes_matches_limb(num_records, nq):
+    num_blocks = (num_records + 127) // 128
+    client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+    indices = [int(i) for i in RNG.integers(0, num_records, nq)]
+    wl, el = _split(client, num_blocks)
+    for keys in client._generate_key_pairs(indices):
+        staged = stage_keys(keys)
+        a = np.asarray(
+            evaluate_selection_blocks(
+                *staged,
+                walk_levels=wl, expand_levels=el, num_blocks=num_blocks,
+            )
+        )
+        b = np.asarray(
+            evaluate_selection_blocks_planes(
+                *staged,
+                walk_levels=wl, expand_levels=el, num_blocks=num_blocks,
+            )
+        )
+        np.testing.assert_array_equal(a, b)
+
+
+def test_planes_pads_beyond_tree_capacity():
+    """num_blocks beyond 2^expand_levels (mesh-padded database) must
+    yield zero selection blocks, like the limb path."""
+    num_records, nq = 300, 4  # tree capacity 4 blocks
+    client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+    indices = [0, 1, 150, 299]
+    wl, el = _split(client, 4)
+    keys0, _ = client._generate_key_pairs(indices)
+    staged = stage_keys(keys0)
+    a = np.asarray(
+        evaluate_selection_blocks(
+            *staged, walk_levels=wl, expand_levels=el, num_blocks=8
+        )
+    )
+    b = np.asarray(
+        evaluate_selection_blocks_planes(
+            *staged, walk_levels=wl, expand_levels=el, num_blocks=8
+        )
+    )
+    np.testing.assert_array_equal(a, b)
+    assert not a[:, 4:, :].any()
+
+
+def test_bitrev_leaves_mode():
+    """bitrev_leaves=True returns the plane-order leaves: natural block g
+    at position bitrev(g), full 2^expand_levels width."""
+    num_records, nq = 2048, 8
+    num_blocks = num_records // 128
+    client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+    indices = [int(i) for i in RNG.integers(0, num_records, nq)]
+    wl, el = _split(client, num_blocks)
+    keys0, _ = client._generate_key_pairs(indices)
+    staged = stage_keys(keys0)
+    natural = np.asarray(
+        evaluate_selection_blocks_planes(
+            *staged, walk_levels=wl, expand_levels=el,
+            num_blocks=num_blocks,
+        )
+    )
+    raw = np.asarray(
+        evaluate_selection_blocks_planes(
+            *staged, walk_levels=wl, expand_levels=el,
+            num_blocks=num_blocks, bitrev_leaves=True,
+        )
+    )
+    perm = bitrev_permutation(el)
+    np.testing.assert_array_equal(raw[:, perm, :][:, :num_blocks], natural)
+
+
+def test_bitrev_permutation_is_involution():
+    for levels in range(0, 8):
+        perm = bitrev_permutation(levels)
+        np.testing.assert_array_equal(perm[perm], np.arange(1 << levels))
+
+
+def test_dense_server_serves_via_planes(monkeypatch):
+    """DPF_TPU_EXPANSION=planes routes the dense server through the
+    plane-resident expansion with byte-identical responses."""
+    from distributed_point_functions_tpu.pir import messages
+    from distributed_point_functions_tpu.pir.database import (
+        DenseDpfPirDatabase,
+    )
+    from distributed_point_functions_tpu.pir.server import DenseDpfPirServer
+
+    num_records = 1000
+    records = [RNG.bytes(20) for _ in range(num_records)]
+    client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+    keys0, _ = client._generate_key_pairs([5, 999, 123])
+    req = messages.PirRequest(
+        plain_request=messages.PlainRequest(dpf_keys=list(keys0))
+    )
+    server = DenseDpfPirServer.create_plain(DenseDpfPirDatabase(records))
+
+    monkeypatch.setenv("DPF_TPU_EXPANSION", "limb")
+    a = server.handle_request(req).dpf_pir_response.masked_response
+    monkeypatch.setenv("DPF_TPU_EXPANSION", "planes")
+    b = server.handle_request(req).dpf_pir_response.masked_response
+    assert a == b
