@@ -278,7 +278,9 @@ def _expand_levels_fn(num_levels: int):
 
 
 @jax.jit
-def _eval_paths(seeds, control, paths, cw_seeds, cw_left, cw_right, bit_indices):
+def _eval_paths_limb(
+    seeds, control, paths, cw_seeds, cw_left, cw_right, bit_indices
+):
     """Walk `L` tree levels for a batch of paths simultaneously.
 
     seeds: uint32[n, 4]; control: uint32[n]; paths: uint32[n, 4];
@@ -311,6 +313,104 @@ def _eval_paths(seeds, control, paths, cw_seeds, cw_left, cw_right, bit_indices)
         body, (seeds, control), (cw_seeds, cw_left, cw_right, bit_indices)
     )
     return seeds, control
+
+
+@jax.jit
+def _eval_paths_planes(
+    seeds, control, paths, cw_seeds, cw_left, cw_right, bit_indices
+):
+    """`_eval_paths_limb` computed in bitsliced plane layout: one
+    transpose in, per level a packed-select-mask AES (no per-level
+    transposes — the path-walk analog of
+    `dense_eval_planes.evaluate_selection_blocks_planes`), one transpose
+    out. Bit-identical to the limb kernel in both correction-word modes.
+    """
+    from .ops.aes_bitslice import (
+        aes_rounds_select_planes,
+        limbs_to_planes,
+        pack_select_bits,
+        planes_to_limbs,
+        sigma_planes,
+    )
+    from .pir.dense_eval_planes import pack_key_bits, pack_key_planes
+
+    n = seeds.shape[0]
+    per_seed = cw_seeds.shape[1] == n and n != 1
+    pad = (-n) % 32
+    if pad:
+        seeds = jnp.pad(seeds, ((0, pad), (0, 0)))
+        control = jnp.pad(control, ((0, pad),))
+        paths = jnp.pad(paths, ((0, pad), (0, 0)))
+        if per_seed:
+            cw_seeds = jnp.pad(cw_seeds, ((0, 0), (0, pad), (0, 0)))
+            cw_left = jnp.pad(cw_left, ((0, 0), (0, pad)))
+            cw_right = jnp.pad(cw_right, ((0, 0), (0, pad)))
+    np32 = n + pad
+    groups = np32 // 32
+
+    state0 = limbs_to_planes(seeds)
+    ctrl0 = pack_key_bits(control.astype(U32))
+    shifts = jnp.arange(32, dtype=U32)
+
+    def cw_planes(cw_seed, cw_l, cw_r):
+        """Per-level packed correction planes/words for both modes."""
+        if per_seed:
+            return (
+                pack_key_planes(cw_seed),        # [16, 8, groups]
+                pack_key_bits(cw_l),             # [groups]
+                pack_key_bits(cw_r),
+            )
+        # Shared correction words: every lane uses the same bit, so the
+        # packed word is all-ones or all-zeros.
+        bits = ((cw_seed[0][:, None] >> shifts) & U32(1)).reshape(128)
+        planes = (U32(0) - bits).reshape(16, 8, 1)
+        return (
+            planes,                               # broadcasts over groups
+            (U32(0) - (cw_l[0] & U32(1)))[None],
+            (U32(0) - (cw_r[0] & U32(1)))[None],
+        )
+
+    def body(carry, x):
+        state, ctrl = carry
+        cw_seed, cw_l, cw_r, bit_index = x
+        pbit = limb.get_bit(paths, bit_index)  # uint32[np32]
+        sel = pack_select_bits(pbit)           # [groups]
+        sig = sigma_planes(state)
+        h = aes_rounds_select_planes(
+            fixed_keys.RK_LEFT, fixed_keys.RK_RIGHT, sel, sig
+        ) ^ sig
+        cwp, cwl_w, cwr_w = cw_planes(cw_seed, cw_l, cw_r)
+        h = h ^ (cwp & ctrl[None, None, :])
+        t_new = h[0, 0]
+        h = h.at[0, 0].set(jnp.zeros_like(t_new))
+        cw_dir = (sel & cwr_w) | (~sel & cwl_w)
+        ctrl = t_new ^ (ctrl & cw_dir)
+        return (h, ctrl), None
+
+    (state, ctrl), _ = lax.scan(
+        body, (state0, ctrl0), (cw_seeds, cw_left, cw_right, bit_indices)
+    )
+    out = planes_to_limbs(state)
+    ctrl_bits = (ctrl[:, None] >> shifts) & U32(1)  # [groups, 32]
+    control_out = ctrl_bits.reshape(np32)
+    return out[:n], control_out[:n]
+
+
+def _eval_paths(seeds, control, paths, cw_seeds, cw_left, cw_right,
+                bit_indices):
+    """Dispatch the path walk: `DPF_TPU_EVAL_PATHS` = `limb` | `planes` |
+    `auto` (default: planes on TPU, limb elsewhere — same trade-off as
+    `dense_eval.expansion_impl`)."""
+    mode = os.environ.get("DPF_TPU_EVAL_PATHS", "auto")
+    if mode == "planes" or (
+        mode == "auto" and jax.default_backend() == "tpu"
+    ):
+        return _eval_paths_planes(
+            seeds, control, paths, cw_seeds, cw_left, cw_right, bit_indices
+        )
+    return _eval_paths_limb(
+        seeds, control, paths, cw_seeds, cw_left, cw_right, bit_indices
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("num_blocks",))

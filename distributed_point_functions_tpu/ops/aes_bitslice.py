@@ -183,33 +183,18 @@ def aes_encrypt_bs(round_keys: np.ndarray, blocks: jnp.ndarray) -> jnp.ndarray:
     return out.reshape(shape)
 
 
-def aes_encrypt_select_bs(
+def aes_rounds_select_planes(
     round_keys0: np.ndarray,
     round_keys1: np.ndarray,
-    select: jnp.ndarray,
-    blocks: jnp.ndarray,
+    sel: jnp.ndarray,
+    state: jnp.ndarray,
 ) -> jnp.ndarray:
-    """Bitsliced AES-128 with per-block key choice (0 -> rk0, 1 -> rk1).
-
-    One AES pass; each round key bit-plane is composed from the packed
-    select mask, so path-dependent hashing costs no extra AES work.
-    """
-    shape = blocks.shape
-    flat = blocks.reshape(-1, 4)
-    n = flat.shape[0]
-    pad = (-n) % 32
-    if pad:
-        flat = jnp.pad(flat, ((0, pad), (0, 0)))
-    sel_flat = jnp.broadcast_to(select, shape[:-1]).reshape(-1).astype(U32)
-    if pad:
-        sel_flat = jnp.pad(sel_flat, (0, pad))
-    # Pack one select bit per block: word g bit i = select of block 32g+i.
-    shifts = jnp.arange(32, dtype=U32)
-    sel = ((sel_flat.reshape(-1, 32) & U32(1)) << shifts).sum(
-        axis=-1, dtype=U32
-    )  # disjoint bits: sum == OR
-
-    state = limbs_to_planes(flat)
+    """AES-128 rounds on plane state [16, 8, G] with per-block key choice
+    from a *packed* select mask (uint32[G]: bit i of word g selects the
+    key of block 32g+i; 0 -> rk0, 1 -> rk1). One AES pass; each round-key
+    bit-plane is composed from the mask, so path-dependent hashing costs
+    no extra AES work. Shared by `aes_encrypt_select_bs` and the
+    plane-resident path walks."""
     bits0 = _rk_bits(round_keys0).astype(bool)
     bits1 = _rk_bits(round_keys1).astype(bool)
     nsel = ~sel
@@ -242,7 +227,39 @@ def aes_encrypt_select_bs(
         state = ark(state, rnd)
     state = _sub_bytes_planes(state)
     state = state[_SHIFT_ROWS]
-    state = ark(state, 10)
+    return ark(state, 10)
+
+
+def pack_select_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """uint32[n] 0/1 (n % 32 == 0) -> packed uint32[n/32] (word g bit i =
+    bit of lane 32g+i)."""
+    shifts = jnp.arange(32, dtype=U32)
+    return ((bits.reshape(-1, 32) & U32(1)) << shifts).sum(
+        axis=-1, dtype=U32
+    )  # disjoint bits: sum == OR
+
+
+def aes_encrypt_select_bs(
+    round_keys0: np.ndarray,
+    round_keys1: np.ndarray,
+    select: jnp.ndarray,
+    blocks: jnp.ndarray,
+) -> jnp.ndarray:
+    """Bitsliced AES-128 with per-block key choice (0 -> rk0, 1 -> rk1)
+    on uint32[..., 4] limb blocks."""
+    shape = blocks.shape
+    flat = blocks.reshape(-1, 4)
+    n = flat.shape[0]
+    pad = (-n) % 32
+    if pad:
+        flat = jnp.pad(flat, ((0, pad), (0, 0)))
+    sel_flat = jnp.broadcast_to(select, shape[:-1]).reshape(-1).astype(U32)
+    if pad:
+        sel_flat = jnp.pad(sel_flat, (0, pad))
+    sel = pack_select_bits(sel_flat)
+    state = aes_rounds_select_planes(
+        round_keys0, round_keys1, sel, limbs_to_planes(flat)
+    )
     out = planes_to_limbs(state)
     if pad:
         out = out[:n]

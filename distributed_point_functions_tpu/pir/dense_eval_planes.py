@@ -92,14 +92,15 @@ def pack_key_planes(cw: jnp.ndarray) -> jnp.ndarray:
 
 
 def pack_key_bits(bits: jnp.ndarray) -> jnp.ndarray:
-    """uint32[nk] 0/1 -> uint32[nk/32] packed (word m bit i = key 32m+i)."""
-    nk = bits.shape[0]
-    if nk % 32:
+    """uint32[nk] 0/1 -> uint32[nk/32] packed (word m bit i = key 32m+i).
+
+    Same packing as `aes_bitslice.pack_select_bits` (the single
+    implementation), with the key-count contract checked."""
+    if bits.shape[0] % 32:
         raise ValueError("key count must be padded to a multiple of 32")
-    shifts = jnp.arange(32, dtype=U32)
-    return ((bits.reshape(-1, 32) & U32(1)) << shifts).sum(
-        axis=-1, dtype=U32
-    )
+    from ..ops.aes_bitslice import pack_select_bits
+
+    return pack_select_bits(bits)
 
 
 def _tile_keys(words: jnp.ndarray, num_groups: int) -> jnp.ndarray:
