@@ -260,7 +260,7 @@ _expand_level = jax.jit(_expand_level_body)
 
 
 @functools.lru_cache(maxsize=None)
-def _expand_levels_fn(num_levels: int):
+def _expand_levels_limb_fn(num_levels: int):
     """One jitted program running `num_levels` width-doubling expansion
     levels (the whole `ExpandSeeds` loop fused; widths double per level so
     a scan cannot carry them — the unroll specializes per level count,
@@ -275,6 +275,94 @@ def _expand_levels_fn(num_levels: int):
         return seeds, control
 
     return run
+
+
+@functools.lru_cache(maxsize=None)
+def _expand_levels_planes_fn(num_levels: int):
+    """`_expand_levels_limb_fn` computed in bitsliced plane layout (see
+    `pir/dense_eval_planes.py` for the design): children are appended
+    [all-left; all-right] per level so the lane order ends up
+    path-bit-reversed and prefix-minor; a trace-time-static gather
+    restores the natural interleaved order, making the output
+    bit-identical to the limb program. Shared correction words only (one
+    key), like the limb program."""
+
+    @jax.jit
+    def run(seeds, control, cw_seeds, cw_left, cw_right):
+        from .ops.aes_bitslice import (
+            broadcast_cw_planes,
+            limbs_to_planes,
+            pack_select_bits,
+            planes_to_limbs,
+        )
+        from .pir.dense_eval_planes import (
+            bitrev_permutation,
+            expand_level_planes,
+        )
+
+        # Plane layout carries its padding through every level (dead
+        # lanes double along with live ones), so entering at the root
+        # from p=1 seed would do 32x the AES work forever. Run the first
+        # levels in limb space until the width fills the 32-block words
+        # (exactly, or within ~12% for non-power-of-two prefix counts).
+        p = seeds.shape[0]
+        limb_levels = 0
+        while limb_levels < num_levels:
+            width = p << limb_levels
+            if width % 32 == 0 or width >= 256:
+                break
+            limb_levels += 1
+        for i in range(limb_levels):
+            seeds, control = _expand_level_body(
+                seeds, control, cw_seeds[i], cw_left[i], cw_right[i]
+            )
+
+        n0 = seeds.shape[0]
+        pad = (-n0) % 32
+        if pad:
+            seeds = jnp.pad(seeds, ((0, pad), (0, 0)))
+            control = jnp.pad(control, ((0, pad),))
+        n32 = n0 + pad
+        shifts = jnp.arange(32, dtype=U32)
+
+        state = limbs_to_planes(seeds)
+        ctrl = pack_select_bits(control.astype(U32))
+
+        plane_levels = num_levels - limb_levels
+        for i in range(limb_levels, num_levels):
+            state, ctrl = expand_level_planes(
+                state,
+                ctrl,
+                broadcast_cw_planes(cw_seeds[i]),
+                U32(0) - (cw_left[i] & U32(1)),
+                U32(0) - (cw_right[i] & U32(1)),
+            )
+
+        out = planes_to_limbs(state)  # [2^PL * n32, 4], lane-ordered
+        ctrl_bits = ((ctrl[:, None] >> shifts) & U32(1)).reshape(-1)
+        # lane(path, prefix) = bitrev(path) * n32 + prefix over the plane
+        # levels only (the limb prefix is already natural/interleaved);
+        # natural index = prefix * 2^PL + path. Static per specialization.
+        rev = bitrev_permutation(plane_levels)
+        path = np.arange(1 << plane_levels)
+        lane = rev[path][:, None] * n32 + np.arange(n0)[None, :]
+        perm = jnp.asarray(
+            np.ascontiguousarray(lane.T.reshape(-1))  # prefix-major
+        )
+        return out[perm], ctrl_bits[perm]
+
+    return run
+
+
+def _expand_levels_fn(num_levels: int):
+    """Dispatch the fused expansion program: `DPF_TPU_EXPAND_LEVELS` =
+    `limb` | `planes` | `auto` (default: planes on TPU, limb elsewhere)."""
+    mode = os.environ.get("DPF_TPU_EXPAND_LEVELS", "auto")
+    if mode == "planes" or (
+        mode == "auto" and jax.default_backend() == "tpu"
+    ):
+        return _expand_levels_planes_fn(num_levels)
+    return _expand_levels_limb_fn(num_levels)
 
 
 @jax.jit

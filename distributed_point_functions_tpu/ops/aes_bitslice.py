@@ -230,6 +230,16 @@ def aes_rounds_select_planes(
     return ark(state, 10)
 
 
+def broadcast_cw_planes(cw: jnp.ndarray) -> jnp.ndarray:
+    """uint32[4] 128-bit correction word -> all-ones/all-zeros plane masks
+    [16, 8, 1] (broadcast over groups). Encodes the limbs_to_planes layout
+    invariant (limb l bit b -> byte 4l + b//8, bit b%8, i.e. flat index
+    32l + b) in one place."""
+    shifts = jnp.arange(32, dtype=U32)
+    bits = ((cw[:, None] >> shifts) & U32(1)).reshape(128)
+    return (U32(0) - bits).reshape(16, 8, 1)
+
+
 def pack_select_bits(bits: jnp.ndarray) -> jnp.ndarray:
     """uint32[n] 0/1 (n % 32 == 0) -> packed uint32[n/32] (word g bit i =
     bit of lane 32g+i)."""

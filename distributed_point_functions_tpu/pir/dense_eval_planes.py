@@ -111,6 +111,33 @@ def _tile_keys(words: jnp.ndarray, num_groups: int) -> jnp.ndarray:
     return jnp.tile(words, (1,) * (words.ndim - 1) + (reps,))
 
 
+def expand_level_planes(state, ctrl, cw_p, cwl_w, cwr_w):
+    """One [all-left; all-right] plane-space expansion level — the shared
+    recurrence body of this module's covering-subtree expansion and
+    `dpf._expand_levels_planes_fn`.
+
+    state: [16, 8, G] planes; ctrl: uint32[G] packed parent control bits;
+    cw_p: [16, 8, 2G or 1] seed-correction planes for the doubled width;
+    cwl_w / cwr_w: packed direction-correction words broadcastable to [G]
+    (one half each). Returns (state [16, 8, 2G], ctrl [2G])."""
+    sig = sigma_planes(state)
+    left = aes_rounds_planes(fixed_keys.RK_LEFT, sig) ^ sig
+    right = aes_rounds_planes(fixed_keys.RK_RIGHT, sig) ^ sig
+    state = jnp.concatenate([left, right], axis=-1)
+    ctrl2 = jnp.concatenate([ctrl, ctrl])  # parent bit, both halves
+    state = state ^ (cw_p & ctrl2[None, None, :])
+    t_new = state[0, 0]  # LSB plane = control bits
+    state = state.at[0, 0].set(jnp.zeros_like(t_new))
+    half = ctrl.shape[0]
+    cw_dir = jnp.concatenate(
+        [
+            jnp.broadcast_to(cwl_w, (half,)),
+            jnp.broadcast_to(cwr_w, (half,)),
+        ]
+    )
+    return state, t_new ^ (ctrl2 & cw_dir)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -160,23 +187,14 @@ def evaluate_selection_blocks_planes(
 
     for i in range(expand_levels):
         lvl = walk_levels + i
-        sig = sigma_planes(state)
-        left = aes_rounds_planes(fixed_keys.RK_LEFT, sig) ^ sig
-        right = aes_rounds_planes(fixed_keys.RK_RIGHT, sig) ^ sig
-        state = jnp.concatenate([left, right], axis=-1)  # [16, 8, 2G]
-        ctrl2 = jnp.concatenate([ctrl, ctrl])  # parent bit, both halves
-        groups = state.shape[-1]
-        cw_p = _tile_keys(pack_key_planes(cw_seeds[lvl]), groups)
-        state = state ^ (cw_p & ctrl2[None, None, :])
-        t_new = state[0, 0]  # LSB plane = control bits
-        state = state.at[0, 0].set(jnp.zeros_like(t_new))
-        cw_dir = jnp.concatenate(
-            [
-                _tile_keys(pack_key_bits(cw_left[lvl]), groups // 2),
-                _tile_keys(pack_key_bits(cw_right[lvl]), groups // 2),
-            ]
+        groups2 = 2 * state.shape[-1]
+        state, ctrl = expand_level_planes(
+            state,
+            ctrl,
+            _tile_keys(pack_key_planes(cw_seeds[lvl]), groups2),
+            _tile_keys(pack_key_bits(cw_left[lvl]), groups2 // 2),
+            _tile_keys(pack_key_bits(cw_right[lvl]), groups2 // 2),
         )
-        ctrl = t_new ^ (ctrl2 & cw_dir)
 
     # Leaf value blocks: output PRG + XOR value correction (party
     # negation is the identity for XOR shares).

@@ -69,3 +69,60 @@ def test_evaluate_at_share_correctness_via_planes(monkeypatch):
     for i, p in enumerate(points):
         s = vt.add(vt.to_python(e0, (i,)), vt.to_python(e1, (i,)))
         assert s == (beta if p == alpha else 0), (p, s)
+
+
+@pytest.mark.parametrize(
+    "p,levels",
+    [(1, 1), (1, 6), (3, 5), (32, 4), (7, 0)],
+)
+def test_expand_levels_planes_matches_limb(p, levels):
+    from distributed_point_functions_tpu.dpf import (
+        _expand_levels_limb_fn,
+        _expand_levels_planes_fn,
+    )
+
+    seeds = jnp.asarray(RNG.integers(0, 2**32, (p, 4), dtype=np.uint32))
+    control = jnp.asarray(RNG.integers(0, 2, p, dtype=np.uint32))
+    lmax = max(levels, 1)
+    cw_s = jnp.asarray(
+        RNG.integers(0, 2**32, (lmax, 4), dtype=np.uint32)
+    )
+    cw_l = jnp.asarray(RNG.integers(0, 2, lmax, dtype=np.uint32))
+    cw_r = jnp.asarray(RNG.integers(0, 2, lmax, dtype=np.uint32))
+    a = _expand_levels_limb_fn(levels)(seeds, control, cw_s, cw_l, cw_r)
+    b = _expand_levels_planes_fn(levels)(seeds, control, cw_s, cw_l, cw_r)
+    np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+    np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
+
+
+def test_hierarchical_eval_via_planes(monkeypatch):
+    """evaluate_until with DPF_TPU_EXPAND_LEVELS=planes: share sums over
+    a two-level hierarchy still reconstruct the point function."""
+    monkeypatch.setenv("DPF_TPU_EXPAND_LEVELS", "planes")
+    params = [
+        DpfParameters(log_domain_size=6, value_type=IntType(32)),
+        DpfParameters(log_domain_size=10, value_type=IntType(32)),
+    ]
+    dpf = DistributedPointFunction.create_incremental(params)
+    alpha, betas = 777, [5, 9]
+    k0, k1 = dpf.generate_keys_incremental(alpha, betas)
+    ctx0 = dpf.create_evaluation_context(k0)
+    ctx1 = dpf.create_evaluation_context(k1)
+    lvl0_0 = np.asarray(dpf.evaluate_next([], ctx0), dtype=np.uint32)
+    lvl0_1 = np.asarray(dpf.evaluate_next([], ctx1), dtype=np.uint32)
+    total0 = lvl0_0 + lvl0_1
+    prefix = alpha >> 4
+    for x in range(64):
+        assert total0[x] == (betas[0] if x == prefix else 0), x
+    # Descend under the live prefix to the full domain.
+    lvl1_0 = np.asarray(
+        dpf.evaluate_next([prefix], ctx0), dtype=np.uint32
+    )
+    lvl1_1 = np.asarray(
+        dpf.evaluate_next([prefix], ctx1), dtype=np.uint32
+    )
+    total1 = lvl1_0 + lvl1_1
+    base = prefix << 4
+    for j in range(16):
+        want = betas[1] if base + j == alpha else 0
+        assert total1[j] == want, (base + j, int(total1[j]))
