@@ -54,5 +54,14 @@ timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
     --num_iterations 3 \
     2>&1 | tee benchmarks/results/only_nonzeros_${stamp}.json || fail=1
 
+echo "=== domain 2^128 (CPU baselines: 32.7s hierarchical, 3.1s direct) ==="
+timeout 3600 python benchmarks/synthetic_data_benchmarks.py \
+    --log_domain_size 128 --log_num_nonzeros 20 --num_iterations 2 \
+    2>&1 | tee benchmarks/results/synthetic128_${stamp}.json || fail=1
+timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
+    --log_domain_size 128 --log_num_nonzeros 20 --only_nonzeros \
+    --num_iterations 3 \
+    2>&1 | tee benchmarks/results/only_nonzeros128_${stamp}.json || fail=1
+
 echo "done (fail=$fail): benchmarks/results/*_${stamp}.*"
 exit $fail

@@ -41,23 +41,80 @@ def synthesize_nonzeros(distribution: str, log_domain_size: int, n: int,
                         rng: np.random.Generator) -> np.ndarray:
     """Random nonzero indices with the reference's workload shapes
     (`experiments/README.md:35-48`): uniform, or power-law with 90% of mass
-    in the first 10%/50% of the domain."""
-    domain = 1 << log_domain_size
-    if distribution == "uniform":
-        vals = rng.integers(0, domain, n, dtype=np.uint64)
-    else:
-        frac = 0.1 if distribution == "powerlaw10" else 0.5
-        head = rng.random(n) < 0.9
-        vals = np.where(
-            head,
-            rng.integers(0, max(1, int(domain * frac)), n, dtype=np.uint64),
-            rng.integers(0, domain, n, dtype=np.uint64),
+    in the first 10%/50% of the domain.
+
+    Returns uint64[m, 2] (hi, lo) limb pairs, sorted and deduplicated —
+    domains up to 2^128 (`experiments/README.md:72-108`) exceed any numpy
+    integer dtype. For log_domain_size <= 64, hi is identically 0.
+    """
+    def draw(bits, k):
+        hi_bits = max(0, bits - 64)
+        lo_bits = min(bits, 64)
+        hi = (
+            _rand_bits(rng, hi_bits, k)
+            if hi_bits
+            else np.zeros(k, dtype=np.uint64)
         )
-    return np.unique(vals)
+        return hi, _rand_bits(rng, lo_bits, k)
+
+    if distribution == "uniform":
+        hi, lo = draw(log_domain_size, n)
+    else:
+        head = rng.random(n) < 0.9
+        frac = 0.1 if distribution == "powerlaw10" else 0.5
+        if log_domain_size <= 64:
+            # Exact head bound (the reference's 10%/50% of the domain);
+            # frac < 1 keeps the bound within uint64 even at lds = 64.
+            bound = max(1, int((1 << log_domain_size) * frac))
+            h_lo = rng.integers(0, bound, n, dtype=np.uint64)
+            h_hi = np.zeros(n, dtype=np.uint64)
+        else:
+            # Beyond numpy's integer range: power-of-two head bound
+            # (domain/8 ~ 12.5% for powerlaw10, domain/2 exact for 50%).
+            frac_bits = log_domain_size - (3 if frac == 0.1 else 1)
+            h_hi, h_lo = draw(frac_bits, n)
+        t_hi, t_lo = draw(log_domain_size, n)
+        hi = np.where(head, h_hi, t_hi)
+        lo = np.where(head, h_lo, t_lo)
+    return np.unique(np.stack([hi, lo], axis=1), axis=0)
 
 
-def read_unique_values_from_file(path: str) -> np.ndarray:
-    """Unique integers in the first CSV column, like the reference's
+def _rand_bits(rng: np.random.Generator, bits: int, k: int) -> np.ndarray:
+    """k random uint64 values of `bits` (<= 64) random low bits."""
+    if bits <= 0:
+        return np.zeros(k, dtype=np.uint64)
+    vals = rng.integers(0, 1 << min(bits, 63), k, dtype=np.uint64)
+    if bits == 64:
+        vals = (vals << np.uint64(1)) | rng.integers(
+            0, 2, k, dtype=np.uint64
+        )
+    return vals
+
+
+def _pairs_to_ints(pairs: np.ndarray) -> list:
+    """uint64[m, 2] (hi, lo) -> python ints (arbitrary precision)."""
+    return [(int(h) << 64) | int(l) for h, l in pairs]
+
+
+def _unique_prefixes(pairs: np.ndarray, shift: int) -> list:
+    """Distinct `x >> shift` over (hi, lo) pairs, as python ints."""
+    hi = pairs[:, 0]
+    lo = pairs[:, 1]
+    if shift >= 64:
+        p = np.unique(hi >> np.uint64(shift - 64))
+        return [int(x) for x in p]
+    if shift == 0:
+        u = np.unique(pairs, axis=0)
+        return _pairs_to_ints(u)
+    u = np.unique(
+        np.stack([hi, lo >> np.uint64(shift)], axis=1), axis=0
+    )
+    return [(int(h) << (64 - shift)) | int(l) for h, l in u]
+
+
+def read_unique_values_from_file(path: str) -> list:
+    """Unique integers in the first CSV column (sorted python ints —
+    values may exceed 64 bits), like the reference's
     `ReadUniqueValuesFromFile` (`synthetic_data_benchmarks.cc:121-144`)."""
     values = set()
     with open(path) as f:
@@ -66,7 +123,7 @@ def read_unique_values_from_file(path: str) -> np.ndarray:
             if not fields:
                 raise ValueError(f"Line {line_number} is empty")
             values.add(int(fields[0]))
-    return np.array(sorted(values), dtype=np.uint64)
+    return sorted(values)
 
 
 def main():
@@ -109,14 +166,17 @@ def main():
 
     rng = np.random.default_rng(42)
     if args.input:
-        nonzeros = read_unique_values_from_file(args.input)
-        if not len(nonzeros):
+        values = read_unique_values_from_file(args.input)
+        if not values:
             raise ValueError(f"--input {args.input} contains no values")
-        if int(nonzeros[-1]) >= (1 << lds):
+        if values[-1] >= (1 << lds):
             raise ValueError(
-                f"nonzero {int(nonzeros[-1])} out of range for domain "
-                f"2^{lds}"
+                f"nonzero {values[-1]} out of range for domain 2^{lds}"
             )
+        nonzeros = np.array(
+            [[v >> 64, v & ((1 << 64) - 1)] for v in values],
+            dtype=np.uint64,
+        )
     else:
         nonzeros = synthesize_nonzeros(
             args.distribution, lds, 1 << args.log_num_nonzeros, rng
@@ -127,7 +187,8 @@ def main():
         for l in levels
     ]
     dpf = DistributedPointFunction.create_incremental(params)
-    alpha = int(nonzeros[len(nonzeros) // 2])
+    mid = nonzeros[len(nonzeros) // 2]
+    alpha = (int(mid[0]) << 64) | int(mid[1])
     k0, _ = dpf.generate_keys_incremental(alpha, [1] * len(levels))
 
     max_prefixes = int(args.max_expansion_factor * len(nonzeros))
@@ -145,20 +206,15 @@ def main():
                 # Keep the live prefixes of the workload at this level
                 # (the server knows which buckets are nonzero), capped at
                 # the expansion factor like the reference harness.
-                shift = lds - level_bits
-                live = np.unique(nonzeros >> np.uint64(shift)).astype(
-                    np.uint64
-                )
-                if len(live) > max_prefixes:
-                    live = live[:max_prefixes]
-                prefixes = [int(x) for x in live]
+                live = _unique_prefixes(nonzeros, lds - level_bits)
+                prefixes = live[:max_prefixes]
         return total_evaluated
 
     if args.only_nonzeros:
         # Batched single-point evaluation at the nonzero indices
         # (`RunBatchedSinglePointEvaluation`,
         # `synthetic_data_benchmarks.cc:299-302`).
-        points = [int(x) for x in nonzeros]
+        points = _pairs_to_ints(nonzeros)
         last_level = len(levels) - 1
 
         def one_iteration():

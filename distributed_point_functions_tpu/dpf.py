@@ -357,10 +357,9 @@ def _expand_levels_planes_fn(num_levels: int):
 def _expand_levels_fn(num_levels: int):
     """Dispatch the fused expansion program: `DPF_TPU_EXPAND_LEVELS` =
     `limb` | `planes` | `auto` (default: planes on TPU, limb elsewhere)."""
-    mode = os.environ.get("DPF_TPU_EXPAND_LEVELS", "auto")
-    if mode == "planes" or (
-        mode == "auto" and jax.default_backend() == "tpu"
-    ):
+    from .utils.runtime import planes_selected
+
+    if planes_selected("DPF_TPU_EXPAND_LEVELS"):
         return _expand_levels_planes_fn(num_levels)
     return _expand_levels_limb_fn(num_levels)
 
@@ -450,10 +449,10 @@ def _eval_paths_planes(
             )
         # Shared correction words: every lane uses the same bit, so the
         # packed word is all-ones or all-zeros.
-        bits = ((cw_seed[0][:, None] >> shifts) & U32(1)).reshape(128)
-        planes = (U32(0) - bits).reshape(16, 8, 1)
+        from .ops.aes_bitslice import broadcast_cw_planes
+
         return (
-            planes,                               # broadcasts over groups
+            broadcast_cw_planes(cw_seed[0]),      # broadcasts over groups
             (U32(0) - (cw_l[0] & U32(1)))[None],
             (U32(0) - (cw_r[0] & U32(1)))[None],
         )
@@ -489,10 +488,9 @@ def _eval_paths(seeds, control, paths, cw_seeds, cw_left, cw_right,
     """Dispatch the path walk: `DPF_TPU_EVAL_PATHS` = `limb` | `planes` |
     `auto` (default: planes on TPU, limb elsewhere — same trade-off as
     `dense_eval.expansion_impl`)."""
-    mode = os.environ.get("DPF_TPU_EVAL_PATHS", "auto")
-    if mode == "planes" or (
-        mode == "auto" and jax.default_backend() == "tpu"
-    ):
+    from .utils.runtime import planes_selected
+
+    if planes_selected("DPF_TPU_EVAL_PATHS"):
         return _eval_paths_planes(
             seeds, control, paths, cw_seeds, cw_left, cw_right, bit_indices
         )

@@ -34,7 +34,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.inner_product import unpack_selection_bits
-from ..pir.dense_eval import evaluate_selection_blocks
+from ..pir.dense_eval import expansion_impl
 
 U32 = jnp.uint32
 
@@ -201,7 +201,7 @@ def sharded_dense_pir_step_multi(
 
     def step(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
              *db_shards):
-        sel_local = evaluate_selection_blocks(
+        sel_local = expansion_impl()(
             seeds0,
             control0,
             cw_seeds,

@@ -162,12 +162,9 @@ def expansion_impl():
     elsewhere (the plane path's win is VPU work; CPU compile times favor
     the limb path in the hermetic suite).
     """
-    import os
+    from ..utils.runtime import planes_selected
 
-    mode = os.environ.get("DPF_TPU_EXPANSION", "auto")
-    if mode == "planes" or (
-        mode == "auto" and jax.default_backend() == "tpu"
-    ):
+    if planes_selected("DPF_TPU_EXPANSION"):
         from .dense_eval_planes import evaluate_selection_blocks_planes
 
         return evaluate_selection_blocks_planes

@@ -35,3 +35,23 @@ def log_backend_mode_once(logger: logging.Logger | None = None) -> None:
         "distributed_point_functions_tpu is in mode %s",
         get_backend_mode_string(),
     )
+
+
+def planes_selected(env_var: str) -> bool:
+    """Shared mode predicate for the plane-resident kernel dispatchers
+    (`DPF_TPU_EXPANSION`, `DPF_TPU_EVAL_PATHS`, `DPF_TPU_EXPAND_LEVELS`):
+    `planes` forces them on, `limb` off, `auto` (default) selects planes
+    on TPU. Unknown values raise instead of silently selecting limb.
+    """
+    import os
+
+    import jax
+
+    mode = os.environ.get(env_var, "auto")
+    if mode not in ("auto", "limb", "planes"):
+        raise ValueError(
+            f"{env_var}={mode!r}: expected auto|limb|planes"
+        )
+    return mode == "planes" or (
+        mode == "auto" and jax.default_backend() == "tpu"
+    )

@@ -309,3 +309,33 @@ def test_mesh_server_small_database_beyond_tree_capacity():
     r1 = sharded.handle_request(reqs[1]).dpf_pir_response.masked_response
     for q, idx in enumerate(indices):
         assert xor_bytes(r0[q], r1[q]) == records[idx]
+
+
+def test_sharded_step_planes_matches_limb(monkeypatch):
+    """The sharded step with the plane-resident expansion forced must be
+    bit-identical to the limb expansion (both through shard_map)."""
+    num_records, num_words, nq = 1 << 13, 8, 16
+    num_blocks = num_records // 128
+    client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+    indices = [int(i) for i in RNG.integers(0, num_records, nq)]
+    keys0, _ = client._generate_key_pairs(indices)
+    staged = stage_keys(keys0)
+    total_levels = client._dpf._tree_levels_needed - 1
+    expand_levels = min((num_blocks - 1).bit_length(), total_levels)
+    walk_levels = total_levels - expand_levels
+    db = RNG.integers(0, 1 << 32, (num_records, num_words), dtype=np.uint32)
+
+    outs = {}
+    for mode in ("limb", "planes"):
+        monkeypatch.setenv("DPF_TPU_EXPANSION", mode)
+        mesh = require_mesh()
+        step = sharded_dense_pir_step(
+            mesh,
+            walk_levels=walk_levels,
+            expand_levels=expand_levels,
+            num_blocks=num_blocks,
+        )
+        outs[mode] = np.asarray(
+            step(*staged, shard_database(mesh, jnp.asarray(db)))
+        )
+    np.testing.assert_array_equal(outs["limb"], outs["planes"])
