@@ -381,8 +381,15 @@ def main():
     if expand_mode in ("both", "limb"):
         candidates["limb"] = make_pir_step(evaluate_selection_blocks)
     if expand_mode in ("both", "planes"):
+        import functools
+
+        # force_planes: the A/B must really time the planes kernel (the
+        # small-batch padding guard would silently reroute tiny query
+        # counts to the limb kernel and mislabel the timing).
         candidates["planes"] = make_pir_step(
-            evaluate_selection_blocks_planes
+            functools.partial(
+                evaluate_selection_blocks_planes, force_planes=True
+            )
         )
 
     _PROGRESS["stage"] = "compile"

@@ -20,7 +20,20 @@ jax.jit(lambda a: a ^ np.uint32(3))(x).block_until_ready()
 EOF
 }
 
+# Deadline (epoch seconds, env TPU_WATCH_DEADLINE): no capture *starts*
+# within 45 min of it, and polling stops at it, to keep watcher captures
+# clear of the round's driver-run bench on the single-client tunnel. (A
+# healthy capture finishes well inside 45 min; only a mid-capture tunnel
+# stall runs longer, and then the driver bench would be stalled anyway.)
+deadline=${TPU_WATCH_DEADLINE:-0}
+margin=2700
+
 while true; do
+    if [ "$deadline" -gt 0 ] && \
+       [ "$(date +%s)" -ge "$((deadline - margin))" ]; then
+        echo "$(date -u +%H:%M:%S) deadline margin reached - exiting" >>"$log"
+        exit 0
+    fi
     if probe; then
         echo "$(date -u +%H:%M:%S) tunnel ALIVE - launching capture" >>"$log"
         bash benchmarks/capture_tpu.sh >>"$log" 2>&1

@@ -162,11 +162,19 @@ def expansion_impl():
     elsewhere (the plane path's win is VPU work; CPU compile times favor
     the limb path in the hermetic suite).
     """
+    import functools
+    import os
+
     from ..utils.runtime import planes_selected
 
     if planes_selected("DPF_TPU_EXPANSION"):
         from .dense_eval_planes import evaluate_selection_blocks_planes
 
+        if os.environ.get("DPF_TPU_EXPANSION") == "planes":
+            # Explicitly forced: bypass the small-batch padding guard.
+            return functools.partial(
+                evaluate_selection_blocks_planes, force_planes=True
+            )
         return evaluate_selection_blocks_planes
     return evaluate_selection_blocks
 

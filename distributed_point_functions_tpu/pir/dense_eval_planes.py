@@ -138,13 +138,55 @@ def expand_level_planes(state, ctrl, cw_p, cwl_w, cwr_w):
     return state, t_new ^ (ctrl2 & cw_dir)
 
 
+def evaluate_selection_blocks_planes(
+    seeds0: jnp.ndarray,
+    control0: jnp.ndarray,
+    cw_seeds: jnp.ndarray,
+    cw_left: jnp.ndarray,
+    cw_right: jnp.ndarray,
+    last_vc: jnp.ndarray,
+    *,
+    walk_levels: int,
+    expand_levels: int,
+    num_blocks: int,
+    bitrev_leaves: bool = False,
+    force_planes: bool = False,
+) -> jnp.ndarray:
+    """Plane-resident expansion with a padding-ratio guard.
+
+    The key axis is padded to a multiple of 32 and the dead lanes double
+    along with the live ones at every level, so a batch of e.g. 3 queries
+    would pay ~10x the AES work. When the padding overhead exceeds 25%,
+    fall back to the limb kernel (which pads per 32-block hash call and
+    reaches full occupancy once the width fills a word).
+    `force_planes=True` bypasses the guard (differential tests)."""
+    nk = seeds0.shape[0]
+    padded = ((nk + 31) // 32) * 32
+    if not force_planes and not bitrev_leaves and padded * 4 > nk * 5:
+        from .dense_eval import evaluate_selection_blocks
+
+        return evaluate_selection_blocks(
+            seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
+            walk_levels=walk_levels,
+            expand_levels=expand_levels,
+            num_blocks=num_blocks,
+        )
+    return _evaluate_selection_blocks_planes_jit(
+        seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
+        walk_levels=walk_levels,
+        expand_levels=expand_levels,
+        num_blocks=num_blocks,
+        bitrev_leaves=bitrev_leaves,
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
         "walk_levels", "expand_levels", "num_blocks", "bitrev_leaves"
     ),
 )
-def evaluate_selection_blocks_planes(
+def _evaluate_selection_blocks_planes_jit(
     seeds0: jnp.ndarray,
     control0: jnp.ndarray,
     cw_seeds: jnp.ndarray,

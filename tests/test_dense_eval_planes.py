@@ -53,6 +53,7 @@ def test_planes_matches_limb(num_records, nq):
             evaluate_selection_blocks_planes(
                 *staged,
                 walk_levels=wl, expand_levels=el, num_blocks=num_blocks,
+                force_planes=True,
             )
         )
         np.testing.assert_array_equal(a, b)
@@ -74,7 +75,8 @@ def test_planes_pads_beyond_tree_capacity():
     )
     b = np.asarray(
         evaluate_selection_blocks_planes(
-            *staged, walk_levels=wl, expand_levels=el, num_blocks=8
+            *staged, walk_levels=wl, expand_levels=el, num_blocks=8,
+            force_planes=True,
         )
     )
     np.testing.assert_array_equal(a, b)

@@ -126,3 +126,15 @@ def test_hierarchical_eval_via_planes(monkeypatch):
     for j in range(16):
         want = betas[1] if base + j == alpha else 0
         assert total1[j] == want, (base + j, int(total1[j]))
+
+
+def test_dispatcher_rejects_unknown_mode(monkeypatch):
+    from distributed_point_functions_tpu.utils.runtime import planes_selected
+
+    monkeypatch.setenv("DPF_TPU_EVAL_PATHS", "plane")  # typo
+    with pytest.raises(ValueError, match="auto|limb|planes"):
+        planes_selected("DPF_TPU_EVAL_PATHS")
+    monkeypatch.setenv("DPF_TPU_EVAL_PATHS", "limb")
+    assert planes_selected("DPF_TPU_EVAL_PATHS") is False
+    monkeypatch.setenv("DPF_TPU_EVAL_PATHS", "planes")
+    assert planes_selected("DPF_TPU_EVAL_PATHS") is True

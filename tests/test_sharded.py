@@ -313,8 +313,12 @@ def test_mesh_server_small_database_beyond_tree_capacity():
 
 def test_sharded_step_planes_matches_limb(monkeypatch):
     """The sharded step with the plane-resident expansion forced must be
-    bit-identical to the limb expansion (both through shard_map)."""
-    num_records, num_words, nq = 1 << 13, 8, 16
+    bit-identical to the limb expansion (both through shard_map).
+
+    nq = 256 so each of the 8 shards sees 32 keys — enough that the
+    planes path's small-batch padding guard does not reroute to limb
+    (which would make this comparison vacuous)."""
+    num_records, num_words, nq = 1 << 13, 8, 256
     num_blocks = num_records // 128
     client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
     indices = [int(i) for i in RNG.integers(0, num_records, nq)]
