@@ -30,8 +30,11 @@ north-star's second metric
 (`dpf/distributed_point_function_benchmark.cc:43-95`).
 
 Environment knobs: BENCH_RECORDS (default 2^20), BENCH_RECORD_BYTES (256),
-BENCH_QUERIES (64), BENCH_ITERS (16, min 1), BENCH_NO_PALLAS=1 to force the
-jnp inner product, BENCH_SKIP_NSLEAF=1 to skip the secondary metric.
+BENCH_QUERIES (64), BENCH_ITERS (16, min 1), BENCH_NO_PALLAS=1 /
+BENCH_NO_BITPLANE=1 to skip inner-product tiers, BENCH_EXPANSION=
+both|limb|planes for the expansion A/B, BENCH_SKIP_NSLEAF=1 to skip the
+secondary metric, BENCH_PLATFORM=cpu for a hermetic CPU run, and
+BENCH_TIMEOUT (default 2400 s) for the stall watchdog.
 """
 
 from __future__ import annotations
@@ -288,10 +291,10 @@ def main():
 
     from distributed_point_functions_tpu.ops.inner_product import (
         xor_inner_product,
+        xor_inner_product_bitplane,
     )
     from distributed_point_functions_tpu.ops.inner_product_pallas import (
         permute_db_bitmajor,
-        xor_inner_product_pallas,
         xor_inner_product_pallas_staged,
     )
     from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
@@ -323,9 +326,9 @@ def main():
 
     # Choose the inner-product path: the Pallas packed-bits kernel if it
     # compiles and is bit-identical to the jnp path on this device.
-    use_pallas = os.environ.get("BENCH_NO_PALLAS", "") != "1"
-    _PROGRESS["stage"] = "pallas-check"
-    if use_pallas:
+    def verify_ip(name, fn, staged_layout):
+        """Cross-check a candidate inner product against the XOR path on
+        a small on-device instance; returns True when bit-identical."""
         try:
             check_db = jax.device_put(
                 rng.integers(0, 1 << 32, (4096, num_words), dtype=np.uint32)
@@ -333,21 +336,46 @@ def main():
             check_sel = jax.device_put(
                 rng.integers(0, 1 << 32, (4, 32, 4), dtype=np.uint32)
             )
-            got_p = np.asarray(xor_inner_product_pallas(check_db, check_sel))
-            got_j = np.asarray(xor_inner_product(check_db, check_sel))
-            if not np.array_equal(got_p, got_j):
-                raise RuntimeError("pallas/jnp mismatch on device")
-            _log("inner product: Pallas packed-bits kernel (verified)")
+            arg = (
+                permute_db_bitmajor(check_db) if staged_layout else check_db
+            )
+            got = np.asarray(fn(arg, check_sel))
+            want = np.asarray(xor_inner_product(check_db, check_sel))
+            if not np.array_equal(got, want):
+                raise RuntimeError(f"{name}/jnp mismatch on device")
+            _log(f"inner product: {name} path (verified)")
+            return True
         except Exception as e:  # noqa: BLE001
-            use_pallas = False
             _log(
-                "inner product: falling back to jnp "
+                f"inner product: {name} path unavailable "
                 f"({str(e).splitlines()[0]})"
             )
-    if use_pallas:
+            return False
+
+    _PROGRESS["stage"] = "pallas-check"
+    use_pallas = os.environ.get(
+        "BENCH_NO_PALLAS", ""
+    ) != "1" and verify_ip(
+        "pallas", xor_inner_product_pallas_staged, staged_layout=True
+    )
+    # Bit-plane jnp path (same MXU math as Pallas, no Mosaic): the middle
+    # choice when the Pallas kernel fails on this device/backend.
+    use_bitplane = (
+        not use_pallas
+        and jax.default_backend() == "tpu"
+        and os.environ.get("BENCH_NO_BITPLANE", "") != "1"
+        and verify_ip(
+            "bitplane", xor_inner_product_bitplane, staged_layout=True
+        )
+    )
+    if use_pallas or use_bitplane:
         # Stage the bit-major layout once (the serving path does the same).
         db_words = jax.block_until_ready(permute_db_bitmajor(db_words))
-        inner_product = xor_inner_product_pallas_staged
+        inner_product = (
+            xor_inner_product_pallas_staged
+            if use_pallas
+            else xor_inner_product_bitplane
+        )
     else:
         inner_product = xor_inner_product
 
@@ -456,6 +484,7 @@ def main():
     # the log shows how the batch divides between DPF expansion and the
     # database pass.
     ip_ms = None
+    ip_alt_ms = None
     try:
         expand_only = jax.jit(
             lambda s0, c0, cs, cl, cr, vc: evaluate_selection_blocks_best(
@@ -477,6 +506,22 @@ def main():
                 f"({num_padded * num_words * 4 / per_ip / 1e9:.0f} GB/s), "
                 f"expansion ~{per_batch * 1e3 - ip_ms:.2f} ms"
             )
+        if use_pallas:
+            # Record the bit-plane alternate on the same staged layout so
+            # the capture shows whether Mosaic actually beats plain XLA.
+            try:
+                jax.block_until_ready(
+                    xor_inner_product_bitplane(db_words, sel_fixed)
+                )
+                per_alt, _ = _slope_time(
+                    lambda: xor_inner_product_bitplane(db_words, sel_fixed),
+                    iters,
+                )
+                if per_alt is not None:
+                    ip_alt_ms = per_alt * 1e3
+                    _log(f"split: bitplane alternate {ip_alt_ms:.2f} ms")
+            except Exception as e:  # noqa: BLE001
+                _log(f"bitplane alternate timing failed: {e}")
     except Exception as e:  # noqa: BLE001
         _log(f"split timing failed: {e}")
 
@@ -490,7 +535,14 @@ def main():
 
     extra = {
         "inner_product_effective_gbps": round(gbps, 2),
-        "inner_product_path": "pallas" if use_pallas else "jnp",
+        "inner_product_path": (
+            "pallas" if use_pallas
+            else "bitplane" if use_bitplane
+            else "jnp"
+        ),
+        "inner_product_bitplane_alt_ms": (
+            round(ip_alt_ms, 3) if ip_alt_ms else None
+        ),
         "expansion_path": best,
         "expansion_per_batch_ms": {
             k: round(v * 1e3, 3) for k, v in timings.items()

@@ -102,6 +102,61 @@ def xor_inner_product(
     return acc
 
 
+@jax.jit
+def xor_inner_product_bitplane(
+    db_perm: jnp.ndarray, selections: jnp.ndarray
+) -> jnp.ndarray:
+    """XOR inner product as MXU bit-plane matmuls, in pure jnp.
+
+    Same math as the Pallas kernel (`inner_product_pallas.py`): output bit
+    j of word w is the parity of an integer matmul count, computed as 32
+    value-bit-plane dots with exact f32 accumulation — but expressed as
+    plain XLA ops so it runs on the MXU with no Mosaic dependency (the
+    serving path's middle fallback between the Pallas kernel and the
+    mask-and-XOR path).
+
+    db_perm: uint32[32, G, W] bit-major staged database
+    (`inner_product_pallas.permute_db_bitmajor`; R = 32G records <= 2^24
+    for exact f32 counts); selections: uint32[nq, B, 4] packed blocks.
+    Returns uint32[nq, W].
+    """
+    from .inner_product_pallas import MAX_RECORDS_EXACT
+
+    _, num_groups, num_words = db_perm.shape
+    if 32 * num_groups > MAX_RECORDS_EXACT:
+        raise ValueError(
+            f"bit-plane inner product supports at most "
+            f"{MAX_RECORDS_EXACT} records (f32-exact parity counts); "
+            f"got {32 * num_groups}"
+        )
+    nq = selections.shape[0]
+    packed = selections.reshape(nq, -1)
+    if packed.shape[1] > num_groups:
+        packed = packed[:, :num_groups]
+    elif packed.shape[1] < num_groups:
+        packed = jnp.pad(packed, ((0, 0), (0, num_groups - packed.shape[1])))
+
+    shifts = jnp.arange(32, dtype=U32)
+    # Selection bits: [nq, 32(b), G] -> [nq, 32G] matching db_perm's
+    # (b, g) flattening; 0/1 bf16 feeds the MXU.
+    sel_bits = ((packed[:, None, :] >> shifts[None, :, None]) & U32(1))
+    sel_f = sel_bits.reshape(nq, -1).astype(jnp.bfloat16)
+    db_flat = db_perm.reshape(-1, num_words)  # [32G, W]
+
+    def body(j, counts):
+        bits_j = ((db_flat >> j.astype(U32)) & U32(1)).astype(jnp.bfloat16)
+        c = jax.lax.dot_general(
+            sel_f, bits_j, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [nq, W] counts for value bit j
+        parity = c.astype(jnp.int32).astype(U32) & U32(1)
+        return counts | (parity << j.astype(U32))
+
+    return lax.fori_loop(
+        0, 32, body, jnp.zeros((nq, num_words), dtype=U32)
+    )
+
+
 def xor_inner_product_np(
     db_words: np.ndarray, selections: np.ndarray
 ) -> np.ndarray:

@@ -7,12 +7,15 @@ XORs with Highway SIMD (`pir/dense_dpf_pir_database.h:101-111`,
 record is zero-padded to the maximum record size, and the record count is
 padded to a multiple of 128 so whole selection blocks line up with rows.
 
-`inner_product_with` serves the whole query batch in one database pass.
-On TPU it routes through the Pallas MXU kernel
-(`ops/inner_product_pallas.py`), staging the bit-major database layout
-once on first use; elsewhere (CPU tests) or on any kernel failure it
-falls back to the jitted jnp XOR-reduction (`ops/inner_product.py`).
-Set ``DPF_TPU_INNER_PRODUCT=jnp`` (or ``pallas``) to force a path.
+`inner_product_with` serves the whole query batch in one database pass
+through a three-tier chain: on TPU the Pallas MXU kernel
+(`ops/inner_product_pallas.py`, bit-major layout staged once on first
+use); on its failure the pure-jnp MXU bit-plane path
+(`ops/inner_product.py:xor_inner_product_bitplane`, same math, no Mosaic
+dependency); and finally — elsewhere (CPU tests), beyond the 2^24-record
+f32-exactness bound, or on any failure — the jitted jnp XOR-reduction.
+Set ``DPF_TPU_INNER_PRODUCT=pallas|bitplane|jnp`` to force a tier
+(forced tiers propagate their errors instead of falling through).
 """
 
 from __future__ import annotations
@@ -25,7 +28,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.inner_product import xor_inner_product
+from ..ops.inner_product import (
+    xor_inner_product,
+    xor_inner_product_bitplane,
+)
 from ..ops.inner_product_pallas import (
     MAX_RECORDS_EXACT,
     permute_db_bitmajor,
@@ -135,7 +141,7 @@ class DenseDpfPirDatabase:
         mode = os.environ.get("DPF_TPU_INNER_PRODUCT", "auto")
         if mode == "pallas":
             return True
-        if mode == "jnp":
+        if mode in ("jnp", "bitplane"):
             return False
         return (
             not self._pallas_failed
@@ -144,6 +150,7 @@ class DenseDpfPirDatabase:
         )
 
     def _inner_product_device(self, selections: jnp.ndarray) -> jnp.ndarray:
+        mode = os.environ.get("DPF_TPU_INNER_PRODUCT", "auto")
         if self._use_pallas():
             try:
                 if self._db_perm is None:
@@ -154,15 +161,38 @@ class DenseDpfPirDatabase:
                     self._db_perm, selections
                 )
             except Exception as e:
-                if os.environ.get("DPF_TPU_INNER_PRODUCT") == "pallas":
+                if mode == "pallas":
                     raise
                 # Remember the failure: a failed trace/compile is not
                 # cached by jit, so retrying would pay it on every batch.
                 self._pallas_failed = True
-                self._db_perm = None
                 warnings.warn(
                     "pallas inner-product kernel failed; serving via the "
-                    f"jnp path ({str(e).splitlines()[0][:200]})"
+                    f"bit-plane jnp path ({str(e).splitlines()[0][:200]})"
+                )
+        # Middle fallback: the same MXU bit-plane math in pure jnp — no
+        # Mosaic dependency (`ops/inner_product.py`). Same staged layout
+        # and record-count bound as the Pallas kernel. A forced
+        # mode=bitplane propagates its errors (incl. the record-count
+        # bound); auto mode falls through to the XOR path on any failure.
+        if mode == "bitplane" or (
+            mode == "auto"
+            and jax.default_backend() == "tpu"
+            and self._num_padded <= MAX_RECORDS_EXACT
+        ):
+            try:
+                if self._db_perm is None:
+                    self._db_perm = jax.block_until_ready(
+                        permute_db_bitmajor(jnp.asarray(self._host_words))
+                    )
+                return xor_inner_product_bitplane(self._db_perm, selections)
+            except Exception as e:  # noqa: BLE001
+                if mode == "bitplane":
+                    raise
+                self._db_perm = None
+                warnings.warn(
+                    "bit-plane inner product failed; serving via the XOR "
+                    f"path ({str(e).splitlines()[0][:200]})"
                 )
         return xor_inner_product(self.db_words, selections)
 

@@ -64,3 +64,55 @@ def test_pallas_inner_product_odd_query_counts(nq):
     sel = pack_selection_bits_np(bits)
     got = np.asarray(xor_inner_product_pallas(db, sel, interpret=True))
     np.testing.assert_array_equal(got, xor_inner_product_np(db, sel))
+
+
+def test_bitplane_jnp_matches_xor_paths():
+    """The pure-jnp MXU bit-plane inner product (the serving path's
+    middle fallback) must match the mask-and-XOR path bit for bit."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from distributed_point_functions_tpu.ops.inner_product import (
+        xor_inner_product,
+        xor_inner_product_bitplane,
+    )
+    from distributed_point_functions_tpu.ops.inner_product_pallas import (
+        permute_db_bitmajor,
+    )
+
+    rng = np.random.default_rng(31)
+    for R, W, nq in [(512, 8, 5), (4096, 20, 3)]:
+        db = jnp.asarray(rng.integers(0, 1 << 32, (R, W), dtype=np.uint32))
+        sel = jnp.asarray(
+            rng.integers(0, 1 << 32, (nq, R // 128, 4), dtype=np.uint32)
+        )
+        a = np.asarray(
+            xor_inner_product_bitplane(permute_db_bitmajor(db), sel)
+        )
+        b = np.asarray(xor_inner_product(db, sel))
+        np.testing.assert_array_equal(a, b)
+
+
+def test_database_serves_via_bitplane(monkeypatch):
+    """DPF_TPU_INNER_PRODUCT=bitplane routes the database through the
+    bit-plane path with identical record bytes."""
+    import numpy as np
+
+    from distributed_point_functions_tpu.ops.inner_product import (
+        pack_selection_bits_np,
+    )
+    from distributed_point_functions_tpu.pir.database import (
+        DenseDpfPirDatabase,
+    )
+
+    rng = np.random.default_rng(32)
+    records = [rng.bytes(24) for _ in range(300)]
+    db = DenseDpfPirDatabase(records)
+    bits = rng.integers(0, 2, (4, db.num_selection_bits), dtype=np.uint32)
+    sel = pack_selection_bits_np(bits)
+
+    monkeypatch.setenv("DPF_TPU_INNER_PRODUCT", "jnp")
+    a = db.inner_product_with(sel)
+    monkeypatch.setenv("DPF_TPU_INNER_PRODUCT", "bitplane")
+    b = db.inner_product_with(sel)
+    assert a == b
