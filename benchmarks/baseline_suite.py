@@ -61,6 +61,7 @@ def bench_dense_big(scale: str):
 
     from distributed_point_functions_tpu.ops.inner_product import (
         xor_inner_product,
+        xor_inner_product_bitplane,
     )
     from distributed_point_functions_tpu.ops.inner_product_pallas import (
         permute_db_bitmajor,
@@ -84,11 +85,25 @@ def bench_dense_big(scale: str):
     )
     t0 = time.perf_counter()
     on_tpu = jax.default_backend() == "tpu"
+    ip_name = "jnp"
     if on_tpu:
         db = jax.block_until_ready(
             permute_db_bitmajor(jax.device_put(db_host))
         )
-        inner_product = xor_inner_product_pallas_staged
+        # Same tier order as the serving path: Pallas, else the pure-jnp
+        # bit-plane MXU path (both consume the staged layout).
+        try:
+            jax.block_until_ready(
+                xor_inner_product_pallas_staged(
+                    db, np.zeros((8, db.shape[1], 4), np.uint32)
+                )
+            )
+            inner_product = xor_inner_product_pallas_staged
+            ip_name = "pallas"
+        except Exception as e:  # noqa: BLE001
+            print(f"# pallas unavailable, using bitplane: {e}", flush=True)
+            inner_product = xor_inner_product_bitplane
+            ip_name = "bitplane"
     else:
         db = jax.device_put(db_host)
         inner_product = xor_inner_product
@@ -130,7 +145,7 @@ def bench_dense_big(scale: str):
         stage_db_s=round(stage_db_s, 2),
         keygen_s=round(keygen_s, 2),
         backend=jax.default_backend(),
-        inner_product="pallas" if on_tpu else "jnp",
+        inner_product=ip_name,
     )
 
 
@@ -197,6 +212,14 @@ def bench_sparse_big(scale: str):
 
 
 def main():
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # The environment's sitecustomize pins the remote-TPU platform;
+        # the config update (pre-backend-init) restores the requested one.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", choices=["smoke", "full"], default="smoke")
     ap.add_argument(
