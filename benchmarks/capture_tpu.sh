@@ -11,6 +11,18 @@ fail=0
 # Every suite runs under `timeout`: the observed tunnel-stall mode blocks
 # inside block_until_ready where no Python-level watchdog can be relied
 # on, and a hung suite would kill the watcher's recovery loop.
+
+# Pass 0 — minimal headline first. The tunnel has come up for windows as
+# short as ~4 minutes; one limb-only compile (~150 s) plus a short
+# measurement maximizes the chance a brief window still yields the
+# round's gating number before the full A/B + sweeps below.
+echo "=== quick headline (limb only, no secondary metrics) ==="
+timeout 600 env BENCH_EXPANSION=limb BENCH_SKIP_NSLEAF=1 BENCH_ITERS=8 \
+    BENCH_TIMEOUT=540 python bench.py \
+    2>benchmarks/results/bench_quick_${stamp}.log \
+    | tee benchmarks/results/bench_quick_${stamp}.json
+tail -5 benchmarks/results/bench_quick_${stamp}.log
+
 echo "=== headline bench (2^20 x 256B) ==="
 timeout 2700 python bench.py 2>benchmarks/results/bench_${stamp}.log \
     | tee benchmarks/results/bench_${stamp}.json || fail=1
