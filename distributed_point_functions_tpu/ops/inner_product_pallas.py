@@ -45,8 +45,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 U32 = jnp.uint32
+I32 = jnp.int32
+I8 = jnp.int8
 BF16 = jnp.bfloat16
 F32 = jnp.float32
 
@@ -116,25 +119,45 @@ def _ip_kernel(sel_ref, db_ref, out_ref, *, num_value_bits: int):
     lax.fori_loop(0, 32, body, 0)
 
 
-def _pick_group_tile(num_groups: int) -> int:
-    """Largest tile <= _TILE_GROUPS that divides num_groups and is a
+def _pick_group_tile(num_groups: int, max_tile: int = _TILE_GROUPS) -> int:
+    """Largest tile <= max_tile that divides num_groups and is a
     multiple of 8 (TPU sublane), or the full axis for small databases.
 
     `permute_db_bitmajor` pads so num_groups % _TILE_GROUPS == 0; the
     search only matters for hand-built layouts. A large layout with no
     legal tile is rejected rather than compiled as one giant VMEM block.
     """
-    tg = min(_TILE_GROUPS, num_groups)
+    tg = min(max_tile, num_groups)
     while tg >= 8:
         if num_groups % tg == 0 and tg % 8 == 0:
             return tg
         tg -= 8
-    if num_groups > _TILE_GROUPS:
+    if num_groups > max_tile:
         raise ValueError(
             f"no legal group tile for {num_groups} groups; stage the "
             "database with permute_db_bitmajor (which pads)"
         )
     return num_groups
+
+
+def _stage_selections(selections: jnp.ndarray, num_groups: int):
+    """Flatten packed selection blocks to [nq_pad, num_groups] words.
+
+    Extra words beyond the staged layout's (zero-padded) groups are
+    dropped; missing words and the query count's non-multiple-of-8 tail
+    are zero-padded (zero selection bits never contribute to a XOR).
+    Returns (packed, nq) with nq the caller's true query count.
+    """
+    nq = selections.shape[0]
+    packed = selections.reshape(nq, -1)
+    if packed.shape[1] > num_groups:
+        packed = packed[:, :num_groups]
+    elif packed.shape[1] < num_groups:
+        packed = jnp.pad(packed, ((0, 0), (0, num_groups - packed.shape[1])))
+    nq_pad = ((nq + 7) // 8) * 8
+    if nq_pad != nq:
+        packed = jnp.pad(packed, ((0, nq_pad - nq), (0, 0)))
+    return packed, nq
 
 
 @functools.partial(
@@ -194,19 +217,146 @@ def xor_inner_product_pallas_staged(
             f"pallas inner product supports at most {MAX_RECORDS_EXACT} "
             f"records (f32-exact parity counts); got {num_records}"
         )
-    nq = selections.shape[0]
-    packed = selections.reshape(nq, -1)
-    if packed.shape[1] > num_groups:
-        packed = packed[:, :num_groups]
-    elif packed.shape[1] < num_groups:
-        # The staged layout is padded with zero records; zero selection
-        # words for them contribute nothing to the XOR.
-        packed = jnp.pad(packed, ((0, 0), (0, num_groups - packed.shape[1])))
-    nq_pad = ((nq + 7) // 8) * 8
-    if nq_pad != nq:
-        packed = jnp.pad(packed, ((0, nq_pad - nq), (0, 0)))
+    packed, nq = _stage_selections(selections, num_groups)
+    nq_pad = packed.shape[0]
     out = _ip_pallas_staged(
         db_perm, packed, tile_queries=tile_queries, interpret=interpret
+    )
+    return out[:nq] if nq_pad != nq else out
+
+
+def _ip_kernel_v2(sel_ref, db_ref, out_ref, *, j_chunk: int, int8: bool):
+    """One large MXU dot per (grid step, value-bit chunk).
+
+    v1 (`_ip_kernel`) issues 32x32 = 1024 tiny [TQ, TG] x [TG, W] dots per
+    grid step; MXU pipeline fill dominates (measured 13.6 ms at the
+    2^20 x 256B headline, ~10% MXU). Here the whole record tile is
+    unpacked in VMEM into one [TQ, 32*TG] x [32*TG, j_chunk*W] dot per
+    value-bit chunk: K grows 32x, the dot count per step drops from 1024
+    to 32/j_chunk.
+
+    Record order along K is b-major (k = b*TG + g, record 32g+b): the LHS
+    tiles the packed selection words 32x along lanes (`pltpu.repeat`) and
+    shifts by k//TG, the RHS is just `db_ref` flattened (major-dim merge
+    [32, TG, W] -> [32TG, W] — no lane reshuffle, which Mosaic cannot
+    lower). Value bits unpack the same way: repeat along lanes, shift by
+    lane//W, so RHS column j*W + w matches the caller's [nq, 32, W]
+    recombination.
+
+    int8=True uses the int8 MXU path (i8 x i8 -> i32 dot): 2x the bf16
+    rate and exact int32 counts — no f32 2^24-record exactness cap.
+
+    sel_ref: uint32[TQ, TG]; db_ref: uint32[32, TG, W];
+    out_ref: float32|int32[TQ, 32*W] counts.
+    """
+
+    @pl.when(pl.program_id(1) == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    tq, tg = sel_ref.shape
+    _, _, w = db_ref.shape
+    tr = 32 * tg
+
+    def to_mm(bits_u32):
+        # Mosaic has no direct u32->bf16 cast; hop via i32 (exact 0/1s).
+        as_i32 = bits_u32.astype(I32)
+        return as_i32.astype(I8) if int8 else as_i32.astype(F32).astype(BF16)
+
+    sel_rep = pltpu.repeat(sel_ref[:], 32, axis=1)  # [TQ, 32*TG] tiled
+    b_iota = lax.broadcasted_iota(U32, (tq, tr), 1) // U32(tg)
+    lhs = to_mm((sel_rep >> b_iota) & U32(1))
+
+    dbw = db_ref[:].reshape(tr, w)  # b-major record rows
+    db_rep = pltpu.repeat(dbw, j_chunk, axis=1)  # [TR, j_chunk*W]
+    acc_t = I32 if int8 else F32
+    for jc in range(0, 32, j_chunk):
+        j_iota = (
+            lax.broadcasted_iota(U32, (tr, j_chunk * w), 1) // U32(w)
+        ) + U32(jc)
+        rhs = to_mm((db_rep >> j_iota) & U32(1))
+        out_ref[:, jc * w : (jc + j_chunk) * w] += lax.dot_general(
+            lhs, rhs, (((1,), (0,)), ((), ())), preferred_element_type=acc_t
+        )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("tile_queries", "tile_groups", "j_chunk", "int8",
+                     "interpret"),
+)
+def _ip_pallas_staged_v2(
+    db_perm: jnp.ndarray,
+    packed: jnp.ndarray,
+    tile_queries: int = 64,
+    tile_groups: int = 32,
+    j_chunk: int = 8,
+    int8: bool = False,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    _, num_groups, num_words = db_perm.shape
+    nq = packed.shape[0]
+    tg = _pick_group_tile(num_groups, max_tile=tile_groups)
+    tq = min(tile_queries, nq)
+    while tq > 8 and (nq % tq != 0 or tq % 8 != 0):
+        tq -= 8 if tq % 8 == 0 else tq % 8
+    if nq % tq != 0:
+        tq = nq
+
+    acc_t = I32 if int8 else F32
+    counts = pl.pallas_call(
+        functools.partial(_ip_kernel_v2, j_chunk=j_chunk, int8=int8),
+        grid=(nq // tq, num_groups // tg),
+        in_specs=[
+            pl.BlockSpec((tq, tg), lambda q, r: (q, r)),
+            pl.BlockSpec((32, tg, num_words), lambda q, r: (0, r, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (tq, 32 * num_words), lambda q, r: (q, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((nq, 32 * num_words), acc_t),
+        interpret=interpret,
+    )(packed, db_perm)
+    parity = counts.reshape(nq, 32, num_words).astype(I32).astype(U32) & U32(1)
+    return (parity << jnp.arange(32, dtype=U32)[None, :, None]).sum(
+        axis=1, dtype=U32
+    )
+
+
+def xor_inner_product_pallas2_staged(
+    db_perm: jnp.ndarray,
+    selections: jnp.ndarray,
+    tile_queries: int = 64,
+    tile_groups: int = 32,
+    j_chunk: int = 8,
+    int8: bool = True,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """v2 serving entry: same staged layout/signature as
+    `xor_inner_product_pallas_staged`, one large dot per step.
+
+    With int8=True the parity counts accumulate exactly in int32, so the
+    record cap is the int32 range rather than f32's 2^24.
+    """
+    _, num_groups, _ = db_perm.shape
+    num_records = 32 * num_groups
+    if not int8 and num_records > MAX_RECORDS_EXACT:
+        raise ValueError(
+            f"bf16/f32 parity counts support at most {MAX_RECORDS_EXACT} "
+            f"records; got {num_records} (use int8=True)"
+        )
+    if 32 % j_chunk != 0:
+        raise ValueError(f"j_chunk must divide 32; got {j_chunk}")
+    packed, nq = _stage_selections(selections, num_groups)
+    nq_pad = packed.shape[0]
+    out = _ip_pallas_staged_v2(
+        db_perm,
+        packed,
+        tile_queries=tile_queries,
+        tile_groups=tile_groups,
+        j_chunk=j_chunk,
+        int8=int8,
+        interpret=interpret,
     )
     return out[:nq] if nq_pad != nq else out
 

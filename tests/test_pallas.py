@@ -116,3 +116,45 @@ def test_database_serves_via_bitplane(monkeypatch):
     monkeypatch.setenv("DPF_TPU_INNER_PRODUCT", "bitplane")
     b = db.inner_product_with(sel)
     assert a == b
+
+
+@pytest.mark.parametrize("int8", [False, True])
+@pytest.mark.parametrize(
+    "num_records,num_words,nq",
+    [(256, 8, 1), (1024, 64, 4), (384, 5, 2), (8192, 16, 16)],
+)
+def test_pallas_v2_matches_oracles(num_records, num_words, nq, int8):
+    from distributed_point_functions_tpu.ops.inner_product_pallas import (
+        xor_inner_product_pallas2_staged,
+    )
+
+    db = RNG.integers(0, 1 << 32, (num_records, num_words), dtype=np.uint32)
+    bits = RNG.integers(0, 2, (nq, num_records), dtype=np.uint32)
+    sel = pack_selection_bits_np(bits)
+    got = np.asarray(
+        xor_inner_product_pallas2_staged(
+            permute_db_bitmajor(db), sel, int8=int8, interpret=True
+        )
+    )
+    np.testing.assert_array_equal(got, xor_inner_product_np(db, sel))
+
+
+@pytest.mark.parametrize("tile_groups,j_chunk", [(8, 4), (16, 16), (64, 32)])
+def test_pallas_v2_tile_variants(tile_groups, j_chunk):
+    from distributed_point_functions_tpu.ops.inner_product_pallas import (
+        xor_inner_product_pallas2_staged,
+    )
+
+    db = RNG.integers(0, 1 << 32, (4096, 8), dtype=np.uint32)
+    bits = RNG.integers(0, 2, (5, 4096), dtype=np.uint32)
+    sel = pack_selection_bits_np(bits)
+    got = np.asarray(
+        xor_inner_product_pallas2_staged(
+            permute_db_bitmajor(db),
+            sel,
+            tile_groups=tile_groups,
+            j_chunk=j_chunk,
+            interpret=True,
+        )
+    )
+    np.testing.assert_array_equal(got, xor_inner_product_np(db, sel))
