@@ -31,7 +31,8 @@ north-star's second metric
 
 Environment knobs: BENCH_RECORDS (default 2^20), BENCH_RECORD_BYTES (256),
 BENCH_QUERIES (64), BENCH_ITERS (16, min 1), BENCH_NO_PALLAS=1 /
-BENCH_NO_BITPLANE=1 to skip inner-product tiers, BENCH_EXPANSION=
+BENCH_NO_PALLAS2=1 / BENCH_NO_BITPLANE=1 to skip inner-product tiers,
+BENCH_EXPANSION=
 both|limb|planes for the expansion A/B, BENCH_SKIP_NSLEAF=1 to skip the
 secondary metric, BENCH_PLATFORM=cpu for a hermetic CPU run, and
 BENCH_TIMEOUT (default 2400 s) for the stall watchdog.
@@ -295,6 +296,7 @@ def main():
     )
     from distributed_point_functions_tpu.ops.inner_product_pallas import (
         permute_db_bitmajor,
+        xor_inner_product_pallas2_staged,
         xor_inner_product_pallas_staged,
     )
     from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
@@ -353,29 +355,45 @@ def main():
             return False
 
     _PROGRESS["stage"] = "pallas-check"
-    use_pallas = os.environ.get(
-        "BENCH_NO_PALLAS", ""
-    ) != "1" and verify_ip(
-        "pallas", xor_inner_product_pallas_staged, staged_layout=True
+    no_pallas = os.environ.get("BENCH_NO_PALLAS", "") == "1"
+    use_pallas2 = (
+        not no_pallas
+        and os.environ.get("BENCH_NO_PALLAS2", "") != "1"
+        and verify_ip(
+            "pallas2", xor_inner_product_pallas2_staged, staged_layout=True
+        )
+    )
+    use_pallas = (
+        not use_pallas2
+        and not no_pallas
+        and verify_ip(
+            "pallas", xor_inner_product_pallas_staged, staged_layout=True
+        )
     )
     # Bit-plane jnp path (same MXU math as Pallas, no Mosaic): the middle
-    # choice when the Pallas kernel fails on this device/backend.
+    # choice when the Pallas kernels fail on this device/backend.
     use_bitplane = (
-        not use_pallas
+        not (use_pallas2 or use_pallas)
         and jax.default_backend() == "tpu"
         and os.environ.get("BENCH_NO_BITPLANE", "") != "1"
         and verify_ip(
             "bitplane", xor_inner_product_bitplane, staged_layout=True
         )
     )
-    if use_pallas or use_bitplane:
+    ip_name = (
+        "pallas2" if use_pallas2
+        else "pallas" if use_pallas
+        else "bitplane" if use_bitplane
+        else "jnp"
+    )
+    if ip_name != "jnp":
         # Stage the bit-major layout once (the serving path does the same).
         db_words = jax.block_until_ready(permute_db_bitmajor(db_words))
-        inner_product = (
-            xor_inner_product_pallas_staged
-            if use_pallas
-            else xor_inner_product_bitplane
-        )
+        inner_product = {
+            "pallas2": xor_inner_product_pallas2_staged,
+            "pallas": xor_inner_product_pallas_staged,
+            "bitplane": xor_inner_product_bitplane,
+        }[ip_name]
     else:
         inner_product = xor_inner_product
 
@@ -506,22 +524,27 @@ def main():
                 f"({num_padded * num_words * 4 / per_ip / 1e9:.0f} GB/s), "
                 f"expansion ~{per_batch * 1e3 - ip_ms:.2f} ms"
             )
-        if use_pallas:
-            # Record the bit-plane alternate on the same staged layout so
-            # the capture shows whether Mosaic actually beats plain XLA.
-            try:
-                jax.block_until_ready(
-                    xor_inner_product_bitplane(db_words, sel_fixed)
-                )
-                per_alt, _ = _slope_time(
-                    lambda: xor_inner_product_bitplane(db_words, sel_fixed),
-                    iters,
-                )
-                if per_alt is not None:
-                    ip_alt_ms = per_alt * 1e3
-                    _log(f"split: bitplane alternate {ip_alt_ms:.2f} ms")
-            except Exception as e:  # noqa: BLE001
-                _log(f"bitplane alternate timing failed: {e}")
+        if use_pallas2 or use_pallas:
+            # Record the alternates on the same staged layout so the
+            # capture shows how the tiers compare on this hardware.
+            alts = {"bitplane": xor_inner_product_bitplane}
+            if use_pallas2:
+                alts["pallas_v1"] = xor_inner_product_pallas_staged
+            for alt_name, alt_fn in alts.items():
+                try:
+                    jax.block_until_ready(alt_fn(db_words, sel_fixed))
+                    per_alt, _ = _slope_time(
+                        lambda f=alt_fn: f(db_words, sel_fixed), iters
+                    )
+                    if per_alt is not None:
+                        if alt_name == "bitplane":
+                            ip_alt_ms = per_alt * 1e3
+                        _log(
+                            f"split: {alt_name} alternate "
+                            f"{per_alt * 1e3:.2f} ms"
+                        )
+                except Exception as e:  # noqa: BLE001
+                    _log(f"{alt_name} alternate timing failed: {e}")
     except Exception as e:  # noqa: BLE001
         _log(f"split timing failed: {e}")
 
@@ -535,11 +558,7 @@ def main():
 
     extra = {
         "inner_product_effective_gbps": round(gbps, 2),
-        "inner_product_path": (
-            "pallas" if use_pallas
-            else "bitplane" if use_bitplane
-            else "jnp"
-        ),
+        "inner_product_path": ip_name,
         "inner_product_bitplane_alt_ms": (
             round(ip_alt_ms, 3) if ip_alt_ms else None
         ),

@@ -8,13 +8,14 @@ record is zero-padded to the maximum record size, and the record count is
 padded to a multiple of 128 so whole selection blocks line up with rows.
 
 `inner_product_with` serves the whole query batch in one database pass
-through a three-tier chain: on TPU the Pallas MXU kernel
-(`ops/inner_product_pallas.py`, bit-major layout staged once on first
-use); on its failure the pure-jnp MXU bit-plane path
-(`ops/inner_product.py:xor_inner_product_bitplane`, same math, no Mosaic
-dependency); and finally — elsewhere (CPU tests), beyond the 2^24-record
-f32-exactness bound, or on any failure — the jitted jnp XOR-reduction.
-Set ``DPF_TPU_INNER_PRODUCT=pallas|bitplane|jnp`` to force a tier
+through a tier chain: on TPU the v2 Pallas MXU kernel first
+(`ops/inner_product_pallas.py:xor_inner_product_pallas2_staged`, one
+large int8 dot per tile, bit-major layout staged once on first use);
+then the v1 Pallas kernel and the pure-jnp MXU bit-plane path (same
+math, no Mosaic dependency; both f32-exact only to 2^24 records); and
+finally — elsewhere (CPU tests) or on any failure — the jitted jnp
+XOR-reduction. Set
+``DPF_TPU_INNER_PRODUCT=pallas2|pallas|bitplane|jnp`` to force a tier
 (forced tiers propagate their errors instead of falling through).
 """
 
@@ -35,6 +36,7 @@ from ..ops.inner_product import (
 from ..ops.inner_product_pallas import (
     MAX_RECORDS_EXACT,
     permute_db_bitmajor,
+    xor_inner_product_pallas2_staged,
     xor_inner_product_pallas_staged,
 )
 
@@ -107,7 +109,7 @@ class DenseDpfPirDatabase:
         )
         self._db_words = None  # row-major device copy (jnp fallback path)
         self._db_perm = None  # bit-major layout, staged on first pallas use
-        self._pallas_failed = False
+        self._failed_tiers: set = set()
 
     @property
     def size(self) -> int:
@@ -137,64 +139,70 @@ class DenseDpfPirDatabase:
     def record(self, i: int) -> bytes:
         return self._records[i]
 
-    def _use_pallas(self) -> bool:
+    def _staged_perm(self) -> jnp.ndarray:
+        """Bit-major layout (`permute_db_bitmajor`), staged once."""
+        if self._db_perm is None:
+            self._db_perm = jax.block_until_ready(
+                permute_db_bitmajor(jnp.asarray(self._host_words))
+            )
+        return self._db_perm
+
+    def _tier_chain(self):
+        """(tiers-to-try, forced): the inner-product fallback chain.
+
+        Auto mode on TPU: the v2 Pallas kernel (one large int8 MXU dot
+        per tile, exact int32 counts — no record cap below int32 range),
+        then the v1 Pallas kernel and the pure-jnp bit-plane path (both
+        f32-exact only to 2^24 records), then the jnp XOR reduction.
+        A forced tier propagates its errors instead of falling through.
+        """
         mode = os.environ.get("DPF_TPU_INNER_PRODUCT", "auto")
-        if mode == "pallas":
-            return True
-        if mode in ("jnp", "bitplane"):
-            return False
-        return (
-            not self._pallas_failed
-            and jax.default_backend() == "tpu"
-            and self._num_padded <= MAX_RECORDS_EXACT
-        )
+        if mode != "auto":
+            return [mode], True
+        chain = []
+        if jax.default_backend() == "tpu":
+            chain.append("pallas2")
+            if self._num_padded <= MAX_RECORDS_EXACT:
+                chain += ["pallas", "bitplane"]
+        chain.append("jnp")
+        return chain, False
 
     def _inner_product_device(self, selections: jnp.ndarray) -> jnp.ndarray:
-        mode = os.environ.get("DPF_TPU_INNER_PRODUCT", "auto")
-        if self._use_pallas():
+        chain, forced = self._tier_chain()
+        for tier in chain:
+            # Remembered failures: a failed trace/compile is not cached
+            # by jit, so retrying would pay it on every batch.
+            if tier in self._failed_tiers:
+                continue
             try:
-                if self._db_perm is None:
-                    self._db_perm = jax.block_until_ready(
-                        permute_db_bitmajor(jnp.asarray(self._host_words))
+                if tier == "pallas2":
+                    return xor_inner_product_pallas2_staged(
+                        self._staged_perm(), selections
                     )
-                return xor_inner_product_pallas_staged(
-                    self._db_perm, selections
-                )
-            except Exception as e:
-                if mode == "pallas":
-                    raise
-                # Remember the failure: a failed trace/compile is not
-                # cached by jit, so retrying would pay it on every batch.
-                self._pallas_failed = True
-                warnings.warn(
-                    "pallas inner-product kernel failed; serving via the "
-                    f"bit-plane jnp path ({str(e).splitlines()[0][:200]})"
-                )
-        # Middle fallback: the same MXU bit-plane math in pure jnp — no
-        # Mosaic dependency (`ops/inner_product.py`). Same staged layout
-        # and record-count bound as the Pallas kernel. A forced
-        # mode=bitplane propagates its errors (incl. the record-count
-        # bound); auto mode falls through to the XOR path on any failure.
-        if mode == "bitplane" or (
-            mode == "auto"
-            and jax.default_backend() == "tpu"
-            and self._num_padded <= MAX_RECORDS_EXACT
-        ):
-            try:
-                if self._db_perm is None:
-                    self._db_perm = jax.block_until_ready(
-                        permute_db_bitmajor(jnp.asarray(self._host_words))
+                if tier == "pallas":
+                    return xor_inner_product_pallas_staged(
+                        self._staged_perm(), selections
                     )
-                return xor_inner_product_bitplane(self._db_perm, selections)
-            except Exception as e:  # noqa: BLE001
-                if mode == "bitplane":
-                    raise
-                self._db_perm = None
-                warnings.warn(
-                    "bit-plane inner product failed; serving via the XOR "
-                    f"path ({str(e).splitlines()[0][:200]})"
+                if tier == "bitplane":
+                    return xor_inner_product_bitplane(
+                        self._staged_perm(), selections
+                    )
+                if tier == "jnp":
+                    return xor_inner_product(self.db_words, selections)
+                raise ValueError(
+                    f"unknown DPF_TPU_INNER_PRODUCT tier {tier!r}"
                 )
-        return xor_inner_product(self.db_words, selections)
+            except Exception as e:  # noqa: BLE001 - fall through the chain
+                if forced or tier == "jnp":
+                    raise
+                self._failed_tiers.add(tier)
+                if tier == chain[-2]:
+                    self._db_perm = None  # jnp path reads row-major only
+                warnings.warn(
+                    f"{tier} inner product failed; falling back "
+                    f"({str(e).splitlines()[0][:200]})"
+                )
+        raise AssertionError("unreachable: jnp tier returns or raises")
 
     def inner_product_with(self, selections: jnp.ndarray) -> List[bytes]:
         """XOR of all records whose selection bit is 1, per query.

@@ -158,3 +158,43 @@ def test_pallas_v2_tile_variants(tile_groups, j_chunk):
         )
     )
     np.testing.assert_array_equal(got, xor_inner_product_np(db, sel))
+
+
+def test_database_tier_chain_fallthrough(monkeypatch):
+    """Auto mode falls through failing tiers and serves; forced tiers
+    propagate errors; remembered failures skip retries."""
+    import jax
+
+    from distributed_point_functions_tpu.pir import database as db_mod
+    from distributed_point_functions_tpu.pir.database import (
+        DenseDpfPirDatabase,
+    )
+
+    rng = np.random.default_rng(9)
+    records = [rng.bytes(16) for _ in range(200)]
+    db = DenseDpfPirDatabase(records)
+    bits = rng.integers(0, 2, (2, db.num_selection_bits), dtype=np.uint32)
+    sel = pack_selection_bits_np(bits)
+
+    monkeypatch.setenv("DPF_TPU_INNER_PRODUCT", "jnp")
+    want = db.inner_product_with(sel)
+
+    # Forced unknown tier raises.
+    monkeypatch.setenv("DPF_TPU_INNER_PRODUCT", "nope")
+    with pytest.raises(ValueError, match="unknown"):
+        db.inner_product_with(sel)
+
+    # Auto on a fake-TPU backend: break pallas2 + pallas, bitplane serves.
+    db2 = DenseDpfPirDatabase(records)
+    monkeypatch.setenv("DPF_TPU_INNER_PRODUCT", "auto")
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    def boom(*a, **k):
+        raise RuntimeError("mosaic says no")
+
+    monkeypatch.setattr(db_mod, "xor_inner_product_pallas2_staged", boom)
+    monkeypatch.setattr(db_mod, "xor_inner_product_pallas_staged", boom)
+    with pytest.warns(UserWarning):
+        got = db2.inner_product_with(sel)
+    assert got == want
+    assert db2._failed_tiers == {"pallas2", "pallas"}
