@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Priority queue for the next TPU tunnel window: the new-kernel A/Bs
+# first (cheap, high information), then the remaining reference sweeps
+# that the 2026-07-30 15:49 stall cut off. Run via tpu_watch-style
+# polling or directly when the tunnel answers.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+mkdir -p benchmarks/results
+stamp=$(date +%Y%m%d_%H%M%S)
+
+echo "=== inner-product kernel A/B (v1 vs v2 variants) ==="
+timeout 1800 python benchmarks/ip_ab.py \
+    2>benchmarks/results/ip_ab_${stamp}.log \
+    | tee benchmarks/results/ip_ab_${stamp}.json
+tail -3 benchmarks/results/ip_ab_${stamp}.log
+
+echo "=== headline at larger query batches (v2 tier auto) ==="
+for q in 64 128 256; do
+    timeout 1200 env BENCH_QUERIES=$q BENCH_SKIP_NSLEAF=1 BENCH_ITERS=8 \
+        BENCH_TIMEOUT=1100 python bench.py \
+        2>benchmarks/results/bench_q${q}_${stamp}.log \
+        | tee benchmarks/results/bench_q${q}_${stamp}.json
+done
+
+echo "=== expansion stage profile ==="
+timeout 1800 python benchmarks/expand_profile.py \
+    2>benchmarks/results/expand_profile_${stamp}.log \
+    | tee benchmarks/results/expand_profile_${stamp}.json
+
+echo "=== remaining reference sweeps ==="
+timeout 3600 python benchmarks/run_benchmarks.py \
+    --suite dpf,dcf,mic,inner_product,int_mod_n --big \
+    2>&1 | tee benchmarks/results/sweeps_${stamp}.json
+
+echo "=== synthetic configs (2^32 and 2^128) ==="
+timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
+    --log_domain_size 32 --log_num_nonzeros 20 --num_iterations 3 \
+    2>&1 | tee benchmarks/results/synthetic_${stamp}.json
+timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
+    --log_domain_size 32 --log_num_nonzeros 20 --only_nonzeros \
+    --num_iterations 3 \
+    2>&1 | tee benchmarks/results/only_nonzeros_${stamp}.json
+timeout 3600 python benchmarks/synthetic_data_benchmarks.py \
+    --log_domain_size 128 --log_num_nonzeros 20 --num_iterations 2 \
+    2>&1 | tee benchmarks/results/synthetic128_${stamp}.json
+timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
+    --log_domain_size 128 --log_num_nonzeros 20 --only_nonzeros \
+    --num_iterations 3 \
+    2>&1 | tee benchmarks/results/only_nonzeros128_${stamp}.json
+
+echo "next_window done: benchmarks/results/*_${stamp}.*"

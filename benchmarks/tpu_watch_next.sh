@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# Poll the axon TPU tunnel; the moment it answers, run the full perf
+# capture (benchmarks/next_window.sh). Writes a heartbeat log so a stalled
+# tunnel is distinguishable from a stalled capture.
+set -u
+cd "$(dirname "$0")/.."
+log=benchmarks/results/tpu_watch.log
+mkdir -p benchmarks/results
+
+probe() {
+    timeout 75 python - <<'EOF' >/dev/null 2>&1
+import numpy as np
+import jax
+# sitecustomize sets jax_platforms="axon,cpu": a fast axon init failure
+# silently falls back to CPU, so assert the device really is the TPU.
+assert jax.devices()[0].platform == "tpu", jax.devices()
+x = jax.device_put(np.zeros(8, np.uint32))
+x.block_until_ready()
+jax.jit(lambda a: a ^ np.uint32(3))(x).block_until_ready()
+EOF
+}
+
+# Deadline (epoch seconds, env TPU_WATCH_DEADLINE): no capture *starts*
+# within 45 min of it, and polling stops at it, to keep watcher captures
+# clear of the round's driver-run bench on the single-client tunnel. (A
+# healthy capture finishes well inside 45 min; only a mid-capture tunnel
+# stall runs longer, and then the driver bench would be stalled anyway.)
+deadline=${TPU_WATCH_DEADLINE:-0}
+margin=2700
+
+while true; do
+    if [ "$deadline" -gt 0 ] && \
+       [ "$(date +%s)" -ge "$((deadline - margin))" ]; then
+        echo "$(date -u +%H:%M:%S) deadline margin reached - exiting" >>"$log"
+        exit 0
+    fi
+    if probe; then
+        echo "$(date -u +%H:%M:%S) tunnel ALIVE - launching capture" >>"$log"
+        bash benchmarks/next_window.sh >>"$log" 2>&1
+        rc=$?
+        echo "$(date -u +%H:%M:%S) capture exited rc=$rc" >>"$log"
+        if [ "$rc" -eq 0 ]; then
+            exit 0
+        fi
+        # Capture died (tunnel dropped mid-run?): go back to polling.
+    else
+        echo "$(date -u +%H:%M:%S) tunnel down" >>"$log"
+    fi
+    # 1-vCPU machine: each probe costs ~30s of CPU (jax import), so poll
+    # sparingly to leave the core free for the build.
+    sleep 180
+done
