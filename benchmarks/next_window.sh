@@ -22,6 +22,13 @@ for q in 64 128 256; do
         | tee benchmarks/results/bench_q${q}_${stamp}.json
 done
 
+echo "=== level-kernel ablation (planes expansion, XLA levels) ==="
+timeout 1200 env BENCH_QUERIES=64 BENCH_SKIP_NSLEAF=1 BENCH_ITERS=8 \
+    BENCH_TIMEOUT=1100 BENCH_EXPANSION=planes DPF_TPU_LEVEL_KERNEL=xla \
+    python bench.py \
+    2>benchmarks/results/bench_levelxla_${stamp}.log \
+    | tee benchmarks/results/bench_levelxla_${stamp}.json
+
 echo "=== expansion stage profile ==="
 timeout 1800 python benchmarks/expand_profile.py \
     2>benchmarks/results/expand_profile_${stamp}.log \

@@ -1,0 +1,243 @@
+"""Pallas TPU kernels for the plane-resident DPF expansion levels.
+
+The XLA version of one expansion level (`pir/dense_eval_planes.py:
+expand_level_planes`) runs the ~2000-gate bitsliced AES circuit as jnp
+ops on `[16, 8, G]` plane tensors; every stack/reshape/fusion break
+materializes the full state to HBM, and at the headline config the
+measured cost (~8 ms per 64-query batch) is ~12x the VPU gate-work
+roofline (~0.7 ms) — the level is HBM-bound on intermediates, not
+compute-bound. These kernels run a whole level in VMEM per lane tile:
+
+* `expand_level_planes_pallas` — sigma, BOTH fixed-key AES applications
+  (left/right children), seed correction under the parent control mask,
+  LSB extract/clear, and the direction-correction of the control bits,
+  one input read + two output writes of HBM traffic per level;
+* `value_hash_planes_pallas` — the leaf MMO output hash + value
+  correction the same way.
+
+Round keys are baked in as `[16, 8, 1]` all-ones/zeros constant masks
+per round (fixed-key AES: AddRoundKey is XOR with a constant plane).
+Per-key correction planes stay packed at `[16, 8, KG]` (KG = keys/32)
+and are tiled across the node-major lane axis in VMEM via
+`pltpu.repeat` — the lane layout guarantees lane = node * KG + keyword,
+so a whole-array tile repeats every KG lanes.
+
+Everything is differentially tested against the XLA twins in interpret
+mode (`tests/test_expand_pallas.py`) and re-verified on hardware before
+serving (`pir/dense_eval_planes.py` falls back to the XLA level on any
+compile failure).
+
+Reference semantics: `ExpandSeeds`
+(`dpf/distributed_point_function.cc:289-372`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .. import keys as fixed_keys
+from . import aes as _aes
+from .aes_bitslice import _mix_columns_planes, _rk_bits, _sub_bytes_planes
+
+U32 = jnp.uint32
+
+_SHIFT_ROWS = list(_aes._SHIFT_ROWS)
+
+# Default lane tile: [16, 8, 1024] u32 state = 512 KB; the kernel's
+# working set (sigma + two AES states + temporaries) stays well under
+# VMEM at this width.
+_TILE_LANES = 1024
+
+
+def _rk_masks(round_keys: np.ndarray) -> np.ndarray:
+    """uint8[11, 16] schedule -> uint32[11, 16, 8, 1] all-ones/zeros
+    plane masks (AddRoundKey with a fixed key = XOR with constants)."""
+    bits = _rk_bits(round_keys).astype(np.uint32)  # [11, 16, 8]
+    return (bits * np.uint32(0xFFFFFFFF))[..., None]
+
+_MASKS_LEFT = _rk_masks(fixed_keys.RK_LEFT)
+_MASKS_RIGHT = _rk_masks(fixed_keys.RK_RIGHT)
+_MASKS_VALUE = _rk_masks(fixed_keys.RK_VALUE)
+_MASKS_LR = np.stack([_MASKS_LEFT, _MASKS_RIGHT])  # [2, 11, 16, 8, 1]
+
+
+def _shift_rows_static(state: jnp.ndarray) -> jnp.ndarray:
+    """Byte-axis permutation as static slices + one concat (avoids a
+    gather, which Mosaic may not lower)."""
+    return jnp.concatenate(
+        [state[j : j + 1] for j in _SHIFT_ROWS], axis=0
+    )
+
+
+def _aes_fixed_planes(masks: jnp.ndarray, state: jnp.ndarray) -> jnp.ndarray:
+    """AES-128 rounds on [16, 8, T] planes; `masks` is the [11, 16, 8, 1]
+    round-key plane-mask array (a kernel input: Pallas forbids captured
+    array constants)."""
+    state = state ^ masks[0]
+    for rnd in range(1, 10):
+        state = _sub_bytes_planes(state)
+        state = _shift_rows_static(state)
+        state = _mix_columns_planes(state)
+        state = state ^ masks[rnd]
+    state = _sub_bytes_planes(state)
+    state = _shift_rows_static(state)
+    return state ^ masks[10]
+
+
+def _sigma(state: jnp.ndarray) -> jnp.ndarray:
+    lo = state[:8]
+    hi = state[8:]
+    return jnp.concatenate([hi, hi ^ lo], axis=0)
+
+
+def _level_kernel(
+    state_ref,
+    ctrl_ref,
+    cwp_ref,
+    cwl_ref,
+    cwr_ref,
+    masks_ref,
+    outl_ref,
+    outr_ref,
+    ctl_ref,
+    ctr_ref,
+    *,
+    reps: int,
+):
+    sig = _sigma(state_ref[:])
+    masks = masks_ref[:]  # [2, 11, 16, 8, 1]: left/right round-key planes
+    left = _aes_fixed_planes(masks[0], sig) ^ sig
+    right = _aes_fixed_planes(masks[1], sig) ^ sig
+
+    ctrl = ctrl_ref[:]  # [1, T] packed parent control bits
+    cwp = pltpu.repeat(cwp_ref[:], reps, axis=2)  # [16, 8, T]
+    mask = cwp & ctrl[0][None, None, :]
+    left = left ^ mask
+    right = right ^ mask
+
+    t_left = left[0, 0]  # LSB plane = child control bits
+    t_right = right[0, 0]
+    zero = jnp.zeros_like(t_left)
+    outl_ref[:] = left.at[0, 0].set(zero)
+    outr_ref[:] = right.at[0, 0].set(zero)
+
+    cwl = pltpu.repeat(cwl_ref[:], reps, axis=1)  # [1, T]
+    cwr = pltpu.repeat(cwr_ref[:], reps, axis=1)
+    ctl_ref[:] = (t_left ^ (ctrl[0] & cwl[0]))[None, :]
+    ctr_ref[:] = (t_right ^ (ctrl[0] & cwr[0]))[None, :]
+
+
+def _pick_tile(num_lanes: int, key_groups: int) -> int:
+    tile = min(_TILE_LANES, num_lanes)
+    while tile > key_groups and (
+        num_lanes % tile != 0 or tile % key_groups != 0
+    ):
+        tile //= 2
+    if num_lanes % tile != 0 or tile % key_groups != 0:
+        tile = num_lanes
+    return tile
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def expand_level_planes_pallas(
+    state: jnp.ndarray,
+    ctrl: jnp.ndarray,
+    cwp_kg: jnp.ndarray,
+    cwl_kg: jnp.ndarray,
+    cwr_kg: jnp.ndarray,
+    interpret: bool = False,
+):
+    """One [all-left; all-right] expansion level, fused in VMEM.
+
+    state: uint32[16, 8, G]; ctrl: uint32[G] packed parent control bits;
+    cwp_kg: uint32[16, 8, KG] per-key seed-correction planes
+    (`pack_key_planes`); cwl_kg / cwr_kg: uint32[KG] packed per-key
+    direction-correction bits. Returns (state [16, 8, 2G], ctrl [2G])
+    in [all-left; all-right] child order — the same contract as
+    `dense_eval_planes.expand_level_planes` with untiled corrections.
+    """
+    _, _, g = state.shape
+    kg = cwp_kg.shape[-1]
+    tile = _pick_tile(g, kg)
+    reps = tile // kg
+    ctrl2 = ctrl[None, :]
+    cwl2 = cwl_kg[None, :]
+    cwr2 = cwr_kg[None, :]
+    grid = (g // tile,)
+    out_shapes = (
+        jax.ShapeDtypeStruct((16, 8, g), U32),
+        jax.ShapeDtypeStruct((16, 8, g), U32),
+        jax.ShapeDtypeStruct((1, g), U32),
+        jax.ShapeDtypeStruct((1, g), U32),
+    )
+    outl, outr, ctl, ctr = pl.pallas_call(
+        functools.partial(_level_kernel, reps=reps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((16, 8, tile), lambda l: (0, 0, l)),
+            pl.BlockSpec((1, tile), lambda l: (0, l)),
+            pl.BlockSpec((16, 8, kg), lambda l: (0, 0, 0)),
+            pl.BlockSpec((1, kg), lambda l: (0, 0)),
+            pl.BlockSpec((1, kg), lambda l: (0, 0)),
+            pl.BlockSpec(
+                (2, 11, 16, 8, 1), lambda l: (0, 0, 0, 0, 0)
+            ),
+        ],
+        out_specs=(
+            pl.BlockSpec((16, 8, tile), lambda l: (0, 0, l)),
+            pl.BlockSpec((16, 8, tile), lambda l: (0, 0, l)),
+            pl.BlockSpec((1, tile), lambda l: (0, l)),
+            pl.BlockSpec((1, tile), lambda l: (0, l)),
+        ),
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(state, ctrl2, cwp_kg, cwl2, cwr2, _MASKS_LR)
+    new_state = jnp.concatenate([outl, outr], axis=-1)
+    new_ctrl = jnp.concatenate([ctl[0], ctr[0]])
+    return new_state, new_ctrl
+
+
+def _value_kernel(state_ref, ctrl_ref, vc_ref, masks_ref, out_ref, *,
+                  reps: int):
+    sig = _sigma(state_ref[:])
+    values = _aes_fixed_planes(masks_ref[:], sig) ^ sig
+    vc = pltpu.repeat(vc_ref[:], reps, axis=2)
+    out_ref[:] = values ^ (vc & ctrl_ref[:][0][None, None, :])
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def value_hash_planes_pallas(
+    state: jnp.ndarray,
+    ctrl: jnp.ndarray,
+    vc_kg: jnp.ndarray,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Leaf MMO output hash + value correction, fused in VMEM.
+
+    state: uint32[16, 8, G]; ctrl: uint32[G]; vc_kg: uint32[16, 8, KG]
+    per-key value-correction planes. Returns uint32[16, 8, G] — same
+    math as `mmo_hash_planes(RK_VALUE, state) ^ (vc_tiled & ctrl)`.
+    """
+    _, _, g = state.shape
+    kg = vc_kg.shape[-1]
+    tile = _pick_tile(g, kg)
+    reps = tile // kg
+    return pl.pallas_call(
+        functools.partial(_value_kernel, reps=reps),
+        grid=(g // tile,),
+        in_specs=[
+            pl.BlockSpec((16, 8, tile), lambda l: (0, 0, l)),
+            pl.BlockSpec((1, tile), lambda l: (0, l)),
+            pl.BlockSpec((16, 8, kg), lambda l: (0, 0, 0)),
+            pl.BlockSpec((11, 16, 8, 1), lambda l: (0, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((16, 8, tile), lambda l: (0, 0, l)),
+        out_shape=jax.ShapeDtypeStruct((16, 8, g), U32),
+        interpret=interpret,
+    )(state, ctrl[None, :], vc_kg, jnp.asarray(_MASKS_VALUE))

@@ -41,6 +41,8 @@ subtree, as in `dense_eval.py`.
 from __future__ import annotations
 
 import functools
+import os
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -54,6 +56,10 @@ from ..ops.aes_bitslice import (
     mmo_hash_planes,
     planes_to_limbs,
     sigma_planes,
+)
+from ..ops.expand_planes_pallas import (
+    expand_level_planes_pallas,
+    value_hash_planes_pallas,
 )
 from .dense_eval import _walk_zeros
 
@@ -171,19 +177,57 @@ def evaluate_selection_blocks_planes(
             expand_levels=expand_levels,
             num_blocks=num_blocks,
         )
+    use_kernel = _level_kernel_enabled()
+    if use_kernel:
+        try:
+            return _evaluate_selection_blocks_planes_jit(
+                seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
+                walk_levels=walk_levels,
+                expand_levels=expand_levels,
+                num_blocks=num_blocks,
+                bitrev_leaves=bitrev_leaves,
+                level_kernel=True,
+            )
+        except Exception as e:  # noqa: BLE001 - fall back to the XLA level
+            if os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto") == "pallas":
+                raise
+            global _LEVEL_KERNEL_FAILED
+            _LEVEL_KERNEL_FAILED = True
+            warnings.warn(
+                "pallas level kernel failed; serving via the XLA level "
+                f"({str(e).splitlines()[0][:200]})"
+            )
     return _evaluate_selection_blocks_planes_jit(
         seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
         walk_levels=walk_levels,
         expand_levels=expand_levels,
         num_blocks=num_blocks,
         bitrev_leaves=bitrev_leaves,
+        level_kernel=False,
     )
+
+
+_LEVEL_KERNEL_FAILED = False
+
+
+def _level_kernel_enabled() -> bool:
+    """Whether the fused Pallas level kernel serves the expansion.
+
+    DPF_TPU_LEVEL_KERNEL=pallas forces it (errors propagate), =xla
+    disables it; auto uses it on TPU until a remembered failure."""
+    mode = os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto")
+    if mode == "pallas":
+        return True
+    if mode == "xla":
+        return False
+    return not _LEVEL_KERNEL_FAILED and jax.default_backend() == "tpu"
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "walk_levels", "expand_levels", "num_blocks", "bitrev_leaves"
+        "walk_levels", "expand_levels", "num_blocks", "bitrev_leaves",
+        "level_kernel",
     ),
 )
 def _evaluate_selection_blocks_planes_jit(
@@ -198,6 +242,7 @@ def _evaluate_selection_blocks_planes_jit(
     expand_levels: int,
     num_blocks: int,
     bitrev_leaves: bool = False,
+    level_kernel: bool = False,
 ) -> jnp.ndarray:
     """Drop-in for `dense_eval.evaluate_selection_blocks` (bit-identical
     output), computed with the plane-resident expansion.
@@ -229,6 +274,15 @@ def _evaluate_selection_blocks_planes_jit(
 
     for i in range(expand_levels):
         lvl = walk_levels + i
+        if level_kernel:
+            state, ctrl = expand_level_planes_pallas(
+                state,
+                ctrl,
+                pack_key_planes(cw_seeds[lvl]),
+                pack_key_bits(cw_left[lvl]),
+                pack_key_bits(cw_right[lvl]),
+            )
+            continue
         groups2 = 2 * state.shape[-1]
         state, ctrl = expand_level_planes(
             state,
@@ -240,9 +294,14 @@ def _evaluate_selection_blocks_planes_jit(
 
     # Leaf value blocks: output PRG + XOR value correction (party
     # negation is the identity for XOR shares).
-    values = mmo_hash_planes(fixed_keys.RK_VALUE, state)
-    vc_p = _tile_keys(pack_key_planes(last_vc), values.shape[-1])
-    values = values ^ (vc_p & ctrl[None, None, :])
+    if level_kernel:
+        values = value_hash_planes_pallas(
+            state, ctrl, pack_key_planes(last_vc)
+        )
+    else:
+        values = mmo_hash_planes(fixed_keys.RK_VALUE, state)
+        vc_p = _tile_keys(pack_key_planes(last_vc), values.shape[-1])
+        values = values ^ (vc_p & ctrl[None, None, :])
 
     # Leave plane space once: [w * nkp, 4] node-major -> [nkp, w, 4].
     w = 1 << expand_levels

@@ -1,0 +1,121 @@
+"""Differential tests for the Pallas plane-expansion kernels (interpret
+mode on CPU) against their XLA twins in `pir/dense_eval_planes.py` —
+the same per-target discipline as the inner-product kernels
+(`pir/internal/inner_product_hwy_test.cc:427-434`)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from distributed_point_functions_tpu import keys as fixed_keys
+from distributed_point_functions_tpu.ops.aes_bitslice import (
+    mmo_hash_planes,
+)
+from distributed_point_functions_tpu.ops.expand_planes_pallas import (
+    expand_level_planes_pallas,
+    value_hash_planes_pallas,
+)
+from distributed_point_functions_tpu.pir.dense_eval_planes import (
+    _tile_keys,
+    expand_level_planes,
+    pack_key_bits,
+    pack_key_planes,
+)
+
+RNG = np.random.default_rng(23)
+
+
+def _random_inputs(g, nk):
+    kg = nk // 32
+    assert g % kg == 0
+    state = RNG.integers(0, 1 << 32, (16, 8, g), dtype=np.uint32)
+    ctrl = RNG.integers(0, 1 << 32, (g,), dtype=np.uint32)
+    cw = RNG.integers(0, 1 << 32, (nk, 4), dtype=np.uint32)
+    cwl = RNG.integers(0, 2, (nk,), dtype=np.uint32)
+    cwr = RNG.integers(0, 2, (nk,), dtype=np.uint32)
+    return state, ctrl, cw, cwl, cwr
+
+
+@pytest.mark.parametrize("g,nk", [(2, 64), (8, 32), (64, 64), (24, 96)])
+def test_level_kernel_matches_xla(g, nk):
+    state, ctrl, cw, cwl, cwr = _random_inputs(g, nk)
+    cwp_kg = pack_key_planes(jnp.asarray(cw))
+    cwl_kg = pack_key_bits(jnp.asarray(cwl))
+    cwr_kg = pack_key_bits(jnp.asarray(cwr))
+
+    want_state, want_ctrl = expand_level_planes(
+        jnp.asarray(state),
+        jnp.asarray(ctrl),
+        _tile_keys(cwp_kg, 2 * g),
+        _tile_keys(cwl_kg, g),
+        _tile_keys(cwr_kg, g),
+    )
+    got_state, got_ctrl = expand_level_planes_pallas(
+        jnp.asarray(state), jnp.asarray(ctrl), cwp_kg, cwl_kg, cwr_kg,
+        interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got_state),
+                                  np.asarray(want_state))
+    np.testing.assert_array_equal(np.asarray(got_ctrl),
+                                  np.asarray(want_ctrl))
+
+
+@pytest.mark.parametrize("g,nk", [(2, 64), (64, 64), (24, 96)])
+def test_value_kernel_matches_xla(g, nk):
+    state, ctrl, cw, _, _ = _random_inputs(g, nk)
+    vc_kg = pack_key_planes(jnp.asarray(cw))
+
+    want = mmo_hash_planes(fixed_keys.RK_VALUE, jnp.asarray(state))
+    want = want ^ (
+        _tile_keys(vc_kg, g) & jnp.asarray(ctrl)[None, None, :]
+    )
+    got = value_hash_planes_pallas(
+        jnp.asarray(state), jnp.asarray(ctrl), vc_kg, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_serving_expansion_with_level_kernel(monkeypatch):
+    """The full covering-subtree expansion served through the Pallas
+    level kernels (interpret mode) is bit-identical to the limb kernel."""
+    import functools
+
+    from distributed_point_functions_tpu.pir import dense_eval_planes as dep
+    from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
+    from distributed_point_functions_tpu.pir.dense_eval import (
+        evaluate_selection_blocks,
+        stage_keys,
+    )
+
+    monkeypatch.setattr(
+        dep, "expand_level_planes_pallas",
+        functools.partial(dep.expand_level_planes_pallas, interpret=True),
+    )
+    monkeypatch.setattr(
+        dep, "value_hash_planes_pallas",
+        functools.partial(dep.value_hash_planes_pallas, interpret=True),
+    )
+    monkeypatch.setenv("DPF_TPU_LEVEL_KERNEL", "pallas")
+
+    num_records = 33 * 128  # odd block count: exercises truncation
+    nq = 64
+    num_blocks = (num_records + 127) // 128
+    total = max(0, (num_records - 1).bit_length())
+    expand = min((num_blocks - 1).bit_length(), total)
+    walk = total - expand
+
+    client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+    idx = [int(i) for i in RNG.integers(0, num_records, nq)]
+    keys0, _ = client._generate_key_pairs(idx)
+    staged = stage_keys(keys0)
+
+    want = np.asarray(evaluate_selection_blocks(
+        *staged, walk_levels=walk, expand_levels=expand,
+        num_blocks=num_blocks,
+    ))
+    got = np.asarray(dep.evaluate_selection_blocks_planes(
+        *staged, walk_levels=walk, expand_levels=expand,
+        num_blocks=num_blocks, force_planes=True,
+    ))
+    np.testing.assert_array_equal(got, want)
