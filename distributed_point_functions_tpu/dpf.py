@@ -278,14 +278,15 @@ def _expand_levels_limb_fn(num_levels: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _expand_levels_planes_fn(num_levels: int):
+def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False):
     """`_expand_levels_limb_fn` computed in bitsliced plane layout (see
     `pir/dense_eval_planes.py` for the design): children are appended
     [all-left; all-right] per level so the lane order ends up
     path-bit-reversed and prefix-minor; a trace-time-static gather
     restores the natural interleaved order, making the output
     bit-identical to the limb program. Shared correction words only (one
-    key), like the limb program."""
+    key), like the limb program. With `level_kernel` each level runs the
+    fused Pallas VMEM kernel (`ops/expand_planes_pallas.py`)."""
 
     @jax.jit
     def run(seeds, control, cw_seeds, cw_left, cw_right):
@@ -295,6 +296,7 @@ def _expand_levels_planes_fn(num_levels: int):
             pack_select_bits,
             planes_to_limbs,
         )
+        from .ops.expand_planes_pallas import expand_level_planes_pallas
         from .pir.dense_eval_planes import (
             bitrev_permutation,
             expand_level_planes,
@@ -330,13 +332,22 @@ def _expand_levels_planes_fn(num_levels: int):
 
         plane_levels = num_levels - limb_levels
         for i in range(limb_levels, num_levels):
-            state, ctrl = expand_level_planes(
-                state,
-                ctrl,
-                broadcast_cw_planes(cw_seeds[i]),
-                U32(0) - (cw_left[i] & U32(1)),
-                U32(0) - (cw_right[i] & U32(1)),
-            )
+            if level_kernel:
+                state, ctrl = expand_level_planes_pallas(
+                    state,
+                    ctrl,
+                    broadcast_cw_planes(cw_seeds[i]),
+                    (U32(0) - (cw_left[i] & U32(1)))[None],
+                    (U32(0) - (cw_right[i] & U32(1)))[None],
+                )
+            else:
+                state, ctrl = expand_level_planes(
+                    state,
+                    ctrl,
+                    broadcast_cw_planes(cw_seeds[i]),
+                    U32(0) - (cw_left[i] & U32(1)),
+                    U32(0) - (cw_right[i] & U32(1)),
+                )
 
         out = planes_to_limbs(state)  # [2^PL * n32, 4], lane-ordered
         ctrl_bits = ((ctrl[:, None] >> shifts) & U32(1)).reshape(-1)
@@ -356,12 +367,37 @@ def _expand_levels_planes_fn(num_levels: int):
 
 def _expand_levels_fn(num_levels: int):
     """Dispatch the fused expansion program: `DPF_TPU_EXPAND_LEVELS` =
-    `limb` | `planes` | `auto` (default: planes on TPU, limb elsewhere)."""
+    `limb` | `planes` | `auto` (default: planes on TPU, limb elsewhere).
+    On TPU the plane levels run the fused Pallas kernel
+    (`DPF_TPU_LEVEL_KERNEL`), falling back to the XLA level on compile
+    failure."""
     from .utils.runtime import planes_selected
 
-    if planes_selected("DPF_TPU_EXPAND_LEVELS"):
+    if not planes_selected("DPF_TPU_EXPAND_LEVELS"):
+        return _expand_levels_limb_fn(num_levels)
+    from .pir import dense_eval_planes as _dep
+
+    if not _dep._level_kernel_enabled():
         return _expand_levels_planes_fn(num_levels)
-    return _expand_levels_limb_fn(num_levels)
+    fast = _expand_levels_planes_fn(num_levels, level_kernel=True)
+
+    def run_with_fallback(*args):
+        import os as _os
+        import warnings as _warnings
+
+        try:
+            return fast(*args)
+        except Exception as e:  # noqa: BLE001 - fall back to XLA level
+            if _os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto") == "pallas":
+                raise
+            _dep._remember_level_kernel_failure()
+            _warnings.warn(
+                "pallas level kernel failed in hierarchical expansion; "
+                f"using the XLA level ({str(e).splitlines()[0][:200]})"
+            )
+            return _expand_levels_planes_fn(num_levels)(*args)
+
+    return run_with_fallback
 
 
 @jax.jit
@@ -402,15 +438,18 @@ def _eval_paths_limb(
     return seeds, control
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=("level_kernel",))
 def _eval_paths_planes(
-    seeds, control, paths, cw_seeds, cw_left, cw_right, bit_indices
+    seeds, control, paths, cw_seeds, cw_left, cw_right, bit_indices,
+    level_kernel: bool = False,
 ):
     """`_eval_paths_limb` computed in bitsliced plane layout: one
     transpose in, per level a packed-select-mask AES (no per-level
     transposes — the path-walk analog of
     `dense_eval_planes.evaluate_selection_blocks_planes`), one transpose
     out. Bit-identical to the limb kernel in both correction-word modes.
+    With `level_kernel` each level runs the fused Pallas select-key AES
+    kernel (`ops/expand_planes_pallas.py:path_level_planes_pallas`).
     """
     from .ops.aes_bitslice import (
         aes_rounds_select_planes,
@@ -462,11 +501,18 @@ def _eval_paths_planes(
         cw_seed, cw_l, cw_r, bit_index = x
         pbit = limb.get_bit(paths, bit_index)  # uint32[np32]
         sel = pack_select_bits(pbit)           # [groups]
+        cwp, cwl_w, cwr_w = cw_planes(cw_seed, cw_l, cw_r)
+        if level_kernel:
+            from .ops import expand_planes_pallas as _epp
+
+            state, ctrl = _epp.path_level_planes_pallas(
+                state, ctrl, sel, cwp, cwl_w, cwr_w, per_seed=per_seed
+            )
+            return (state, ctrl), None
         sig = sigma_planes(state)
         h = aes_rounds_select_planes(
             fixed_keys.RK_LEFT, fixed_keys.RK_RIGHT, sel, sig
         ) ^ sig
-        cwp, cwl_w, cwr_w = cw_planes(cw_seed, cw_l, cw_r)
         h = h ^ (cwp & ctrl[None, None, :])
         t_new = h[0, 0]
         h = h.at[0, 0].set(jnp.zeros_like(t_new))
@@ -487,14 +533,35 @@ def _eval_paths(seeds, control, paths, cw_seeds, cw_left, cw_right,
                 bit_indices):
     """Dispatch the path walk: `DPF_TPU_EVAL_PATHS` = `limb` | `planes` |
     `auto` (default: planes on TPU, limb elsewhere — same trade-off as
-    `dense_eval.expansion_impl`)."""
+    `dense_eval.expansion_impl`). On TPU the plane levels run the fused
+    Pallas select-key kernel (`DPF_TPU_LEVEL_KERNEL`), with XLA-level
+    fallback on compile failure."""
+    import os as _os
+    import warnings as _warnings
+
     from .utils.runtime import planes_selected
 
-    if planes_selected("DPF_TPU_EVAL_PATHS"):
-        return _eval_paths_planes(
+    if not planes_selected("DPF_TPU_EVAL_PATHS"):
+        return _eval_paths_limb(
             seeds, control, paths, cw_seeds, cw_left, cw_right, bit_indices
         )
-    return _eval_paths_limb(
+    from .pir import dense_eval_planes as _dep
+
+    if _dep._level_kernel_enabled():
+        try:
+            return _eval_paths_planes(
+                seeds, control, paths, cw_seeds, cw_left, cw_right,
+                bit_indices, level_kernel=True,
+            )
+        except Exception as e:  # noqa: BLE001 - fall back to XLA level
+            if _os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto") == "pallas":
+                raise
+            _dep._remember_level_kernel_failure()
+            _warnings.warn(
+                "pallas level kernel failed in the path walk; using the "
+                f"XLA level ({str(e).splitlines()[0][:200]})"
+            )
+    return _eval_paths_planes(
         seeds, control, paths, cw_seeds, cw_left, cw_right, bit_indices
     )
 

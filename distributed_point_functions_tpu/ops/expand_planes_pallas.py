@@ -241,3 +241,122 @@ def value_hash_planes_pallas(
         out_shape=jax.ShapeDtypeStruct((16, 8, g), U32),
         interpret=interpret,
     )(state, ctrl[None, :], vc_kg, jnp.asarray(_MASKS_VALUE))
+
+
+def _path_kernel(
+    state_ref,
+    ctrl_ref,
+    sel_ref,
+    cwp_ref,
+    cwl_ref,
+    cwr_ref,
+    masks_ref,
+    outs_ref,
+    outc_ref,
+    *,
+    reps: int,
+    per_seed: bool,
+):
+    """One path-walk level: select-key AES (per-lane left/right round
+    keys from the packed path-bit mask — the reference's per-lane key
+    select, `dpf/internal/aes_128_fixed_key_hash_hwy.h:123-155`), seed
+    correction, LSB extract/clear, and the direction-corrected control
+    update, fused in VMEM. With `per_seed` the correction refs are
+    lane-aligned (batch-of-keys mode, `evaluate_and_apply`); otherwise
+    they are [.., KG] per-key words tiled in-kernel."""
+    sig = _sigma(state_ref[:])
+    masks = masks_ref[:]  # [2, 11, 16, 8, 1] left/right plane masks
+    sel = sel_ref[:]  # [1, T] packed path bits
+    selb = sel[0][None, None, :]
+
+    def ark(st, rnd):
+        m0 = masks[0, rnd]
+        m1 = masks[1, rnd]
+        return st ^ ((m0 & ~selb) | (m1 & selb))
+
+    st = ark(sig, 0)
+    for rnd in range(1, 10):
+        st = _sub_bytes_planes(st)
+        st = _shift_rows_static(st)
+        st = _mix_columns_planes(st)
+        st = ark(st, rnd)
+    st = _sub_bytes_planes(st)
+    st = _shift_rows_static(st)
+    h = ark(st, 10) ^ sig
+
+    ctrl = ctrl_ref[:]  # [1, T]
+    if per_seed:
+        cwp = cwp_ref[:]
+        cwl = cwl_ref[:]
+        cwr = cwr_ref[:]
+    else:
+        cwp = pltpu.repeat(cwp_ref[:], reps, axis=2)
+        cwl = pltpu.repeat(cwl_ref[:], reps, axis=1)
+        cwr = pltpu.repeat(cwr_ref[:], reps, axis=1)
+    h = h ^ (cwp & ctrl[0][None, None, :])
+    t_new = h[0, 0]
+    outs_ref[:] = h.at[0, 0].set(jnp.zeros_like(t_new))
+    cw_dir = (sel[0] & cwr[0]) | (~sel[0] & cwl[0])
+    outc_ref[:] = (t_new ^ (ctrl[0] & cw_dir))[None, :]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("per_seed", "interpret")
+)
+def path_level_planes_pallas(
+    state: jnp.ndarray,
+    ctrl: jnp.ndarray,
+    sel: jnp.ndarray,
+    cwp: jnp.ndarray,
+    cwl: jnp.ndarray,
+    cwr: jnp.ndarray,
+    per_seed: bool,
+    interpret: bool = False,
+):
+    """One path-walk level on [16, 8, G] planes.
+
+    sel: uint32[G] packed path bits (1 -> right key). With per_seed,
+    cwp is uint32[16, 8, G] lane-aligned correction planes and cwl/cwr
+    are uint32[G]; otherwise cwp is [16, 8, KG] / cwl, cwr [KG] per-key
+    words tiled across lanes in-kernel. Returns (state [16, 8, G],
+    ctrl [G]) — the fused body of `dpf._eval_paths_planes`."""
+    _, _, g = state.shape
+    kg = g if per_seed else cwp.shape[-1]
+    tile = _pick_tile(g, kg if not per_seed else 1)
+    reps = tile // kg if not per_seed else 1
+    if per_seed:
+        cw_specs = [
+            pl.BlockSpec((16, 8, tile), lambda l: (0, 0, l)),
+            pl.BlockSpec((1, tile), lambda l: (0, l)),
+            pl.BlockSpec((1, tile), lambda l: (0, l)),
+        ]
+    else:
+        cw_specs = [
+            pl.BlockSpec((16, 8, kg), lambda l: (0, 0, 0)),
+            pl.BlockSpec((1, kg), lambda l: (0, 0)),
+            pl.BlockSpec((1, kg), lambda l: (0, 0)),
+        ]
+    outs, outc = pl.pallas_call(
+        functools.partial(_path_kernel, reps=reps, per_seed=per_seed),
+        grid=(g // tile,),
+        in_specs=[
+            pl.BlockSpec((16, 8, tile), lambda l: (0, 0, l)),
+            pl.BlockSpec((1, tile), lambda l: (0, l)),
+            pl.BlockSpec((1, tile), lambda l: (0, l)),
+            *cw_specs,
+            pl.BlockSpec(
+                (2, 11, 16, 8, 1), lambda l: (0, 0, 0, 0, 0)
+            ),
+        ],
+        out_specs=(
+            pl.BlockSpec((16, 8, tile), lambda l: (0, 0, l)),
+            pl.BlockSpec((1, tile), lambda l: (0, l)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((16, 8, g), U32),
+            jax.ShapeDtypeStruct((1, g), U32),
+        ),
+        interpret=interpret,
+    )(state, ctrl[None, :], sel[None, :], cwp, cwl[None, :], cwr[None, :],
+      _MASKS_LR)
+    return outs, outc[0]

@@ -191,8 +191,7 @@ def evaluate_selection_blocks_planes(
         except Exception as e:  # noqa: BLE001 - fall back to the XLA level
             if os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto") == "pallas":
                 raise
-            global _LEVEL_KERNEL_FAILED
-            _LEVEL_KERNEL_FAILED = True
+            _remember_level_kernel_failure()
             warnings.warn(
                 "pallas level kernel failed; serving via the XLA level "
                 f"({str(e).splitlines()[0][:200]})"
@@ -208,6 +207,14 @@ def evaluate_selection_blocks_planes(
 
 
 _LEVEL_KERNEL_FAILED = False
+
+
+def _remember_level_kernel_failure() -> None:
+    """Disable the auto-mode Pallas level kernel for this process (a
+    failed trace is not cached by jit, so retrying would pay it on every
+    batch)."""
+    global _LEVEL_KERNEL_FAILED
+    _LEVEL_KERNEL_FAILED = True
 
 
 def _level_kernel_enabled() -> bool:

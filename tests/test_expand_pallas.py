@@ -119,3 +119,86 @@ def test_serving_expansion_with_level_kernel(monkeypatch):
         num_blocks=num_blocks, force_planes=True,
     ))
     np.testing.assert_array_equal(got, want)
+
+
+def test_hierarchical_expansion_with_level_kernel(monkeypatch):
+    """Full-domain evaluate_next through the plane path with the Pallas
+    level kernel (interpret mode) matches the limb program."""
+    import functools
+
+    from distributed_point_functions_tpu import dpf as dpf_mod
+    from distributed_point_functions_tpu.dpf import (
+        DistributedPointFunction,
+        DpfParameters,
+    )
+    from distributed_point_functions_tpu.ops import (
+        expand_planes_pallas as epp,
+    )
+    from distributed_point_functions_tpu.value_types import IntType
+
+    monkeypatch.setenv("DPF_TPU_EXPAND_LEVELS", "limb")
+    params = DpfParameters(log_domain_size=11, value_type=IntType(64))
+    d = DistributedPointFunction.create(params)
+    k0, k1 = d.generate_keys(777, 99)
+
+    def run_both():
+        outs = []
+        for k in (k0, k1):
+            ctx = d.create_evaluation_context(k)
+            outs.append(np.asarray(d.evaluate_next([], ctx)))
+        return outs
+
+    want = run_both()
+
+    # Planes path + forced Pallas level kernel, interpret mode: patch the
+    # kernel symbol where the planes program imports it from.
+    monkeypatch.setenv("DPF_TPU_EXPAND_LEVELS", "planes")
+    monkeypatch.setenv("DPF_TPU_LEVEL_KERNEL", "pallas")
+    monkeypatch.setattr(
+        epp, "expand_level_planes_pallas",
+        functools.partial(epp.expand_level_planes_pallas, interpret=True),
+    )
+    dpf_mod._expand_levels_planes_fn.cache_clear()
+    got = run_both()
+    dpf_mod._expand_levels_planes_fn.cache_clear()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(g, w)
+    total = want[0] + want[1]  # uint64 addition wraps mod 2^64
+    assert int(total[777]) == 99
+
+
+@pytest.mark.parametrize("per_seed", [False, True])
+def test_path_walk_with_level_kernel(monkeypatch, per_seed):
+    """The path walk served through the Pallas select-key kernel
+    (interpret mode) matches the limb walk in both correction modes."""
+    import functools
+
+    from distributed_point_functions_tpu import dpf as dpf_mod
+    from distributed_point_functions_tpu.ops import (
+        expand_planes_pallas as epp,
+    )
+
+    monkeypatch.setattr(
+        epp, "path_level_planes_pallas",
+        functools.partial(epp.path_level_planes_pallas, interpret=True),
+    )
+
+    n, levels = 64, 6
+    seeds = RNG.integers(0, 1 << 32, (n, 4), dtype=np.uint32)
+    control = RNG.integers(0, 2, (n,), dtype=np.uint32)
+    paths = RNG.integers(0, 1 << 32, (n, 4), dtype=np.uint32)
+    m = n if per_seed else 1
+    cw_seeds = RNG.integers(0, 1 << 32, (levels, m, 4), dtype=np.uint32)
+    cw_left = RNG.integers(0, 2, (levels, m), dtype=np.uint32)
+    cw_right = RNG.integers(0, 2, (levels, m), dtype=np.uint32)
+    bit_indices = np.arange(levels, dtype=np.uint32)[::-1].copy()
+
+    args = tuple(
+        jnp.asarray(a)
+        for a in (seeds, control, paths, cw_seeds, cw_left, cw_right,
+                  bit_indices)
+    )
+    want_s, want_c = dpf_mod._eval_paths_limb(*args)
+    got_s, got_c = dpf_mod._eval_paths_planes(*args, level_kernel=True)
+    np.testing.assert_array_equal(np.asarray(got_s), np.asarray(want_s))
+    np.testing.assert_array_equal(np.asarray(got_c), np.asarray(want_c))
