@@ -211,3 +211,65 @@ def keygen_batch_dense(
         _ptr(last_vc),
     )
     return cw_seeds, cw_ctrl, last_vc
+
+
+def cuckoo_build(
+    keys: "list[bytes]",
+    seeds: "list[bytes]",
+    num_buckets: int,
+    max_relocations: int,
+    rng_seed: int = 0x5EED,
+) -> np.ndarray:
+    """Native cuckoo-table build (`native/cuckoo_build.cc`).
+
+    `seeds[i]` is hash function i's full SHA256 prefix (family seed +
+    derivation seed, `hashing/hash_family.py` semantics). Returns
+    int64[num_buckets] of key indices (-1 = empty bucket) — a legal
+    cuckoo assignment (each key lands in one of its hash buckets; layout
+    may differ from the Python builder's, which the protocol permits).
+    Raises on placement failure, like `CuckooHashTable.insert`.
+    """
+    lib = get_lib()
+    if not hasattr(lib.dpf_cuckoo_build, "_configured"):
+        lib.dpf_cuckoo_build.argtypes = [
+            ctypes.c_void_p,  # keys_concat
+            ctypes.c_void_p,  # key_offsets
+            ctypes.c_int64,  # num_keys
+            ctypes.c_void_p,  # seeds_concat
+            ctypes.c_void_p,  # seed_offsets
+            ctypes.c_int,  # num_hashes
+            ctypes.c_int64,  # num_buckets
+            ctypes.c_int64,  # max_relocations
+            ctypes.c_uint64,  # rng_seed
+            ctypes.c_void_p,  # out_slots
+        ]
+        lib.dpf_cuckoo_build.restype = ctypes.c_int
+        lib.dpf_cuckoo_build._configured = True
+    keys_concat = np.frombuffer(
+        b"".join(keys), dtype=np.uint8
+    ) if keys else np.zeros(0, np.uint8)
+    key_offsets = np.zeros(len(keys) + 1, dtype=np.uint64)
+    np.cumsum([len(k) for k in keys], out=key_offsets[1:])
+    seeds_concat = np.frombuffer(b"".join(seeds), dtype=np.uint8)
+    seed_offsets = np.zeros(len(seeds) + 1, dtype=np.uint64)
+    np.cumsum([len(s) for s in seeds], out=seed_offsets[1:])
+    out = np.empty(num_buckets, dtype=np.int64)
+    rc = lib.dpf_cuckoo_build(
+        _ptr(keys_concat),
+        _ptr(key_offsets),
+        ctypes.c_int64(len(keys)),
+        _ptr(seeds_concat),
+        _ptr(seed_offsets),
+        ctypes.c_int(len(seeds)),
+        ctypes.c_int64(num_buckets),
+        ctypes.c_int64(max_relocations),
+        ctypes.c_uint64(rng_seed),
+        _ptr(out),
+    )
+    if rc == -1:
+        raise RuntimeError(
+            "cuckoo insertion failed: relocation budget exhausted"
+        )
+    if rc != 0:
+        raise ValueError(f"dpf_cuckoo_build rejected arguments (rc={rc})")
+    return out

@@ -11,12 +11,14 @@ inner products (`cuckoo_hashed_dpf_pir_database.cc:164-183`).
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 
 from ..hashing import CuckooHashTable, create_hash_family_from_config
 from ..hashing.hash_family import create_hash_functions
+from ..hashing.hash_family_config import HASH_FAMILY_SHA256
 from .database import DenseDpfPirDatabase
 
 
@@ -62,23 +64,13 @@ class CuckooHashedDpfPirDatabase:
                 raise ValueError("num_buckets must be positive")
             if params.num_hash_functions <= 0:
                 raise ValueError("num_hash_functions must be positive")
-            family = create_hash_family_from_config(params.hash_family_config)
-            hash_functions = create_hash_functions(
-                family, params.num_hash_functions
-            )
-            table = CuckooHashTable(
-                hash_functions,
-                params.num_buckets,
-                max_relocations=max(128, len(self._records)),
-                max_stash_size=0,
-            )
             for key in self._records:
                 if not key:
                     raise ValueError("key cannot be empty")
-                table.insert(key)
+            slots = self._build_slots(params)
             key_builder = DenseDpfPirDatabase.Builder()
             value_builder = DenseDpfPirDatabase.Builder()
-            for slot in table.get_table():
+            for slot in slots:
                 if slot is not None:
                     key_builder.insert(slot)
                     value_builder.insert(self._records[slot])
@@ -91,6 +83,66 @@ class CuckooHashedDpfPirDatabase:
                 size=len(self._records),
                 num_buckets=params.num_buckets,
             )
+
+        def _build_slots(self, params):
+            """bucket -> key (or None): the cuckoo assignment.
+
+            The native builder (`native/cuckoo_build.cc`, same SHA256
+            family semantics, ~50x faster at the 2^24-key scale) is
+            tried first unless DPF_NATIVE_CUCKOO=0; any legal assignment
+            serves the protocol, so its layout needn't match the Python
+            builder's. Fallback is the Python `CuckooHashTable` loop.
+            """
+            import os as _os
+
+            keys = list(self._records)
+            if (
+                _os.environ.get("DPF_NATIVE_CUCKOO", "1") != "0"
+                and params.hash_family_config.hash_family
+                == HASH_FAMILY_SHA256
+            ):
+                try:
+                    from .. import native as _native
+
+                    family_seed = params.hash_family_config.seed
+                    family_seed = (
+                        family_seed.encode()
+                        if isinstance(family_seed, str)
+                        else bytes(family_seed)
+                    )
+                    seeds = [
+                        family_seed + str(i).encode()
+                        for i in range(params.num_hash_functions)
+                    ]
+                    idx = _native.cuckoo_build(
+                        keys,
+                        seeds,
+                        params.num_buckets,
+                        max_relocations=max(128, len(keys)),
+                    )
+                    return [
+                        keys[i] if i >= 0 else None for i in idx
+                    ]
+                except Exception as e:  # noqa: BLE001 - python fallback
+                    warnings.warn(
+                        "native cuckoo builder unavailable; using the "
+                        f"Python insertion loop ({e})"
+                    )
+            family = create_hash_family_from_config(
+                params.hash_family_config
+            )
+            hash_functions = create_hash_functions(
+                family, params.num_hash_functions
+            )
+            table = CuckooHashTable(
+                hash_functions,
+                params.num_buckets,
+                max_relocations=max(128, len(keys)),
+                max_stash_size=0,
+            )
+            for key in keys:
+                table.insert(key)
+            return table.get_table()
 
     def __init__(
         self,

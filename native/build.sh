@@ -8,6 +8,7 @@ g++ -O2 -fPIC -maes -std=c++17 -c aesni.cc -o aesni.o
 g++ -O2 -fPIC -std=c++17 -c aes128.cc -o aes128.o
 g++ -O2 -fPIC -std=c++17 -c dpf_kernels.cc -o dpf_kernels.o
 g++ -O2 -fPIC -std=c++17 -c keygen.cc -o keygen.o
-g++ -shared -o libdpf_native.so aes128.o aesni.o dpf_kernels.o keygen.o
-rm -f aes128.o aesni.o dpf_kernels.o keygen.o
+g++ -O2 -fPIC -std=c++17 -c cuckoo_build.cc -o cuckoo_build.o
+g++ -shared -o libdpf_native.so aes128.o aesni.o dpf_kernels.o keygen.o cuckoo_build.o
+rm -f aes128.o aesni.o dpf_kernels.o keygen.o cuckoo_build.o
 echo "built $(pwd)/libdpf_native.so"

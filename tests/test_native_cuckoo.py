@@ -1,0 +1,147 @@
+"""Native cuckoo builder differentials (`native/cuckoo_build.cc`).
+
+The native table layout may legally differ from the Python builder's
+(random eviction order); what must hold is (1) hash semantics identical
+to `hashing/sha256_hash_family.py`, (2) every key placed in one of its
+own hash buckets with no key lost, (3) the sparse PIR protocol serves
+correctly from a natively-built database."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu import native
+from distributed_point_functions_tpu.hashing import (
+    create_hash_family_from_config,
+)
+from distributed_point_functions_tpu.hashing.hash_family import (
+    create_hash_functions,
+)
+from distributed_point_functions_tpu.hashing.hash_family_config import (
+    HASH_FAMILY_SHA256,
+    HashFamilyConfig,
+)
+
+RNG = np.random.default_rng(41)
+
+
+def _keys(n):
+    return [bytes(f"key-{i:08d}", "ascii") for i in range(n)]
+
+
+def test_native_hash_matches_python_family():
+    lib = native.get_lib()
+    import ctypes
+
+    keys = [b"alpha", b"beta-longer-key", b"\x00\x01\x02", b"d" * 300]
+    family_seed = b"fam-seed-0123"
+    seeds = [family_seed + str(i).encode() for i in range(3)]
+    nb = 1013
+    concat = b"".join(keys)
+    offs = np.cumsum([0] + [len(k) for k in keys]).astype(np.uint64)
+    sconcat = b"".join(seeds)
+    soffs = np.cumsum([0] + [len(s) for s in seeds]).astype(np.uint64)
+    out = np.zeros(len(keys) * len(seeds), dtype=np.int64)
+    rc = lib.dpf_cuckoo_hash_buckets(
+        ctypes.c_char_p(concat),
+        offs.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(len(keys)),
+        ctypes.c_char_p(sconcat),
+        soffs.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(len(seeds)),
+        ctypes.c_int64(nb),
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    assert rc == 0
+
+    config = HashFamilyConfig(HASH_FAMILY_SHA256, family_seed)
+    fns = create_hash_functions(create_hash_family_from_config(config), 3)
+    want = [fn(k, nb) for k in keys for fn in fns]
+    assert out.tolist() == want
+
+
+def test_native_build_is_legal_assignment():
+    keys = _keys(2000)
+    family_seed = b"seedling"
+    num_hashes = 3
+    nb = 3000
+    seeds = [family_seed + str(i).encode() for i in range(num_hashes)]
+    slots = native.cuckoo_build(keys, seeds, nb, max_relocations=2000)
+    assert slots.shape == (nb,)
+    placed = slots[slots >= 0]
+    # No key lost, none duplicated.
+    assert sorted(placed.tolist()) == list(range(len(keys)))
+    # Every key sits in one of ITS OWN hash buckets.
+    config = HashFamilyConfig(HASH_FAMILY_SHA256, family_seed)
+    fns = create_hash_functions(
+        create_hash_family_from_config(config), num_hashes
+    )
+    for b in np.nonzero(slots >= 0)[0][:200]:
+        k = keys[slots[b]]
+        assert b in {fn(k, nb) for fn in fns}
+
+
+def test_native_build_failure_raises():
+    # 5 keys, 2 buckets, 2 hash functions: pigeonhole failure.
+    keys = _keys(5)
+    seeds = [b"s0", b"s1"]
+    with pytest.raises(RuntimeError, match="relocation"):
+        native.cuckoo_build(keys, seeds, 2, max_relocations=64)
+
+
+def test_sparse_protocol_serves_from_native_build(monkeypatch):
+    from distributed_point_functions_tpu.pir.cuckoo_database import (
+        CuckooHashedDpfPirDatabase,
+    )
+    from distributed_point_functions_tpu.pir.sparse_client import (
+        CuckooHashingSparseDpfPirClient,
+        _is_prefix_padded_with_zeros,
+    )
+    from distributed_point_functions_tpu.pir.sparse_server import (
+        CuckooHashingSparseDpfPirServer,
+    )
+
+    monkeypatch.setenv("DPF_NATIVE_CUCKOO", "1")
+    pairs = [
+        (f"user{i}".encode(), f"value-{i}".encode()) for i in range(300)
+    ]
+    params = CuckooHashingSparseDpfPirServer.generate_params(
+        len(pairs), seed=b"0123456789abcdef"
+    )
+    builder = CuckooHashedDpfPirDatabase.Builder().set_params(params)
+    for kv in pairs:
+        builder.insert(kv)
+    db = builder.build()
+    db2 = builder.clone().build()
+    server0 = CuckooHashingSparseDpfPirServer.create_plain(params, db)
+    server1 = CuckooHashingSparseDpfPirServer.create_plain(params, db2)
+    client = CuckooHashingSparseDpfPirClient.create(
+        params, lambda pt, ci: pt
+    )
+    queries = [b"user3", b"user244", b"missing-key"]
+    req0, req1 = client.create_plain_requests(queries)
+    r0 = server0.handle_request(req0)
+    r1 = server1.handle_request(req1)
+    combined = [
+        bytes(x ^ y for x, y in zip(a, b))
+        for a, b in zip(
+            r0.dpf_pir_response.masked_response,
+            r1.dpf_pir_response.masked_response,
+        )
+    ]
+    expected = {b"user3": b"value-3", b"user244": b"value-244"}
+    nh = params.num_hash_functions
+    for i, q in enumerate(queries):
+        found = None
+        for j in range(nh):
+            idx = 2 * (nh * i + j)
+            if found is None and _is_prefix_padded_with_zeros(
+                combined[idx], q
+            ):
+                found = combined[idx + 1]
+        if q in expected:
+            assert found is not None
+            assert found[: len(expected[q])] == expected[q]
+        else:
+            assert found is None or all(b == 0 for b in found)
