@@ -27,6 +27,7 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import sys
 import time
 
@@ -65,6 +66,7 @@ def bench_dense_big(scale: str):
     )
     from distributed_point_functions_tpu.ops.inner_product_pallas import (
         permute_db_bitmajor,
+        xor_inner_product_pallas2_staged,
         xor_inner_product_pallas_staged,
     )
     from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
@@ -90,20 +92,22 @@ def bench_dense_big(scale: str):
         db = jax.block_until_ready(
             permute_db_bitmajor(jax.device_put(db_host))
         )
-        # Same tier order as the serving path: Pallas, else the pure-jnp
-        # bit-plane MXU path (both consume the staged layout).
-        try:
-            jax.block_until_ready(
-                xor_inner_product_pallas_staged(
-                    db, np.zeros((8, db.shape[1], 4), np.uint32)
+        # Same tier order as the serving path: v2 Pallas, v1 Pallas,
+        # then the pure-jnp bit-plane MXU path (all consume the staged
+        # layout).
+        inner_product, ip_name = xor_inner_product_bitplane, "bitplane"
+        for cand_name, cand in (
+            ("pallas2", xor_inner_product_pallas2_staged),
+            ("pallas", xor_inner_product_pallas_staged),
+        ):
+            try:
+                jax.block_until_ready(
+                    cand(db, np.zeros((8, db.shape[1], 4), np.uint32))
                 )
-            )
-            inner_product = xor_inner_product_pallas_staged
-            ip_name = "pallas"
-        except Exception as e:  # noqa: BLE001
-            print(f"# pallas unavailable, using bitplane: {e}", flush=True)
-            inner_product = xor_inner_product_bitplane
-            ip_name = "bitplane"
+                inner_product, ip_name = cand, cand_name
+                break
+            except Exception as e:  # noqa: BLE001
+                print(f"# {cand_name} unavailable: {e}", flush=True)
     else:
         db = jax.device_put(db_host)
         inner_product = xor_inner_product
@@ -164,7 +168,10 @@ def bench_sparse_big(scale: str):
 
     num_keys = (1 << 24) if scale == "full" else (1 << 14)
     value_bytes = 16
-    num_queries = 8
+    query_counts = [
+        int(q)
+        for q in os.environ.get("BENCH_SPARSE_QUERIES", "8,64").split(",")
+    ]
 
     rng = np.random.default_rng(13)
     t0 = time.perf_counter()
@@ -184,31 +191,33 @@ def bench_sparse_big(scale: str):
     client = CuckooHashingSparseDpfPirClient.create_from_public_params(
         server.get_public_params().SerializeToString(), lambda pt, ci: pt
     )
-    queries = [b"k%012d" % int(i) for i in
-               rng.integers(0, num_keys, num_queries)]
+    for num_queries in query_counts:
+        queries = [b"k%012d" % int(i) for i in
+                   rng.integers(0, num_keys, num_queries)]
 
-    t0 = time.perf_counter()
-    req0, _req1 = client.create_plain_requests(queries)
-    resp = server.handle_request(req0)
-    first_s = time.perf_counter() - t0
-    assert len(resp.dpf_pir_response.masked_response) == (
-        2 * num_queries * params.num_hash_functions
-    )
+        t0 = time.perf_counter()
+        req0, _req1 = client.create_plain_requests(queries)
+        resp = server.handle_request(req0)
+        first_s = time.perf_counter() - t0
+        assert len(resp.dpf_pir_response.masked_response) == (
+            2 * num_queries * params.num_hash_functions
+        )
 
-    # handle_request blocks internally (the inner product is read back to
-    # host bytes), so wall-clock per call is the honest serving time.
-    per_batch = _slope(lambda: server.handle_request(req0), iters=3)
-    _emit(
-        benchmark=f"sparse_pir_{num_keys}keys_{num_queries}q",
-        queries_per_s=(
-            round(num_queries / per_batch, 2) if per_batch else None
-        ),
-        per_batch_ms=round(per_batch * 1e3, 3) if per_batch else None,
-        build_s=round(build_s, 1),
-        first_request_s=round(first_s, 1),
-        num_buckets=params.num_buckets,
-        backend=jax.default_backend(),
-    )
+        # handle_request blocks internally (the inner product is read
+        # back to host bytes), so wall-clock per call is the honest
+        # serving time.
+        per_batch = _slope(lambda: server.handle_request(req0), iters=3)
+        _emit(
+            benchmark=f"sparse_pir_{num_keys}keys_{num_queries}q",
+            queries_per_s=(
+                round(num_queries / per_batch, 2) if per_batch else None
+            ),
+            per_batch_ms=round(per_batch * 1e3, 3) if per_batch else None,
+            build_s=round(build_s, 1),
+            first_request_s=round(first_s, 1),
+            num_buckets=params.num_buckets,
+            backend=jax.default_backend(),
+        )
 
 
 def main():
