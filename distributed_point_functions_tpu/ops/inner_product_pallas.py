@@ -283,7 +283,7 @@ def _ip_kernel_v2(sel_ref, db_ref, out_ref, *, j_chunk: int, int8: bool):
 @functools.partial(
     jax.jit,
     static_argnames=("tile_queries", "tile_groups", "j_chunk", "int8",
-                     "interpret"),
+                     "interpret", "vma"),
 )
 def _ip_pallas_staged_v2(
     db_perm: jnp.ndarray,
@@ -293,6 +293,7 @@ def _ip_pallas_staged_v2(
     j_chunk: int = 8,
     int8: bool = False,
     interpret: bool = False,
+    vma: tuple = (),
 ) -> jnp.ndarray:
     _, num_groups, num_words = db_perm.shape
     nq = packed.shape[0]
@@ -314,7 +315,12 @@ def _ip_pallas_staged_v2(
         out_specs=pl.BlockSpec(
             (tq, 32 * num_words), lambda q, r: (q, 0)
         ),
-        out_shape=jax.ShapeDtypeStruct((nq, 32 * num_words), acc_t),
+        # vma: required when called inside a shard_map with the sharding
+        # checker on (the multi-chip MXU step, `parallel/sharded.py`).
+        out_shape=jax.ShapeDtypeStruct(
+            (nq, 32 * num_words), acc_t,
+            **({"vma": frozenset(vma)} if vma else {}),
+        ),
         interpret=interpret,
     )(packed, db_perm)
     parity = counts.reshape(nq, 32, num_words).astype(I32).astype(U32) & U32(1)
@@ -331,6 +337,7 @@ def xor_inner_product_pallas2_staged(
     j_chunk: int = 8,
     int8: bool = True,
     interpret: bool = False,
+    vma: tuple = (),
 ) -> jnp.ndarray:
     """v2 serving entry: same staged layout/signature as
     `xor_inner_product_pallas_staged`, one large dot per step.
@@ -357,6 +364,7 @@ def xor_inner_product_pallas2_staged(
         j_chunk=j_chunk,
         int8=int8,
         interpret=interpret,
+        vma=vma,
     )
     return out[:nq] if nq_pad != nq else out
 

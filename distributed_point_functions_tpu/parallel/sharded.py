@@ -296,3 +296,144 @@ def pad_staged_queries(staged, ndev: int):
         np.pad(cr, ((0, 0), (0, pad))),
         np.pad(vc, ((0, pad), (0, 0))),
     )
+
+
+def stage_sharded_bitmajor(mesh: Mesh, db_words, axis_name: str = "x"):
+    """Stage a record-sharded database into bit-major MXU layout per
+    shard: uint32[R, W] sharded on records -> uint32[32, G, W] sharded on
+    the group axis (records never leave their device; the permutation is
+    `permute_db_bitmajor` applied shard-locally).
+
+    R must be divisible by 4096 * mesh size so every shard permutes
+    without padding (4096 = 32 bit-classes x 128 lane groups).
+    """
+    from ..ops.inner_product_pallas import permute_db_bitmajor
+
+    ndev = mesh.devices.size
+    _check_divisible("num_records", db_words.shape[0], 4096 * ndev)
+    fn = jax.jit(
+        jax.shard_map(
+            permute_db_bitmajor,
+            mesh=mesh,
+            in_specs=P(axis_name, None),
+            out_specs=P(None, axis_name, None),
+        )
+    )
+    return fn(db_words)
+
+
+def _local_partial_ip_mxu(db_perm_shard, selections, idx, interpret,
+                          axis_name):
+    """This device's XOR partial via the v2 Pallas MXU kernel on its
+    bit-major shard (`ops/inner_product_pallas.py`): the multi-chip
+    analog of the single-chip pallas2 serving tier."""
+    from ..ops.inner_product_pallas import xor_inner_product_pallas2_staged
+
+    g_local = db_perm_shard.shape[1]
+    nq = selections.shape[0]
+    packed = selections.reshape(nq, -1)
+    packed_local = lax.dynamic_slice_in_dim(
+        packed, idx * g_local, g_local, axis=1
+    )
+    return xor_inner_product_pallas2_staged(
+        db_perm_shard,
+        packed_local.reshape(nq, -1, 4),
+        interpret=interpret,
+        vma=(axis_name,),
+    )
+
+
+def sharded_dense_pir_step_mxu(
+    mesh: Mesh,
+    *,
+    walk_levels: int,
+    expand_levels: int,
+    num_blocks: int,
+    num_databases: int = 1,
+    axis_name: str = "x",
+    real_num_blocks: int | None = None,
+    interpret: bool = False,
+):
+    """`sharded_dense_pir_step_multi` with the local inner product on the
+    MXU: databases arrive bit-major staged (`stage_sharded_bitmajor`,
+    uint32[32, G, W] sharded on the group axis) and each device runs the
+    v2 Pallas kernel on its shard. `interpret=True` runs the kernel in
+    interpret mode (CPU-mesh tests of the multi-chip wiring).
+
+    Returns fn(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
+    *db_perms) -> tuple of uint32[nq, W_i].
+    """
+    ndev = mesh.devices.size
+    if (
+        real_num_blocks is not None
+        and real_num_blocks > (1 << expand_levels)
+    ):
+        raise ValueError(
+            f"DPF tree leaf capacity 2^{expand_levels} cannot cover the "
+            f"{real_num_blocks} real record blocks; only mesh-padding "
+            "blocks may lie beyond the tree"
+        )
+
+    def step(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
+             *db_shards):
+        sel_local = expansion_impl()(
+            seeds0,
+            control0,
+            cw_seeds,
+            cw_left,
+            cw_right,
+            last_vc,
+            walk_levels=walk_levels,
+            expand_levels=expand_levels,
+            num_blocks=num_blocks,
+        )
+        sel_all = lax.all_gather(sel_local, axis_name, tiled=True)
+        idx = lax.axis_index(axis_name)
+        return tuple(
+            _local_partial_ip_mxu(
+                db_shard, sel_all, idx, interpret, axis_name
+            )[None]
+            for db_shard in db_shards
+        )
+
+    shard_mapped = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(
+            P(axis_name),
+            P(axis_name),
+            P(None, axis_name),
+            P(None, axis_name),
+            P(None, axis_name),
+            P(axis_name),
+        ) + (P(None, axis_name, None),) * num_databases,
+        out_specs=(P(axis_name),) * num_databases,
+    )
+
+    @jax.jit
+    def run(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
+            *db_perms):
+        if len(db_perms) != num_databases:
+            raise ValueError(
+                f"expected {num_databases} databases, got {len(db_perms)}"
+            )
+        _check_divisible("num_queries", seeds0.shape[0], ndev)
+        for db in db_perms:
+            if db.ndim != 3 or db.shape[0] != 32:
+                raise ValueError(
+                    "databases must be bit-major staged "
+                    "(stage_sharded_bitmajor)"
+                )
+            _check_divisible("num_groups", db.shape[1], 128 * ndev)
+            if db.shape[1] * 32 != num_blocks * 128:
+                raise ValueError(
+                    f"database has {db.shape[1] * 32} rows but the step "
+                    f"was built for num_blocks={num_blocks}"
+                )
+        partials = shard_mapped(
+            seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
+            *db_perms,
+        )
+        return tuple(_xor_combine(p, mesh) for p in partials)
+
+    return run

@@ -343,3 +343,45 @@ def test_sharded_step_planes_matches_limb(monkeypatch):
             step(*staged, shard_database(mesh, jnp.asarray(db)))
         )
     np.testing.assert_array_equal(outs["limb"], outs["planes"])
+
+
+def test_sharded_mxu_step_matches_xor_step():
+    """The MXU sharded step (bit-major shards + v2 Pallas kernel in
+    interpret mode) is bit-identical to the mask-and-XOR sharded step."""
+    from distributed_point_functions_tpu.parallel.sharded import (
+        sharded_dense_pir_step_mxu,
+        stage_sharded_bitmajor,
+    )
+
+    mesh8 = require_mesh()
+    rng = np.random.default_rng(77)
+    ndev = mesh8.devices.size
+    num_records = 4096 * ndev  # stage_sharded_bitmajor's granularity
+    num_words = 8
+    nq = 8 * ndev
+    num_blocks = num_records // 128
+    total = (num_records - 1).bit_length()
+    expand = min((num_blocks - 1).bit_length(), total)
+    walk = total - expand
+
+    db = jnp.asarray(rng.integers(
+        0, 1 << 32, (num_records, num_words), dtype=np.uint32
+    ))
+    client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+    keys0, _ = client._generate_key_pairs(
+        [int(i) for i in rng.integers(0, num_records, nq)]
+    )
+    staged = stage_keys(keys0)
+
+    want = np.asarray(sharded_dense_pir_step(
+        mesh8, walk_levels=walk, expand_levels=expand,
+        num_blocks=num_blocks,
+    )(*staged, db))
+
+    db_perm = stage_sharded_bitmajor(mesh8, db)
+    assert db_perm.shape == (32, num_records // 32, num_words)
+    got = np.asarray(sharded_dense_pir_step_mxu(
+        mesh8, walk_levels=walk, expand_levels=expand,
+        num_blocks=num_blocks, interpret=True,
+    )(*staged, db_perm)[0])
+    np.testing.assert_array_equal(got, want)
