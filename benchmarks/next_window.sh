@@ -8,6 +8,11 @@ cd "$(dirname "$0")/.."
 mkdir -p benchmarks/results
 stamp=$(date +%Y%m%d_%H%M%S)
 
+echo "=== kernel smoke (tiny shapes, fast compiles) ==="
+timeout 1500 python benchmarks/kernel_smoke.py \
+    2>benchmarks/results/kernel_smoke_${stamp}.log \
+    | tee benchmarks/results/kernel_smoke_${stamp}.json
+
 echo "=== inner-product kernel A/B (v1 vs v2 variants) ==="
 timeout 1800 python benchmarks/ip_ab.py \
     2>benchmarks/results/ip_ab_${stamp}.log \
