@@ -260,11 +260,13 @@ _expand_level = jax.jit(_expand_level_body)
 
 
 @functools.lru_cache(maxsize=None)
-def _expand_levels_limb_fn(num_levels: int):
+def _expand_levels_limb_fn(num_levels: int, hash_leaves: bool = False):
     """One jitted program running `num_levels` width-doubling expansion
     levels (the whole `ExpandSeeds` loop fused; widths double per level so
     a scan cannot carry them — the unroll specializes per level count,
-    cached across calls)."""
+    cached across calls). With `hash_leaves` the leaf seeds come back
+    already passed through the value MMO hash (single-block value types:
+    fuses `HashExpandedSeeds` into the same program)."""
 
     @jax.jit
     def run(seeds, control, cw_seeds, cw_left, cw_right):
@@ -272,13 +274,16 @@ def _expand_levels_limb_fn(num_levels: int):
             seeds, control = _expand_level_body(
                 seeds, control, cw_seeds[i], cw_left[i], cw_right[i]
             )
+        if hash_leaves:
+            seeds = aes.mmo_hash(fixed_keys.RK_VALUE, seeds)
         return seeds, control
 
     return run
 
 
 @functools.lru_cache(maxsize=None)
-def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False):
+def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False,
+                             hash_leaves: bool = False):
     """`_expand_levels_limb_fn` computed in bitsliced plane layout (see
     `pir/dense_eval_planes.py` for the design): children are appended
     [all-left; all-right] per level so the lane order ends up
@@ -286,17 +291,24 @@ def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False):
     restores the natural interleaved order, making the output
     bit-identical to the limb program. Shared correction words only (one
     key), like the limb program. With `level_kernel` each level runs the
-    fused Pallas VMEM kernel (`ops/expand_planes_pallas.py`)."""
+    fused Pallas VMEM kernel (`ops/expand_planes_pallas.py`); with
+    `hash_leaves` the leaf value MMO hash runs before leaving plane
+    layout (no extra transpose round-trip for single-block value
+    types)."""
 
     @jax.jit
     def run(seeds, control, cw_seeds, cw_left, cw_right):
         from .ops.aes_bitslice import (
             broadcast_cw_planes,
             limbs_to_planes,
+            mmo_hash_planes,
             pack_select_bits,
             planes_to_limbs,
         )
-        from .ops.expand_planes_pallas import expand_level_planes_pallas
+        from .ops.expand_planes_pallas import (
+            expand_level_planes_pallas,
+            value_hash_planes_pallas,
+        )
         from .pir.dense_eval_planes import (
             bitrev_permutation,
             expand_level_planes,
@@ -349,6 +361,15 @@ def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False):
                     U32(0) - (cw_right[i] & U32(1)),
                 )
 
+        if hash_leaves:
+            if level_kernel:
+                # Zero value-correction planes: the kernel reduces to the
+                # pure MMO output hash (correction is arithmetic here and
+                # stays in the leaf stage).
+                zeros_vc = jnp.zeros((16, 8, 1), dtype=U32)
+                state = value_hash_planes_pallas(state, ctrl, zeros_vc)
+            else:
+                state = mmo_hash_planes(fixed_keys.RK_VALUE, state)
         out = planes_to_limbs(state)  # [2^PL * n32, 4], lane-ordered
         ctrl_bits = ((ctrl[:, None] >> shifts) & U32(1)).reshape(-1)
         # lane(path, prefix) = bitrev(path) * n32 + prefix over the plane
@@ -365,21 +386,24 @@ def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False):
     return run
 
 
-def _expand_levels_fn(num_levels: int):
+def _expand_levels_fn(num_levels: int, hash_leaves: bool = False):
     """Dispatch the fused expansion program: `DPF_TPU_EXPAND_LEVELS` =
     `limb` | `planes` | `auto` (default: planes on TPU, limb elsewhere).
     On TPU the plane levels run the fused Pallas kernel
     (`DPF_TPU_LEVEL_KERNEL`), falling back to the XLA level on compile
-    failure."""
+    failure. `hash_leaves` fuses the leaf value MMO hash into the same
+    program (single-block value types)."""
     from .utils.runtime import planes_selected
 
     if not planes_selected("DPF_TPU_EXPAND_LEVELS"):
-        return _expand_levels_limb_fn(num_levels)
+        return _expand_levels_limb_fn(num_levels, hash_leaves=hash_leaves)
     from .pir import dense_eval_planes as _dep
 
     if not _dep._level_kernel_enabled():
-        return _expand_levels_planes_fn(num_levels)
-    fast = _expand_levels_planes_fn(num_levels, level_kernel=True)
+        return _expand_levels_planes_fn(num_levels,
+                                        hash_leaves=hash_leaves)
+    fast = _expand_levels_planes_fn(num_levels, level_kernel=True,
+                                    hash_leaves=hash_leaves)
 
     def run_with_fallback(*args):
         import os as _os
@@ -395,7 +419,9 @@ def _expand_levels_fn(num_levels: int):
                 "pallas level kernel failed in hierarchical expansion; "
                 f"using the XLA level ({str(e).splitlines()[0][:200]})"
             )
-            return _expand_levels_planes_fn(num_levels)(*args)
+            return _expand_levels_planes_fn(
+                num_levels, hash_leaves=hash_leaves
+            )(*args)
 
     return run_with_fallback
 
@@ -579,16 +605,25 @@ def _value_hash(seeds, num_blocks):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("vtype", "cepb", "num_blocks", "party")
+    jax.jit,
+    static_argnames=("vtype", "cepb", "num_blocks", "party", "pre_hashed"),
 )
-def _leaf_stage(seeds, control, vc_dev, vtype, cepb, num_blocks, party):
+def _leaf_stage(seeds, control, vc_dev, vtype, cepb, num_blocks, party,
+                pre_hashed=False):
     """Hash seeds into value blocks, parse, apply value correction.
 
     Returns a value pytree with batch shape [n, cepb] (the first
     `corrected_elements_per_block` elements of each block, mirroring
     `EvaluateUntil`'s correction loop, `distributed_point_function.h:838-862`).
+    With `pre_hashed` (single-block value types) `seeds` are already the
+    value-hashed blocks from the fused expansion.
     """
-    blocks = _value_hash(seeds, num_blocks)
+    if pre_hashed:
+        if num_blocks != 1:
+            raise ValueError("pre_hashed requires single-block values")
+        blocks = seeds[:, None, :]
+    else:
+        blocks = _value_hash(seeds, num_blocks)
     values = vtype.dev_from_value_blocks(blocks)  # [n, epb, ...]
     values = jax.tree_util.tree_map(lambda x: x[:, :cepb], values)
     vc = jax.tree_util.tree_map(lambda x: x[None, :cepb], vc_dev)
@@ -1102,13 +1137,16 @@ class DistributedPointFunction:
         )
 
     def _expand(self, seeds: jnp.ndarray, control: jnp.ndarray,
-                key: DpfKey, start: int, stop: int):
+                key: DpfKey, start: int, stop: int,
+                hash_leaves: bool = False):
         """Expand seeds from tree level `start` to `stop` (width-doubling).
 
         All levels run in ONE jitted program (specialized per level count
         via `_expand_levels_fn`): a per-level Python loop of `_expand_level`
         jits would pay one dispatch per level and a fresh compile per
-        distinct width.
+        distinct width. With `hash_leaves` the returned seeds are already
+        value-hashed (fused `HashExpandedSeeds`; single-block value types
+        only — the leaf stage then skips its own hash).
         """
         if stop - start > 62:
             raise ValueError(
@@ -1116,11 +1154,15 @@ class DistributedPointFunction:
                 "intermediate hierarchy levels"
             )
         if stop == start:
+            if hash_leaves:
+                return (
+                    aes.mmo_hash(fixed_keys.RK_VALUE, seeds), control
+                )
             return seeds, control
         cw_seeds, cw_left, cw_right = self._stage_correction_words(
             key, start, stop
         )
-        return _expand_levels_fn(stop - start)(
+        return _expand_levels_fn(stop - start, hash_leaves=hash_leaves)(
             seeds,
             control,
             jnp.asarray(cw_seeds),
@@ -1170,7 +1212,8 @@ class DistributedPointFunction:
             jnp.asarray(bit_indices),
         )
 
-    def _leaf_values(self, seeds, control, key: DpfKey, hierarchy_level: int):
+    def _leaf_values(self, seeds, control, key: DpfKey,
+                     hierarchy_level: int, pre_hashed: bool = False):
         """Full-expansion leaf values, flattened to domain order."""
         vt = self.parameters[hierarchy_level].value_type
         cepb = 1 << (
@@ -1186,6 +1229,7 @@ class DistributedPointFunction:
             cepb,
             self._blocks_needed[hierarchy_level],
             key.party,
+            pre_hashed=pre_hashed,
         )
         # Flatten [n, cepb, ...] -> [n * cepb, ...] (domain order).
         return jax.tree_util.tree_map(
@@ -1245,8 +1289,13 @@ class DistributedPointFunction:
             control = jnp.asarray(
                 np.array([key.party], dtype=np.uint32)
             )
-            seeds, control = self._expand(seeds, control, key, 0, stop_level)
-            out = self._leaf_values(seeds, control, key, hierarchy_level)
+            fuse = self._blocks_needed[hierarchy_level] == 1
+            seeds, control = self._expand(
+                seeds, control, key, 0, stop_level, hash_leaves=fuse
+            )
+            out = self._leaf_values(
+                seeds, control, key, hierarchy_level, pre_hashed=fuse
+            )
             ctx.previous_hierarchy_level = hierarchy_level
             return out
 
@@ -1268,10 +1317,13 @@ class DistributedPointFunction:
             tree_indices, prev_hl, update_ctx, ctx
         )
         start_level = self._hierarchy_to_tree[prev_hl]
+        fuse = self._blocks_needed[hierarchy_level] == 1
         seeds, control = self._expand(
-            seeds, control, key, start_level, stop_level
+            seeds, control, key, start_level, stop_level, hash_leaves=fuse
         )
-        values = self._leaf_values(seeds, control, key, hierarchy_level)
+        values = self._leaf_values(
+            seeds, control, key, hierarchy_level, pre_hashed=fuse
+        )
 
         # Select the per-prefix output spans.
         outputs_per_prefix = 1 << (lds - prev_lds)

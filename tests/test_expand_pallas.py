@@ -3,6 +3,8 @@ mode on CPU) against their XLA twins in `pir/dense_eval_planes.py` —
 the same per-target discipline as the inner-product kernels
 (`pir/internal/inner_product_hwy_test.cc:427-434`)."""
 
+import warnings
+
 import numpy as np
 import pytest
 
@@ -158,13 +160,24 @@ def test_hierarchical_expansion_with_level_kernel(monkeypatch):
         epp, "expand_level_planes_pallas",
         functools.partial(epp.expand_level_planes_pallas, interpret=True),
     )
+    monkeypatch.setattr(
+        epp, "value_hash_planes_pallas",
+        functools.partial(epp.value_hash_planes_pallas, interpret=True),
+    )
     dpf_mod._expand_levels_planes_fn.cache_clear()
-    got = run_both()
+    with warnings.catch_warnings():
+        # The kernel path must actually serve (no silent XLA fallback).
+        warnings.simplefilter("error")
+        got = run_both()
     dpf_mod._expand_levels_planes_fn.cache_clear()
     for w, g in zip(want, got):
         np.testing.assert_array_equal(g, w)
-    total = want[0] + want[1]  # uint64 addition wraps mod 2^64
-    assert int(total[777]) == 99
+    # uint64 values are (lo, hi) uint32 limb pairs on CPU (no x64).
+    def u64(x):
+        return (int(x[1]) << 32) | int(x[0])
+
+    total = (u64(want[0][777]) + u64(want[1][777])) % (1 << 64)
+    assert total == 99
 
 
 @pytest.mark.parametrize("per_seed", [False, True])
@@ -202,3 +215,35 @@ def test_path_walk_with_level_kernel(monkeypatch, per_seed):
     got_s, got_c = dpf_mod._eval_paths_planes(*args, level_kernel=True)
     np.testing.assert_array_equal(np.asarray(got_s), np.asarray(want_s))
     np.testing.assert_array_equal(np.asarray(got_c), np.asarray(want_c))
+
+
+def test_hierarchical_fused_leaf_hash_planes_xla(monkeypatch):
+    """The fused leaf value hash (hash_leaves) in the XLA planes program
+    matches the limb program (which fuses the same hash)."""
+    from distributed_point_functions_tpu import dpf as dpf_mod
+    from distributed_point_functions_tpu.dpf import (
+        DistributedPointFunction,
+        DpfParameters,
+    )
+    from distributed_point_functions_tpu.value_types import IntType
+
+    params = DpfParameters(log_domain_size=10, value_type=IntType(32))
+    d = DistributedPointFunction.create(params)
+    k0, k1 = d.generate_keys(513, 7)
+
+    def run_both():
+        outs = []
+        for k in (k0, k1):
+            ctx = d.create_evaluation_context(k)
+            outs.append(np.asarray(d.evaluate_next([], ctx)))
+        return outs
+
+    monkeypatch.setenv("DPF_TPU_EXPAND_LEVELS", "limb")
+    want = run_both()
+    monkeypatch.setenv("DPF_TPU_EXPAND_LEVELS", "planes")
+    monkeypatch.setenv("DPF_TPU_LEVEL_KERNEL", "xla")
+    got = run_both()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(g, w)
+    total = (want[0].astype(np.uint64) + want[1].astype(np.uint64))
+    assert int(total[513]) % (1 << 32) == 7
