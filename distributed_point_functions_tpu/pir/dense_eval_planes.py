@@ -217,17 +217,103 @@ def _remember_level_kernel_failure() -> None:
     _LEVEL_KERNEL_FAILED = True
 
 
+_LEVEL_KERNEL_VERIFIED = False
+
+
+def _level_kernel_selfcheck() -> bool:
+    """One-time on-device bit-identity check of the fused level kernels
+    against their XLA twins on a small random instance (the serving-path
+    analog of bench.py's inner-product verification): auto mode must
+    never serve a kernel Mosaic compiles incorrectly."""
+    global _LEVEL_KERNEL_VERIFIED
+    if _LEVEL_KERNEL_VERIFIED:
+        return True
+    import numpy as _np
+
+    from ..ops.expand_planes_pallas import (
+        expand_level_planes_pallas,
+        value_hash_planes_pallas,
+    )
+
+    rng = _np.random.default_rng(1234)
+    g, nk = 64, 64
+    state = jnp.asarray(
+        rng.integers(0, 1 << 32, (16, 8, g), dtype=_np.uint32)
+    )
+    ctrl = jnp.asarray(rng.integers(0, 1 << 32, (g,), dtype=_np.uint32))
+    cwp = pack_key_planes(
+        jnp.asarray(rng.integers(0, 1 << 32, (nk, 4), dtype=_np.uint32))
+    )
+    cwl = pack_key_bits(
+        jnp.asarray(rng.integers(0, 2, (nk,), dtype=_np.uint32))
+    )
+    cwr = pack_key_bits(
+        jnp.asarray(rng.integers(0, 2, (nk,), dtype=_np.uint32))
+    )
+    want_s, want_c = expand_level_planes(
+        state, ctrl, _tile_keys(cwp, 2 * g), _tile_keys(cwl, g),
+        _tile_keys(cwr, g),
+    )
+    got_s, got_c = expand_level_planes_pallas(state, ctrl, cwp, cwl, cwr)
+    if not (
+        _np.array_equal(_np.asarray(got_s), _np.asarray(want_s))
+        and _np.array_equal(_np.asarray(got_c), _np.asarray(want_c))
+    ):
+        raise RuntimeError("level kernel/XLA bit mismatch on this device")
+    want_v = mmo_hash_planes(fixed_keys.RK_VALUE, state) ^ (
+        _tile_keys(cwp, g) & ctrl[None, None, :]
+    )
+    got_v = value_hash_planes_pallas(state, ctrl, cwp)
+    if not _np.array_equal(_np.asarray(got_v), _np.asarray(want_v)):
+        raise RuntimeError("value kernel/XLA bit mismatch on this device")
+
+    from ..ops.aes_bitslice import aes_rounds_select_planes
+    from ..ops.expand_planes_pallas import path_level_planes_pallas
+
+    sel = jnp.asarray(rng.integers(0, 1 << 32, (g,), dtype=_np.uint32))
+    sig = sigma_planes(state)
+    h = aes_rounds_select_planes(
+        fixed_keys.RK_LEFT, fixed_keys.RK_RIGHT, sel, sig
+    ) ^ sig
+    h = h ^ (_tile_keys(cwp, g) & ctrl[None, None, :])
+    t_new = h[0, 0]
+    want_ps = h.at[0, 0].set(jnp.zeros_like(t_new))
+    cw_dir = (sel & _tile_keys(cwr, g)) | (~sel & _tile_keys(cwl, g))
+    want_pc = t_new ^ (ctrl & cw_dir)
+    got_ps, got_pc = path_level_planes_pallas(
+        state, ctrl, sel, cwp, cwl, cwr, per_seed=False
+    )
+    if not (
+        _np.array_equal(_np.asarray(got_ps), _np.asarray(want_ps))
+        and _np.array_equal(_np.asarray(got_pc), _np.asarray(want_pc))
+    ):
+        raise RuntimeError("path kernel/XLA bit mismatch on this device")
+    _LEVEL_KERNEL_VERIFIED = True
+    return True
+
+
 def _level_kernel_enabled() -> bool:
     """Whether the fused Pallas level kernel serves the expansion.
 
     DPF_TPU_LEVEL_KERNEL=pallas forces it (errors propagate), =xla
-    disables it; auto uses it on TPU until a remembered failure."""
+    disables it; auto uses it on TPU after a one-time on-device
+    bit-identity self-check, until a remembered failure."""
     mode = os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto")
     if mode == "pallas":
         return True
     if mode == "xla":
         return False
-    return not _LEVEL_KERNEL_FAILED and jax.default_backend() == "tpu"
+    if _LEVEL_KERNEL_FAILED or jax.default_backend() != "tpu":
+        return False
+    try:
+        return _level_kernel_selfcheck()
+    except Exception as e:  # noqa: BLE001 - never break serving
+        _remember_level_kernel_failure()
+        warnings.warn(
+            "pallas level kernels failed their on-device self-check; "
+            f"serving via the XLA levels ({str(e).splitlines()[0][:200]})"
+        )
+        return False
 
 
 @functools.partial(

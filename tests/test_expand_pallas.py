@@ -247,3 +247,41 @@ def test_hierarchical_fused_leaf_hash_planes_xla(monkeypatch):
         np.testing.assert_array_equal(g, w)
     total = (want[0].astype(np.uint64) + want[1].astype(np.uint64))
     assert int(total[513]) % (1 << 32) == 7
+
+
+def test_level_kernel_selfcheck(monkeypatch):
+    """Auto mode runs a one-time on-device bit-identity self-check; a
+    mismatching kernel is remembered as failed and serving falls back."""
+    import functools
+
+    from distributed_point_functions_tpu.ops import (
+        expand_planes_pallas as epp,
+    )
+    from distributed_point_functions_tpu.pir import dense_eval_planes as dep
+
+    monkeypatch.setenv("DPF_TPU_LEVEL_KERNEL", "auto")
+    monkeypatch.setattr(dep.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(dep, "_LEVEL_KERNEL_FAILED", False)
+    monkeypatch.setattr(dep, "_LEVEL_KERNEL_VERIFIED", False)
+
+    # Interpret-mode kernels: the self-check passes and enables serving.
+    for name in ("expand_level_planes_pallas", "value_hash_planes_pallas",
+                 "path_level_planes_pallas"):
+        monkeypatch.setattr(
+            epp, name, functools.partial(getattr(epp, name), interpret=True)
+        )
+    assert dep._level_kernel_enabled() is True
+    assert dep._LEVEL_KERNEL_VERIFIED is True
+
+    # A kernel that returns garbage: self-check trips, failure remembered.
+    monkeypatch.setattr(dep, "_LEVEL_KERNEL_VERIFIED", False)
+    monkeypatch.setattr(dep, "_LEVEL_KERNEL_FAILED", False)
+
+    def bad(state, ctrl, cwp, cwl, cwr, **kw):
+        s, c = epp.expand_level_planes_pallas(state, ctrl, cwp, cwl, cwr)
+        return s ^ jnp.uint32(1), c
+
+    monkeypatch.setattr(epp, "expand_level_planes_pallas", bad)
+    with pytest.warns(UserWarning, match="self-check"):
+        assert dep._level_kernel_enabled() is False
+    assert dep._LEVEL_KERNEL_FAILED is True
