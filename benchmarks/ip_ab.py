@@ -80,6 +80,12 @@ def main():
         "v2_int8_tg16_j8": functools.partial(
             xor_inner_product_pallas2_staged, int8=True, tile_groups=16
         ),
+        "v2_int8_tq64": functools.partial(
+            xor_inner_product_pallas2_staged, int8=True, tile_queries=64
+        ),
+        "v2_int8_tq128": functools.partial(
+            xor_inner_product_pallas2_staged, int8=True, tile_queries=128
+        ),
     }
 
     # Small-instance verification vs the jnp XOR path.

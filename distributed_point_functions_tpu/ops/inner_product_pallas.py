@@ -332,7 +332,7 @@ def _ip_pallas_staged_v2(
 def xor_inner_product_pallas2_staged(
     db_perm: jnp.ndarray,
     selections: jnp.ndarray,
-    tile_queries: int = 64,
+    tile_queries: int = 256,
     tile_groups: int = 32,
     j_chunk: int = 8,
     int8: bool = True,
@@ -343,7 +343,10 @@ def xor_inner_product_pallas2_staged(
     `xor_inner_product_pallas_staged`, one large dot per step.
 
     With int8=True the parity counts accumulate exactly in int32, so the
-    record cap is the int32 range rather than f32's 2^24.
+    record cap is the int32 range rather than f32's 2^24. The query tile
+    defaults high (256) because the in-VMEM database-tile unpack repeats
+    per query tile: large batches (dense_big's 1024 queries) pay it
+    nq/tile_queries times.
     """
     _, num_groups, _ = db_perm.shape
     num_records = 32 * num_groups
