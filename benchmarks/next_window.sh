@@ -19,6 +19,11 @@ timeout 1800 python benchmarks/ip_ab.py \
     | tee benchmarks/results/ip_ab_${stamp}.json
 tail -3 benchmarks/results/ip_ab_${stamp}.log
 
+echo "=== inner-product A/B at 256 queries (query-tile variants) ==="
+timeout 1800 env BENCH_QUERIES=256 python benchmarks/ip_ab.py \
+    2>benchmarks/results/ip_ab_q256_${stamp}.log \
+    | tee benchmarks/results/ip_ab_q256_${stamp}.json
+
 echo "=== headline at larger query batches (v2 tier auto) ==="
 for q in 64 128 256; do
     timeout 1200 env BENCH_QUERIES=$q BENCH_SKIP_NSLEAF=1 BENCH_ITERS=8 \

@@ -298,7 +298,11 @@ def _ip_pallas_staged_v2(
     _, num_groups, num_words = db_perm.shape
     nq = packed.shape[0]
     tg = _pick_group_tile(num_groups, max_tile=tile_groups)
-    tq = min(tile_queries, nq)
+    # Cap the query tile so the i32/f32 counts block stays ~<=2 MB in
+    # VMEM (tq * 32W * 4 B): wide records would otherwise blow the
+    # budget at large tiles (e.g. W=256 caps tq at 64).
+    tq_cap = max(8, (2 << 20) // (32 * num_words * 4) // 8 * 8)
+    tq = min(tile_queries, nq, tq_cap)
     while tq > 8 and (nq % tq != 0 or tq % 8 != 0):
         tq -= 8 if tq % 8 == 0 else tq % 8
     if nq % tq != 0:
