@@ -125,7 +125,13 @@ def main():
     state0, ctrl0 = jax.block_until_ready(enter(seeds_w, control_w))
     report("enter_planes", slope(lambda: enter(seeds_w, control_w)))
 
-    # Stage 3: each expansion level at its true width.
+    # Stage 3: each expansion level at its true width — the XLA level
+    # and (on TPU) the fused Pallas kernel side by side.
+    from distributed_point_functions_tpu.ops.expand_planes_pallas import (
+        expand_level_planes_pallas,
+    )
+
+    try_kernel = jax.default_backend() == "tpu"
     states = [(state0, ctrl0)]
     for i in range(expand_levels):
         lvl = walk_levels + i
@@ -145,6 +151,26 @@ def main():
         states.append(jax.block_until_ready(level(s_in, c_in)))
         report(f"level_{i:02d}_groups{groups2}",
                slope(lambda l=level, s=s_in, c=c_in: l(s, c)))
+        if try_kernel:
+            def kernel_fn(s, c, lvl=lvl):
+                return expand_level_planes_pallas(
+                    s,
+                    c,
+                    pack_key_planes(cw_seeds[lvl]),
+                    pack_key_bits(cw_left[lvl]),
+                    pack_key_bits(cw_right[lvl]),
+                )
+
+            try:
+                klevel = jax.jit(kernel_fn)
+                jax.block_until_ready(klevel(s_in, c_in))
+                report(
+                    f"level_{i:02d}_groups{groups2}_kernel",
+                    slope(lambda l=klevel, s=s_in, c=c_in: l(s, c)),
+                )
+            except Exception as e:  # noqa: BLE001
+                log(f"kernel level {i} failed: {str(e).splitlines()[0]}")
+                try_kernel = False
 
     state_f, ctrl_f = states[-1]
 
@@ -172,8 +198,10 @@ def main():
     jax.block_until_ready(exitp(values))
     report("exit_planes_bitrev", slope(lambda: exitp(values)))
 
-    total = sum(v for v in results.values() if v)
-    print(json.dumps({"stage": "sum_of_stages", "ms": round(total, 3)}),
+    total = sum(
+        v for k, v in results.items() if v and not k.endswith("_kernel")
+    )
+    print(json.dumps({"stage": "sum_of_stages_xla", "ms": round(total, 3)}),
           flush=True)
 
 
