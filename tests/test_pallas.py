@@ -198,3 +198,22 @@ def test_database_tier_chain_fallthrough(monkeypatch):
         got = db2.inner_product_with(sel)
     assert got == want
     assert db2._failed_tiers == {"pallas2", "pallas"}
+
+
+def test_pallas_v2_wide_records_cap_query_tile():
+    """W=64-word (256 B) records at a 256-query batch: the VMEM cap
+    drops the query tile below the 256 default and the kernel still
+    matches the oracle (grid covers all query tiles)."""
+    from distributed_point_functions_tpu.ops.inner_product_pallas import (
+        xor_inner_product_pallas2_staged,
+    )
+
+    db = RNG.integers(0, 1 << 32, (4096, 64), dtype=np.uint32)
+    bits = RNG.integers(0, 2, (256, 4096), dtype=np.uint32)
+    sel = pack_selection_bits_np(bits)
+    got = np.asarray(
+        xor_inner_product_pallas2_staged(
+            permute_db_bitmajor(db), sel, interpret=True
+        )
+    )
+    np.testing.assert_array_equal(got, xor_inner_product_np(db, sel))
