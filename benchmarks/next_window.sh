@@ -66,3 +66,9 @@ timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
     2>&1 | tee benchmarks/results/only_nonzeros128_${stamp}.json
 
 echo "next_window done: benchmarks/results/*_${stamp}.*"
+
+# Persist whatever this window captured even if no operator is watching.
+git add benchmarks/results >/dev/null 2>&1
+git commit -q -m "Record TPU window results (automated capture)" \
+    >/dev/null 2>&1 || true
+echo "results committed"
