@@ -104,6 +104,39 @@ class DistributedComparisonFunction:
             if not (0 <= x < (1 << lds)):
                 raise ValueError(f"evaluation point {x} out of range")
 
+        # Fused path: the whole 32-level walk + accumulate as one jitted
+        # program (per-level dispatch dominated the generic engine at the
+        # benchmark shapes); DPF_TPU_DCF_FUSED=0 forces the generic
+        # engine, which also serves as fallback.
+        import os
+
+        if os.environ.get("DPF_TPU_DCF_FUSED", "1") != "0":
+            try:
+                if staged is None:
+                    staged = self.dpf.stage_key_batch(
+                        [k.key for k in keys]
+                    )
+                masks = np.zeros((lds, n), dtype=bool)
+                for hl in range(lds):
+                    bit_pos = lds - hl - 1
+                    masks[hl] = [
+                        ((x >> bit_pos) & 1) == 0
+                        for x in evaluation_points
+                    ]
+                return self.dpf.evaluate_and_accumulate(
+                    staged,
+                    list(evaluation_points),
+                    masks,
+                    evaluation_points_rightshift=1,
+                )
+            except Exception as e:  # noqa: BLE001 - generic fallback
+                import warnings
+
+                warnings.warn(
+                    "fused DCF evaluation failed; using the generic "
+                    f"engine ({str(e).splitlines()[0][:200]})"
+                )
+
         acc = [vt.dev_zeros((n,))]
 
         def accumulator(values, hierarchy_level):
