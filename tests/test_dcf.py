@@ -185,3 +185,37 @@ def test_dcf_staged_batch_reuse_matches_fresh():
         np.testing.assert_array_equal(fresh, reused)
     with pytest.raises(ValueError, match="either keys or staged"):
         dcf.batch_evaluate(None, [1])
+
+
+def test_evaluate_and_accumulate_contracts():
+    """The fused engine validates its inputs and refuses mixed types."""
+    import numpy as np
+    import pytest
+
+    from distributed_point_functions_tpu.dpf import (
+        DistributedPointFunction,
+        DpfParameters,
+    )
+    from distributed_point_functions_tpu.value_types import IntType
+
+    params = [DpfParameters(i, IntType(32)) for i in range(1, 4)]
+    d = DistributedPointFunction.create_incremental(params)
+    k0, _ = d.generate_keys_incremental(3, [1, 1, 1])
+    staged = d.stage_key_batch([k0, k0])
+
+    with pytest.raises(ValueError, match="size mismatch"):
+        d.evaluate_and_accumulate(staged, [1], np.zeros((3, 1), bool))
+    with pytest.raises(ValueError, match="level_masks"):
+        d.evaluate_and_accumulate(staged, [1, 2], np.zeros((2, 2), bool))
+
+    mixed = [
+        DpfParameters(1, IntType(32)),
+        DpfParameters(2, IntType(64)),
+    ]
+    dm = DistributedPointFunction.create_incremental(mixed)
+    km, _ = dm.generate_keys_incremental(1, [1, 1])
+    staged_m = dm.stage_key_batch([km])
+    with pytest.raises(ValueError, match="single value type"):
+        dm.evaluate_and_accumulate(
+            staged_m, [1], np.zeros((2, 1), bool)
+        )
