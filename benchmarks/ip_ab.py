@@ -15,6 +15,8 @@ import functools
 import json
 import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
 import time
 
 import numpy as np

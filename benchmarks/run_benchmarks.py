@@ -21,11 +21,12 @@ on one chip in minutes; pass --big for the reference-sized sweeps.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 import numpy as np
 
-sys.path.insert(0, ".")  # repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
 
 from benchmarks.common import run_timed  # noqa: E402
 
