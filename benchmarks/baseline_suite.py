@@ -221,6 +221,9 @@ def bench_sparse_big(scale: str):
 
 
 def main():
+    from benchmarks.common import setup_compilation_cache
+
+    setup_compilation_cache()
     import os
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":

@@ -3,8 +3,27 @@
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Callable
+
+
+def setup_compilation_cache() -> None:
+    """Point jax at the shared persistent compile cache (same location as
+    bench.py): the reference-mirroring sweeps compile many large
+    multi-level programs, and on the tunneled TPU each cold compile costs
+    minutes — a cache hit across runs/retries is the difference between a
+    sweep finishing and hitting its window timeout."""
+    import jax
+
+    cache_dir = os.environ.get(
+        "BENCH_CACHE_DIR", os.path.expanduser("~/.cache/jax_bench")
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
 
 
 def run_timed(

@@ -57,9 +57,9 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    cache_dir = os.path.expanduser("~/.cache/jax_bench")
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from benchmarks.common import setup_compilation_cache
+
+    setup_compilation_cache()
     log(f"devices: {jax.devices()}")
 
     from distributed_point_functions_tpu import keys as fk

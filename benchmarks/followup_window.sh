@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Round-2 follow-up TPU queue (after the kernel-legality and self-check
+# fixes): smoke the kernels first, A/B the inner product, headline at
+# growing query batches, then the remaining reference sweeps. Each stage
+# is its own process under `timeout` so a mid-stage tunnel stall never
+# kills the queue.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+mkdir -p benchmarks/results
+stamp=$(date +%Y%m%d_%H%M%S)
+
+echo "=== kernel smoke (tiny shapes, fast compiles) ==="
+timeout 1500 python benchmarks/kernel_smoke.py \
+    2>benchmarks/results/kernel_smoke_${stamp}.log \
+    | tee benchmarks/results/kernel_smoke_${stamp}.json
+tail -3 benchmarks/results/kernel_smoke_${stamp}.log
+
+echo "=== inner-product kernel A/B (v1 vs v2 variants) ==="
+timeout 2400 python benchmarks/ip_ab.py \
+    2>benchmarks/results/ip_ab_${stamp}.log \
+    | tee benchmarks/results/ip_ab_${stamp}.json
+tail -3 benchmarks/results/ip_ab_${stamp}.log
+
+echo "=== headline at larger query batches (v2 tier auto) ==="
+for q in 128 256 64; do
+    timeout 1500 env BENCH_QUERIES=$q BENCH_SKIP_NSLEAF=1 BENCH_ITERS=8 \
+        BENCH_TIMEOUT=1400 python bench.py \
+        2>benchmarks/results/bench_q${q}_${stamp}.log \
+        | tee benchmarks/results/bench_q${q}_${stamp}.json
+    tail -4 benchmarks/results/bench_q${q}_${stamp}.log
+done
+
+echo "=== inner-product A/B at 256 queries ==="
+timeout 1800 env BENCH_QUERIES=256 python benchmarks/ip_ab.py \
+    2>benchmarks/results/ip_ab_q256_${stamp}.log \
+    | tee benchmarks/results/ip_ab_q256_${stamp}.json
+
+echo "=== expansion stage profile ==="
+timeout 1800 python benchmarks/expand_profile.py \
+    2>benchmarks/results/expand_profile_${stamp}.log \
+    | tee benchmarks/results/expand_profile_${stamp}.json
+
+echo "=== BASELINE large configs (fixed kernels + native cuckoo build) ==="
+timeout 3600 python benchmarks/baseline_suite.py --scale full \
+    --suite dense_big \
+    2>&1 | tee benchmarks/results/dense_big_${stamp}.json
+timeout 3600 python benchmarks/baseline_suite.py --scale full \
+    --suite sparse_big \
+    2>&1 | tee benchmarks/results/sparse_big_${stamp}.json
+
+echo "=== remaining reference sweeps (compile cache on) ==="
+timeout 3600 python benchmarks/run_benchmarks.py \
+    --suite dpf,dcf,mic,inner_product,int_mod_n --big \
+    2>&1 | tee benchmarks/results/sweeps_${stamp}.json
+
+echo "=== synthetic configs (2^32 and 2^128) ==="
+timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
+    --log_domain_size 32 --log_num_nonzeros 20 --num_iterations 3 \
+    2>&1 | tee benchmarks/results/synthetic_${stamp}.json
+timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
+    --log_domain_size 32 --log_num_nonzeros 20 --only_nonzeros \
+    --num_iterations 3 \
+    2>&1 | tee benchmarks/results/only_nonzeros_${stamp}.json
+timeout 3600 python benchmarks/synthetic_data_benchmarks.py \
+    --log_domain_size 128 --log_num_nonzeros 20 --num_iterations 2 \
+    2>&1 | tee benchmarks/results/synthetic128_${stamp}.json
+timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
+    --log_domain_size 128 --log_num_nonzeros 20 --only_nonzeros \
+    --num_iterations 3 \
+    2>&1 | tee benchmarks/results/only_nonzeros128_${stamp}.json
+
+echo "followup done: benchmarks/results/*_${stamp}.*"
+git add benchmarks/results >/dev/null 2>&1
+git commit -q -m "Record TPU window results (automated capture)" \
+    >/dev/null 2>&1 || true
+echo "results committed"

@@ -48,9 +48,9 @@ def main():
 
     import jax
 
-    cache_dir = os.path.expanduser("~/.cache/jax_bench")
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from benchmarks.common import setup_compilation_cache
+
+    setup_compilation_cache()
 
     from distributed_point_functions_tpu.ops.inner_product import (
         xor_inner_product,

@@ -269,6 +269,9 @@ SUITES = {
 
 
 def main():
+    from benchmarks.common import setup_compilation_cache
+
+    setup_compilation_cache()
     import os
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":

@@ -128,6 +128,9 @@ def read_unique_values_from_file(path: str) -> list:
 
 
 def main():
+    from benchmarks.common import setup_compilation_cache
+
+    setup_compilation_cache()
     import os
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":

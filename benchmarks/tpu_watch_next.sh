@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Poll the axon TPU tunnel; the moment it answers, run the full perf
-# capture (benchmarks/next_window.sh). Writes a heartbeat log so a stalled
+# capture (benchmarks/followup_window.sh). Writes a heartbeat log so a stalled
 # tunnel is distinguishable from a stalled capture.
 set -u
 cd "$(dirname "$0")/.."
@@ -36,7 +36,7 @@ while true; do
     fi
     if probe; then
         echo "$(date -u +%H:%M:%S) tunnel ALIVE - launching capture" >>"$log"
-        bash benchmarks/next_window.sh >>"$log" 2>&1
+        bash benchmarks/followup_window.sh >>"$log" 2>&1
         rc=$?
         echo "$(date -u +%H:%M:%S) capture exited rc=$rc" >>"$log"
         if [ "$rc" -eq 0 ]; then

@@ -96,6 +96,16 @@ def _sigma(state: jnp.ndarray) -> jnp.ndarray:
     return jnp.concatenate([hi, hi ^ lo], axis=0)
 
 
+def _zero_lsb_plane(state: jnp.ndarray) -> jnp.ndarray:
+    """state with plane [0, 0] (the seed LSB = embedded control bit)
+    zeroed, built from static slices + leading-axis concatenates:
+    `.at[0, 0].set(...)` lowers to a scatter, which Mosaic rejects
+    ('Unimplemented primitive in Pallas TPU lowering: scatter')."""
+    zero = jnp.zeros_like(state[0, 0])
+    row0 = jnp.concatenate([zero[None], state[0, 1:]], axis=0)
+    return jnp.concatenate([row0[None], state[1:]], axis=0)
+
+
 def _level_kernel(
     state_ref,
     ctrl_ref,
@@ -123,9 +133,8 @@ def _level_kernel(
 
     t_left = left[0, 0]  # LSB plane = child control bits
     t_right = right[0, 0]
-    zero = jnp.zeros_like(t_left)
-    outl_ref[:] = left.at[0, 0].set(zero)
-    outr_ref[:] = right.at[0, 0].set(zero)
+    outl_ref[:] = _zero_lsb_plane(left)
+    outr_ref[:] = _zero_lsb_plane(right)
 
     cwl = pltpu.repeat(cwl_ref[:], reps, axis=1)  # [1, T]
     cwr = pltpu.repeat(cwr_ref[:], reps, axis=1)
@@ -295,7 +304,7 @@ def _path_kernel(
         cwr = pltpu.repeat(cwr_ref[:], reps, axis=1)
     h = h ^ (cwp & ctrl[0][None, None, :])
     t_new = h[0, 0]
-    outs_ref[:] = h.at[0, 0].set(jnp.zeros_like(t_new))
+    outs_ref[:] = _zero_lsb_plane(h)
     cw_dir = (sel[0] & cwr[0]) | (~sel[0] & cwl[0])
     outc_ref[:] = (t_new ^ (ctrl[0] & cw_dir))[None, :]
 

@@ -119,20 +119,31 @@ def _ip_kernel(sel_ref, db_ref, out_ref, *, num_value_bits: int):
     lax.fori_loop(0, 32, body, 0)
 
 
-def _pick_group_tile(num_groups: int, max_tile: int = _TILE_GROUPS) -> int:
+def _pick_group_tile(
+    num_groups: int, max_tile: int = _TILE_GROUPS, lane_step: int = 8
+) -> int:
     """Largest tile <= max_tile that divides num_groups and is a
-    multiple of 8 (TPU sublane), or the full axis for small databases.
+    multiple of `lane_step`, or the full axis for small databases.
 
+    `lane_step` is 128 when the group axis is a block's *last* (lane)
+    dimension — Mosaic requires last block dims to be 128-divisible or
+    span the whole array axis — and 8 (sublane) otherwise.
     `permute_db_bitmajor` pads so num_groups % _TILE_GROUPS == 0; the
     search only matters for hand-built layouts. A large layout with no
     legal tile is rejected rather than compiled as one giant VMEM block.
     """
     tg = min(max_tile, num_groups)
-    while tg >= 8:
-        if num_groups % tg == 0 and tg % 8 == 0:
+    tg -= tg % lane_step
+    while tg >= lane_step:
+        if num_groups % tg == 0:
             return tg
-        tg -= 8
-    if num_groups > max_tile:
+        tg -= lane_step
+    # No legal tile at or under the request: round UP to the smallest
+    # legal one (a sub-lane_step request like the old tile_groups=32
+    # default would otherwise be Mosaic-rejected on hardware).
+    if lane_step < num_groups and num_groups % lane_step == 0:
+        return lane_step
+    if num_groups > max(max_tile, 4 * lane_step):
         raise ValueError(
             f"no legal group tile for {num_groups} groups; stage the "
             "database with permute_db_bitmajor (which pads)"
@@ -171,7 +182,7 @@ def _ip_pallas_staged(
 ) -> jnp.ndarray:
     _, num_groups, num_words = db_perm.shape
     nq = packed.shape[0]
-    tg = _pick_group_tile(num_groups)
+    tg = _pick_group_tile(num_groups, lane_step=8 if interpret else 128)
     # Query tile: a multiple of 8 (TPU sublane) dividing the padded batch
     # (callers pad nq to a multiple of 8), or the whole batch if smaller.
     tq = min(tile_queries, nq)
@@ -289,7 +300,7 @@ def _ip_pallas_staged_v2(
     db_perm: jnp.ndarray,
     packed: jnp.ndarray,
     tile_queries: int = 64,
-    tile_groups: int = 32,
+    tile_groups: int = 128,
     j_chunk: int = 8,
     int8: bool = False,
     interpret: bool = False,
@@ -297,7 +308,13 @@ def _ip_pallas_staged_v2(
 ) -> jnp.ndarray:
     _, num_groups, num_words = db_perm.shape
     nq = packed.shape[0]
-    tg = _pick_group_tile(num_groups, max_tile=tile_groups)
+    tg = _pick_group_tile(
+        num_groups, max_tile=tile_groups,
+        # Mosaic requires the selections block's lane dim (groups) to be
+        # 128-divisible or span the axis; interpret mode has no such rule
+        # (and the tile-variant tests exercise smaller tiles there).
+        lane_step=8 if interpret else 128,
+    )
     # Cap the query tile so the i32/f32 counts block stays ~<=2 MB in
     # VMEM (tq * 32W * 4 B): wide records would otherwise blow the
     # budget at large tiles (e.g. W=256 caps tq at 64).
@@ -337,8 +354,8 @@ def xor_inner_product_pallas2_staged(
     db_perm: jnp.ndarray,
     selections: jnp.ndarray,
     tile_queries: int = 256,
-    tile_groups: int = 32,
-    j_chunk: int = 8,
+    tile_groups: int = 128,
+    j_chunk: int = 32,
     int8: bool = True,
     interpret: bool = False,
     vma: tuple = (),

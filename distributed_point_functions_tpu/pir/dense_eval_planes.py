@@ -206,6 +206,17 @@ def evaluate_selection_blocks_planes(
     )
 
 
+def _trace_state_clean() -> bool:
+    """True when no jax trace is active (private API, so fail open: a
+    missing symbol just means the self-check runs as before)."""
+    try:
+        from jax._src import core as _core
+
+        return bool(_core.trace_state_clean())
+    except Exception:  # noqa: BLE001 - jax internals moved
+        return True
+
+
 _LEVEL_KERNEL_FAILED = False
 
 
@@ -305,6 +316,13 @@ def _level_kernel_enabled() -> bool:
         return False
     if _LEVEL_KERNEL_FAILED or jax.default_backend() != "tpu":
         return False
+    if not _trace_state_clean():
+        # Reached while an outer jit is being traced (e.g. the fused DCF
+        # program calling the path walk): the self-check cannot run here —
+        # its jitted twins would be traced into the outer program and the
+        # comparisons would explode on tracers. Report the last *eager*
+        # verification result; never record a failure from this path.
+        return _LEVEL_KERNEL_VERIFIED
     try:
         return _level_kernel_selfcheck()
     except Exception as e:  # noqa: BLE001 - never break serving

@@ -217,3 +217,29 @@ def test_pallas_v2_wide_records_cap_query_tile():
         )
     )
     np.testing.assert_array_equal(got, xor_inner_product_np(db, sel))
+
+
+@pytest.mark.parametrize(
+    "num_groups,max_tile",
+    [
+        (128, 128),    # bench.py's small verify instance (4096 records)
+        (32768, 128),  # headline 2^20 records
+        (131072, 128), # dense_big 2^22 records
+        (128, 32),     # the round-2 hardware failure: requested tile 32
+        (64, 128),     # small database, tile spans the axis
+    ],
+)
+def test_group_tile_mosaic_legal(num_groups, max_tile):
+    """Non-interpret lowering must pick selection-block lane dims Mosaic
+    accepts: divisible by 128 or equal to the whole group axis. The
+    round-2 TPU window showed tile_groups=32 on a [8, 128] selections
+    array is rejected by Mosaic ('block shape ... divisible by 8 and 128
+    respectively'), silently dropping the v2 MXU kernel from the tier
+    chain."""
+    from distributed_point_functions_tpu.ops.inner_product_pallas import (
+        _pick_group_tile,
+    )
+
+    tg = _pick_group_tile(num_groups, max_tile=max_tile, lane_step=128)
+    assert num_groups % tg == 0
+    assert tg % 128 == 0 or tg == num_groups
