@@ -369,7 +369,7 @@ def xor_inner_product_pallas2_staged(
     per query tile: large batches (dense_big's 1024 queries) pay it
     nq/tile_queries times.
     """
-    _, num_groups, _ = db_perm.shape
+    _, num_groups, num_words = db_perm.shape
     num_records = 32 * num_groups
     if not int8 and num_records > MAX_RECORDS_EXACT:
         raise ValueError(
@@ -378,6 +378,23 @@ def xor_inner_product_pallas2_staged(
         )
     if 32 % j_chunk != 0:
         raise ValueError(f"j_chunk must divide 32; got {j_chunk}")
+    # Mosaic's `pltpu.repeat` miscompiles (tpu_compile_helper exit 1) when
+    # the source lane dim is below a half lane-tile and the factor exceeds
+    # 8 — mapped on v5e 2026-07-31: W∈{4,8} × j_chunk∈{16,32} all crash,
+    # W≥16 all legal. j_chunk only affects throughput, so cap it for
+    # narrow records instead of crashing.
+    if num_words < 16:
+        j_chunk = min(j_chunk, 8)
+    # The kernel's selections repeat has a fixed factor of 32, so a group
+    # tile under 16 lanes hits the same miscompile with no knob to cap.
+    # `permute_db_bitmajor` pads serving layouts to 128-group multiples;
+    # only hand-built layouts can get here.
+    if not interpret and num_groups < 16:
+        raise ValueError(
+            f"compiled v2 kernel needs >= 16 selection groups (512 "
+            f"records); got {num_groups} — pad the staged layout or use "
+            f"xor_inner_product_pallas_staged"
+        )
     packed, nq = _stage_selections(selections, num_groups)
     nq_pad = packed.shape[0]
     out = _ip_pallas_staged_v2(

@@ -145,7 +145,9 @@ def test_pallas_v2_tile_variants(tile_groups, j_chunk):
         xor_inner_product_pallas2_staged,
     )
 
-    db = RNG.integers(0, 1 << 32, (4096, 8), dtype=np.uint32)
+    # W=16: wide enough that the narrow-record cap leaves j_chunk alone,
+    # so each declared chunk size actually runs in the kernel.
+    db = RNG.integers(0, 1 << 32, (4096, 16), dtype=np.uint32)
     bits = RNG.integers(0, 2, (5, 4096), dtype=np.uint32)
     sel = pack_selection_bits_np(bits)
     got = np.asarray(
@@ -158,6 +160,54 @@ def test_pallas_v2_tile_variants(tile_groups, j_chunk):
         )
     )
     np.testing.assert_array_equal(got, xor_inner_product_np(db, sel))
+
+
+def test_pallas_v2_narrow_records_cap_j_chunk(monkeypatch):
+    """W<16 must cap j_chunk at 8: Mosaic's pltpu.repeat miscompiles for
+    sub-half-lane-tile sources with factors >8 (hardware-mapped on v5e,
+    2026-07-31). The public entry silently caps instead of crashing; the
+    cap must actually reach the jitted core, and results stay exact."""
+    from distributed_point_functions_tpu.ops import inner_product_pallas as ipp
+
+    forwarded = {}
+    real_core = ipp._ip_pallas_staged_v2
+
+    def spy(db_perm, packed, **kw):
+        forwarded["j_chunk"] = kw["j_chunk"]
+        return real_core(db_perm, packed, **kw)
+
+    monkeypatch.setattr(ipp, "_ip_pallas_staged_v2", spy)
+    for num_words, want_chunk in ((4, 8), (8, 8), (16, 32)):
+        db = RNG.integers(0, 1 << 32, (4096, num_words), dtype=np.uint32)
+        bits = RNG.integers(0, 2, (5, 4096), dtype=np.uint32)
+        sel = pack_selection_bits_np(bits)
+        got = np.asarray(
+            ipp.xor_inner_product_pallas2_staged(
+                permute_db_bitmajor(db), sel, j_chunk=32, interpret=True
+            )
+        )
+        assert forwarded["j_chunk"] == want_chunk
+        np.testing.assert_array_equal(got, xor_inner_product_np(db, sel))
+
+
+def test_pallas_v2_rejects_tiny_group_count():
+    """Compiled mode refuses hand-built layouts under 16 groups (the
+    selections repeat would hit the same Mosaic miscompile, factor 32)."""
+    import jax.numpy as jnp
+
+    from distributed_point_functions_tpu.ops.inner_product_pallas import (
+        xor_inner_product_pallas2_staged,
+    )
+
+    db_perm = jnp.zeros((32, 8, 4), dtype=jnp.uint32)
+    sel = pack_selection_bits_np(
+        RNG.integers(0, 2, (2, 256), dtype=np.uint32)
+    )
+    with pytest.raises(ValueError, match="16 selection groups"):
+        xor_inner_product_pallas2_staged(db_perm, sel)
+    # interpret mode has no Mosaic and still serves tiny layouts
+    out = xor_inner_product_pallas2_staged(db_perm, sel, interpret=True)
+    assert out.shape == (2, 4)
 
 
 def test_database_tier_chain_fallthrough(monkeypatch):
