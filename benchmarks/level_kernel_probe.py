@@ -1,0 +1,94 @@
+"""Isolate the Mosaic compile failure of the fused level kernel at
+large group counts (expand_profile: level 9, G=2048, q64 -> kg=2 crashes
+tpu_compile_helper; levels <= 7 with the same kg succeed).
+
+Runs the level kernel compiled at a sweep of (G, kg) shapes and reports
+ok/crash per shape, then the same for the value-hash kernel. Each case
+is its own jit cache entry; crashes surface as INTERNAL remote_compile
+errors. Run on the real chip between capture stages.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    from benchmarks.common import setup_compilation_cache
+
+    setup_compilation_cache()
+    import jax  # noqa: E402
+    import jax.numpy as jnp  # noqa: E402
+
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+
+    from distributed_point_functions_tpu.ops.expand_planes_pallas import (
+        expand_level_planes_pallas,
+        value_hash_planes_pallas,
+    )
+
+    rng = np.random.default_rng(11)
+
+    def case(g: int, kg: int, which: str, tile: int | None = None) -> dict:
+        state = jnp.asarray(
+            rng.integers(0, 1 << 32, (16, 8, g), dtype=np.uint32)
+        )
+        ctrl = jnp.asarray(rng.integers(0, 1 << 32, (g,), dtype=np.uint32))
+        cwp = jnp.asarray(
+            rng.integers(0, 1 << 32, (16, 8, kg), dtype=np.uint32)
+        )
+        cwb = jnp.asarray(rng.integers(0, 1 << 32, (kg,), dtype=np.uint32))
+        tag = {"kernel": which, "g": g, "kg": kg}
+        if tile is not None:
+            tag["tile"] = tile
+        t0 = time.perf_counter()
+        try:
+            if which == "level":
+                out = expand_level_planes_pallas(
+                    state, ctrl, cwp, cwb, cwb, tile_lanes=tile
+                )
+                jax.block_until_ready(out)
+            else:
+                out = value_hash_planes_pallas(state, ctrl, cwp)
+                jax.block_until_ready(out)
+            return {**tag, "ok": True,
+                    "compile_s": round(time.perf_counter() - t0, 1)}
+        except Exception as e:  # noqa: BLE001
+            return {**tag, "ok": False, "error": str(e).splitlines()[0][:160]}
+
+    # The 2026-07-31 expand_profile found the level kernel fine through
+    # G=1024 (one grid step) and crashing tpu_compile_helper at G=2048
+    # (the first multi-step lane grid). The kernels now chunk in XLA
+    # (grid-(1,) pallas_call per lane slice); this probe validates the
+    # chunked design at the serving widths and maps the single-block
+    # VMEM ceiling.
+    cases = [
+        # two chunks at a size known-good as one:
+        ("level", 1024, 2, 512),
+        # one big block at the size that used to crash as a 2-step grid:
+        ("level", 2048, 2, 2048),
+        # chunked defaults at the previously-crashing widths:
+        ("level", 2048, 2, None),
+        ("level", 16384, 2, None),
+        # single-block VMEM ceiling:
+        ("level", 4096, 2, 4096),
+        # wide correction sources (small in-kernel repeat factors):
+        ("level", 2048, 128, None),
+        ("level", 8192, 128, None),
+        # value-hash kernel at the bench's real leaf width:
+        ("value", 2048, 2, None),
+        ("value", 16384, 2, None),
+    ]
+    for which, g, kg, tile in cases:
+        print(json.dumps(case(g, kg, which, tile)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# TPU window queue after the 2026-07-31 03:16-04:00 window: that window
+# captured the fixed-kernel headline (q128 6601.9 q/s = 412.6x), the
+# v2 inner-product A/Bs, and the expansion profile, and died during
+# dense_big. This queue leads with the level-kernel shape probe (the
+# fused expansion kernels crash Mosaic at G>=2048 — the probe maps the
+# boundary), then the remaining large configs and reference sweeps.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+mkdir -p benchmarks/results
+stamp=$(date +%Y%m%d_%H%M%S)
+
+echo "=== level-kernel shape probe ==="
+timeout 2400 python benchmarks/level_kernel_probe.py \
+    2>benchmarks/results/level_probe_${stamp}.log \
+    | tee benchmarks/results/level_probe_${stamp}.json
+
+echo "=== BASELINE large configs ==="
+timeout 3600 python benchmarks/baseline_suite.py --scale full \
+    --suite dense_big \
+    2>&1 | tee benchmarks/results/dense_big_${stamp}.json
+timeout 3600 python benchmarks/baseline_suite.py --scale full \
+    --suite sparse_big \
+    2>&1 | tee benchmarks/results/sparse_big_${stamp}.json
+
+echo "=== remaining reference sweeps (compile cache on) ==="
+timeout 3600 python benchmarks/run_benchmarks.py \
+    --suite dpf,dcf,mic,inner_product,int_mod_n --big \
+    2>&1 | tee benchmarks/results/sweeps_${stamp}.json
+
+echo "=== synthetic configs (2^32 and 2^128) ==="
+timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
+    --log_domain_size 32 --log_num_nonzeros 20 --num_iterations 3 \
+    2>&1 | tee benchmarks/results/synthetic_${stamp}.json
+timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
+    --log_domain_size 32 --log_num_nonzeros 20 --only_nonzeros \
+    --num_iterations 3 \
+    2>&1 | tee benchmarks/results/only_nonzeros_${stamp}.json
+timeout 3600 python benchmarks/synthetic_data_benchmarks.py \
+    --log_domain_size 128 --log_num_nonzeros 20 --num_iterations 2 \
+    2>&1 | tee benchmarks/results/synthetic128_${stamp}.json
+
+echo "window2 done: benchmarks/results/*_${stamp}.*"
+git add benchmarks/results >/dev/null 2>&1
+git commit -q -m "Record TPU window results (automated capture)" \
+    >/dev/null 2>&1 || true
+echo "results committed"

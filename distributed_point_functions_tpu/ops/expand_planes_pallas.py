@@ -142,6 +142,22 @@ def _level_kernel(
     ctr_ref[:] = (t_right ^ (ctrl[0] & cwr[0]))[None, :]
 
 
+def _check_tile(tile: int, g: int, kg: int) -> None:
+    """Fail fast on an illegal forced tile: every chunk width (tile, and
+    the g % tile remainder if the probe/test caller passes one that does
+    not divide g) must be a positive multiple of kg, or the in-kernel
+    correction repeat silently truncates and dies in an opaque mid-trace
+    broadcast error."""
+    widths = {min(tile, g)} if tile > 0 else {0}
+    if 0 < tile < g and g % tile:
+        widths.add(g % tile)
+    if tile <= 0 or any(w <= 0 or w % kg for w in widths):
+        raise ValueError(
+            f"tile_lanes={tile} must be a positive multiple of the key "
+            f"group count {kg} (lanes={g}), as must any remainder chunk"
+        )
+
+
 def _pick_tile(num_lanes: int, key_groups: int) -> int:
     tile = min(_TILE_LANES, num_lanes)
     while tile > key_groups and (
@@ -153,7 +169,9 @@ def _pick_tile(num_lanes: int, key_groups: int) -> int:
     return tile
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "tile_lanes")
+)
 def expand_level_planes_pallas(
     state: jnp.ndarray,
     ctrl: jnp.ndarray,
@@ -161,6 +179,7 @@ def expand_level_planes_pallas(
     cwl_kg: jnp.ndarray,
     cwr_kg: jnp.ndarray,
     interpret: bool = False,
+    tile_lanes: int | None = None,
 ):
     """One [all-left; all-right] expansion level, fused in VMEM.
 
@@ -173,42 +192,60 @@ def expand_level_planes_pallas(
     """
     _, _, g = state.shape
     kg = cwp_kg.shape[-1]
-    tile = _pick_tile(g, kg)
-    reps = tile // kg
+    tile = _pick_tile(g, kg) if tile_lanes is None else tile_lanes
+    _check_tile(tile, g, kg)
     ctrl2 = ctrl[None, :]
     cwl2 = cwl_kg[None, :]
     cwr2 = cwr_kg[None, :]
-    grid = (g // tile,)
-    out_shapes = (
-        jax.ShapeDtypeStruct((16, 8, g), U32),
-        jax.ShapeDtypeStruct((16, 8, g), U32),
-        jax.ShapeDtypeStruct((1, g), U32),
-        jax.ShapeDtypeStruct((1, g), U32),
-    )
-    outl, outr, ctl, ctr = pl.pallas_call(
-        functools.partial(_level_kernel, reps=reps),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((16, 8, tile), lambda l: (0, 0, l)),
-            pl.BlockSpec((1, tile), lambda l: (0, l)),
-            pl.BlockSpec((16, 8, kg), lambda l: (0, 0, 0)),
-            pl.BlockSpec((1, kg), lambda l: (0, 0)),
-            pl.BlockSpec((1, kg), lambda l: (0, 0)),
-            pl.BlockSpec(
-                (2, 11, 16, 8, 1), lambda l: (0, 0, 0, 0, 0)
+
+    def call(state_c, ctrl_c):
+        # One grid-(1,) pallas_call per lane chunk: multi-step lane grids
+        # crash tpu_compile_helper on v5e (expand_profile 2026-07-31:
+        # fine through G=1024 = one grid step, exit-1 at G=2048 = two),
+        # so the chunking lives here in XLA instead of in the grid.
+        t = state_c.shape[-1]
+        reps = t // kg  # a chunk can be narrower than the nominal tile
+        out_shapes = (
+            jax.ShapeDtypeStruct((16, 8, t), U32),
+            jax.ShapeDtypeStruct((16, 8, t), U32),
+            jax.ShapeDtypeStruct((1, t), U32),
+            jax.ShapeDtypeStruct((1, t), U32),
+        )
+        return pl.pallas_call(
+            functools.partial(_level_kernel, reps=reps),
+            grid=(1,),
+            in_specs=[
+                pl.BlockSpec((16, 8, t), lambda l: (0, 0, 0)),
+                pl.BlockSpec((1, t), lambda l: (0, 0)),
+                pl.BlockSpec((16, 8, kg), lambda l: (0, 0, 0)),
+                pl.BlockSpec((1, kg), lambda l: (0, 0)),
+                pl.BlockSpec((1, kg), lambda l: (0, 0)),
+                pl.BlockSpec(
+                    (2, 11, 16, 8, 1), lambda l: (0, 0, 0, 0, 0)
+                ),
+            ],
+            out_specs=(
+                pl.BlockSpec((16, 8, t), lambda l: (0, 0, 0)),
+                pl.BlockSpec((16, 8, t), lambda l: (0, 0, 0)),
+                pl.BlockSpec((1, t), lambda l: (0, 0)),
+                pl.BlockSpec((1, t), lambda l: (0, 0)),
             ),
-        ],
-        out_specs=(
-            pl.BlockSpec((16, 8, tile), lambda l: (0, 0, l)),
-            pl.BlockSpec((16, 8, tile), lambda l: (0, 0, l)),
-            pl.BlockSpec((1, tile), lambda l: (0, l)),
-            pl.BlockSpec((1, tile), lambda l: (0, l)),
-        ),
-        out_shape=out_shapes,
-        interpret=interpret,
-    )(state, ctrl2, cwp_kg, cwl2, cwr2, _MASKS_LR)
-    new_state = jnp.concatenate([outl, outr], axis=-1)
-    new_ctrl = jnp.concatenate([ctl[0], ctr[0]])
+            out_shape=out_shapes,
+            interpret=interpret,
+        )(state_c, ctrl_c, cwp_kg, cwl2, cwr2, _MASKS_LR)
+
+    ls, rs, lc, rc = [], [], [], []
+    for lo in range(0, g, tile):
+        outl, outr, ctl, ctr = call(
+            state[:, :, lo : lo + tile], ctrl2[:, lo : lo + tile]
+        )
+        ls.append(outl)
+        rs.append(outr)
+        lc.append(ctl[0])
+        rc.append(ctr[0])
+    # Global [all-left; all-right] child order across chunks.
+    new_state = jnp.concatenate(ls + rs, axis=-1)
+    new_ctrl = jnp.concatenate(lc + rc)
     return new_state, new_ctrl
 
 
@@ -220,12 +257,15 @@ def _value_kernel(state_ref, ctrl_ref, vc_ref, masks_ref, out_ref, *,
     out_ref[:] = values ^ (vc & ctrl_ref[:][0][None, None, :])
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "tile_lanes")
+)
 def value_hash_planes_pallas(
     state: jnp.ndarray,
     ctrl: jnp.ndarray,
     vc_kg: jnp.ndarray,
     interpret: bool = False,
+    tile_lanes: int | None = None,
 ) -> jnp.ndarray:
     """Leaf MMO output hash + value correction, fused in VMEM.
 
@@ -235,21 +275,37 @@ def value_hash_planes_pallas(
     """
     _, _, g = state.shape
     kg = vc_kg.shape[-1]
-    tile = _pick_tile(g, kg)
-    reps = tile // kg
-    return pl.pallas_call(
-        functools.partial(_value_kernel, reps=reps),
-        grid=(g // tile,),
-        in_specs=[
-            pl.BlockSpec((16, 8, tile), lambda l: (0, 0, l)),
-            pl.BlockSpec((1, tile), lambda l: (0, l)),
-            pl.BlockSpec((16, 8, kg), lambda l: (0, 0, 0)),
-            pl.BlockSpec((11, 16, 8, 1), lambda l: (0, 0, 0, 0)),
+    tile = _pick_tile(g, kg) if tile_lanes is None else tile_lanes
+    _check_tile(tile, g, kg)
+    ctrl2 = ctrl[None, :]
+    masks = jnp.asarray(_MASKS_VALUE)
+
+    def call(state_c, ctrl_c):
+        # Grid-(1,) per lane chunk, like `expand_level_planes_pallas`:
+        # multi-step lane grids crash tpu_compile_helper on v5e.
+        t = state_c.shape[-1]
+        reps = t // kg  # a chunk can be narrower than the nominal tile
+        return pl.pallas_call(
+            functools.partial(_value_kernel, reps=reps),
+            grid=(1,),
+            in_specs=[
+                pl.BlockSpec((16, 8, t), lambda l: (0, 0, 0)),
+                pl.BlockSpec((1, t), lambda l: (0, 0)),
+                pl.BlockSpec((16, 8, kg), lambda l: (0, 0, 0)),
+                pl.BlockSpec((11, 16, 8, 1), lambda l: (0, 0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((16, 8, t), lambda l: (0, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((16, 8, t), U32),
+            interpret=interpret,
+        )(state_c, ctrl_c, vc_kg, masks)
+
+    return jnp.concatenate(
+        [
+            call(state[:, :, lo : lo + tile], ctrl2[:, lo : lo + tile])
+            for lo in range(0, g, tile)
         ],
-        out_specs=pl.BlockSpec((16, 8, tile), lambda l: (0, 0, l)),
-        out_shape=jax.ShapeDtypeStruct((16, 8, g), U32),
-        interpret=interpret,
-    )(state, ctrl[None, :], vc_kg, jnp.asarray(_MASKS_VALUE))
+        axis=-1,
+    )
 
 
 def _path_kernel(
@@ -310,7 +366,7 @@ def _path_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("per_seed", "interpret")
+    jax.jit, static_argnames=("per_seed", "interpret", "tile_lanes")
 )
 def path_level_planes_pallas(
     state: jnp.ndarray,
@@ -321,6 +377,7 @@ def path_level_planes_pallas(
     cwr: jnp.ndarray,
     per_seed: bool,
     interpret: bool = False,
+    tile_lanes: int | None = None,
 ):
     """One path-walk level on [16, 8, G] planes.
 
@@ -331,41 +388,68 @@ def path_level_planes_pallas(
     ctrl [G]) — the fused body of `dpf._eval_paths_planes`."""
     _, _, g = state.shape
     kg = g if per_seed else cwp.shape[-1]
-    tile = _pick_tile(g, kg if not per_seed else 1)
-    reps = tile // kg if not per_seed else 1
-    if per_seed:
-        cw_specs = [
-            pl.BlockSpec((16, 8, tile), lambda l: (0, 0, l)),
-            pl.BlockSpec((1, tile), lambda l: (0, l)),
-            pl.BlockSpec((1, tile), lambda l: (0, l)),
-        ]
+    if tile_lanes is None:
+        tile = _pick_tile(g, kg if not per_seed else 1)
     else:
-        cw_specs = [
-            pl.BlockSpec((16, 8, kg), lambda l: (0, 0, 0)),
-            pl.BlockSpec((1, kg), lambda l: (0, 0)),
-            pl.BlockSpec((1, kg), lambda l: (0, 0)),
-        ]
-    outs, outc = pl.pallas_call(
-        functools.partial(_path_kernel, reps=reps, per_seed=per_seed),
-        grid=(g // tile,),
-        in_specs=[
-            pl.BlockSpec((16, 8, tile), lambda l: (0, 0, l)),
-            pl.BlockSpec((1, tile), lambda l: (0, l)),
-            pl.BlockSpec((1, tile), lambda l: (0, l)),
-            *cw_specs,
-            pl.BlockSpec(
-                (2, 11, 16, 8, 1), lambda l: (0, 0, 0, 0, 0)
+        tile = tile_lanes
+    _check_tile(tile, g, 1 if per_seed else kg)
+    ctrl2 = ctrl[None, :]
+    sel2 = sel[None, :]
+    cwl2 = cwl[None, :]
+    cwr2 = cwr[None, :]
+
+    def call(state_c, ctrl_c, sel_c, cwp_c, cwl_c, cwr_c):
+        # Grid-(1,) per lane chunk (multi-step lane grids crash
+        # tpu_compile_helper on v5e — see `expand_level_planes_pallas`).
+        t = state_c.shape[-1]
+        # A chunk can be narrower than the nominal tile.
+        reps = t // kg if not per_seed else 1
+        if per_seed:
+            cw_specs = [
+                pl.BlockSpec((16, 8, t), lambda l: (0, 0, 0)),
+                pl.BlockSpec((1, t), lambda l: (0, 0)),
+                pl.BlockSpec((1, t), lambda l: (0, 0)),
+            ]
+        else:
+            cw_specs = [
+                pl.BlockSpec((16, 8, kg), lambda l: (0, 0, 0)),
+                pl.BlockSpec((1, kg), lambda l: (0, 0)),
+                pl.BlockSpec((1, kg), lambda l: (0, 0)),
+            ]
+        return pl.pallas_call(
+            functools.partial(_path_kernel, reps=reps, per_seed=per_seed),
+            grid=(1,),
+            in_specs=[
+                pl.BlockSpec((16, 8, t), lambda l: (0, 0, 0)),
+                pl.BlockSpec((1, t), lambda l: (0, 0)),
+                pl.BlockSpec((1, t), lambda l: (0, 0)),
+                *cw_specs,
+                pl.BlockSpec(
+                    (2, 11, 16, 8, 1), lambda l: (0, 0, 0, 0, 0)
+                ),
+            ],
+            out_specs=(
+                pl.BlockSpec((16, 8, t), lambda l: (0, 0, 0)),
+                pl.BlockSpec((1, t), lambda l: (0, 0)),
             ),
-        ],
-        out_specs=(
-            pl.BlockSpec((16, 8, tile), lambda l: (0, 0, l)),
-            pl.BlockSpec((1, tile), lambda l: (0, l)),
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((16, 8, g), U32),
-            jax.ShapeDtypeStruct((1, g), U32),
-        ),
-        interpret=interpret,
-    )(state, ctrl[None, :], sel[None, :], cwp, cwl[None, :], cwr[None, :],
-      _MASKS_LR)
-    return outs, outc[0]
+            out_shape=(
+                jax.ShapeDtypeStruct((16, 8, t), U32),
+                jax.ShapeDtypeStruct((1, t), U32),
+            ),
+            interpret=interpret,
+        )(state_c, ctrl_c, sel_c, cwp_c, cwl_c, cwr_c, _MASKS_LR)
+
+    ss, cs = [], []
+    for lo in range(0, g, tile):
+        sl = slice(lo, lo + tile)
+        outs, outc = call(
+            state[:, :, sl],
+            ctrl2[:, sl],
+            sel2[:, sl],
+            cwp[:, :, sl] if per_seed else cwp,
+            cwl2[:, sl] if per_seed else cwl2,
+            cwr2[:, sl] if per_seed else cwr2,
+        )
+        ss.append(outs)
+        cs.append(outc[0])
+    return jnp.concatenate(ss, axis=-1), jnp.concatenate(cs)

@@ -271,6 +271,19 @@ def _level_kernel_selfcheck() -> bool:
         and _np.array_equal(_np.asarray(got_c), _np.asarray(want_c))
     ):
         raise RuntimeError("level kernel/XLA bit mismatch on this device")
+    # Chunked path (serving widths over _TILE_LANES run one grid-(1,)
+    # call per lane slice): force sub-width tiles so the multi-call
+    # assembly and its [all-left; all-right] order are checked on device.
+    got_s, got_c = expand_level_planes_pallas(
+        state, ctrl, cwp, cwl, cwr, tile_lanes=16
+    )
+    if not (
+        _np.array_equal(_np.asarray(got_s), _np.asarray(want_s))
+        and _np.array_equal(_np.asarray(got_c), _np.asarray(want_c))
+    ):
+        raise RuntimeError(
+            "chunked level kernel/XLA bit mismatch on this device"
+        )
     want_v = mmo_hash_planes(fixed_keys.RK_VALUE, state) ^ (
         _tile_keys(cwp, g) & ctrl[None, None, :]
     )

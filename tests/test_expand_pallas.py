@@ -63,6 +63,81 @@ def test_level_kernel_matches_xla(g, nk):
                                   np.asarray(want_ctrl))
 
 
+@pytest.mark.parametrize("tile", [16, 32, 128])
+def test_level_kernel_chunked_matches_xla(tile):
+    """Forced sub-width lane tiles exercise the chunked path (one
+    grid-(1,) pallas_call per lane slice — multi-step lane grids crash
+    tpu_compile_helper on v5e) and must keep the global
+    [all-left; all-right] child order. tile=128 > g covers a chunk
+    narrower than the nominal tile (the in-kernel repeat factor must
+    follow the chunk width, not the tile)."""
+    g, nk = 64, 64
+    state, ctrl, cw, cwl, cwr = _random_inputs(g, nk)
+    cwp_kg = pack_key_planes(jnp.asarray(cw))
+    cwl_kg = pack_key_bits(jnp.asarray(cwl))
+    cwr_kg = pack_key_bits(jnp.asarray(cwr))
+
+    want_state, want_ctrl = expand_level_planes_pallas(
+        jnp.asarray(state), jnp.asarray(ctrl), cwp_kg, cwl_kg, cwr_kg,
+        interpret=True,
+    )
+    got_state, got_ctrl = expand_level_planes_pallas(
+        jnp.asarray(state), jnp.asarray(ctrl), cwp_kg, cwl_kg, cwr_kg,
+        interpret=True, tile_lanes=tile,
+    )
+    np.testing.assert_array_equal(np.asarray(got_state),
+                                  np.asarray(want_state))
+    np.testing.assert_array_equal(np.asarray(got_ctrl),
+                                  np.asarray(want_ctrl))
+
+
+def test_value_kernel_chunked_matches_xla():
+    g, nk = 64, 64
+    state, ctrl, cw, _, _ = _random_inputs(g, nk)
+    vc_kg = pack_key_planes(jnp.asarray(cw))
+
+    want = value_hash_planes_pallas(
+        jnp.asarray(state), jnp.asarray(ctrl), vc_kg, interpret=True
+    )
+    got = value_hash_planes_pallas(
+        jnp.asarray(state), jnp.asarray(ctrl), vc_kg, interpret=True,
+        tile_lanes=16,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("per_seed", [False, True])
+def test_path_kernel_chunked_matches_unchunked(per_seed):
+    from distributed_point_functions_tpu.ops.expand_planes_pallas import (
+        path_level_planes_pallas,
+    )
+
+    g, nk = 64, 64
+    state, ctrl, cw, cwl, cwr = _random_inputs(g, nk)
+    sel = RNG.integers(0, 1 << 32, (g,), dtype=np.uint32)
+    if per_seed:
+        cwp = jnp.asarray(
+            RNG.integers(0, 1 << 32, (16, 8, g), dtype=np.uint32)
+        )
+        cwlb = jnp.asarray(RNG.integers(0, 1 << 32, (g,), dtype=np.uint32))
+        cwrb = jnp.asarray(RNG.integers(0, 1 << 32, (g,), dtype=np.uint32))
+    else:
+        cwp = pack_key_planes(jnp.asarray(cw))
+        cwlb = pack_key_bits(jnp.asarray(cwl))
+        cwrb = pack_key_bits(jnp.asarray(cwr))
+
+    want = path_level_planes_pallas(
+        jnp.asarray(state), jnp.asarray(ctrl), jnp.asarray(sel),
+        cwp, cwlb, cwrb, per_seed, interpret=True,
+    )
+    got = path_level_planes_pallas(
+        jnp.asarray(state), jnp.asarray(ctrl), jnp.asarray(sel),
+        cwp, cwlb, cwrb, per_seed, interpret=True, tile_lanes=16,
+    )
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want[0]))
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want[1]))
+
+
 @pytest.mark.parametrize("g,nk", [(2, 64), (64, 64), (24, 96)])
 def test_value_kernel_matches_xla(g, nk):
     state, ctrl, cw, _, _ = _random_inputs(g, nk)
