@@ -15,6 +15,21 @@ timeout 2400 python benchmarks/level_kernel_probe.py \
     2>benchmarks/results/level_probe_${stamp}.log \
     | tee benchmarks/results/level_probe_${stamp}.json
 
+echo "=== headline A/B: fused level kernels vs XLA levels ==="
+for lk in pallas xla; do
+    timeout 1500 env DPF_TPU_LEVEL_KERNEL=$lk BENCH_SKIP_NSLEAF=1 \
+        BENCH_ITERS=8 BENCH_TIMEOUT=1400 python bench.py \
+        2>benchmarks/results/bench_lk_${lk}_${stamp}.log \
+        | tee benchmarks/results/bench_lk_${lk}_${stamp}.json
+    tail -4 benchmarks/results/bench_lk_${lk}_${stamp}.log
+done
+
+echo "=== ns/leaf with fused kernels ==="
+timeout 1500 env BENCH_ITERS=8 BENCH_TIMEOUT=1400 \
+    BENCH_ONLY_NSLEAF=1 python bench.py \
+    2>benchmarks/results/bench_nsleaf_${stamp}.log \
+    | tee benchmarks/results/bench_nsleaf_${stamp}.json || true
+
 echo "=== BASELINE large configs ==="
 timeout 3600 python benchmarks/baseline_suite.py --scale full \
     --suite dense_big \
