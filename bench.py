@@ -507,6 +507,14 @@ def main():
     if not candidates:
         _emit(0.0, 0.0, error="no expansion path compiled")
         return
+    try:
+        from distributed_point_functions_tpu.pir.dense_eval_planes import (
+            level_kernel_status,
+        )
+
+        _log(f"level kernels: {level_kernel_status()}")
+    except Exception:  # noqa: BLE001 - observability only
+        pass
     if len(outputs) == 2 and not np.array_equal(
         outputs["limb"], outputs["planes"]
     ):

@@ -316,6 +316,16 @@ def _level_kernel_selfcheck() -> bool:
     return True
 
 
+def level_kernel_status() -> dict:
+    """Public observability snapshot for benches/captures: the serving
+    mode knob and the one-time self-check flags."""
+    return {
+        "mode": os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto"),
+        "verified": _LEVEL_KERNEL_VERIFIED,
+        "failed": _LEVEL_KERNEL_FAILED,
+    }
+
+
 def _level_kernel_enabled() -> bool:
     """Whether the fused Pallas level kernel serves the expansion.
 
