@@ -369,7 +369,15 @@ def main():
     client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
     indices = [int(i) for i in rng.integers(0, num_records, num_queries)]
     keys0, _ = client._generate_key_pairs(indices)
-    staged = stage_keys(keys0)
+    # Host-side zeros-walk during staging (mirrors serving's default;
+    # DPF_TPU_HOST_WALK=0 restores the on-device walk).
+    from distributed_point_functions_tpu.utils.runtime import (
+        host_walk_enabled,
+    )
+
+    host_walk = walk_levels if host_walk_enabled() else 0
+    staged = stage_keys(keys0, host_walk_levels=host_walk)
+    walk_levels -= host_walk
 
     # Choose the inner-product path: the Pallas packed-bits kernel if it
     # compiles and is bit-identical to the jnp path on this device.

@@ -37,6 +37,16 @@ def log_backend_mode_once(logger: logging.Logger | None = None) -> None:
     )
 
 
+def host_walk_enabled() -> bool:
+    """Shared predicate for the host-side zeros-walk during key staging
+    (`DPF_TPU_HOST_WALK`, default on; `0` restores the on-device walk).
+    Serving and bench must gate identically or their measured paths
+    diverge."""
+    import os
+
+    return os.environ.get("DPF_TPU_HOST_WALK", "1") != "0"
+
+
 def planes_selected(env_var: str) -> bool:
     """Shared mode predicate for the plane-resident kernel dispatchers
     (`DPF_TPU_EXPANSION`, `DPF_TPU_EVAL_PATHS`, `DPF_TPU_EXPAND_LEVELS`):

@@ -353,3 +353,103 @@ def test_chunked_serving_matches_unchunked(monkeypatch):
     ).dpf_pir_response.masked_response
     for q, idx in enumerate(indices):
         assert xor_bytes(r0[q], r1[q]) == records[idx]
+
+
+# ---------------------------------------------------------------------------
+# Host-side zeros-walk staging
+
+
+def test_stage_keys_host_walk_matches_device_walk():
+    """`stage_keys(host_walk_levels=K)` must put the staged batch at
+    exactly the state the device walk reaches: same seeds/control, and
+    the correction-word arrays drop the walked levels."""
+    import jax
+    import numpy as np
+
+    from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
+    from distributed_point_functions_tpu.pir.dense_eval import (
+        _walk_zeros,
+        stage_keys,
+    )
+
+    num_records = 1 << 14  # 128 blocks; tree has walkable prefix levels
+    client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+    rng = np.random.default_rng(21)
+    indices = [int(i) for i in rng.integers(0, num_records, 7)]
+    keys0, _ = client._generate_key_pairs(indices)
+
+    plain = stage_keys(keys0)
+    total = plain[2].shape[0]
+    walk = total - max(0, (128 - 1).bit_length())
+    assert walk > 0
+
+    want_seeds, want_ctrl = jax.jit(_walk_zeros)(
+        plain[0], plain[1], plain[2][:walk], plain[3][:walk]
+    )
+    got = stage_keys(keys0, host_walk_levels=walk)
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want_seeds))
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want_ctrl))
+    np.testing.assert_array_equal(
+        np.asarray(got[2]), np.asarray(plain[2][walk:])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got[3]), np.asarray(plain[3][walk:])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got[4]), np.asarray(plain[4][walk:])
+    )
+    with pytest.raises(ValueError, match="host_walk_levels"):
+        stage_keys(keys0, host_walk_levels=total + 1)
+
+
+def test_stage_keys_host_walk_numpy_fallback(monkeypatch):
+    """The numpy MMO fallback walks identically to the native oracle."""
+    import numpy as np
+
+    from distributed_point_functions_tpu.pir import dense_eval
+
+    num_records = 1 << 14
+    from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
+
+    client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+    rng = np.random.default_rng(22)
+    indices = [int(i) for i in rng.integers(0, num_records, 5)]
+    keys0, _ = client._generate_key_pairs(indices)
+
+    want = dense_eval.stage_keys(keys0, host_walk_levels=7)
+
+    from distributed_point_functions_tpu import native
+
+    def no_lib():
+        raise OSError("native disabled for test")
+
+    monkeypatch.setattr(native, "get_lib", no_lib)
+    monkeypatch.setattr(dense_eval, "_HOST_WALK_NATIVE_UNAVAILABLE", False)
+    with pytest.warns(UserWarning, match="numpy path"):
+        got = dense_eval.stage_keys(keys0, host_walk_levels=7)
+    # The unavailability is remembered: no further warning, still correct.
+    assert dense_eval._HOST_WALK_NATIVE_UNAVAILABLE is True
+    got2 = dense_eval.stage_keys(keys0, host_walk_levels=7)
+    monkeypatch.setattr(dense_eval, "_HOST_WALK_NATIVE_UNAVAILABLE", False)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
+    for w, g in zip(want, got2):
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
+
+
+def test_plain_serving_with_host_walk_matches_device_walk(monkeypatch):
+    """End-to-end: responses are identical with the host walk on and off."""
+    records = random_records(3000, size=24)
+    database = DenseDpfPirDatabase(records)
+    server = DenseDpfPirServer.create_plain(database)
+    client = DenseDpfPirClient.create(len(records), encrypt_decrypt.encrypt)
+    req0, _ = client.create_plain_requests([5, 1234, 2999])
+
+    monkeypatch.setenv("DPF_TPU_HOST_WALK", "1")
+    on = server.handle_request(req0)
+    monkeypatch.setenv("DPF_TPU_HOST_WALK", "0")
+    off = server.handle_request(req0)
+    assert (
+        on.dpf_pir_response.masked_response
+        == off.dpf_pir_response.masked_response
+    )
