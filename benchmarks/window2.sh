@@ -30,6 +30,11 @@ timeout 1500 env BENCH_ITERS=8 BENCH_TIMEOUT=1400 \
     2>benchmarks/results/bench_nsleaf_${stamp}.log \
     | tee benchmarks/results/bench_nsleaf_${stamp}.json || true
 
+echo "=== expansion stage profile (chunked kernels) ==="
+timeout 1800 python benchmarks/expand_profile.py \
+    2>benchmarks/results/expand_profile_${stamp}.log \
+    | tee benchmarks/results/expand_profile_${stamp}.json
+
 echo "=== BASELINE large configs ==="
 timeout 3600 python benchmarks/baseline_suite.py --scale full \
     --suite dense_big \

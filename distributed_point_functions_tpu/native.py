@@ -16,6 +16,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 from typing import Optional
 
 import numpy as np
@@ -28,6 +29,9 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "libdpf_native.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _keys_ctx = None
+# Serializes the build+load: two threads racing get_lib() on a cold
+# checkout must not CDLL a half-written .so mid-build.
+_lib_lock = threading.Lock()
 
 
 def _build() -> None:
@@ -43,10 +47,20 @@ def _u8(arr) -> np.ndarray:
 
 
 def get_lib() -> ctypes.CDLL:
-    """Loads (building if needed) the native library."""
+    """Loads (building if needed) the native library. Thread-safe: the
+    build+load is serialized so concurrent callers never load a
+    half-written .so."""
     global _lib, _keys_ctx
     if _lib is not None:
         return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        return _load_locked()
+
+
+def _load_locked() -> ctypes.CDLL:
+    global _lib, _keys_ctx
     if not os.path.exists(_LIB_PATH):
         _build()
     lib = ctypes.CDLL(_LIB_PATH)

@@ -191,16 +191,57 @@ def selection_blocks_for_keys(dpf, keys: Sequence[DpfKey], num_blocks: int):
     total_levels = dpf._tree_levels_needed - 1
     expand_levels = min(max(0, (num_blocks - 1).bit_length()), total_levels)
     walk_levels = total_levels - expand_levels
-    staged = stage_keys(keys)
+    staged, device_walk = stage_keys_walked(keys, walk_levels)
     return expansion_impl()(
         *staged,
-        walk_levels=walk_levels,
+        walk_levels=device_walk,
         expand_levels=expand_levels,
         num_blocks=num_blocks,
     )
 
 
+def stage_keys_walked(keys: Sequence[DpfKey], walk_levels: int):
+    """Stage a key batch with the host-side zeros-walk applied when
+    enabled (`DPF_TPU_HOST_WALK`, default on): returns `(staged,
+    device_walk_levels)` where `device_walk_levels` is what the device
+    step must still walk. Callers must pass the second element through —
+    deriving it independently walks already-consumed correction words."""
+    from ..utils.runtime import host_walk_enabled
+
+    host_walk = walk_levels if host_walk_enabled() else 0
+    return stage_keys(keys, host_walk_levels=host_walk), (
+        walk_levels - host_walk
+    )
+
+
 _HOST_WALK_NATIVE_UNAVAILABLE = False
+
+
+def warm_host_walk() -> None:
+    """Build/load the native oracle outside the request path.
+
+    The first `native.get_lib()` on a cold checkout spawns the g++ build
+    (seconds); servers call this at construction so no live request pays
+    it. A failure is remembered (the numpy walk serves instead) and
+    warned about once."""
+    global _HOST_WALK_NATIVE_UNAVAILABLE
+    if _HOST_WALK_NATIVE_UNAVAILABLE:
+        return
+    try:
+        from .. import native
+
+        native.get_lib()
+    except (
+        ImportError,
+        OSError,
+        RuntimeError,
+        subprocess.CalledProcessError,
+    ) as e:
+        _HOST_WALK_NATIVE_UNAVAILABLE = True
+        warnings.warn(
+            "native oracle unavailable for the host zeros-walk; "
+            f"using the numpy path ({str(e).splitlines()[0][:120]})"
+        )
 
 
 def _walk_zeros_host(seeds0, control0, cw_seeds, cw_left, cw_right, levels):
@@ -214,38 +255,24 @@ def _walk_zeros_host(seeds0, control0, cw_seeds, cw_left, cw_right, levels):
     the numpy MMO oracle. A failed native load is remembered (it spawns
     the g++ build) and warned about once — never retried per request,
     and genuine native-path errors are not masked."""
-    global _HOST_WALK_NATIVE_UNAVAILABLE
+    warm_host_walk()
     if not _HOST_WALK_NATIVE_UNAVAILABLE:
-        try:
-            from .. import native
+        from .. import native
 
-            native.get_lib()
-        except (
-            ImportError,
-            OSError,
-            RuntimeError,
-            subprocess.CalledProcessError,
-        ) as e:
-            _HOST_WALK_NATIVE_UNAVAILABLE = True
-            warnings.warn(
-                "native oracle unavailable for the host zeros-walk; "
-                f"using the numpy path ({str(e).splitlines()[0][:120]})"
-            )
-        else:
-            sb = aes.limbs_to_bytes_np(seeds0)
-            cw_b = aes.limbs_to_bytes_np(
-                cw_seeds[:levels].reshape(-1, 4)
-            ).reshape(levels, -1, 16)
-            s, c = native.evaluate_seeds(
-                sb,
-                control0.astype(np.uint8),
-                np.zeros_like(sb),
-                cw_b,
-                cw_left[:levels].astype(np.uint8),
-                cw_right[:levels].astype(np.uint8),
-                per_seed_cw=True,
-            )
-            return aes.bytes_to_limbs_np(s), c.astype(np.uint32)
+        sb = aes.limbs_to_bytes_np(seeds0)
+        cw_b = aes.limbs_to_bytes_np(
+            cw_seeds[:levels].reshape(-1, 4)
+        ).reshape(levels, -1, 16)
+        s, c = native.evaluate_seeds(
+            sb,
+            control0.astype(np.uint8),
+            np.zeros_like(sb),
+            cw_b,
+            cw_left[:levels].astype(np.uint8),
+            cw_right[:levels].astype(np.uint8),
+            per_seed_cw=True,
+        )
+        return aes.bytes_to_limbs_np(s), c.astype(np.uint32)
     seeds = seeds0.copy()
     control = control0.copy()
     for lvl in range(levels):

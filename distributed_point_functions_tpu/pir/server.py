@@ -35,7 +35,7 @@ from ..prng import Aes128CtrSeededPrng, xor_bytes
 from ..value_types import XorType
 from . import messages
 from .database import DenseDpfPirDatabase, words_to_record_bytes
-from .dense_eval import expansion_impl, stage_keys
+from .dense_eval import expansion_impl, stage_keys, stage_keys_walked
 
 # sender(helper_request: PirRequest, while_waiting: Callable[[], None])
 #   -> PirResponse
@@ -223,6 +223,13 @@ class DenseDpfPirServer(DpfPirServer):
         total_levels = self._dpf._tree_levels_needed - 1
         self._expand_levels = min(k, total_levels)
         self._walk_levels = total_levels - self._expand_levels
+        # Build/load the native oracle for the host zeros-walk here, not
+        # on the first request (a cold checkout spawns the g++ build).
+        from ..utils.runtime import host_walk_enabled
+        from .dense_eval import warm_host_walk
+
+        if self._walk_levels > 0 and host_walk_enabled():
+            warm_host_walk()
 
     # -- constructors mirroring CreatePlain/Leader/Helper -------------------
 
@@ -293,13 +300,12 @@ class DenseDpfPirServer(DpfPirServer):
             # (sub-ms there vs ~1.4 ms of dispatch-bound device AES per
             # batch); the device step starts at the expansion root.
             # DPF_TPU_HOST_WALK=0 restores the on-device walk.
-            from ..utils.runtime import host_walk_enabled
-
-            host_walk = self._walk_levels if host_walk_enabled() else 0
-            staged = stage_keys(keys, host_walk_levels=host_walk)
+            staged, device_walk = stage_keys_walked(
+                keys, self._walk_levels
+            )
             selections = expansion_impl()(
                 *staged,
-                walk_levels=self._walk_levels - host_walk,
+                walk_levels=device_walk,
                 expand_levels=self._expand_levels,
                 num_blocks=self._num_blocks,
             )
@@ -313,8 +319,6 @@ class DenseDpfPirServer(DpfPirServer):
     # -- chunked serving (selection tensor larger than the HBM budget) -------
 
     def _selection_budget_bytes(self) -> int:
-        import os
-
         return int(
             os.environ.get("DPF_TPU_SELECTION_BYTES_BUDGET", 1 << 30)
         )
