@@ -200,6 +200,19 @@ def main():
     jax.block_until_ready(exitp(values))
     report("exit_planes_bitrev", slope(lambda: exitp(values)))
 
+    # Same exit without the bit-reversal gather (what serving would pay
+    # with a bitrev-staged database, `bitrev_leaves=True`): if the delta
+    # is material, wiring the block-bitrev into database staging is the
+    # next win; if not, the refactor isn't worth its complexity.
+    def exit_nogather_fn(v):
+        w = 1 << expand_levels
+        out = planes_to_limbs(v).reshape(w, nkp, 4)
+        return jnp.moveaxis(out, 0, 1)
+
+    exitng = jax.jit(exit_nogather_fn)
+    jax.block_until_ready(exitng(values))
+    report("exit_planes_nogather", slope(lambda: exitng(values)))
+
     total = sum(
         v for k, v in results.items() if v and not k.endswith("_kernel")
     )
