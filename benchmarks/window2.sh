@@ -2,18 +2,13 @@
 # TPU window queue after the 2026-07-31 03:16-04:00 window: that window
 # captured the fixed-kernel headline (q128 6601.9 q/s = 412.6x), the
 # v2 inner-product A/Bs, and the expansion profile, and died during
-# dense_big. This queue leads with the level-kernel shape probe (the
-# fused expansion kernels crash Mosaic at G>=2048 — the probe maps the
-# boundary), then the remaining large configs and reference sweeps.
+# dense_big. This queue leads with the headline level-kernel A/B (the
+# round's key number — the chunked kernels' first serving shot), then
+# the shape probe, the remaining large configs, and reference sweeps.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 mkdir -p benchmarks/results
 stamp=$(date +%Y%m%d_%H%M%S)
-
-echo "=== level-kernel shape probe ==="
-timeout 2400 python benchmarks/level_kernel_probe.py \
-    2>benchmarks/results/level_probe_${stamp}.log \
-    | tee benchmarks/results/level_probe_${stamp}.json
 
 echo "=== headline A/B: fused level kernels vs XLA levels ==="
 for lk in pallas xla; do
@@ -23,6 +18,11 @@ for lk in pallas xla; do
         | tee benchmarks/results/bench_lk_${lk}_${stamp}.json
     tail -4 benchmarks/results/bench_lk_${lk}_${stamp}.log
 done
+
+echo "=== level-kernel shape probe ==="
+timeout 2400 python benchmarks/level_kernel_probe.py \
+    2>benchmarks/results/level_probe_${stamp}.log \
+    | tee benchmarks/results/level_probe_${stamp}.json
 
 echo "=== ns/leaf with fused kernels ==="
 timeout 1500 env BENCH_ITERS=8 BENCH_TIMEOUT=1400 \
