@@ -13,18 +13,21 @@ fail=0
 # on, and a hung suite would kill the watcher's recovery loop.
 
 # Pass 0 — minimal headline first. The tunnel has come up for windows as
-# short as ~4 minutes; one limb-only compile (~150 s) plus a short
+# short as ~4 minutes; one single-config compile (planes: ~150-320 s cold,
+# seconds when the persistent compile cache is warm) plus a short
 # measurement maximizes the chance a brief window still yields the
-# round's gating number before the full A/B + sweeps below.
-echo "=== quick headline (limb only, no secondary metrics) ==="
-timeout 600 env BENCH_EXPANSION=limb BENCH_SKIP_NSLEAF=1 BENCH_ITERS=8 \
-    BENCH_TIMEOUT=540 python bench.py \
+# round's gating number before the full A/B + sweeps below. The budget
+# must cover init (90 s fast-fail here) + a cold planes compile.
+echo "=== quick headline (planes single-config, no secondary metrics) ==="
+timeout 700 env BENCH_ITERS=8 BENCH_INIT_BUDGET=90 \
+    BENCH_TIMEOUT=620 python bench.py \
     2>benchmarks/results/bench_quick_${stamp}.log \
     | tee benchmarks/results/bench_quick_${stamp}.json
 tail -5 benchmarks/results/bench_quick_${stamp}.log
 
-echo "=== headline bench (2^20 x 256B) ==="
-timeout 2700 python bench.py 2>benchmarks/results/bench_${stamp}.log \
+echo "=== headline bench (2^20 x 256B, expansion A/B + ns/leaf) ==="
+timeout 2700 env BENCH_EXPANSION=both BENCH_NSLEAF=1 BENCH_TIMEOUT=2600 \
+    python bench.py 2>benchmarks/results/bench_${stamp}.log \
     | tee benchmarks/results/bench_${stamp}.json || fail=1
 tail -20 benchmarks/results/bench_${stamp}.log
 # The capture "really happened" iff a positive headline value was
