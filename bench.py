@@ -84,7 +84,8 @@ def _default_metric_unit():
     # every emitter — including the watchdog thread — so the tee'd file
     # never mixes metric shapes.
     if os.environ.get("BENCH_ONLY_NSLEAF", "") == "1":
-        return "dpf_full_domain_eval_ns_per_leaf_ld20_u64", "ns/leaf"
+        ld = int(os.environ.get("BENCH_NSLEAF_LD", 20))
+        return f"dpf_full_domain_eval_ns_per_leaf_ld{ld}_u64", "ns/leaf"
     return _metric_name(), "queries/s"
 
 
@@ -176,7 +177,7 @@ def _start_watchdog():
     t.start()
 
 
-def _ensure_backend(jax, total_budget_secs=None, per_attempt_secs=90):
+def _ensure_backend(jax, total_budget_secs=None, per_attempt_secs=150):
     """Initialize the JAX backend with bounded retries and a watchdog.
 
     Round-1 failure mode (BENCH_r01.json): the axon TPU backend raised
@@ -275,25 +276,27 @@ def _slope_time(fn, iters, reps=3):
 
 
 def _ns_per_leaf(jax, extra):
-    """Secondary metric: single-key full-domain eval ns/leaf, log-domain 20,
-    uint64 (reference: `distributed_point_function_benchmark.cc:43-95`)."""
+    """Secondary metric: single-key full-domain eval ns/leaf, uint64
+    values (reference: `distributed_point_function_benchmark.cc:43-95`).
+    BENCH_NSLEAF_LD picks the log-domain (default 20; measure 24 too so
+    the number isn't a small-domain artifact — VERDICT r02 item 7)."""
     from distributed_point_functions_tpu.dpf import (
         DistributedPointFunction,
         DpfParameters,
     )
     from distributed_point_functions_tpu.value_types import IntType
 
-    log_domain = 20
+    log_domain = int(os.environ.get("BENCH_NSLEAF_LD", 20))
     dpf = DistributedPointFunction.create(
         DpfParameters(log_domain_size=log_domain, value_type=IntType(64))
     )
-    key0, _ = dpf.generate_keys(12345, 42)
+    key0, _ = dpf.generate_keys(12345 % (1 << log_domain), 42)
 
     def run():
         ctx = dpf.create_evaluation_context(key0)
         return dpf.evaluate_next([], ctx)
 
-    _log("ns/leaf: compiling full-domain eval (log domain 20, uint64)")
+    _log(f"ns/leaf: compiling full-domain eval (log domain {log_domain}, uint64)")
     t0 = time.perf_counter()
     out = run()
     np.asarray(out)
@@ -304,7 +307,7 @@ def _ns_per_leaf(jax, extra):
         return
     leaves = 1 << log_domain
     ns = per_call / leaves * 1e9
-    extra["dpf_full_domain_eval_ns_per_leaf_ld20_u64"] = {
+    extra[f"dpf_full_domain_eval_ns_per_leaf_ld{log_domain}_u64"] = {
         "value": round(ns, 3),
         "unit": "ns/leaf",
         "vs_baseline_cpu": round(BASELINE_NS_PER_LEAF / ns, 2)
@@ -377,7 +380,8 @@ def main():
             _ns_per_leaf(jax, extra)
         except Exception as e:  # noqa: BLE001
             err = f"ns/leaf failed: {str(e).splitlines()[0][:200]}"
-        m = extra.get("dpf_full_domain_eval_ns_per_leaf_ld20_u64")
+        ld = int(os.environ.get("BENCH_NSLEAF_LD", 20))
+        m = extra.get(f"dpf_full_domain_eval_ns_per_leaf_ld{ld}_u64")
         if m is None and err is None:
             err = "ns/leaf slope degenerate; no measurement"
         _emit(
@@ -658,6 +662,27 @@ def main():
         _emit(0.0, 0.0, error="no expansion path compiled and passed "
               "share-correctness")
         return
+
+    # BENCH_XPROF=<dir>: capture an xprof device trace of a few serving
+    # batches (per expansion path) before the timed measurement, so every
+    # capture window can dissect where the batch time goes. The trace is
+    # outside the timed region and costs a few extra executions only.
+    xprof_dir = os.environ.get("BENCH_XPROF", "")
+    if xprof_dir:
+        _PROGRESS["stage"] = "xprof"
+        try:
+            from distributed_point_functions_tpu.utils.profiling import (
+                annotate,
+                trace,
+            )
+
+            with trace(xprof_dir):
+                for name, step in candidates.items():
+                    with annotate(f"pir_step_{name}"):
+                        np.asarray(step(*staged, db_words))
+            _log(f"xprof trace captured to {xprof_dir}")
+        except Exception as e:  # noqa: BLE001
+            _log(f"xprof capture failed: {str(e).splitlines()[0]}")
 
     _PROGRESS["stage"] = "measure"
     latencies = {}

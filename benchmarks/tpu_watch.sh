@@ -36,7 +36,7 @@ while true; do
     fi
     if probe; then
         echo "$(date -u +%H:%M:%S) tunnel ALIVE - launching capture" >>"$log"
-        bash benchmarks/capture_tpu.sh >>"$log" 2>&1
+        bash "${CAPTURE_SCRIPT:-benchmarks/capture_tpu.sh}" >>"$log" 2>&1
         rc=$?
         echo "$(date -u +%H:%M:%S) capture exited rc=$rc" >>"$log"
         if [ "$rc" -eq 0 ]; then

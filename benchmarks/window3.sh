@@ -1,0 +1,108 @@
+#!/usr/bin/env bash
+# Round-3 TPU capture queue, ordered by VERDICT r02 priority: the gating
+# headline number first (single-config, compile-cache-friendly), then the
+# level-kernel A/B (the expansion bottleneck), the batch-size sweep +
+# xprof trace, ns/leaf at two domains, DCF/MIC on TPU, sparse re-capture,
+# and the synthetic hierarchical configs. Results commit after every
+# stage with the stage's exit code recorded, so a mid-window tunnel stall
+# neither loses earlier results nor forges a "window succeeded" commit.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+mkdir -p benchmarks/results
+stamp=$(date +%Y%m%d_%H%M%S)
+rcs=""
+
+commit_stage() {
+    # commit_stage <name> <rc>
+    rcs="${rcs}${rcs:+ }$1=$2"
+    git add benchmarks/results >/dev/null 2>&1
+    git commit -q -m "TPU window3 capture: stage $1 rc=$2 (${stamp})" \
+        >/dev/null 2>&1 || true
+}
+
+echo "=== 1. headline (planes single-config, q128) ==="
+timeout 1000 env BENCH_ITERS=16 BENCH_INIT_BUDGET=90 BENCH_TIMEOUT=900 \
+    BENCH_XPROF=benchmarks/results/xprof_${stamp} python bench.py \
+    2>benchmarks/results/bench_q128_${stamp}.log \
+    | tee benchmarks/results/bench_q128_${stamp}.json
+commit_stage headline $?
+
+echo "=== 2. level-kernel A/B (fused pallas levels vs XLA levels) ==="
+for lk in pallas xla; do
+    timeout 1500 env DPF_TPU_LEVEL_KERNEL=$lk BENCH_ITERS=8 \
+        BENCH_INIT_BUDGET=90 BENCH_TIMEOUT=1400 python bench.py \
+        2>benchmarks/results/bench_lk_${lk}_${stamp}.log \
+        | tee benchmarks/results/bench_lk_${lk}_${stamp}.json
+    rc=$?
+    tail -4 benchmarks/results/bench_lk_${lk}_${stamp}.log
+    commit_stage lk_$lk $rc
+done
+
+echo "=== 3. batch sweep (q64..q512; both expansions at q256 cliff) ==="
+for q in 64 256 512; do
+    mode=planes
+    [ "$q" = 256 ] && mode=both
+    rm -f benchmarks/results/bench_extra.json
+    timeout 1200 env BENCH_QUERIES=$q BENCH_EXPANSION=$mode \
+        BENCH_ITERS=8 BENCH_INIT_BUDGET=90 BENCH_TIMEOUT=1100 \
+        python bench.py \
+        2>benchmarks/results/bench_q${q}_${stamp}.log \
+        | tee benchmarks/results/bench_q${q}_${stamp}.json
+    rc=$?
+    cp benchmarks/results/bench_extra.json \
+        benchmarks/results/bench_extra_q${q}_${stamp}.json 2>/dev/null
+    commit_stage q$q $rc
+done
+
+echo "=== 4. ns/leaf at log-domain 20 and 24 ==="
+for ld in 20 24; do
+    timeout 1500 env BENCH_ONLY_NSLEAF=1 BENCH_NSLEAF_LD=$ld \
+        BENCH_INIT_BUDGET=90 BENCH_TIMEOUT=1400 python bench.py \
+        2>benchmarks/results/bench_nsleaf_ld${ld}_${stamp}.log \
+        | tee benchmarks/results/bench_nsleaf_ld${ld}_${stamp}.json
+    commit_stage nsleaf_ld$ld $?
+done
+
+echo "=== 5. DCF/MIC reference sweeps on TPU ==="
+timeout 3600 python benchmarks/run_benchmarks.py --suite dcf,mic --big \
+    2>benchmarks/results/dcf_mic_tpu_${stamp}.log \
+    | tee benchmarks/results/dcf_mic_tpu_${stamp}.jsonl
+commit_stage dcf_mic $?
+
+echo "=== 6. sparse PIR re-capture (native builder + batched queries) ==="
+timeout 3600 python benchmarks/baseline_suite.py --scale full \
+    --suite sparse_big \
+    2>&1 | tee benchmarks/results/sparse_big_${stamp}.json
+commit_stage sparse_big $?
+
+echo "=== 7. synthetic hierarchical (reference experiments configs) ==="
+timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
+    --log_domain_size 32 --log_num_nonzeros 20 --num_iterations 3 \
+    2>&1 | tee benchmarks/results/synthetic_${stamp}.json
+commit_stage synthetic32 $?
+timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
+    --log_domain_size 32 --log_num_nonzeros 20 --only_nonzeros \
+    --num_iterations 3 \
+    2>&1 | tee benchmarks/results/only_nonzeros_${stamp}.json
+commit_stage direct32 $?
+timeout 3600 python benchmarks/synthetic_data_benchmarks.py \
+    --log_domain_size 128 --log_num_nonzeros 20 --num_iterations 2 \
+    2>&1 | tee benchmarks/results/synthetic128_${stamp}.json
+commit_stage synthetic128 $?
+
+echo "=== 8. remaining sweeps (dpf/inner_product/int_mod_n) ==="
+timeout 3600 python benchmarks/run_benchmarks.py \
+    --suite dpf,inner_product,int_mod_n --big \
+    2>&1 | tee benchmarks/results/sweeps_${stamp}.json
+commit_stage sweeps $?
+
+echo "=== 9. kernel smoke (shape envelope) ==="
+timeout 1800 python benchmarks/kernel_smoke.py \
+    2>benchmarks/results/kernel_smoke_${stamp}.log \
+    | tee benchmarks/results/kernel_smoke_${stamp}.json
+commit_stage kernel_smoke $?
+
+echo "window3 done (${stamp}): $rcs"
+git add benchmarks/results >/dev/null 2>&1
+git commit -q -m "TPU window3 capture complete (${stamp}): $rcs" \
+    >/dev/null 2>&1 || true

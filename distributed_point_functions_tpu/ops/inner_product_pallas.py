@@ -382,9 +382,19 @@ def xor_inner_product_pallas2_staged(
     # the source lane dim is below a half lane-tile and the factor exceeds
     # 8 — mapped on v5e 2026-07-31: W∈{4,8} × j_chunk∈{16,32} all crash,
     # W≥16 all legal. j_chunk only affects throughput, so cap it for
-    # narrow records instead of crashing.
-    if num_words < 16:
-        j_chunk = min(j_chunk, 8)
+    # narrow records instead of crashing — loudly, so an A/B over j_chunk
+    # values doesn't silently time identical runs.
+    if num_words < 16 and j_chunk > 8:
+        if j_chunk != 32:  # 32 is the default, not an explicit request
+            import warnings
+
+            warnings.warn(
+                f"narrow records ({num_words} words): j_chunk={j_chunk} "
+                "capped to 8 to dodge Mosaic's narrow-source repeat "
+                "miscompile",
+                stacklevel=2,
+            )
+        j_chunk = 8
     # The kernel's selections repeat has a fixed factor of 32, so a group
     # tile under 16 lanes hits the same miscompile with no knob to cap.
     # `permute_db_bitmajor` pads serving layouts to 128-group multiples;

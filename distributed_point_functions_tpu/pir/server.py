@@ -225,10 +225,13 @@ class DenseDpfPirServer(DpfPirServer):
         self._walk_levels = total_levels - self._expand_levels
         # Build/load the native oracle for the host zeros-walk here, not
         # on the first request (a cold checkout spawns the g++ build).
-        from ..utils.runtime import host_walk_enabled
+        # Warm whenever a walk exists, regardless of the current
+        # DPF_TPU_HOST_WALK value: handle_request re-reads the env per
+        # request, so the flag may be flipped on after construction and
+        # the first live request must not pay the g++ build.
         from .dense_eval import warm_host_walk
 
-        if self._walk_levels > 0 and host_walk_enabled():
+        if self._walk_levels > 0:
             warm_host_walk()
 
     # -- constructors mirroring CreatePlain/Leader/Helper -------------------

@@ -416,9 +416,17 @@ def test_stage_keys_host_walk_numpy_fallback(monkeypatch):
     indices = [int(i) for i in rng.integers(0, num_records, 5)]
     keys0, _ = client._generate_key_pairs(indices)
 
-    want = dense_eval.stage_keys(keys0, host_walk_levels=7)
-
     from distributed_point_functions_tpu import native
+
+    # `want` must really come from the native oracle: on a machine where
+    # the native lib cannot build, stage_keys would silently fall back to
+    # the same numpy walk and the comparison below would be vacuous.
+    try:
+        native.get_lib()
+    except Exception as e:  # noqa: BLE001
+        pytest.skip(f"native host-walk oracle unavailable: {e}")
+
+    want = dense_eval.stage_keys(keys0, host_walk_levels=7)
 
     def no_lib():
         raise OSError("native disabled for test")
