@@ -6,14 +6,17 @@
 
 PY ?= python
 
-.PHONY: test test-fast protos native bench bench-tpu sweeps dryrun lint
+.PHONY: test test-fast protos native bench bench-tpu sweeps dryrun lint ci
 
 test:          ## full hermetic suite (CPU, virtual 8-device mesh)
 	$(PY) -m pytest tests/ -q
 
-test-fast:     ## quick signal: kernels + protocol smoke
-	$(PY) -m pytest tests/test_aes.py tests/test_pallas.py \
-	    tests/test_proto_validator.py tests/test_hybrid_crypto.py -q
+test-fast:     ## <3 min hermetic signal (skips compile-heavy modules)
+	$(PY) -m pytest tests/test_aes.py tests/test_aes_sbox_tower.py \
+	    tests/test_proto_validator.py tests/test_hybrid_crypto.py \
+	    tests/test_serialization.py tests/test_farm_hash.py \
+	    tests/test_native.py tests/test_native_cuckoo.py \
+	    tests/test_testing_utils.py tests/test_demo.py -q
 
 protos:        ## regenerate *_pb2.py from protos/*.proto
 	cd protos && ./generate.sh
@@ -33,3 +36,9 @@ sweeps:        ## reference-mirroring benchmark sweeps (small shapes)
 dryrun:        ## driver-style multichip dryrun on 8 virtual CPU devices
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	    $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+lint:          ## stdlib AST lint (no flake8/ruff in this image)
+	$(PY) tools/lint.py
+
+ci:            ## presubmit: lint + protoc-check + native + test-fast + dryrun
+	bash ci/presubmit.sh

@@ -73,6 +73,15 @@ def _log(msg):
 _EMIT_LOCK = threading.Lock()
 
 
+def _nsleaf_ld():
+    # Parsed leniently: this runs on the watchdog emitter path, where a
+    # malformed env value must not be able to kill the JSON emission.
+    try:
+        return int(os.environ.get("BENCH_NSLEAF_LD", "20").strip())
+    except ValueError:
+        return 20
+
+
 def _metric_name():
     num_records = int(os.environ.get("BENCH_RECORDS", 1 << 20))
     record_bytes = int(os.environ.get("BENCH_RECORD_BYTES", 256))
@@ -84,7 +93,7 @@ def _default_metric_unit():
     # every emitter — including the watchdog thread — so the tee'd file
     # never mixes metric shapes.
     if os.environ.get("BENCH_ONLY_NSLEAF", "") == "1":
-        ld = int(os.environ.get("BENCH_NSLEAF_LD", 20))
+        ld = _nsleaf_ld()
         return f"dpf_full_domain_eval_ns_per_leaf_ld{ld}_u64", "ns/leaf"
     return _metric_name(), "queries/s"
 
@@ -286,7 +295,7 @@ def _ns_per_leaf(jax, extra):
     )
     from distributed_point_functions_tpu.value_types import IntType
 
-    log_domain = int(os.environ.get("BENCH_NSLEAF_LD", 20))
+    log_domain = _nsleaf_ld()
     dpf = DistributedPointFunction.create(
         DpfParameters(log_domain_size=log_domain, value_type=IntType(64))
     )
@@ -380,8 +389,9 @@ def main():
             _ns_per_leaf(jax, extra)
         except Exception as e:  # noqa: BLE001
             err = f"ns/leaf failed: {str(e).splitlines()[0][:200]}"
-        ld = int(os.environ.get("BENCH_NSLEAF_LD", 20))
-        m = extra.get(f"dpf_full_domain_eval_ns_per_leaf_ld{ld}_u64")
+        m = extra.get(
+            f"dpf_full_domain_eval_ns_per_leaf_ld{_nsleaf_ld()}_u64"
+        )
         if m is None and err is None:
             err = "ns/leaf slope degenerate; no measurement"
         _emit(

@@ -12,7 +12,6 @@ Run on the live chip after `capture_tpu.sh` (contention-free).
 
 from __future__ import annotations
 
-import functools
 import json
 import math
 import os

@@ -73,7 +73,6 @@ def main():
     # Level kernels vs XLA twins.
     from distributed_point_functions_tpu.ops.expand_planes_pallas import (
         expand_level_planes_pallas,
-        path_level_planes_pallas,
         value_hash_planes_pallas,
     )
     from distributed_point_functions_tpu import keys as fixed_keys

@@ -11,13 +11,16 @@ cd "$(dirname "$0")/.."
 mkdir -p benchmarks/results
 stamp=$(date +%Y%m%d_%H%M%S)
 rcs=""
+fail=0
 
 commit_stage() {
-    # commit_stage <name> <rc>
+    # commit_stage <name> <rc>; commits ONLY the results pathspec so a
+    # pre-staged unrelated change can't be swept into a capture commit.
     rcs="${rcs}${rcs:+ }$1=$2"
+    [ "$2" -ne 0 ] && fail=1
     git add benchmarks/results >/dev/null 2>&1
     git commit -q -m "TPU window3 capture: stage $1 rc=$2 (${stamp})" \
-        >/dev/null 2>&1 || true
+        -- benchmarks/results >/dev/null 2>&1 || true
 }
 
 echo "=== 1. headline (planes single-config, q128) ==="
@@ -102,7 +105,9 @@ timeout 1800 python benchmarks/kernel_smoke.py \
     | tee benchmarks/results/kernel_smoke_${stamp}.json
 commit_stage kernel_smoke $?
 
-echo "window3 done (${stamp}): $rcs"
+echo "window3 done (${stamp}): $rcs (fail=$fail)"
 git add benchmarks/results >/dev/null 2>&1
 git commit -q -m "TPU window3 capture complete (${stamp}): $rcs" \
-    >/dev/null 2>&1 || true
+    -- benchmarks/results >/dev/null 2>&1 || true
+# Nonzero when any stage failed so tpu_watch keeps re-polling the window.
+exit $fail

@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Presubmit pipeline — the TPU build's equivalent of the reference's
+# .bazelci/presubmit.yml:15-34 (two-compiler matrix, benchmark-tagged
+# targets excluded). Stages:
+#   1. lint        — stdlib AST lint (tools/lint.py)
+#   2. protos      — generated *_pb2.py match protos/*.proto
+#   3. native      — C++ oracle kernels build (g++)
+#   4. test-fast   — <3 min hermetic signal tier
+#   5. dryrun      — 8-virtual-device multichip compile+step
+# Benchmarks are excluded exactly as the reference excludes
+# `--test_tag_filters=-benchmark`. `FULL=1` appends the whole suite.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+fail=0
+
+stage() {
+    echo "=== presubmit: $1 ==="
+    shift
+    "$@" || { echo "FAILED: $*"; fail=1; }
+}
+
+stage lint python tools/lint.py
+
+stage protoc-check bash -c '
+    tmp=$(mktemp -d) &&
+    protoc --python_out="$tmp" -Iprotos \
+        protos/distributed_point_function.proto \
+        protos/distributed_comparison_function.proto \
+        protos/multiple_interval_containment.proto \
+        protos/private_information_retrieval.proto \
+        protos/hash_family_config.proto &&
+    ok=0 &&
+    for f in "$tmp"/*_pb2.py; do
+        name=$(basename "$f")
+        cmp -s "$f" "distributed_point_functions_tpu/protos/$name" \
+            || { echo "stale generated proto: $name"; ok=1; }
+    done; rm -rf "$tmp"; exit $ok'
+
+stage native bash -c 'cd native && bash build.sh'
+
+stage test-fast make -s test-fast
+
+stage dryrun make -s dryrun
+
+if [ "${FULL:-0}" = "1" ]; then
+    stage test-full python -m pytest tests/ -q
+fi
+
+echo "presubmit: $([ $fail -eq 0 ] && echo PASS || echo FAIL)"
+exit $fail

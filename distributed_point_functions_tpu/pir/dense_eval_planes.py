@@ -49,7 +49,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import keys as fixed_keys
-from ..ops import aes
 from ..ops.aes_bitslice import (
     aes_rounds_planes,
     limbs_to_planes,

@@ -19,7 +19,6 @@ import math
 from typing import Sequence
 
 from .dpf import DistributedPointFunction
-from .protos import dpf_pb2
 from .serialization import parameters_from_proto, value_type_from_proto
 
 _ALLOWED_BITSIZES = (8, 16, 32, 64, 128)

@@ -14,7 +14,7 @@ integer; tuples recurse (`value_type_helpers.h:182-461`).
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Tuple
 
 from . import value_types as vt_mod
 from .dpf import (

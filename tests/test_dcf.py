@@ -7,7 +7,6 @@ inputs of a small group for the MIC gate
 (`dcf/fss_gates/multiple_interval_containment_test.cc:43-119`).
 """
 
-import secrets
 
 import numpy as np
 import pytest
@@ -18,7 +17,6 @@ from distributed_point_functions_tpu.dcf import (
 )
 from distributed_point_functions_tpu.fss_gates import (
     Interval,
-    MicKey,
     MicParameters,
     MultipleIntervalContainmentGate,
 )

@@ -6,7 +6,6 @@ to `hashing/sha256_hash_family.py`, (2) every key placed in one of its
 own hash buckets with no key lost, (3) the sparse PIR protocol serves
 correctly from a natively-built database."""
 
-import hashlib
 
 import numpy as np
 import pytest

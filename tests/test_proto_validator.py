@@ -4,7 +4,6 @@ Mirrors the reference's exhaustive malformed-proto rejection
 (`dpf/internal/proto_validator_test.cc`).
 """
 
-import math
 
 import pytest
 

@@ -1,0 +1,112 @@
+"""Stdlib-only lint for the repo (no flake8/ruff in this image).
+
+Checks, per Python file: syntax (ast.parse), unused imports, trailing
+whitespace, tabs in indentation, CRLF line endings, and accidental
+`print(` in library code (the package logs via utils/runtime or
+logging; benchmarks/tests/tools may print).
+
+Mirrors the role of the reference CI's compiler-warning gate
+(`.bazelci/presubmit.yml:15-34`) at the level a Python codebase needs.
+Exit code 0 = clean.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+# Directories scanned; _pb2 files are generated and exempt.
+SCAN_DIRS = ["distributed_point_functions_tpu", "tests", "benchmarks", "tools"]
+TOP_LEVEL = ["bench.py", "__graft_entry__.py"]
+PRINT_OK_DIRS = {"tests", "benchmarks", "tools", "examples"}
+
+
+def _iter_files():
+    for d in SCAN_DIRS:
+        root = REPO / d
+        if root.is_dir():
+            yield from sorted(root.rglob("*.py"))
+    for f in TOP_LEVEL:
+        p = REPO / f
+        if p.exists():
+            yield p
+
+
+def _unused_imports(tree: ast.AST, src: str) -> list[tuple[int, str]]:
+    imported: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = (a.asname or a.name).split(".")[0]
+                imported[name] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                imported[a.asname or a.name] = node.lineno
+    if not imported:
+        return []
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            pass  # the base Name is walked separately
+    # __all__ reexports and doctest-style usage count as used.
+    for name in list(imported):
+        if name in used or f'"{name}"' in src or f"'{name}'" in src:
+            imported.pop(name)
+    return [(line, name) for name, line in sorted(imported.items())]
+
+
+def main() -> int:
+    problems: list[str] = []
+    for path in _iter_files():
+        rel = path.relative_to(REPO)
+        if path.name.endswith("_pb2.py"):
+            continue
+        reexport_ok = path.name == "__init__.py"
+        raw = path.read_bytes()
+        if b"\r\n" in raw:
+            problems.append(f"{rel}: CRLF line endings")
+        src = raw.decode("utf-8")
+        try:
+            tree = ast.parse(src, filename=str(rel))
+        except SyntaxError as e:
+            problems.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
+            continue
+        if not reexport_ok:
+            for lineno, name in _unused_imports(tree, src):
+                problems.append(f"{rel}:{lineno}: unused import '{name}'")
+        lib_code = rel.parts[0] not in PRINT_OK_DIRS and not any(
+            part in ("examples",) for part in rel.parts
+        )
+        for i, line in enumerate(src.splitlines(), 1):
+            if line.rstrip() != line:
+                problems.append(f"{rel}:{i}: trailing whitespace")
+            if line[: len(line) - len(line.lstrip())].count("\t"):
+                problems.append(f"{rel}:{i}: tab in indentation")
+        if lib_code and str(rel) not in TOP_LEVEL:
+            for node in ast.walk(tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"
+                ):
+                    problems.append(
+                        f"{rel}:{node.lineno}: print() in library code "
+                        "(use logging)"
+                    )
+    for p in problems:
+        print(p)
+    print(f"lint: {len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
