@@ -89,6 +89,66 @@ def main() -> None:
     for which, g, kg, tile in cases:
         print(json.dumps(case(g, kg, which, tile)), flush=True)
 
+    # Fused tail kernel (last r levels + value hash per subtree tile):
+    # map the VMEM ceiling over (entry width, r, tile). q128 serving is
+    # kg=4, g0=2048, r=4; q64 is kg=2, g0=1024.
+    from distributed_point_functions_tpu.ops.expand_planes_pallas import (
+        expand_tail_planes_pallas,
+    )
+
+    def tail_case(g0: int, kg: int, r: int, tile: int) -> dict:
+        state = jnp.asarray(
+            rng.integers(0, 1 << 32, (16, 8, g0), dtype=np.uint32)
+        )
+        ctrl = jnp.asarray(
+            rng.integers(0, 1 << 32, (g0,), dtype=np.uint32)
+        )
+        cwp = jnp.asarray(
+            rng.integers(0, 1 << 32, (r, 16, 8, kg), dtype=np.uint32)
+        )
+        cwb = jnp.asarray(
+            rng.integers(0, 1 << 32, (r, kg), dtype=np.uint32)
+        )
+        vc = jnp.asarray(
+            rng.integers(0, 1 << 32, (16, 8, kg), dtype=np.uint32)
+        )
+        tag = {"kernel": "tail", "g0": g0, "kg": kg, "r": r, "tile": tile,
+               "out_lanes": tile << r}
+        t0 = time.perf_counter()
+        try:
+            out = expand_tail_planes_pallas(
+                state, ctrl, cwp, cwb, cwb, vc, tile_lanes=tile
+            )
+            jax.block_until_ready(out)
+            # Per-call time after compile (whole-width launch set).
+            t1 = time.perf_counter()
+            jax.block_until_ready(
+                expand_tail_planes_pallas(
+                    state, ctrl, cwp, cwb, cwb, vc, tile_lanes=tile
+                )
+            )
+            return {**tag, "ok": True,
+                    "compile_s": round(t1 - t0, 1),
+                    "run_ms": round((time.perf_counter() - t1) * 1e3, 2)}
+        except Exception as e:  # noqa: BLE001
+            return {**tag, "ok": False,
+                    "error": str(e).splitlines()[0][:160]}
+
+    tail_cases = [
+        # q128 serving split (kg=4): vary tile -> out_lanes 2048..8192
+        (2048, 4, 4, 128),
+        (2048, 4, 4, 256),
+        (2048, 4, 4, 512),
+        # q64 serving (kg=2), deeper tails from a smaller split:
+        (1024, 2, 4, 128),
+        (512, 2, 5, 128),
+        (256, 2, 6, 128),
+        # VMEM ceiling: out 16384 lanes (8 MB) in one call
+        (2048, 4, 4, 1024),
+    ]
+    for g0, kg, r, tile in tail_cases:
+        print(json.dumps(tail_case(g0, kg, r, tile)), flush=True)
+
 
 if __name__ == "__main__":
     main()

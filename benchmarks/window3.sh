@@ -30,8 +30,8 @@ timeout 1000 env BENCH_ITERS=16 BENCH_INIT_BUDGET=90 BENCH_TIMEOUT=900 \
     | tee benchmarks/results/bench_q128_${stamp}.json
 commit_stage headline $?
 
-echo "=== 2. level-kernel A/B (fused pallas levels vs XLA levels) ==="
-for lk in pallas xla; do
+echo "=== 2. level-kernel A/B (fused tail vs per-level pallas vs XLA) ==="
+for lk in tail pallas xla; do
     timeout 1500 env DPF_TPU_LEVEL_KERNEL=$lk BENCH_ITERS=8 \
         BENCH_INIT_BUDGET=90 BENCH_TIMEOUT=1400 python bench.py \
         2>benchmarks/results/bench_lk_${lk}_${stamp}.log \
@@ -40,6 +40,12 @@ for lk in pallas xla; do
     tail -4 benchmarks/results/bench_lk_${lk}_${stamp}.log
     commit_stage lk_$lk $rc
 done
+
+echo "=== 2b. level/tail kernel shape probe ==="
+timeout 2400 python benchmarks/level_kernel_probe.py \
+    2>benchmarks/results/level_probe_${stamp}.log \
+    | tee benchmarks/results/level_probe_${stamp}.json
+commit_stage level_probe $?
 
 echo "=== 3. batch sweep (q64..q512; both expansions at q256 cliff) ==="
 for q in 64 256 512; do

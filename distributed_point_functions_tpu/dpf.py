@@ -498,7 +498,9 @@ def _expand_levels_fn(num_levels: int, hash_leaves: bool = False):
         try:
             return fast(*args)
         except Exception as e:  # noqa: BLE001 - fall back to XLA level
-            if _os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto") == "pallas":
+            if _os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto") in (
+                "pallas", "tail"
+            ):
                 raise
             _dep._remember_level_kernel_failure()
             _warnings.warn(
@@ -666,7 +668,9 @@ def _eval_paths(seeds, control, paths, cw_seeds, cw_left, cw_right,
                 bit_indices, level_kernel=True,
             )
         except Exception as e:  # noqa: BLE001 - fall back to XLA level
-            if _os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto") == "pallas":
+            if _os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto") in (
+                "pallas", "tail"
+            ):
                 raise
             _dep._remember_level_kernel_failure()
             _warnings.warn(

@@ -308,6 +308,154 @@ def value_hash_planes_pallas(
     )
 
 
+def _tail_kernel(
+    state_ref,
+    ctrl_ref,
+    cwp_ref,
+    cwl_ref,
+    cwr_ref,
+    vc_ref,
+    masks_lr_ref,
+    masks_v_ref,
+    out_ref,
+    *,
+    kg: int,
+    r: int,
+):
+    """Expand one lane tile through the last `r` levels AND the leaf
+    value hash entirely in VMEM: one HBM read of the [16, 8, T] entry
+    tile, one HBM write of the [16, 8, T * 2^r] value planes.
+
+    Subtrees of distinct tiles are independent, so each tile runs the
+    whole tail alone; the cross-tile leaf order is handled by
+    `tail_node_permutation` at exit. Each level doubles the width with
+    the in-tile [all-left; all-right] concatenation; every width stays
+    >= the entry tile (chosen >= 128 lanes by the caller), clear of
+    Mosaic's narrow-lane edge cases. Correction planes stay [16, 8, KG]
+    and are repeated per level exactly like `_level_kernel`.
+    """
+    state = state_ref[:]
+    ctrl = ctrl_ref[:][0]  # [T]
+    masks = masks_lr_ref[:]  # [2, 11, 16, 8, 1]
+    cwp_all = cwp_ref[:]  # [r, 16, 8, kg]
+    cwl_all = cwl_ref[:]  # [r, kg]
+    cwr_all = cwr_ref[:]  # [r, kg]
+    for i in range(r):
+        w = state.shape[-1]
+        sig = _sigma(state)
+        left = _aes_fixed_planes(masks[0], sig) ^ sig
+        right = _aes_fixed_planes(masks[1], sig) ^ sig
+        state = jnp.concatenate([left, right], axis=-1)
+        ctrl2 = jnp.concatenate([ctrl, ctrl])
+        cwp = pltpu.repeat(cwp_all[i], 2 * w // kg, axis=2)  # [16, 8, 2w]
+        state = state ^ (cwp & ctrl2[None, None, :])
+        t_new = state[0, 0]
+        state = _zero_lsb_plane(state)
+        cwl = pltpu.repeat(cwl_all[i][None, :], w // kg, axis=1)[0]
+        cwr = pltpu.repeat(cwr_all[i][None, :], w // kg, axis=1)[0]
+        cw_dir = jnp.concatenate(
+            [ctrl & cwl, ctrl & cwr]
+        )
+        ctrl = t_new ^ cw_dir
+    # Leaf value hash (MMO with the value key) + value correction.
+    sig = _sigma(state)
+    values = _aes_fixed_planes(masks_v_ref[:], sig) ^ sig
+    wf = values.shape[-1]
+    vc = pltpu.repeat(vc_ref[:], wf // kg, axis=2)
+    out_ref[:] = values ^ (vc & ctrl[None, None, :])
+
+
+def tail_node_permutation(
+    entry_order: np.ndarray, r: int, tile_nodes: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Leaf order of the tiled tail expansion.
+
+    entry_order[pos] = natural node index (at the split level) sitting
+    at plane position `pos` when the tail starts. Each tile of
+    `tile_nodes` entry nodes expands independently with per-level
+    [all-left; all-right] concatenation; tiles' outputs concatenate in
+    tile order. Returns (order, perm): order[pos] = natural leaf index
+    at final position pos, and perm = argsort(order), i.e. perm[g] = the
+    final position of natural leaf g (the exit-gather index vector).
+    """
+    chunks = []
+    for lo in range(0, len(entry_order), tile_nodes):
+        m = np.asarray(entry_order[lo : lo + tile_nodes], dtype=np.int64)
+        for _ in range(r):
+            m = np.concatenate([2 * m, 2 * m + 1])
+        chunks.append(m)
+    order = np.concatenate(chunks) if chunks else np.zeros(0, np.int64)
+    return order, np.argsort(order)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "tile_lanes")
+)
+def expand_tail_planes_pallas(
+    state: jnp.ndarray,
+    ctrl: jnp.ndarray,
+    cwp_tail: jnp.ndarray,
+    cwl_tail: jnp.ndarray,
+    cwr_tail: jnp.ndarray,
+    vc_kg: jnp.ndarray,
+    tile_lanes: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused tail: the last `r` expansion levels + the leaf value hash,
+    one kernel launch per entry tile (grid-(1,) each; multi-step lane
+    grids crash tpu_compile_helper on v5e).
+
+    state: uint32[16, 8, G0] planes at the split level; ctrl: uint32[G0];
+    cwp_tail: uint32[r, 16, 8, KG] per-level seed-correction planes;
+    cwl_tail / cwr_tail: uint32[r, KG] per-level packed direction bits;
+    vc_kg: uint32[16, 8, KG] value-correction planes. Returns value
+    planes uint32[16, 8, G0 * 2^r] in TILED order — compose
+    `tail_node_permutation` at exit to recover natural block order.
+    """
+    _, _, g0 = state.shape
+    r = cwp_tail.shape[0]
+    kg = cwp_tail.shape[-1]
+    _check_tile(tile_lanes, g0, kg)
+    ctrl2 = ctrl[None, :]
+    masks_v = jnp.asarray(_MASKS_VALUE)
+
+    def call(state_c, ctrl_c):
+        t = state_c.shape[-1]
+        return pl.pallas_call(
+            functools.partial(_tail_kernel, kg=kg, r=r),
+            grid=(1,),
+            in_specs=[
+                pl.BlockSpec((16, 8, t), lambda l: (0, 0, 0)),
+                pl.BlockSpec((1, t), lambda l: (0, 0)),
+                pl.BlockSpec((r, 16, 8, kg), lambda l: (0, 0, 0, 0)),
+                pl.BlockSpec((r, kg), lambda l: (0, 0)),
+                pl.BlockSpec((r, kg), lambda l: (0, 0)),
+                pl.BlockSpec((16, 8, kg), lambda l: (0, 0, 0)),
+                pl.BlockSpec(
+                    (2, 11, 16, 8, 1), lambda l: (0, 0, 0, 0, 0)
+                ),
+                pl.BlockSpec((11, 16, 8, 1), lambda l: (0, 0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (16, 8, t << r), lambda l: (0, 0, 0)
+            ),
+            out_shape=jax.ShapeDtypeStruct((16, 8, t << r), U32),
+            interpret=interpret,
+        )(
+            state_c, ctrl_c, cwp_tail, cwl_tail, cwr_tail, vc_kg,
+            _MASKS_LR, masks_v,
+        )
+
+    return jnp.concatenate(
+        [
+            call(state[:, :, lo : lo + tile_lanes],
+                 ctrl2[:, lo : lo + tile_lanes])
+            for lo in range(0, g0, tile_lanes)
+        ],
+        axis=-1,
+    )
+
+
 def _path_kernel(
     state_ref,
     ctrl_ref,

@@ -58,6 +58,8 @@ from ..ops.aes_bitslice import (
 )
 from ..ops.expand_planes_pallas import (
     expand_level_planes_pallas,
+    expand_tail_planes_pallas,
+    tail_node_permutation,
     value_hash_planes_pallas,
 )
 from .dense_eval import _walk_zeros
@@ -176,8 +178,14 @@ def evaluate_selection_blocks_planes(
             expand_levels=expand_levels,
             num_blocks=num_blocks,
         )
-    use_kernel = _level_kernel_enabled()
-    if use_kernel:
+    mode = _level_kernel_enabled()
+    if mode:
+        # Tail mode fuses the last levels + value hash per subtree tile
+        # (one kernel launch each); the per-level kernels cover the rest.
+        tail_levels = tile_nodes = 0
+        if mode == "tail" and not bitrev_leaves:
+            kg = padded // 32
+            tail_levels, tile_nodes = _tail_split(kg, expand_levels)
         try:
             return _evaluate_selection_blocks_planes_jit(
                 seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
@@ -186,9 +194,13 @@ def evaluate_selection_blocks_planes(
                 num_blocks=num_blocks,
                 bitrev_leaves=bitrev_leaves,
                 level_kernel=True,
+                tail_levels=tail_levels,
+                tail_tile_nodes=tile_nodes,
             )
         except Exception as e:  # noqa: BLE001 - fall back to the XLA level
-            if os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto") == "pallas":
+            if os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto") in (
+                "pallas", "tail"
+            ):
                 raise
             _remember_level_kernel_failure()
             warnings.warn(
@@ -325,15 +337,73 @@ def level_kernel_status() -> dict:
     }
 
 
-def _level_kernel_enabled() -> bool:
-    """Whether the fused Pallas level kernel serves the expansion.
+def _tail_levels_requested() -> int:
+    """How many final levels the fused tail kernel should cover
+    (DPF_TPU_TAIL_LEVELS, default 4: the measured hot levels are the
+    last ~4 plus the value hash — expand_profile 2026-07-31)."""
+    try:
+        return max(1, int(os.environ.get("DPF_TPU_TAIL_LEVELS", "4")))
+    except ValueError:
+        return 4
 
-    DPF_TPU_LEVEL_KERNEL=pallas forces it (errors propagate), =xla
-    disables it; auto uses it on TPU after a one-time on-device
-    bit-identity self-check, until a remembered failure."""
+
+def _tail_tile_nodes(key_groups: int, a_levels: int) -> int:
+    """Entry-tile node count for the tail kernel: the largest power of
+    two <= DPF_TPU_TAIL_TILE_LANES/KG (target >= 128 lanes so every
+    in-kernel width stays clear of narrow-lane Mosaic edge cases),
+    clamped to the 2^a nodes that exist at the split level."""
+    try:
+        target = int(os.environ.get("DPF_TPU_TAIL_TILE_LANES", "128"))
+    except ValueError:
+        target = 128
+    nodes = max(1, target // key_groups)
+    tile = 1 << (nodes.bit_length() - 1)
+    return min(tile, 1 << a_levels)
+
+
+def _tail_split(key_groups: int, expand_levels: int) -> tuple:
+    """(tail_levels, tile_nodes) for the fused tail: shrink the tail
+    until the entry tile reaches the width floor — min(128 lanes, the
+    explicit DPF_TPU_TAIL_TILE_LANES target, what the key-group packing
+    can express, the whole tree) — so default-config in-kernel widths
+    stay clear of the narrow-lane Mosaic regime while small probe/test
+    tiles remain honored. Env knobs are read here, OUTSIDE the jit, and
+    passed as static args — changing them between calls with identical
+    shapes must not be silently ignored."""
+    tail = min(_tail_levels_requested(), expand_levels)
+    if tail <= 0:
+        return 0, 0
+    try:
+        target = int(os.environ.get("DPF_TPU_TAIL_TILE_LANES", "128"))
+    except ValueError:
+        target = 128
+    best_nodes = 1 << (max(1, target // key_groups).bit_length() - 1)
+    floor = min(
+        128, target, best_nodes * key_groups,
+        key_groups << expand_levels,
+    )
+    while (
+        tail > 1
+        and _tail_tile_nodes(key_groups, expand_levels - tail)
+        * key_groups
+        < floor
+    ):
+        tail -= 1
+    return tail, _tail_tile_nodes(key_groups, expand_levels - tail)
+
+
+def _level_kernel_enabled():
+    """Whether (and how) the fused Pallas kernels serve the expansion:
+    False, "pallas" (per-level kernels), or "tail" (per-level kernels
+    plus the fused multi-level tail + value hash).
+
+    DPF_TPU_LEVEL_KERNEL=pallas|tail forces the mode (errors propagate),
+    =xla disables it; auto uses the per-level kernels on TPU after a
+    one-time on-device bit-identity self-check, until a remembered
+    failure."""
     mode = os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto")
-    if mode == "pallas":
-        return True
+    if mode in ("pallas", "tail"):
+        return mode
     if mode == "xla":
         return False
     if _LEVEL_KERNEL_FAILED or jax.default_backend() != "tpu":
@@ -344,9 +414,9 @@ def _level_kernel_enabled() -> bool:
         # its jitted twins would be traced into the outer program and the
         # comparisons would explode on tracers. Report the last *eager*
         # verification result; never record a failure from this path.
-        return _LEVEL_KERNEL_VERIFIED
+        return "pallas" if _LEVEL_KERNEL_VERIFIED else False
     try:
-        return _level_kernel_selfcheck()
+        return "pallas" if _level_kernel_selfcheck() else False
     except Exception as e:  # noqa: BLE001 - never break serving
         _remember_level_kernel_failure()
         warnings.warn(
@@ -360,7 +430,7 @@ def _level_kernel_enabled() -> bool:
     jax.jit,
     static_argnames=(
         "walk_levels", "expand_levels", "num_blocks", "bitrev_leaves",
-        "level_kernel",
+        "level_kernel", "tail_levels", "tail_tile_nodes",
     ),
 )
 def _evaluate_selection_blocks_planes_jit(
@@ -376,6 +446,8 @@ def _evaluate_selection_blocks_planes_jit(
     num_blocks: int,
     bitrev_leaves: bool = False,
     level_kernel: bool = False,
+    tail_levels: int = 0,
+    tail_tile_nodes: int = 0,
 ) -> jnp.ndarray:
     """Drop-in for `dense_eval.evaluate_selection_blocks` (bit-identical
     output), computed with the plane-resident expansion.
@@ -405,7 +477,8 @@ def _evaluate_selection_blocks_planes_jit(
     state = limbs_to_planes(seeds)  # [16, 8, key_groups]
     ctrl = pack_key_bits(control.astype(U32))  # [key_groups]
 
-    for i in range(expand_levels):
+    a_levels = expand_levels - tail_levels
+    for i in range(a_levels):
         lvl = walk_levels + i
         if level_kernel:
             state, ctrl = expand_level_planes_pallas(
@@ -427,7 +500,32 @@ def _evaluate_selection_blocks_planes_jit(
 
     # Leaf value blocks: output PRG + XOR value correction (party
     # negation is the identity for XOR shares).
-    if level_kernel:
+    tile_nodes = tail_tile_nodes
+    if tail_levels:
+        # Fused tail: the last `tail_levels` levels AND the value hash,
+        # one kernel launch per independent subtree tile.
+        base = walk_levels + a_levels
+        cwp_tail = jnp.stack(
+            [pack_key_planes(cw_seeds[base + j])
+             for j in range(tail_levels)]
+        )
+        cwl_tail = jnp.stack(
+            [pack_key_bits(cw_left[base + j]) for j in range(tail_levels)]
+        )
+        cwr_tail = jnp.stack(
+            [pack_key_bits(cw_right[base + j])
+             for j in range(tail_levels)]
+        )
+        values = expand_tail_planes_pallas(
+            state,
+            ctrl,
+            cwp_tail,
+            cwl_tail,
+            cwr_tail,
+            pack_key_planes(last_vc),
+            tile_lanes=tile_nodes * key_groups,
+        )
+    elif level_kernel:
         values = value_hash_planes_pallas(
             state, ctrl, pack_key_planes(last_vc)
         )
@@ -441,7 +539,16 @@ def _evaluate_selection_blocks_planes_jit(
     out = planes_to_limbs(values).reshape(w, nkp, 4)
     out = jnp.moveaxis(out, 0, 1)
     if not bitrev_leaves:
-        perm = jnp.asarray(bitrev_permutation(expand_levels))
+        if tail_levels:
+            # The tiled tail's leaf order composes phase A's bit-reversal
+            # with per-tile plane order; tail_node_permutation mirrors
+            # the exact concatenation structure.
+            _, perm_np = tail_node_permutation(
+                bitrev_permutation(a_levels), tail_levels, tile_nodes
+            )
+            perm = jnp.asarray(perm_np)
+        else:
+            perm = jnp.asarray(bitrev_permutation(expand_levels))
         out = out[:, perm, :][:, :num_blocks, :]
         if out.shape[1] < num_blocks:
             # Blocks beyond the tree's capacity (mesh-padded databases)

@@ -345,7 +345,7 @@ def test_level_kernel_selfcheck(monkeypatch):
         monkeypatch.setattr(
             epp, name, functools.partial(getattr(epp, name), interpret=True)
         )
-    assert dep._level_kernel_enabled() is True
+    assert dep._level_kernel_enabled() == "pallas"
     assert dep._LEVEL_KERNEL_VERIFIED is True
 
     # A kernel that returns garbage: self-check trips, failure remembered.
@@ -360,3 +360,125 @@ def test_level_kernel_selfcheck(monkeypatch):
     with pytest.warns(UserWarning, match="self-check"):
         assert dep._level_kernel_enabled() is False
     assert dep._LEVEL_KERNEL_FAILED is True
+
+
+@pytest.mark.parametrize(
+    "g0,nk,r,tile",
+    [(4, 64, 2, 2), (8, 32, 3, 4), (12, 96, 2, 6), (2, 64, 4, 2)],
+)
+def test_tail_kernel_matches_xla(g0, nk, r, tile):
+    """The fused multi-level tail kernel (interpret mode) is
+    bit-identical to per-tile XLA levels + value hash, in tiled order."""
+    from distributed_point_functions_tpu.ops.expand_planes_pallas import (
+        expand_tail_planes_pallas,
+    )
+
+    kg = nk // 32
+    state = jnp.asarray(
+        RNG.integers(0, 1 << 32, (16, 8, g0), dtype=np.uint32)
+    )
+    ctrl = jnp.asarray(RNG.integers(0, 1 << 32, (g0,), dtype=np.uint32))
+    cwp_kg = [
+        pack_key_planes(
+            jnp.asarray(RNG.integers(0, 1 << 32, (nk, 4), dtype=np.uint32))
+        )
+        for _ in range(r)
+    ]
+    cwl_kg = [
+        pack_key_bits(
+            jnp.asarray(RNG.integers(0, 2, (nk,), dtype=np.uint32))
+        )
+        for _ in range(r)
+    ]
+    cwr_kg = [
+        pack_key_bits(
+            jnp.asarray(RNG.integers(0, 2, (nk,), dtype=np.uint32))
+        )
+        for _ in range(r)
+    ]
+    vc_kg = pack_key_planes(
+        jnp.asarray(RNG.integers(0, 1 << 32, (nk, 4), dtype=np.uint32))
+    )
+
+    # XLA twin: per tile, r global-order levels then the value hash.
+    outs = []
+    for lo in range(0, g0, tile):
+        s = state[:, :, lo : lo + tile]
+        c = ctrl[lo : lo + tile]
+        for i in range(r):
+            g2 = 2 * s.shape[-1]
+            s, c = expand_level_planes(
+                s,
+                c,
+                _tile_keys(cwp_kg[i], g2),
+                _tile_keys(cwl_kg[i], g2 // 2),
+                _tile_keys(cwr_kg[i], g2 // 2),
+            )
+        v = mmo_hash_planes(fixed_keys.RK_VALUE, s) ^ (
+            _tile_keys(vc_kg, s.shape[-1]) & c[None, None, :]
+        )
+        outs.append(v)
+    want = np.asarray(jnp.concatenate(outs, axis=-1))
+
+    got = np.asarray(
+        expand_tail_planes_pallas(
+            state,
+            ctrl,
+            jnp.stack(cwp_kg),
+            jnp.stack(cwl_kg),
+            jnp.stack(cwr_kg),
+            vc_kg,
+            tile_lanes=tile,
+            interpret=True,
+        )
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_serving_expansion_with_tail_kernel(monkeypatch):
+    """The covering-subtree expansion served in tail mode (fused last
+    levels + value hash, interpret mode) is bit-identical to the limb
+    kernel — exercising the tiled-order exit permutation."""
+    import functools
+
+    from distributed_point_functions_tpu.pir import dense_eval_planes as dep
+    from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
+    from distributed_point_functions_tpu.pir.dense_eval import (
+        evaluate_selection_blocks,
+        stage_keys,
+    )
+
+    monkeypatch.setattr(
+        dep, "expand_level_planes_pallas",
+        functools.partial(dep.expand_level_planes_pallas, interpret=True),
+    )
+    monkeypatch.setattr(
+        dep, "expand_tail_planes_pallas",
+        functools.partial(dep.expand_tail_planes_pallas, interpret=True),
+    )
+    monkeypatch.setenv("DPF_TPU_LEVEL_KERNEL", "tail")
+    monkeypatch.setenv("DPF_TPU_TAIL_LEVELS", "3")
+    # Tiny tiles so several tail calls + the cross-tile order run.
+    monkeypatch.setenv("DPF_TPU_TAIL_TILE_LANES", "8")
+
+    num_records = 35 * 128  # odd block count: exercises truncation
+    nq = 96  # key padding (96 -> kg 3) alongside the tail tiling
+    num_blocks = (num_records + 127) // 128
+    total = max(0, (num_records - 1).bit_length())
+    expand = min((num_blocks - 1).bit_length(), total)
+    walk = total - expand
+
+    client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+    idx = [int(i) for i in RNG.integers(0, num_records, nq)]
+    keys0, _ = client._generate_key_pairs(idx)
+    staged = stage_keys(keys0)
+
+    want = np.asarray(evaluate_selection_blocks(
+        *staged, walk_levels=walk, expand_levels=expand,
+        num_blocks=num_blocks,
+    ))
+    got = np.asarray(dep.evaluate_selection_blocks_planes(
+        *staged, walk_levels=walk, expand_levels=expand,
+        num_blocks=num_blocks, force_planes=True,
+    ))
+    np.testing.assert_array_equal(got, want)
