@@ -170,7 +170,7 @@ def bench_sparse_big(scale: str):
     value_bytes = 16
     query_counts = [
         int(q)
-        for q in os.environ.get("BENCH_SPARSE_QUERIES", "8,64").split(",")
+        for q in os.environ.get("BENCH_SPARSE_QUERIES", "64,128").split(",")
     ]
 
     rng = np.random.default_rng(13)

@@ -279,7 +279,11 @@ def _ip_kernel_v2(sel_ref, db_ref, out_ref, *, j_chunk: int, int8: bool):
     lhs = to_mm((sel_rep >> b_iota) & U32(1))
 
     dbw = db_ref[:].reshape(tr, w)  # b-major record rows
-    db_rep = pltpu.repeat(dbw, j_chunk, axis=1)  # [TR, j_chunk*W]
+    # j_chunk=1 means no repeat at all — the entry point drops to 1 for
+    # narrow records (W<16), where Mosaic's repeat miscompiles.
+    db_rep = (
+        dbw if j_chunk == 1 else pltpu.repeat(dbw, j_chunk, axis=1)
+    )
     acc_t = I32 if int8 else F32
     for jc in range(0, 32, j_chunk):
         j_iota = (
@@ -378,23 +382,27 @@ def xor_inner_product_pallas2_staged(
         )
     if 32 % j_chunk != 0:
         raise ValueError(f"j_chunk must divide 32; got {j_chunk}")
-    # Mosaic's `pltpu.repeat` miscompiles (tpu_compile_helper exit 1) when
+    # In this kernel's 2-D axis-1 db repeat, Mosaic's `pltpu.repeat`
+    # miscompiles (tpu_compile_helper exit 1) when
     # the source lane dim is below a half lane-tile and the factor exceeds
     # 8 — mapped on v5e 2026-07-31: W∈{4,8} × j_chunk∈{16,32} all crash,
-    # W≥16 all legal. j_chunk only affects throughput, so cap it for
-    # narrow records instead of crashing — loudly, so an A/B over j_chunk
-    # values doesn't silently time identical runs.
-    if num_words < 16 and j_chunk > 8:
+    # W≥16 all legal. The 2026-07-31 kernel smoke then crashed at
+    # W=8 x j_chunk=8 too (tpu_compile_helper exit 1), so the true
+    # boundary is the SOURCE width, not the factor: for W<16 skip the
+    # in-kernel db repeat entirely (j_chunk=1 needs no repeat). j_chunk
+    # only affects throughput, so degrade loudly instead of crashing —
+    # an A/B over j_chunk values must not silently time identical runs.
+    if num_words < 16 and j_chunk > 1:
         if j_chunk != 32:  # 32 is the default, not an explicit request
             import warnings
 
             warnings.warn(
                 f"narrow records ({num_words} words): j_chunk={j_chunk} "
-                "capped to 8 to dodge Mosaic's narrow-source repeat "
+                "dropped to 1 to dodge Mosaic's narrow-source repeat "
                 "miscompile",
                 stacklevel=2,
             )
-        j_chunk = 8
+        j_chunk = 1
     # The kernel's selections repeat has a fixed factor of 32, so a group
     # tile under 16 lanes hits the same miscompile with no knob to cap.
     # `permute_db_bitmajor` pads serving layouts to 128-group multiples;

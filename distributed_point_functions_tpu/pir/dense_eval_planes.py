@@ -347,18 +347,28 @@ def _tail_levels_requested() -> int:
         return 4
 
 
+def _tail_tile_target() -> int:
+    """Target entry-tile lane count — the one place the
+    DPF_TPU_TAIL_TILE_LANES knob is parsed."""
+    try:
+        target = int(os.environ.get("DPF_TPU_TAIL_TILE_LANES", "128"))
+    except ValueError:
+        target = 128
+    return target
+
+
+def _tail_best_nodes(key_groups: int) -> int:
+    """Largest power-of-two node count whose lanes fit the tile target."""
+    target = _tail_tile_target()
+    return 1 << (max(1, target // key_groups).bit_length() - 1)
+
+
 def _tail_tile_nodes(key_groups: int, a_levels: int) -> int:
     """Entry-tile node count for the tail kernel: the largest power of
     two <= DPF_TPU_TAIL_TILE_LANES/KG (target >= 128 lanes so every
     in-kernel width stays clear of narrow-lane Mosaic edge cases),
     clamped to the 2^a nodes that exist at the split level."""
-    try:
-        target = int(os.environ.get("DPF_TPU_TAIL_TILE_LANES", "128"))
-    except ValueError:
-        target = 128
-    nodes = max(1, target // key_groups)
-    tile = 1 << (nodes.bit_length() - 1)
-    return min(tile, 1 << a_levels)
+    return min(_tail_best_nodes(key_groups), 1 << a_levels)
 
 
 def _tail_split(key_groups: int, expand_levels: int) -> tuple:
@@ -373,13 +383,9 @@ def _tail_split(key_groups: int, expand_levels: int) -> tuple:
     tail = min(_tail_levels_requested(), expand_levels)
     if tail <= 0:
         return 0, 0
-    try:
-        target = int(os.environ.get("DPF_TPU_TAIL_TILE_LANES", "128"))
-    except ValueError:
-        target = 128
-    best_nodes = 1 << (max(1, target // key_groups).bit_length() - 1)
     floor = min(
-        128, target, best_nodes * key_groups,
+        128, _tail_tile_target(),
+        _tail_best_nodes(key_groups) * key_groups,
         key_groups << expand_levels,
     )
     while (

@@ -163,10 +163,12 @@ def test_pallas_v2_tile_variants(tile_groups, j_chunk):
 
 
 def test_pallas_v2_narrow_records_cap_j_chunk(monkeypatch):
-    """W<16 must cap j_chunk at 8: Mosaic's pltpu.repeat miscompiles for
-    sub-half-lane-tile sources with factors >8 (hardware-mapped on v5e,
-    2026-07-31). The public entry silently caps instead of crashing; the
-    cap must actually reach the jitted core, and results stay exact."""
+    """W<16 must drop j_chunk to 1 (no in-kernel db repeat at all):
+    Mosaic's pltpu.repeat miscompiles for sub-half-lane-tile sources —
+    factors >8 mapped 2026-07-31, and the W=8 x factor-8 kernel smoke
+    crash showed the true boundary is the source width. The public entry
+    degrades instead of crashing; the drop must actually reach the
+    jitted core, and results stay exact."""
     from distributed_point_functions_tpu.ops import inner_product_pallas as ipp
 
     forwarded = {}
@@ -177,7 +179,7 @@ def test_pallas_v2_narrow_records_cap_j_chunk(monkeypatch):
         return real_core(db_perm, packed, **kw)
 
     monkeypatch.setattr(ipp, "_ip_pallas_staged_v2", spy)
-    for num_words, want_chunk in ((4, 8), (8, 8), (16, 32)):
+    for num_words, want_chunk in ((4, 1), (8, 1), (16, 32)):
         db = RNG.integers(0, 1 << 32, (4096, num_words), dtype=np.uint32)
         bits = RNG.integers(0, 2, (5, 4096), dtype=np.uint32)
         sel = pack_selection_bits_np(bits)
