@@ -369,7 +369,9 @@ def _expand_levels_limb_fn(num_levels: int, hash_leaves: bool = False):
 
 @functools.lru_cache(maxsize=None)
 def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False,
-                             hash_leaves: bool = False):
+                             hash_leaves: bool = False,
+                             tail_req: int = 0,
+                             tail_tile_target: int = 0):
     """`_expand_levels_limb_fn` computed in bitsliced plane layout (see
     `pir/dense_eval_planes.py` for the design): children are appended
     [all-left; all-right] per level so the lane order ends up
@@ -429,7 +431,21 @@ def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False,
         ctrl = pack_select_bits(control.astype(U32))
 
         plane_levels = num_levels - limb_levels
-        for i in range(limb_levels, num_levels):
+        # Fused tail (last levels + leaf hash in one VMEM kernel per
+        # subtree tile): only meaningful when the leaf hash is fused
+        # anyway, and node tiles split on prefix-word boundaries. The
+        # env knobs are read at dispatch time (_expand_levels_fn) and
+        # arrive as cache keys, so the trace never bakes stale values.
+        tail_r = tile_nodes = 0
+        if tail_req and level_kernel and hash_leaves and plane_levels > 0:
+            from .pir.dense_eval_planes import _tail_split
+
+            tail_r, tile_nodes = _tail_split(
+                n32 // 32, plane_levels,
+                requested_levels=tail_req,
+                target_lanes=tail_tile_target,
+            )
+        for i in range(limb_levels, num_levels - tail_r):
             if level_kernel:
                 state, ctrl = expand_level_planes_pallas(
                     state,
@@ -447,23 +463,61 @@ def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False,
                     U32(0) - (cw_right[i] & U32(1)),
                 )
 
-        if hash_leaves:
+        if tail_r:
+            from .ops.expand_planes_pallas import (
+                expand_tail_planes_pallas,
+            )
+
+            base = num_levels - tail_r
+            cwp_tail = jnp.stack(
+                [broadcast_cw_planes(cw_seeds[base + j])
+                 for j in range(tail_r)]
+            )
+            cwl_tail = jnp.stack(
+                [(U32(0) - (cw_left[base + j] & U32(1)))[None]
+                 for j in range(tail_r)]
+            )
+            cwr_tail = jnp.stack(
+                [(U32(0) - (cw_right[base + j] & U32(1)))[None]
+                 for j in range(tail_r)]
+            )
+            # Zero value-correction planes: the kernel reduces to the
+            # pure MMO output hash (correction is arithmetic here and
+            # stays in the leaf stage).
+            state, ctrl = expand_tail_planes_pallas(
+                state,
+                ctrl,
+                cwp_tail,
+                cwl_tail,
+                cwr_tail,
+                jnp.zeros((16, 8, 1), dtype=U32),
+                tile_lanes=tile_nodes * (n32 // 32),
+            )
+        elif hash_leaves:
             if level_kernel:
-                # Zero value-correction planes: the kernel reduces to the
-                # pure MMO output hash (correction is arithmetic here and
-                # stays in the leaf stage).
+                # (same zero-correction reduction as the tail)
                 zeros_vc = jnp.zeros((16, 8, 1), dtype=U32)
                 state = value_hash_planes_pallas(state, ctrl, zeros_vc)
             else:
                 state = mmo_hash_planes(fixed_keys.RK_VALUE, state)
         out = planes_to_limbs(state)  # [2^PL * n32, 4], lane-ordered
         ctrl_bits = ((ctrl[:, None] >> shifts) & U32(1)).reshape(-1)
-        # lane(path, prefix) = bitrev(path) * n32 + prefix over the plane
-        # levels only (the limb prefix is already natural/interleaved);
-        # natural index = prefix * 2^PL + path. Static per specialization.
-        rev = bitrev_permutation(plane_levels)
+        # lane(path, prefix) = position(path) * n32 + prefix over the
+        # plane levels only (the limb prefix is already natural/
+        # interleaved); natural index = prefix * 2^PL + path. Static per
+        # specialization. Without the tail, position = bit-reversal; the
+        # tiled tail composes per-tile plane order on top.
+        if tail_r:
+            from .ops.expand_planes_pallas import tail_node_permutation
+
+            _, pos = tail_node_permutation(
+                bitrev_permutation(plane_levels - tail_r), tail_r,
+                tile_nodes,
+            )
+        else:
+            pos = bitrev_permutation(plane_levels)
         path = np.arange(1 << plane_levels)
-        lane = rev[path][:, None] * n32 + np.arange(n0)[None, :]
+        lane = pos[path][:, None] * n32 + np.arange(n0)[None, :]
         perm = jnp.asarray(
             np.ascontiguousarray(lane.T.reshape(-1))  # prefix-major
         )
@@ -485,11 +539,23 @@ def _expand_levels_fn(num_levels: int, hash_leaves: bool = False):
         return _expand_levels_limb_fn(num_levels, hash_leaves=hash_leaves)
     from .pir import dense_eval_planes as _dep
 
-    if not _dep._level_kernel_enabled():
+    mode = _dep._level_kernel_enabled()
+    if not mode:
         return _expand_levels_planes_fn(num_levels,
                                         hash_leaves=hash_leaves)
+    if mode == "tail":
+        from .pir.dense_eval_planes import (
+            _tail_levels_requested,
+            _tail_tile_target,
+        )
+
+        tail_req, tail_tile = _tail_levels_requested(), _tail_tile_target()
+    else:
+        tail_req, tail_tile = 0, 0
     fast = _expand_levels_planes_fn(num_levels, level_kernel=True,
-                                    hash_leaves=hash_leaves)
+                                    hash_leaves=hash_leaves,
+                                    tail_req=tail_req,
+                                    tail_tile_target=tail_tile)
 
     def run_with_fallback(*args):
         import os as _os

@@ -318,6 +318,7 @@ def _tail_kernel(
     masks_lr_ref,
     masks_v_ref,
     out_ref,
+    outc_ref,
     *,
     kg: int,
     r: int,
@@ -363,6 +364,9 @@ def _tail_kernel(
     wf = values.shape[-1]
     vc = pltpu.repeat(vc_ref[:], wf // kg, axis=2)
     out_ref[:] = values ^ (vc & ctrl[None, None, :])
+    # Final packed control bits (hierarchical callers apply arithmetic
+    # value corrections outside, per leaf control bit).
+    outc_ref[:] = ctrl[None, :]
 
 
 def tail_node_permutation(
@@ -408,9 +412,10 @@ def expand_tail_planes_pallas(
     state: uint32[16, 8, G0] planes at the split level; ctrl: uint32[G0];
     cwp_tail: uint32[r, 16, 8, KG] per-level seed-correction planes;
     cwl_tail / cwr_tail: uint32[r, KG] per-level packed direction bits;
-    vc_kg: uint32[16, 8, KG] value-correction planes. Returns value
-    planes uint32[16, 8, G0 * 2^r] in TILED order — compose
-    `tail_node_permutation` at exit to recover natural block order.
+    vc_kg: uint32[16, 8, KG] value-correction planes. Returns
+    (value planes uint32[16, 8, G0 * 2^r], packed leaf control bits
+    uint32[G0 * 2^r]) in TILED order — compose `tail_node_permutation`
+    at exit to recover natural block order.
     """
     _, _, g0 = state.shape
     r = cwp_tail.shape[0]
@@ -436,24 +441,27 @@ def expand_tail_planes_pallas(
                 ),
                 pl.BlockSpec((11, 16, 8, 1), lambda l: (0, 0, 0, 0)),
             ],
-            out_specs=pl.BlockSpec(
-                (16, 8, t << r), lambda l: (0, 0, 0)
+            out_specs=(
+                pl.BlockSpec((16, 8, t << r), lambda l: (0, 0, 0)),
+                pl.BlockSpec((1, t << r), lambda l: (0, 0)),
             ),
-            out_shape=jax.ShapeDtypeStruct((16, 8, t << r), U32),
+            out_shape=(
+                jax.ShapeDtypeStruct((16, 8, t << r), U32),
+                jax.ShapeDtypeStruct((1, t << r), U32),
+            ),
             interpret=interpret,
         )(
             state_c, ctrl_c, cwp_tail, cwl_tail, cwr_tail, vc_kg,
             _MASKS_LR, masks_v,
         )
 
-    return jnp.concatenate(
-        [
-            call(state[:, :, lo : lo + tile_lanes],
-                 ctrl2[:, lo : lo + tile_lanes])
-            for lo in range(0, g0, tile_lanes)
-        ],
-        axis=-1,
-    )
+    vs, cs = [], []
+    for lo in range(0, g0, tile_lanes):
+        v, c = call(state[:, :, lo : lo + tile_lanes],
+                    ctrl2[:, lo : lo + tile_lanes])
+        vs.append(v)
+        cs.append(c[0])
+    return jnp.concatenate(vs, axis=-1), jnp.concatenate(cs)
 
 
 def _path_kernel(

@@ -357,21 +357,12 @@ def _tail_tile_target() -> int:
     return target
 
 
-def _tail_best_nodes(key_groups: int) -> int:
-    """Largest power-of-two node count whose lanes fit the tile target."""
-    target = _tail_tile_target()
-    return 1 << (max(1, target // key_groups).bit_length() - 1)
-
-
-def _tail_tile_nodes(key_groups: int, a_levels: int) -> int:
-    """Entry-tile node count for the tail kernel: the largest power of
-    two <= DPF_TPU_TAIL_TILE_LANES/KG (target >= 128 lanes so every
-    in-kernel width stays clear of narrow-lane Mosaic edge cases),
-    clamped to the 2^a nodes that exist at the split level."""
-    return min(_tail_best_nodes(key_groups), 1 << a_levels)
-
-
-def _tail_split(key_groups: int, expand_levels: int) -> tuple:
+def _tail_split(
+    key_groups: int,
+    expand_levels: int,
+    requested_levels: int | None = None,
+    target_lanes: int | None = None,
+) -> tuple:
     """(tail_levels, tile_nodes) for the fused tail: shrink the tail
     until the entry tile reaches the width floor — min(128 lanes, the
     explicit DPF_TPU_TAIL_TILE_LANES target, what the key-group packing
@@ -380,22 +371,27 @@ def _tail_split(key_groups: int, expand_levels: int) -> tuple:
     tiles remain honored. Env knobs are read here, OUTSIDE the jit, and
     passed as static args — changing them between calls with identical
     shapes must not be silently ignored."""
-    tail = min(_tail_levels_requested(), expand_levels)
+    if requested_levels is None:
+        requested_levels = _tail_levels_requested()
+    if target_lanes is None:
+        target_lanes = _tail_tile_target()
+    best = 1 << (max(1, target_lanes // key_groups).bit_length() - 1)
+    tail = min(requested_levels, expand_levels)
     if tail <= 0:
         return 0, 0
     floor = min(
-        128, _tail_tile_target(),
-        _tail_best_nodes(key_groups) * key_groups,
+        128, target_lanes, best * key_groups,
         key_groups << expand_levels,
     )
+    def tile_nodes(a_levels):
+        return min(best, 1 << a_levels)
+
     while (
         tail > 1
-        and _tail_tile_nodes(key_groups, expand_levels - tail)
-        * key_groups
-        < floor
+        and tile_nodes(expand_levels - tail) * key_groups < floor
     ):
         tail -= 1
-    return tail, _tail_tile_nodes(key_groups, expand_levels - tail)
+    return tail, tile_nodes(expand_levels - tail)
 
 
 def _level_kernel_enabled():
@@ -522,7 +518,7 @@ def _evaluate_selection_blocks_planes_jit(
             [pack_key_bits(cw_right[base + j])
              for j in range(tail_levels)]
         )
-        values = expand_tail_planes_pallas(
+        values, _ = expand_tail_planes_pallas(
             state,
             ctrl,
             cwp_tail,

@@ -402,6 +402,7 @@ def test_tail_kernel_matches_xla(g0, nk, r, tile):
 
     # XLA twin: per tile, r global-order levels then the value hash.
     outs = []
+    out_ctrls = []
     for lo in range(0, g0, tile):
         s = state[:, :, lo : lo + tile]
         c = ctrl[lo : lo + tile]
@@ -418,21 +419,22 @@ def test_tail_kernel_matches_xla(g0, nk, r, tile):
             _tile_keys(vc_kg, s.shape[-1]) & c[None, None, :]
         )
         outs.append(v)
+        out_ctrls.append(c)
     want = np.asarray(jnp.concatenate(outs, axis=-1))
+    want_ctrl = np.asarray(jnp.concatenate(out_ctrls))
 
-    got = np.asarray(
-        expand_tail_planes_pallas(
-            state,
-            ctrl,
-            jnp.stack(cwp_kg),
-            jnp.stack(cwl_kg),
-            jnp.stack(cwr_kg),
-            vc_kg,
-            tile_lanes=tile,
-            interpret=True,
-        )
+    got_v, got_c = expand_tail_planes_pallas(
+        state,
+        ctrl,
+        jnp.stack(cwp_kg),
+        jnp.stack(cwl_kg),
+        jnp.stack(cwr_kg),
+        vc_kg,
+        tile_lanes=tile,
+        interpret=True,
     )
-    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(np.asarray(got_v), want)
+    np.testing.assert_array_equal(np.asarray(got_c), want_ctrl)
 
 
 def test_serving_expansion_with_tail_kernel(monkeypatch):
@@ -482,3 +484,59 @@ def test_serving_expansion_with_tail_kernel(monkeypatch):
         num_blocks=num_blocks, force_planes=True,
     ))
     np.testing.assert_array_equal(got, want)
+
+
+def test_hierarchical_expansion_with_tail_kernel(monkeypatch):
+    """Full-domain evaluate_next in tail mode (fused last levels + leaf
+    hash per subtree tile, interpret mode) matches the limb program —
+    exercising the tiled exit permutation with shared correction words
+    (kg=1 planes) and the kernel's control-bit output."""
+    import functools
+
+    from distributed_point_functions_tpu import dpf as dpf_mod
+    from distributed_point_functions_tpu.dpf import (
+        DistributedPointFunction,
+        DpfParameters,
+    )
+    from distributed_point_functions_tpu.ops import (
+        expand_planes_pallas as epp,
+    )
+    from distributed_point_functions_tpu.value_types import IntType
+
+    monkeypatch.setenv("DPF_TPU_EXPAND_LEVELS", "limb")
+    params = DpfParameters(log_domain_size=11, value_type=IntType(64))
+    d = DistributedPointFunction.create(params)
+    k0, k1 = d.generate_keys(1234, 55)
+
+    def run_both():
+        outs = []
+        for k in (k0, k1):
+            ctx = d.create_evaluation_context(k)
+            outs.append(np.asarray(d.evaluate_next([], ctx)))
+        return outs
+
+    want = run_both()
+
+    monkeypatch.setenv("DPF_TPU_EXPAND_LEVELS", "planes")
+    monkeypatch.setenv("DPF_TPU_LEVEL_KERNEL", "tail")
+    monkeypatch.setenv("DPF_TPU_TAIL_LEVELS", "3")
+    monkeypatch.setenv("DPF_TPU_TAIL_TILE_LANES", "16")
+    for name in ("expand_level_planes_pallas", "value_hash_planes_pallas",
+                 "expand_tail_planes_pallas"):
+        monkeypatch.setattr(
+            epp, name, functools.partial(getattr(epp, name), interpret=True)
+        )
+    dpf_mod._expand_levels_planes_fn.cache_clear()
+    with warnings.catch_warnings():
+        # The tail path must actually serve (no silent XLA fallback).
+        warnings.simplefilter("error")
+        got = run_both()
+    dpf_mod._expand_levels_planes_fn.cache_clear()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(g, w)
+
+    def u64(x):
+        return (int(x[1]) << 32) | int(x[0])
+
+    total = (u64(want[0][1234]) + u64(want[1][1234])) % (1 << 64)
+    assert total == 55
