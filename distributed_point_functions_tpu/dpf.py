@@ -543,7 +543,9 @@ def _expand_levels_fn(num_levels: int, hash_leaves: bool = False):
     if not mode:
         return _expand_levels_planes_fn(num_levels,
                                         hash_leaves=hash_leaves)
-    if mode == "tail":
+    if mode == "tail" and hash_leaves:
+        # Knobs only enter the cache key when the tail can actually run
+        # (hash_leaves), so no-tail programs aren't re-traced per tuple.
         from .pir.dense_eval_planes import (
             _tail_levels_requested,
             _tail_tile_target,
@@ -563,11 +565,29 @@ def _expand_levels_fn(num_levels: int, hash_leaves: bool = False):
 
         try:
             return fast(*args)
-        except Exception as e:  # noqa: BLE001 - fall back to XLA level
+        except Exception as e:  # noqa: BLE001 - degrade, don't die
             if _os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto") in (
                 "pallas", "tail"
             ):
                 raise
+            if tail_req:
+                # A tail-only failure (e.g. Mosaic rejecting the fused
+                # tail at a big serving shape after the small self-check
+                # passed) degrades to the healthy per-level kernels, not
+                # all the way to XLA.
+                _dep._TAIL_KERNEL_FAILED = True
+                _warnings.warn(
+                    "fused tail kernel failed in hierarchical expansion; "
+                    "retrying with the per-level kernels "
+                    f"({str(e).splitlines()[0][:200]})"
+                )
+                try:
+                    return _expand_levels_planes_fn(
+                        num_levels, level_kernel=True,
+                        hash_leaves=hash_leaves,
+                    )(*args)
+                except Exception as e2:  # noqa: BLE001
+                    e = e2
             _dep._remember_level_kernel_failure()
             _warnings.warn(
                 "pallas level kernel failed in hierarchical expansion; "

@@ -404,7 +404,7 @@ def expand_tail_planes_pallas(
     vc_kg: jnp.ndarray,
     tile_lanes: int,
     interpret: bool = False,
-) -> jnp.ndarray:
+) -> tuple:
     """Fused tail: the last `r` expansion levels + the leaf value hash,
     one kernel launch per entry tile (grid-(1,) each; multi-step lane
     grids crash tpu_compile_helper on v5e).

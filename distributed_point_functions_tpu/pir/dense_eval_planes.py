@@ -186,6 +186,9 @@ def evaluate_selection_blocks_planes(
         if mode == "tail" and not bitrev_leaves:
             kg = padded // 32
             tail_levels, tile_nodes = _tail_split(kg, expand_levels)
+        forced = os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto") in (
+            "pallas", "tail"
+        )
         try:
             return _evaluate_selection_blocks_planes_jit(
                 seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
@@ -197,11 +200,30 @@ def evaluate_selection_blocks_planes(
                 tail_levels=tail_levels,
                 tail_tile_nodes=tile_nodes,
             )
-        except Exception as e:  # noqa: BLE001 - fall back to the XLA level
-            if os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto") in (
-                "pallas", "tail"
-            ):
+        except Exception as e:  # noqa: BLE001 - degrade, don't die
+            if forced:
                 raise
+            if tail_levels:
+                # A tail-only failure degrades to the per-level kernels.
+                global _TAIL_KERNEL_FAILED
+                _TAIL_KERNEL_FAILED = True
+                warnings.warn(
+                    "fused tail kernel failed at serving shape; retrying "
+                    "with the per-level kernels "
+                    f"({str(e).splitlines()[0][:200]})"
+                )
+                try:
+                    return _evaluate_selection_blocks_planes_jit(
+                        seeds0, control0, cw_seeds, cw_left, cw_right,
+                        last_vc,
+                        walk_levels=walk_levels,
+                        expand_levels=expand_levels,
+                        num_blocks=num_blocks,
+                        bitrev_leaves=bitrev_leaves,
+                        level_kernel=True,
+                    )
+                except Exception as e2:  # noqa: BLE001
+                    e = e2
             _remember_level_kernel_failure()
             warnings.warn(
                 "pallas level kernel failed; serving via the XLA level "
@@ -327,6 +349,82 @@ def _level_kernel_selfcheck() -> bool:
     return True
 
 
+_TAIL_KERNEL_VERIFIED = False
+_TAIL_KERNEL_FAILED = False
+
+
+def _tail_kernel_selfcheck() -> bool:
+    """One-time on-device bit-identity check of the fused tail kernel
+    (2 levels + value hash over 2 tiles) against the XLA twin. Separate
+    from `_level_kernel_selfcheck` so a tail-only failure degrades auto
+    mode to the per-level kernels instead of all the way to XLA."""
+    global _TAIL_KERNEL_VERIFIED, _TAIL_KERNEL_FAILED
+    if _TAIL_KERNEL_VERIFIED:
+        return True
+    if _TAIL_KERNEL_FAILED:
+        return False
+    import numpy as _np
+
+    rng = _np.random.default_rng(4321)
+    g0, nk, r, tile = 8, 64, 2, 4
+    state = jnp.asarray(
+        rng.integers(0, 1 << 32, (16, 8, g0), dtype=_np.uint32)
+    )
+    ctrl = jnp.asarray(rng.integers(0, 1 << 32, (g0,), dtype=_np.uint32))
+    cwp = [
+        pack_key_planes(jnp.asarray(
+            rng.integers(0, 1 << 32, (nk, 4), dtype=_np.uint32)
+        ))
+        for _ in range(r)
+    ]
+    cwl = [
+        pack_key_bits(jnp.asarray(
+            rng.integers(0, 2, (nk,), dtype=_np.uint32)
+        ))
+        for _ in range(r)
+    ]
+    cwr = [
+        pack_key_bits(jnp.asarray(
+            rng.integers(0, 2, (nk,), dtype=_np.uint32)
+        ))
+        for _ in range(r)
+    ]
+    vc = pack_key_planes(jnp.asarray(
+        rng.integers(0, 1 << 32, (nk, 4), dtype=_np.uint32)
+    ))
+    want_vs, want_cs = [], []
+    for lo in range(0, g0, tile):
+        s = state[:, :, lo:lo + tile]
+        c = ctrl[lo:lo + tile]
+        for i in range(r):
+            g2 = 2 * s.shape[-1]
+            s, c = expand_level_planes(
+                s, c, _tile_keys(cwp[i], g2), _tile_keys(cwl[i], g2 // 2),
+                _tile_keys(cwr[i], g2 // 2),
+            )
+        want_vs.append(
+            mmo_hash_planes(fixed_keys.RK_VALUE, s)
+            ^ (_tile_keys(vc, s.shape[-1]) & c[None, None, :])
+        )
+        want_cs.append(c)
+    got_v, got_c = expand_tail_planes_pallas(
+        state, ctrl, jnp.stack(cwp), jnp.stack(cwl), jnp.stack(cwr), vc,
+        tile_lanes=tile,
+    )
+    if not (
+        _np.array_equal(
+            _np.asarray(got_v),
+            _np.asarray(jnp.concatenate(want_vs, axis=-1)),
+        )
+        and _np.array_equal(
+            _np.asarray(got_c), _np.asarray(jnp.concatenate(want_cs))
+        )
+    ):
+        raise RuntimeError("tail kernel/XLA bit mismatch on this device")
+    _TAIL_KERNEL_VERIFIED = True
+    return True
+
+
 def level_kernel_status() -> dict:
     """Public observability snapshot for benches/captures: the serving
     mode knob and the one-time self-check flags."""
@@ -334,6 +432,8 @@ def level_kernel_status() -> dict:
         "mode": os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto"),
         "verified": _LEVEL_KERNEL_VERIFIED,
         "failed": _LEVEL_KERNEL_FAILED,
+        "tail_verified": _TAIL_KERNEL_VERIFIED,
+        "tail_failed": _TAIL_KERNEL_FAILED,
     }
 
 
@@ -416,9 +516,12 @@ def _level_kernel_enabled():
         # its jitted twins would be traced into the outer program and the
         # comparisons would explode on tracers. Report the last *eager*
         # verification result; never record a failure from this path.
-        return "pallas" if _LEVEL_KERNEL_VERIFIED else False
+        if not _LEVEL_KERNEL_VERIFIED:
+            return False
+        return "tail" if _TAIL_KERNEL_VERIFIED else "pallas"
     try:
-        return "pallas" if _level_kernel_selfcheck() else False
+        if not _level_kernel_selfcheck():
+            return False
     except Exception as e:  # noqa: BLE001 - never break serving
         _remember_level_kernel_failure()
         warnings.warn(
@@ -426,6 +529,20 @@ def _level_kernel_enabled():
             f"serving via the XLA levels ({str(e).splitlines()[0][:200]})"
         )
         return False
+    # Prefer the fused tail when it verifies on this device; a tail-only
+    # failure degrades to the per-level kernels, not to XLA.
+    try:
+        if _tail_kernel_selfcheck():
+            return "tail"
+    except Exception as e:  # noqa: BLE001 - never break serving
+        global _TAIL_KERNEL_FAILED
+        _TAIL_KERNEL_FAILED = True
+        warnings.warn(
+            "fused tail kernel failed its on-device self-check; "
+            f"serving via the per-level kernels "
+            f"({str(e).splitlines()[0][:200]})"
+        )
+    return "pallas"
 
 
 @functools.partial(

@@ -338,15 +338,36 @@ def test_level_kernel_selfcheck(monkeypatch):
     monkeypatch.setattr(dep.jax, "default_backend", lambda: "tpu")
     monkeypatch.setattr(dep, "_LEVEL_KERNEL_FAILED", False)
     monkeypatch.setattr(dep, "_LEVEL_KERNEL_VERIFIED", False)
+    monkeypatch.setattr(dep, "_TAIL_KERNEL_FAILED", False)
+    monkeypatch.setattr(dep, "_TAIL_KERNEL_VERIFIED", False)
 
-    # Interpret-mode kernels: the self-check passes and enables serving.
+    # Interpret-mode kernels: the self-checks pass and auto mode prefers
+    # the fused tail.
     for name in ("expand_level_planes_pallas", "value_hash_planes_pallas",
                  "path_level_planes_pallas"):
         monkeypatch.setattr(
             epp, name, functools.partial(getattr(epp, name), interpret=True)
         )
-    assert dep._level_kernel_enabled() == "pallas"
+    monkeypatch.setattr(
+        dep, "expand_tail_planes_pallas",
+        functools.partial(dep.expand_tail_planes_pallas, interpret=True),
+    )
+    assert dep._level_kernel_enabled() == "tail"
     assert dep._LEVEL_KERNEL_VERIFIED is True
+    assert dep._TAIL_KERNEL_VERIFIED is True
+
+    # A failing tail degrades auto mode to the per-level kernels only.
+    monkeypatch.setattr(dep, "_TAIL_KERNEL_VERIFIED", False)
+
+    def bad_tail(*a, **kw):
+        raise RuntimeError("tail exploded")
+
+    monkeypatch.setattr(dep, "expand_tail_planes_pallas", bad_tail)
+    with pytest.warns(UserWarning, match="tail kernel"):
+        assert dep._level_kernel_enabled() == "pallas"
+    assert dep._TAIL_KERNEL_FAILED is True
+    monkeypatch.setattr(dep, "_TAIL_KERNEL_FAILED", False)
+    monkeypatch.setattr(dep, "_TAIL_KERNEL_VERIFIED", False)
 
     # A kernel that returns garbage: self-check trips, failure remembered.
     monkeypatch.setattr(dep, "_LEVEL_KERNEL_VERIFIED", False)
