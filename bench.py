@@ -496,6 +496,21 @@ def main():
             return False
 
     _PROGRESS["stage"] = "pallas-check"
+    # Run the level-kernel self-checks EAGERLY before anything traces the
+    # expansion: inside jax.jit the check cannot run, and a fresh process
+    # would silently serve the XLA levels (this is why the r02 headline
+    # never engaged the fused kernels despite auto mode).
+    try:
+        from distributed_point_functions_tpu.pir import (
+            dense_eval_planes as _dep,
+        )
+
+        _log(f"level kernels: eager mode={_dep.warm_level_kernels()!r}")
+    except Exception as e:  # noqa: BLE001 - observability only
+        _log(
+            "level-kernel warmup failed: "
+            f"{(str(e).splitlines() or ['<no message>'])[0]}"
+        )
     no_pallas = os.environ.get("BENCH_NO_PALLAS", "") == "1"
     use_pallas2 = (
         not no_pallas

@@ -65,37 +65,39 @@ def main():
     rng = np.random.default_rng(11)
     num_words = record_bytes // 4
 
-    # Every candidate pins tile_queries explicitly: the kernel clamps
-    # tq=min(tile_queries, nq, vmem cap), so labels always state the
-    # requested tile (tq variants only differ once BENCH_QUERIES exceeds
-    # them — the sweep pairs with BENCH_QUERIES=256 runs).
-    candidates = {
-        "v1": xor_inner_product_pallas_staged,
-        "v2_bf16_tg32_j8_tq64": functools.partial(
-            xor_inner_product_pallas2_staged, int8=False, tile_queries=64
-        ),
-        "v2_int8_tg32_j8_tq64": functools.partial(
-            xor_inner_product_pallas2_staged, int8=True, tile_queries=64
-        ),
-        "v2_int8_tg32_j32_tq64": functools.partial(
-            xor_inner_product_pallas2_staged, int8=True, j_chunk=32,
-            tile_queries=64,
-        ),
-        "v2_int8_tg64_j8_tq64": functools.partial(
-            xor_inner_product_pallas2_staged, int8=True, tile_groups=64,
-            tile_queries=64,
-        ),
-        "v2_int8_tg16_j8_tq64": functools.partial(
-            xor_inner_product_pallas2_staged, int8=True, tile_groups=16,
-            tile_queries=64,
-        ),
-        "v2_int8_tg32_j8_tq128": functools.partial(
-            xor_inner_product_pallas2_staged, int8=True, tile_queries=128
-        ),
-        "v2_int8_tg32_j8_tq256": functools.partial(
-            xor_inner_product_pallas2_staged, int8=True, tile_queries=256
-        ),
-    }
+    # Every candidate pins ALL three tile knobs explicitly. (The r02
+    # sweep's labels named defaults that were never passed — rows tagged
+    # tg32_j8 actually ran tg128_j32, so two rows were the same config
+    # measured twice, 2.7 vs 3.4 ms: that's the noise band. Hence
+    # min-of-3 reps per candidate now, and honest labels.) The kernel
+    # clamps tq = min(tile_queries, nq, vmem cap).
+    candidates = {"v1": xor_inner_product_pallas_staged}
+    seen_effective = set()
+    for tg in (32, 64, 128):
+        for jc in (8, 32):
+            for tq in (64, 128):
+                # The kernel clamps tq = min(tile_queries, nq, VMEM
+                # cap): distinct requested tiles can collapse to one
+                # effective config (the r02 duplicate-label bug) —
+                # dedupe on the effective tuple (cap formula mirrors
+                # _ip_pallas_staged_v2) so every row is a distinct
+                # kernel.
+                tq_cap = max(8, (2 << 20) // (32 * num_words * 4) // 8 * 8)
+                eff_tq = min(tq, nq, tq_cap)
+                eff = (tg, jc, eff_tq)
+                if eff in seen_effective:
+                    continue
+                seen_effective.add(eff)
+                candidates[f"v2_int8_tg{tg}_j{jc}_tq{eff_tq}"] = (
+                    functools.partial(
+                        xor_inner_product_pallas2_staged, int8=True,
+                        tile_groups=tg, j_chunk=jc, tile_queries=tq,
+                    )
+                )
+    candidates["v2_bf16_tg64_j32_tq64"] = functools.partial(
+        xor_inner_product_pallas2_staged, int8=False, tile_groups=64,
+        j_chunk=32, tile_queries=64,
+    )
 
     # Small-instance verification vs the jnp XOR path.
     sdb = jax.device_put(

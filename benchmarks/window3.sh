@@ -63,6 +63,12 @@ for q in 64 256 512; do
     commit_stage q$q $rc
 done
 
+echo "=== 3b. inner-product tile matrix (honest labels, min-of-3) ==="
+timeout 1800 python benchmarks/ip_ab.py \
+    2>benchmarks/results/ip_ab_${stamp}.log \
+    | tee benchmarks/results/ip_ab_${stamp}.json
+commit_stage ip_ab $?
+
 echo "=== 4. ns/leaf at log-domain 20 and 24 ==="
 for ld in 20 24; do
     timeout 1500 env BENCH_ONLY_NSLEAF=1 BENCH_NSLEAF_LD=$ld \

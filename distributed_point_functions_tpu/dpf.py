@@ -198,6 +198,13 @@ def _build_fused_accumulate(plan, vt, blocks_needed):
         else:
             break
 
+    # The walk below runs inside this program's jit trace, where the
+    # level kernels' on-device self-check cannot run; warm eagerly so the
+    # traced walk serves the verified Pallas kernels.
+    from .pir.dense_eval_planes import warm_level_kernels
+
+    warm_level_kernels()
+
     def level_values(seeds, control, parties, vc, blk, bn):
         values = _leaf_stage_at(seeds, control, vc, blk, vt, bn, -1)
         return vt.dev_where(parties != 0, vt.dev_neg(values), values)

@@ -279,13 +279,28 @@ def _ip_kernel_v2(sel_ref, db_ref, out_ref, *, j_chunk: int, int8: bool):
     lhs = to_mm((sel_rep >> b_iota) & U32(1))
 
     dbw = db_ref[:].reshape(tr, w)  # b-major record rows
-    # j_chunk=1 means no repeat at all — the entry point drops to 1 for
-    # narrow records (W<16), where Mosaic's repeat miscompiles.
-    db_rep = (
-        dbw if j_chunk == 1 else pltpu.repeat(dbw, j_chunk, axis=1)
-    )
+    # j_chunk=1 repeats by factor 1 — expected to lower as an identity,
+    # sidestepping Mosaic's narrow-source repeat miscompile (the entry
+    # point drops to 1 for W<16 records; whether a factor-1 repeat on a
+    # narrow source is really legal is UNPROBED on hardware —
+    # benchmarks/kernel_smoke.py's W=8 case answers it, and the serving
+    # tier chain degrades to the v1 kernel if it crashes). The repeat
+    # also launders shard_map's varying-axes metadata exactly like the
+    # multi-factor path: a direct ref read would carry the mesh axis and
+    # mismatch the unvarying iotas and constants throughout the kernel
+    # (the VMA checker runs at trace time on any backend; the declared
+    # out_shape vma covers the result).
+    db_rep = pltpu.repeat(dbw, j_chunk, axis=1)
     acc_t = I32 if int8 else F32
     for jc in range(0, 32, j_chunk):
+        if j_chunk == 1:
+            # Narrow records: shift by the chunk's constant bit index.
+            rhs = to_mm((db_rep >> U32(jc)) & U32(1))
+            out_ref[:, jc * w : (jc + 1) * w] += lax.dot_general(
+                lhs, rhs, (((1,), (0,)), ((), ())),
+                preferred_element_type=acc_t,
+            )
+            continue
         j_iota = (
             lax.broadcasted_iota(U32, (tr, j_chunk * w), 1) // U32(w)
         ) + U32(jc)

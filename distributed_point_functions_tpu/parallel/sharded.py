@@ -198,6 +198,13 @@ def sharded_dense_pir_step_multi(
             f"{real_num_blocks} real record blocks; only mesh-padding "
             "blocks may lie beyond the tree"
         )
+    # The expansion below runs inside a shard_map trace, where the level
+    # kernels' on-device self-check cannot run; warm it here, eagerly, so
+    # the traced expansion serves the verified Pallas kernels instead of
+    # silently dropping to the XLA levels.
+    from ..pir.dense_eval_planes import warm_level_kernels
+
+    warm_level_kernels()
 
     def step(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
              *db_shards):
@@ -373,6 +380,13 @@ def sharded_dense_pir_step_mxu(
             f"{real_num_blocks} real record blocks; only mesh-padding "
             "blocks may lie beyond the tree"
         )
+    # The expansion below runs inside a shard_map trace, where the level
+    # kernels' on-device self-check cannot run; warm it here, eagerly, so
+    # the traced expansion serves the verified Pallas kernels instead of
+    # silently dropping to the XLA levels.
+    from ..pir.dense_eval_planes import warm_level_kernels
+
+    warm_level_kernels()
 
     def step(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
              *db_shards):

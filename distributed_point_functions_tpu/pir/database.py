@@ -41,6 +41,35 @@ from ..ops.inner_product_pallas import (
 )
 
 
+def _v2_tile_knobs() -> dict:
+    """Serving-time tile overrides for the v2 MXU kernel
+    (DPF_TPU_IP_TQ / DPF_TPU_IP_TG / DPF_TPU_IP_JC), so a capture window
+    can A/B the serving path's own tiles without code edits. Unset,
+    malformed, or invalid values keep the kernel defaults — a bad knob
+    must not knock the pallas2 tier out of serving for the process."""
+    knobs = {}
+    for env, key, valid in (
+        ("DPF_TPU_IP_TQ", "tile_queries", lambda v: v > 0),
+        ("DPF_TPU_IP_TG", "tile_groups", lambda v: v > 0),
+        ("DPF_TPU_IP_JC", "j_chunk", lambda v: v > 0 and 32 % v == 0),
+    ):
+        raw = os.environ.get(env, "")
+        if not raw:
+            continue
+        try:
+            val = int(raw)
+        except ValueError:
+            val = None
+        if val is None or not valid(val):
+            warnings.warn(
+                f"{env}={raw!r} is not a valid {key}; keeping the "
+                "kernel default"
+            )
+            continue
+        knobs[key] = val
+    return knobs
+
+
 def words_to_record_bytes(
     out: np.ndarray, num_keys: int, size: int
 ) -> List[bytes]:
@@ -177,7 +206,7 @@ class DenseDpfPirDatabase:
             try:
                 if tier == "pallas2":
                     return xor_inner_product_pallas2_staged(
-                        self._staged_perm(), selections
+                        self._staged_perm(), selections, **_v2_tile_knobs()
                     )
                 if tier == "pallas":
                     return xor_inner_product_pallas_staged(

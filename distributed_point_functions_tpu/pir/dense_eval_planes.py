@@ -251,6 +251,7 @@ def _trace_state_clean() -> bool:
 
 
 _LEVEL_KERNEL_FAILED = False
+_WARNED_TRACED_UNVERIFIED = False
 
 
 def _remember_level_kernel_failure() -> None:
@@ -425,6 +426,18 @@ def _tail_kernel_selfcheck() -> bool:
     return True
 
 
+def warm_level_kernels():
+    """Eagerly run the kernel self-checks (and return the serving mode).
+
+    `_level_kernel_enabled` cannot self-check while an outer jit/shard_map
+    trace is active — it then reports the last *eager* verification, which
+    on a fresh process is "nothing verified" and silently serves the XLA
+    levels. Callers that trace the expansion into a bigger program
+    (bench.py's fused step, the sharded mesh step) call this once, from
+    eager context, before building the traced program."""
+    return _level_kernel_enabled()
+
+
 def level_kernel_status() -> dict:
     """Public observability snapshot for benches/captures: the serving
     mode knob and the one-time self-check flags."""
@@ -516,7 +529,19 @@ def _level_kernel_enabled():
         # its jitted twins would be traced into the outer program and the
         # comparisons would explode on tracers. Report the last *eager*
         # verification result; never record a failure from this path.
+        # Forgetting to warm is a silent perf cliff (the r02 headline
+        # served XLA levels this way), so make it loud exactly once.
         if not _LEVEL_KERNEL_VERIFIED:
+            global _WARNED_TRACED_UNVERIFIED
+            if not _WARNED_TRACED_UNVERIFIED:
+                _WARNED_TRACED_UNVERIFIED = True
+                warnings.warn(
+                    "expansion traced before warm_level_kernels(): the "
+                    "Pallas level kernels are unverified in this process "
+                    "and this program will serve the XLA levels — call "
+                    "dense_eval_planes.warm_level_kernels() from eager "
+                    "context before building traced programs"
+                )
             return False
         return "tail" if _TAIL_KERNEL_VERIFIED else "pallas"
     try:
