@@ -13,6 +13,24 @@ stamp=$(date +%Y%m%d_%H%M%S)
 rcs=""
 fail=0
 
+# Stage guard: with TPU_WATCH_DEADLINE exported (epoch secs, the round
+# driver's bench time), refuse to START a stage whose nominal timeout
+# could still be running inside the driver's 45-min margin — the tunnel
+# serves one client and a late capture must not contend with the
+# round's own bench.
+stage_fits() {
+    # stage_fits <nominal_timeout_secs>
+    local deadline=${TPU_WATCH_DEADLINE:-0}
+    [ "$deadline" -le 0 ] && return 0
+    local now margin=2700
+    now=$(date +%s)
+    if [ $((now + $1)) -ge $((deadline - margin)) ]; then
+        echo "deadline margin: skipping remaining stages" >&2
+        return 1
+    fi
+    return 0
+}
+
 commit_stage() {
     # commit_stage <name> <rc>; commits ONLY the results pathspec so a
     # pre-staged unrelated change can't be swept into a capture commit.
@@ -23,6 +41,17 @@ commit_stage() {
         -- benchmarks/results >/dev/null 2>&1 || true
 }
 
+finish() {
+    # Always land the summary commit, whether the queue completed or a
+    # deadline guard cut it short; the per-stage rc list tells which.
+    echo "window3 done (${stamp}): $rcs (fail=$fail)"
+    git add benchmarks/results >/dev/null 2>&1
+    git commit -q -m "TPU window3 capture (${stamp}): $rcs" \
+        -- benchmarks/results >/dev/null 2>&1 || true
+    exit $fail
+}
+
+stage_fits 1000 || finish
 echo "=== 1. headline (planes single-config, q128) ==="
 timeout 1000 env BENCH_ITERS=16 BENCH_INIT_BUDGET=90 BENCH_TIMEOUT=900 \
     BENCH_XPROF=benchmarks/results/xprof_${stamp} python bench.py \
@@ -32,6 +61,7 @@ commit_stage headline $?
 
 echo "=== 2. level-kernel A/B (fused tail vs per-level pallas vs XLA) ==="
 for lk in tail pallas xla; do
+    stage_fits 1500 || finish
     timeout 1500 env DPF_TPU_LEVEL_KERNEL=$lk BENCH_ITERS=8 \
         BENCH_INIT_BUDGET=90 BENCH_TIMEOUT=1400 python bench.py \
         2>benchmarks/results/bench_lk_${lk}_${stamp}.log \
@@ -41,6 +71,7 @@ for lk in tail pallas xla; do
     commit_stage lk_$lk $rc
 done
 
+stage_fits 2400 || finish
 echo "=== 2b. level/tail kernel shape probe ==="
 timeout 2400 python benchmarks/level_kernel_probe.py \
     2>benchmarks/results/level_probe_${stamp}.log \
@@ -49,6 +80,7 @@ commit_stage level_probe $?
 
 echo "=== 3. batch sweep (q64..q512; both expansions at q256 cliff) ==="
 for q in 64 256 512; do
+    stage_fits 1200 || finish
     mode=planes
     [ "$q" = 256 ] && mode=both
     rm -f benchmarks/results/bench_extra.json
@@ -63,12 +95,14 @@ for q in 64 256 512; do
     commit_stage q$q $rc
 done
 
+stage_fits 1800 || finish
 echo "=== 3b. inner-product tile matrix (honest labels, min-of-3) ==="
 timeout 1800 python benchmarks/ip_ab.py \
     2>benchmarks/results/ip_ab_${stamp}.log \
     | tee benchmarks/results/ip_ab_${stamp}.json
 commit_stage ip_ab $?
 
+stage_fits 3000 || finish
 echo "=== 4. ns/leaf at log-domain 20 and 24 ==="
 for ld in 20 24; do
     timeout 1500 env BENCH_ONLY_NSLEAF=1 BENCH_NSLEAF_LD=$ld \
@@ -78,48 +112,51 @@ for ld in 20 24; do
     commit_stage nsleaf_ld$ld $?
 done
 
+stage_fits 3600 || finish
 echo "=== 5. DCF/MIC reference sweeps on TPU ==="
 timeout 3600 python benchmarks/run_benchmarks.py --suite dcf,mic --big \
     2>benchmarks/results/dcf_mic_tpu_${stamp}.log \
     | tee benchmarks/results/dcf_mic_tpu_${stamp}.jsonl
 commit_stage dcf_mic $?
 
+stage_fits 3600 || finish
 echo "=== 6. sparse PIR re-capture (native builder + batched queries) ==="
 timeout 3600 python benchmarks/baseline_suite.py --scale full \
     --suite sparse_big \
     2>&1 | tee benchmarks/results/sparse_big_${stamp}.json
 commit_stage sparse_big $?
 
+stage_fits 2700 || finish
 echo "=== 7. synthetic hierarchical (reference experiments configs) ==="
 timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
     --log_domain_size 32 --log_num_nonzeros 20 --num_iterations 3 \
     2>&1 | tee benchmarks/results/synthetic_${stamp}.json
 commit_stage synthetic32 $?
+stage_fits 2700 || finish
 timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
     --log_domain_size 32 --log_num_nonzeros 20 --only_nonzeros \
     --num_iterations 3 \
     2>&1 | tee benchmarks/results/only_nonzeros_${stamp}.json
 commit_stage direct32 $?
+stage_fits 3600 || finish
 timeout 3600 python benchmarks/synthetic_data_benchmarks.py \
     --log_domain_size 128 --log_num_nonzeros 20 --num_iterations 2 \
     2>&1 | tee benchmarks/results/synthetic128_${stamp}.json
 commit_stage synthetic128 $?
 
+stage_fits 3600 || finish
 echo "=== 8. remaining sweeps (dpf/inner_product/int_mod_n) ==="
 timeout 3600 python benchmarks/run_benchmarks.py \
     --suite dpf,inner_product,int_mod_n --big \
     2>&1 | tee benchmarks/results/sweeps_${stamp}.json
 commit_stage sweeps $?
 
+stage_fits 1800 || finish
 echo "=== 9. kernel smoke (shape envelope) ==="
 timeout 1800 python benchmarks/kernel_smoke.py \
     2>benchmarks/results/kernel_smoke_${stamp}.log \
     | tee benchmarks/results/kernel_smoke_${stamp}.json
 commit_stage kernel_smoke $?
 
-echo "window3 done (${stamp}): $rcs (fail=$fail)"
-git add benchmarks/results >/dev/null 2>&1
-git commit -q -m "TPU window3 capture complete (${stamp}): $rcs" \
-    -- benchmarks/results >/dev/null 2>&1 || true
 # Nonzero when any stage failed so tpu_watch keeps re-polling the window.
-exit $fail
+finish
