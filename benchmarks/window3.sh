@@ -59,16 +59,47 @@ timeout 1000 env BENCH_ITERS=16 BENCH_INIT_BUDGET=90 BENCH_TIMEOUT=900 \
     | tee benchmarks/results/bench_q128_${stamp}.json
 commit_stage headline $?
 
-echo "=== 2. level-kernel A/B (fused tail vs per-level pallas vs XLA) ==="
-for lk in tail pallas xla; do
-    stage_fits 1500 || finish
-    timeout 1500 env DPF_TPU_LEVEL_KERNEL=$lk BENCH_ITERS=8 \
-        BENCH_INIT_BUDGET=90 BENCH_TIMEOUT=1400 python bench.py \
-        2>benchmarks/results/bench_lk_${lk}_${stamp}.log \
-        | tee benchmarks/results/bench_lk_${lk}_${stamp}.json
+# Stage 1 doubles as the driver-cache warmer: it compiles the exact
+# driver-config programs into ~/.cache/jax_bench (same shapes, same
+# cache dir), so the driver's own run hits warm compiles. Stage 1b then
+# measures what a truly COLD driver run would cost, against a throwaway
+# cache, so BENCH_TIMEOUT is set from data instead of hope (VERDICT r03
+# weak #6). Low priority order cost: one extra headline run.
+# Skipped (not finish) when it doesn't fit: this stage is lower
+# priority than the A/B legs after it, which may still fit.
+if stage_fits 2100; then
+    echo "=== 1b. cold-path wall clock (fresh compile cache) ==="
+    cold_cache=$(mktemp -d)
+    cold_t0=$(date +%s)
+    timeout 2000 env BENCH_CACHE_DIR="$cold_cache" BENCH_ITERS=8 \
+        BENCH_INIT_BUDGET=90 BENCH_TIMEOUT=1900 python bench.py \
+        2>benchmarks/results/bench_cold_${stamp}.log \
+        | tee benchmarks/results/bench_cold_${stamp}.json
     rc=$?
-    tail -4 benchmarks/results/bench_lk_${lk}_${stamp}.log
-    commit_stage lk_$lk $rc
+    cold_secs=$(( $(date +%s) - cold_t0 ))
+    rm -rf "$cold_cache"
+    echo "{\"cold_path_wall_secs\": ${cold_secs}, \"rc\": ${rc}}" \
+        | tee benchmarks/results/cold_path_${stamp}.json
+    commit_stage cold_path $rc
+fi
+
+echo "=== 2. level-kernel A/B (head+tail / tail / pallas / XLA) ==="
+# Explicit head counts: forced DPF_TPU_LEVEL_KERNEL legs skip the
+# self-checks, so the auto head would silently stay off. 9 levels fills
+# the 2048-lane cap at the headline kg=4.
+for leg in "tailhead tail 9" "tail tail 0" "pallas pallas 0" \
+           "xla xla 0"; do
+    set -- $leg
+    name=$1; lk=$2; head=$3
+    stage_fits 1500 || finish
+    timeout 1500 env DPF_TPU_LEVEL_KERNEL=$lk DPF_TPU_HEAD_LEVELS=$head \
+        BENCH_ITERS=8 \
+        BENCH_INIT_BUDGET=90 BENCH_TIMEOUT=1400 python bench.py \
+        2>benchmarks/results/bench_lk_${name}_${stamp}.log \
+        | tee benchmarks/results/bench_lk_${name}_${stamp}.json
+    rc=$?
+    tail -4 benchmarks/results/bench_lk_${name}_${stamp}.log
+    commit_stage lk_$name $rc
 done
 
 stage_fits 2400 || finish

@@ -5,7 +5,8 @@
 #   1. lint        — stdlib AST lint (tools/lint.py)
 #   2. protos      — generated *_pb2.py match protos/*.proto
 #   3. native      — C++ oracle kernels build (g++)
-#   4. test-fast   — <3 min hermetic signal tier
+#   4. test-fast   — <5 min hermetic signal tier (incl. tiny-shape
+#                    interpret cases of every serving Pallas kernel)
 #   5. dryrun      — 8-virtual-device multichip compile+step
 # Benchmarks are excluded exactly as the reference excludes
 # `--test_tag_filters=-benchmark`. `FULL=1` appends the whole suite.

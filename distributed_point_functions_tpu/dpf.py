@@ -378,7 +378,9 @@ def _expand_levels_limb_fn(num_levels: int, hash_leaves: bool = False):
 def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False,
                              hash_leaves: bool = False,
                              tail_req: int = 0,
-                             tail_tile_target: int = 0):
+                             tail_tile_target: int = 0,
+                             head_req: int = 0,
+                             head_cap: int = 0):
     """`_expand_levels_limb_fn` computed in bitsliced plane layout (see
     `pir/dense_eval_planes.py` for the design): children are appended
     [all-left; all-right] per level so the lane order ends up
@@ -452,7 +454,41 @@ def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False,
                 requested_levels=tail_req,
                 target_lanes=tail_tile_target,
             )
-        for i in range(limb_levels, num_levels - tail_r):
+        # Fused head (first plane levels in one launch over the narrow
+        # width): head_req/head_cap arrive as dispatch-time cache keys
+        # like the tail knobs, so the trace never bakes stale env state.
+        head_r = 0
+        if head_req and level_kernel and plane_levels - tail_r > 0:
+            avail = plane_levels - tail_r
+            if head_req > 0:
+                head_r = min(head_req, avail)
+            else:
+                from .pir.dense_eval_planes import _auto_head_count
+
+                head_r = _auto_head_count(head_cap, n32 // 32, avail)
+        if head_r:
+            from .ops.expand_planes_pallas import (
+                expand_head_planes_pallas,
+            )
+
+            h0 = limb_levels
+            state, ctrl = expand_head_planes_pallas(
+                state,
+                ctrl,
+                jnp.stack(
+                    [broadcast_cw_planes(cw_seeds[h0 + j])
+                     for j in range(head_r)]
+                ),
+                jnp.stack(
+                    [(U32(0) - (cw_left[h0 + j] & U32(1)))[None]
+                     for j in range(head_r)]
+                ),
+                jnp.stack(
+                    [(U32(0) - (cw_right[h0 + j] & U32(1)))[None]
+                     for j in range(head_r)]
+                ),
+            )
+        for i in range(limb_levels + head_r, num_levels - tail_r):
             if level_kernel:
                 state, ctrl = expand_level_planes_pallas(
                     state,
@@ -561,10 +597,25 @@ def _expand_levels_fn(num_levels: int, hash_leaves: bool = False):
         tail_req, tail_tile = _tail_levels_requested(), _tail_tile_target()
     else:
         tail_req, tail_tile = 0, 0
+    # Head knobs, resolved at dispatch time (env + self-check state are
+    # process state; the jitted program only sees the cache keys).
+    raw_head = os.environ.get("DPF_TPU_HEAD_LEVELS", "auto")
+    head_cap = _dep._head_max_lanes()
+    if raw_head != "auto":
+        try:
+            head_req = max(0, int(raw_head))
+        except ValueError:
+            head_req = 0
+    elif _dep._HEAD_KERNEL_VERIFIED and not _dep._HEAD_KERNEL_FAILED:
+        head_req = -1  # auto: fill to head_cap lanes
+    else:
+        head_req = 0
     fast = _expand_levels_planes_fn(num_levels, level_kernel=True,
                                     hash_leaves=hash_leaves,
                                     tail_req=tail_req,
-                                    tail_tile_target=tail_tile)
+                                    tail_tile_target=tail_tile,
+                                    head_req=head_req,
+                                    head_cap=head_cap)
 
     def run_with_fallback(*args):
         import os as _os
@@ -577,24 +628,52 @@ def _expand_levels_fn(num_levels: int, hash_leaves: bool = False):
                 "pallas", "tail"
             ):
                 raise
-            if tail_req:
-                # A tail-only failure (e.g. Mosaic rejecting the fused
-                # tail at a big serving shape after the small self-check
-                # passed) degrades to the healthy per-level kernels, not
-                # all the way to XLA.
-                _dep._TAIL_KERNEL_FAILED = True
-                _warnings.warn(
-                    "fused tail kernel failed in hierarchical expansion; "
-                    "retrying with the per-level kernels "
-                    f"({str(e).splitlines()[0][:200]})"
-                )
+            if head_req:
+                # Retry without the head, keeping the tail/per-level
+                # kernels. The head is demoted ONLY when the retry
+                # succeeds: head_req may be set while the traced program
+                # resolved the actual head to 0 levels (short segments,
+                # cap below two doublings), and a tail failure there
+                # must not burn the healthy head's process-wide flag.
                 try:
-                    return _expand_levels_planes_fn(
+                    out = _expand_levels_planes_fn(
+                        num_levels, level_kernel=True,
+                        hash_leaves=hash_leaves,
+                        tail_req=tail_req,
+                        tail_tile_target=tail_tile,
+                    )(*args)
+                except Exception as e2:  # noqa: BLE001
+                    e = e2
+                else:
+                    _dep._HEAD_KERNEL_FAILED = True
+                    _warnings.warn(
+                        "fused head kernel failed in hierarchical "
+                        "expansion; serving without it "
+                        f"({str(e).splitlines()[0][:200]})"
+                    )
+                    return out
+            if tail_req:
+                # A tail failure (e.g. Mosaic rejecting the fused tail
+                # at a big serving shape after the small self-check
+                # passed) degrades to the healthy per-level kernels, not
+                # all the way to XLA; demoted only when that retry
+                # succeeds (a shared failure falls through to the
+                # level-kernel demotion below).
+                try:
+                    out = _expand_levels_planes_fn(
                         num_levels, level_kernel=True,
                         hash_leaves=hash_leaves,
                     )(*args)
                 except Exception as e2:  # noqa: BLE001
                     e = e2
+                else:
+                    _dep._TAIL_KERNEL_FAILED = True
+                    _warnings.warn(
+                        "fused tail kernel failed in hierarchical "
+                        "expansion; serving with the per-level kernels "
+                        f"({str(e).splitlines()[0][:200]})"
+                    )
+                    return out
             _dep._remember_level_kernel_failure()
             _warnings.warn(
                 "pallas level kernel failed in hierarchical expansion; "

@@ -369,6 +369,107 @@ def _tail_kernel(
     outc_ref[:] = ctrl[None, :]
 
 
+def _head_kernel(
+    state_ref,
+    ctrl_ref,
+    cwp_ref,
+    cwl_ref,
+    cwr_ref,
+    masks_lr_ref,
+    out_ref,
+    outc_ref,
+    *,
+    kg: int,
+    r: int,
+):
+    """Expand the whole (narrow) entry width through the FIRST `r`
+    levels in one launch: one HBM read of the [16, 8, G0] entry planes,
+    one HBM write of the [16, 8, G0 << r] result.
+
+    The narrow early levels are pure overhead off-chip: at the headline
+    config they cost ~6 ms of XLA launches (or worse, per-level kernel
+    launches) for microseconds of gate work (expand_profile 2026-07-31,
+    levels 0-8). A single tile covers the full width, so the per-level
+    in-kernel [all-left; all-right] concatenation is exactly the global
+    level order — no exit permutation, unlike the tiled tail."""
+    state = state_ref[:]
+    ctrl = ctrl_ref[:][0]
+    masks = masks_lr_ref[:]
+    cwp_all = cwp_ref[:]
+    cwl_all = cwl_ref[:]
+    cwr_all = cwr_ref[:]
+    for i in range(r):
+        w = state.shape[-1]
+        sig = _sigma(state)
+        left = _aes_fixed_planes(masks[0], sig) ^ sig
+        right = _aes_fixed_planes(masks[1], sig) ^ sig
+        state = jnp.concatenate([left, right], axis=-1)
+        ctrl2 = jnp.concatenate([ctrl, ctrl])
+        cwp = pltpu.repeat(cwp_all[i], 2 * w // kg, axis=2)
+        state = state ^ (cwp & ctrl2[None, None, :])
+        t_new = state[0, 0]
+        state = _zero_lsb_plane(state)
+        cwl = pltpu.repeat(cwl_all[i][None, :], w // kg, axis=1)[0]
+        cwr = pltpu.repeat(cwr_all[i][None, :], w // kg, axis=1)[0]
+        ctrl = t_new ^ jnp.concatenate([ctrl & cwl, ctrl & cwr])
+    out_ref[:] = state
+    outc_ref[:] = ctrl[None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def expand_head_planes_pallas(
+    state: jnp.ndarray,
+    ctrl: jnp.ndarray,
+    cwp_head: jnp.ndarray,
+    cwl_head: jnp.ndarray,
+    cwr_head: jnp.ndarray,
+    interpret: bool = False,
+) -> tuple:
+    """Fused head: the FIRST `r` expansion levels in one grid-(1,)
+    launch over the full (narrow) width.
+
+    state: uint32[16, 8, G0] entry planes (G0 = key_groups at the top
+    of the expansion); ctrl: uint32[G0]; cwp_head: uint32[r, 16, 8, KG]
+    per-level seed-correction planes; cwl_head / cwr_head: uint32[r, KG]
+    per-level packed direction bits. Returns
+    (state uint32[16, 8, G0 << r], ctrl uint32[G0 << r]) bit-identical
+    to `r` successive `expand_level_planes` applications — single-tile,
+    so no exit permutation. The caller bounds G0 << r so the in-kernel
+    working set stays within VMEM (~16 MB/core)."""
+    _, _, g0 = state.shape
+    r = cwp_head.shape[0]
+    kg = cwp_head.shape[-1]
+    if g0 % kg:
+        raise ValueError(
+            f"entry lanes {g0} must be a multiple of key groups {kg}"
+        )
+    gf = g0 << r
+    out, outc = pl.pallas_call(
+        functools.partial(_head_kernel, kg=kg, r=r),
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((16, 8, g0), lambda l: (0, 0, 0)),
+            pl.BlockSpec((1, g0), lambda l: (0, 0)),
+            pl.BlockSpec((r, 16, 8, kg), lambda l: (0, 0, 0, 0)),
+            pl.BlockSpec((r, kg), lambda l: (0, 0)),
+            pl.BlockSpec((r, kg), lambda l: (0, 0)),
+            pl.BlockSpec((2, 11, 16, 8, 1), lambda l: (0, 0, 0, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((16, 8, gf), lambda l: (0, 0, 0)),
+            pl.BlockSpec((1, gf), lambda l: (0, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((16, 8, gf), U32),
+            jax.ShapeDtypeStruct((1, gf), U32),
+        ),
+        interpret=interpret,
+    )(
+        state, ctrl[None, :], cwp_head, cwl_head, cwr_head, _MASKS_LR
+    )
+    return out, outc[0]
+
+
 def tail_node_permutation(
     entry_order: np.ndarray, r: int, tile_nodes: int
 ) -> tuple[np.ndarray, np.ndarray]:

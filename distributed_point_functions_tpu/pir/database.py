@@ -139,6 +139,7 @@ class DenseDpfPirDatabase:
         self._db_words = None  # row-major device copy (jnp fallback path)
         self._db_perm = None  # bit-major layout, staged on first pallas use
         self._failed_tiers: set = set()
+        self._failed_knobs: set = set()  # v2 knob combos that crashed
 
     @property
     def size(self) -> int:
@@ -205,9 +206,33 @@ class DenseDpfPirDatabase:
                 continue
             try:
                 if tier == "pallas2":
-                    return xor_inner_product_pallas2_staged(
-                        self._staged_perm(), selections, **_v2_tile_knobs()
-                    )
+                    knobs = _v2_tile_knobs()
+                    knob_key = tuple(sorted(knobs.items()))
+                    if knob_key in self._failed_knobs:
+                        knobs, knob_key = {}, ()
+                    try:
+                        return xor_inner_product_pallas2_staged(
+                            self._staged_perm(), selections, **knobs
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        # The positivity pre-check above cannot know the
+                        # kernel's real tile floors/multiples; a
+                        # positive-but-unsupported knob (e.g. TG below the
+                        # 16-lane miscompile floor) must cost ONE retry
+                        # with defaults — remembered, so later batches go
+                        # straight to the defaults (a failed trace is not
+                        # cached by jit) — not the pallas2 tier itself.
+                        if not knobs:
+                            raise
+                        self._failed_knobs.add(knob_key)
+                        warnings.warn(
+                            "pallas2 failed with env tile knobs "
+                            f"{knobs}; retrying with kernel defaults "
+                            f"({str(e).splitlines()[0][:200]})"
+                        )
+                        return xor_inner_product_pallas2_staged(
+                            self._staged_perm(), selections
+                        )
                 if tier == "pallas":
                     return xor_inner_product_pallas_staged(
                         self._staged_perm(), selections

@@ -57,6 +57,7 @@ from ..ops.aes_bitslice import (
     sigma_planes,
 )
 from ..ops.expand_planes_pallas import (
+    expand_head_planes_pallas,
     expand_level_planes_pallas,
     expand_tail_planes_pallas,
     tail_node_permutation,
@@ -181,14 +182,18 @@ def evaluate_selection_blocks_planes(
     mode = _level_kernel_enabled()
     if mode:
         # Tail mode fuses the last levels + value hash per subtree tile
-        # (one kernel launch each); the per-level kernels cover the rest.
+        # (one kernel launch each); the fused head covers the narrow
+        # entry levels in one launch; the per-level kernels (if any
+        # levels remain) cover the middle.
         tail_levels = tile_nodes = 0
+        kg = padded // 32
         if mode == "tail" and not bitrev_leaves:
-            kg = padded // 32
             tail_levels, tile_nodes = _tail_split(kg, expand_levels)
+        head_levels = _head_split(kg, expand_levels - tail_levels)
         forced = os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto") in (
             "pallas", "tail"
         )
+        global _HEAD_KERNEL_FAILED, _TAIL_KERNEL_FAILED
         try:
             return _evaluate_selection_blocks_planes_jit(
                 seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
@@ -199,21 +204,45 @@ def evaluate_selection_blocks_planes(
                 level_kernel=True,
                 tail_levels=tail_levels,
                 tail_tile_nodes=tile_nodes,
+                head_levels=head_levels,
             )
         except Exception as e:  # noqa: BLE001 - degrade, don't die
             if forced:
                 raise
-            if tail_levels:
-                # A tail-only failure degrades to the per-level kernels.
-                global _TAIL_KERNEL_FAILED
-                _TAIL_KERNEL_FAILED = True
-                warnings.warn(
-                    "fused tail kernel failed at serving shape; retrying "
-                    "with the per-level kernels "
-                    f"({str(e).splitlines()[0][:200]})"
-                )
+            if head_levels:
+                # Retry without the head, keeping the tail. The head is
+                # demoted ONLY when this retry succeeds — a shared
+                # failure (e.g. the tail is the culprit) must not burn
+                # the healthy head's process-wide flag on zero evidence.
                 try:
-                    return _evaluate_selection_blocks_planes_jit(
+                    out = _evaluate_selection_blocks_planes_jit(
+                        seeds0, control0, cw_seeds, cw_left, cw_right,
+                        last_vc,
+                        walk_levels=walk_levels,
+                        expand_levels=expand_levels,
+                        num_blocks=num_blocks,
+                        bitrev_leaves=bitrev_leaves,
+                        level_kernel=True,
+                        tail_levels=tail_levels,
+                        tail_tile_nodes=tile_nodes,
+                    )
+                except Exception as e2:  # noqa: BLE001
+                    e = e2
+                else:
+                    _HEAD_KERNEL_FAILED = True
+                    warnings.warn(
+                        "fused head kernel failed at serving shape; "
+                        "serving without it "
+                        f"({str(e).splitlines()[0][:200]})"
+                    )
+                    return out
+            if tail_levels:
+                # Retry on the per-level kernels alone (no head, no
+                # tail); the tail is demoted only when that succeeds —
+                # if this fails too, the level-kernel failure below
+                # already disables the whole family.
+                try:
+                    out = _evaluate_selection_blocks_planes_jit(
                         seeds0, control0, cw_seeds, cw_left, cw_right,
                         last_vc,
                         walk_levels=walk_levels,
@@ -224,6 +253,14 @@ def evaluate_selection_blocks_planes(
                     )
                 except Exception as e2:  # noqa: BLE001
                     e = e2
+                else:
+                    _TAIL_KERNEL_FAILED = True
+                    warnings.warn(
+                        "fused tail kernel failed at serving shape; "
+                        "serving with the per-level kernels "
+                        f"({str(e).splitlines()[0][:200]})"
+                    )
+                    return out
             _remember_level_kernel_failure()
             warnings.warn(
                 "pallas level kernel failed; serving via the XLA level "
@@ -352,6 +389,108 @@ def _level_kernel_selfcheck() -> bool:
 
 _TAIL_KERNEL_VERIFIED = False
 _TAIL_KERNEL_FAILED = False
+_HEAD_KERNEL_VERIFIED = False
+_HEAD_KERNEL_FAILED = False
+
+
+def _head_max_lanes() -> int:
+    """Exit-width cap for the fused head kernel (DPF_TPU_HEAD_MAX_LANES,
+    default 2048: the in-kernel working set is ~6 copies of the widest
+    [16, 8, W] u32 state, ~3 MB at 2048 lanes — comfortably inside the
+    ~16 MB/core VMEM)."""
+    try:
+        return max(0, int(os.environ.get("DPF_TPU_HEAD_MAX_LANES", "2048")))
+    except ValueError:
+        return 2048
+
+
+def _auto_head_count(cap: int, entry_lanes: int, avail: int) -> int:
+    """Pure auto-sizing rule for the fused head, shared by the serving
+    path (`_head_split`) and the hierarchical dispatch (`dpf.py`): fill
+    levels until the exit width reaches `cap` lanes; a 1-level head is
+    just a worse per-level launch, so the minimum is 2."""
+    if avail <= 0 or entry_lanes <= 0 or cap < 2 * entry_lanes:
+        return 0
+    head = min(avail, (cap // entry_lanes).bit_length() - 1)
+    return head if head >= 2 else 0
+
+
+def _head_split(key_groups: int, a_levels: int) -> int:
+    """How many entry levels the fused head kernel covers (0 = no head).
+
+    The head runs from `key_groups` lanes until its exit width reaches
+    the VMEM lane cap (or the per-level/tail boundary). A 1-level head
+    is just a worse per-level launch, so the minimum is 2.
+    DPF_TPU_HEAD_LEVELS forces the count (0 disables) — honored even
+    before the self-check has run, so forced A/B legs
+    (DPF_TPU_LEVEL_KERNEL=pallas|tail) can measure the head; a failure
+    then propagates (forced) or demotes the head (auto)."""
+    if a_levels <= 0:
+        return 0
+    raw = os.environ.get("DPF_TPU_HEAD_LEVELS", "auto")
+    if raw != "auto":
+        try:
+            return max(0, min(int(raw), a_levels))
+        except ValueError:
+            pass
+    if _HEAD_KERNEL_FAILED or not _HEAD_KERNEL_VERIFIED:
+        return 0
+    return _auto_head_count(_head_max_lanes(), key_groups, a_levels)
+
+
+def _head_kernel_selfcheck() -> bool:
+    """One-time on-device bit-identity check of the fused head kernel
+    against sequential XLA levels. The head's serving entry is naturally
+    narrow (key_groups lanes), so the check runs at a matching narrow
+    entry — the shape family it actually serves."""
+    global _HEAD_KERNEL_VERIFIED, _HEAD_KERNEL_FAILED
+    if _HEAD_KERNEL_FAILED:
+        return False
+    if _HEAD_KERNEL_VERIFIED:
+        return True
+    import numpy as _np
+
+    rng = _np.random.default_rng(9876)
+    g0, nk, r = 2, 64, 3
+    state = jnp.asarray(
+        rng.integers(0, 1 << 32, (16, 8, g0), dtype=_np.uint32)
+    )
+    ctrl = jnp.asarray(rng.integers(0, 1 << 32, (g0,), dtype=_np.uint32))
+    cwp = [
+        pack_key_planes(jnp.asarray(
+            rng.integers(0, 1 << 32, (nk, 4), dtype=_np.uint32)
+        ))
+        for _ in range(r)
+    ]
+    cwl = [
+        pack_key_bits(jnp.asarray(
+            rng.integers(0, 2, (nk,), dtype=_np.uint32)
+        ))
+        for _ in range(r)
+    ]
+    cwr = [
+        pack_key_bits(jnp.asarray(
+            rng.integers(0, 2, (nk,), dtype=_np.uint32)
+        ))
+        for _ in range(r)
+    ]
+    s, c = state, ctrl
+    for i in range(r):
+        g2 = 2 * s.shape[-1]
+        s, c = expand_level_planes(
+            s, c, _tile_keys(cwp[i], g2), _tile_keys(cwl[i], g2 // 2),
+            _tile_keys(cwr[i], g2 // 2),
+        )
+    got_s, got_c = expand_head_planes_pallas(
+        state, ctrl, jnp.stack(cwp), jnp.stack(cwl), jnp.stack(cwr)
+    )
+    if not (
+        _np.array_equal(_np.asarray(got_s), _np.asarray(s))
+        and _np.array_equal(_np.asarray(got_c), _np.asarray(c))
+    ):
+        raise RuntimeError("head kernel/XLA bit mismatch on this device")
+    _HEAD_KERNEL_VERIFIED = True
+    return True
 
 
 def _tail_kernel_selfcheck() -> bool:
@@ -360,14 +499,23 @@ def _tail_kernel_selfcheck() -> bool:
     from `_level_kernel_selfcheck` so a tail-only failure degrades auto
     mode to the per-level kernels instead of all the way to XLA."""
     global _TAIL_KERNEL_VERIFIED, _TAIL_KERNEL_FAILED
-    if _TAIL_KERNEL_VERIFIED:
-        return True
+    # FAILED wins over VERIFIED: a serving-shape failure recorded after a
+    # successful self-check must demote the tail for the whole process
+    # (jit does not cache failed traces, so re-attempting pays the full
+    # compile on every request).
     if _TAIL_KERNEL_FAILED:
         return False
+    if _TAIL_KERNEL_VERIFIED:
+        return True
     import numpy as _np
 
     rng = _np.random.default_rng(4321)
-    g0, nk, r, tile = 8, 64, 2, 4
+    # Entry tile of 128 lanes (2 tiles, so the multi-tile assembly is
+    # exercised): serving tiles are >=128 lanes by _tail_split's floor,
+    # and Mosaic's known crash regime is narrow lanes — a self-check at
+    # 4-lane tiles could fail (and permanently demote the tail) at a
+    # shape the tail never serves.
+    g0, nk, r, tile = 256, 64, 2, 128
     state = jnp.asarray(
         rng.integers(0, 1 << 32, (16, 8, g0), dtype=_np.uint32)
     )
@@ -447,6 +595,8 @@ def level_kernel_status() -> dict:
         "failed": _LEVEL_KERNEL_FAILED,
         "tail_verified": _TAIL_KERNEL_VERIFIED,
         "tail_failed": _TAIL_KERNEL_FAILED,
+        "head_verified": _HEAD_KERNEL_VERIFIED,
+        "head_failed": _HEAD_KERNEL_FAILED,
     }
 
 
@@ -516,6 +666,7 @@ def _level_kernel_enabled():
     =xla disables it; auto uses the per-level kernels on TPU after a
     one-time on-device bit-identity self-check, until a remembered
     failure."""
+    global _TAIL_KERNEL_FAILED
     mode = os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto")
     if mode in ("pallas", "tail"):
         return mode
@@ -543,7 +694,11 @@ def _level_kernel_enabled():
                     "context before building traced programs"
                 )
             return False
-        return "tail" if _TAIL_KERNEL_VERIFIED else "pallas"
+        return (
+            "tail"
+            if _TAIL_KERNEL_VERIFIED and not _TAIL_KERNEL_FAILED
+            else "pallas"
+        )
     try:
         if not _level_kernel_selfcheck():
             return False
@@ -554,13 +709,24 @@ def _level_kernel_enabled():
             f"serving via the XLA levels ({str(e).splitlines()[0][:200]})"
         )
         return False
+    # The fused head is orthogonal to the tail/per-level choice: verify
+    # it here so `_head_split` can enable it inside traced programs. A
+    # head-only failure costs nothing but the head.
+    global _HEAD_KERNEL_FAILED
+    try:
+        _head_kernel_selfcheck()
+    except Exception as e:  # noqa: BLE001 - never break serving
+        _HEAD_KERNEL_FAILED = True
+        warnings.warn(
+            "fused head kernel failed its on-device self-check; "
+            f"serving without it ({str(e).splitlines()[0][:200]})"
+        )
     # Prefer the fused tail when it verifies on this device; a tail-only
     # failure degrades to the per-level kernels, not to XLA.
     try:
         if _tail_kernel_selfcheck():
             return "tail"
     except Exception as e:  # noqa: BLE001 - never break serving
-        global _TAIL_KERNEL_FAILED
         _TAIL_KERNEL_FAILED = True
         warnings.warn(
             "fused tail kernel failed its on-device self-check; "
@@ -574,7 +740,7 @@ def _level_kernel_enabled():
     jax.jit,
     static_argnames=(
         "walk_levels", "expand_levels", "num_blocks", "bitrev_leaves",
-        "level_kernel", "tail_levels", "tail_tile_nodes",
+        "level_kernel", "tail_levels", "tail_tile_nodes", "head_levels",
     ),
 )
 def _evaluate_selection_blocks_planes_jit(
@@ -592,6 +758,7 @@ def _evaluate_selection_blocks_planes_jit(
     level_kernel: bool = False,
     tail_levels: int = 0,
     tail_tile_nodes: int = 0,
+    head_levels: int = 0,
 ) -> jnp.ndarray:
     """Drop-in for `dense_eval.evaluate_selection_blocks` (bit-identical
     output), computed with the plane-resident expansion.
@@ -622,7 +789,30 @@ def _evaluate_selection_blocks_planes_jit(
     ctrl = pack_key_bits(control.astype(U32))  # [key_groups]
 
     a_levels = expand_levels - tail_levels
-    for i in range(a_levels):
+    start = 0
+    if head_levels:
+        # Fused head: the first levels in ONE launch over the (narrow)
+        # full width — bit-identical to the per-level sequence, so the
+        # rest of the pipeline is unchanged.
+        hs = walk_levels
+        state, ctrl = expand_head_planes_pallas(
+            state,
+            ctrl,
+            jnp.stack(
+                [pack_key_planes(cw_seeds[hs + j])
+                 for j in range(head_levels)]
+            ),
+            jnp.stack(
+                [pack_key_bits(cw_left[hs + j])
+                 for j in range(head_levels)]
+            ),
+            jnp.stack(
+                [pack_key_bits(cw_right[hs + j])
+                 for j in range(head_levels)]
+            ),
+        )
+        start = head_levels
+    for i in range(start, a_levels):
         lvl = walk_levels + i
         if level_kernel:
             state, ctrl = expand_level_planes_pallas(

@@ -8,6 +8,7 @@ import warnings
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from distributed_point_functions_tpu import keys as fixed_keys
@@ -39,14 +40,14 @@ def _random_inputs(g, nk):
     return state, ctrl, cw, cwl, cwr
 
 
-@pytest.mark.parametrize("g,nk", [(2, 64), (8, 32), (64, 64), (24, 96)])
+@pytest.mark.parametrize("g,nk", [(8, 32), (64, 64), (24, 96)])
 def test_level_kernel_matches_xla(g, nk):
     state, ctrl, cw, cwl, cwr = _random_inputs(g, nk)
     cwp_kg = pack_key_planes(jnp.asarray(cw))
     cwl_kg = pack_key_bits(jnp.asarray(cwl))
     cwr_kg = pack_key_bits(jnp.asarray(cwr))
 
-    want_state, want_ctrl = expand_level_planes(
+    want_state, want_ctrl = jax.jit(expand_level_planes)(
         jnp.asarray(state),
         jnp.asarray(ctrl),
         _tile_keys(cwp_kg, 2 * g),
@@ -340,9 +341,11 @@ def test_level_kernel_selfcheck(monkeypatch):
     monkeypatch.setattr(dep, "_LEVEL_KERNEL_VERIFIED", False)
     monkeypatch.setattr(dep, "_TAIL_KERNEL_FAILED", False)
     monkeypatch.setattr(dep, "_TAIL_KERNEL_VERIFIED", False)
+    monkeypatch.setattr(dep, "_HEAD_KERNEL_FAILED", False)
+    monkeypatch.setattr(dep, "_HEAD_KERNEL_VERIFIED", False)
 
     # Interpret-mode kernels: the self-checks pass and auto mode prefers
-    # the fused tail.
+    # the fused tail (with the fused head verified alongside).
     for name in ("expand_level_planes_pallas", "value_hash_planes_pallas",
                  "path_level_planes_pallas"):
         monkeypatch.setattr(
@@ -352,9 +355,14 @@ def test_level_kernel_selfcheck(monkeypatch):
         dep, "expand_tail_planes_pallas",
         functools.partial(dep.expand_tail_planes_pallas, interpret=True),
     )
+    monkeypatch.setattr(
+        dep, "expand_head_planes_pallas",
+        functools.partial(dep.expand_head_planes_pallas, interpret=True),
+    )
     assert dep._level_kernel_enabled() == "tail"
     assert dep._LEVEL_KERNEL_VERIFIED is True
     assert dep._TAIL_KERNEL_VERIFIED is True
+    assert dep._HEAD_KERNEL_VERIFIED is True
 
     # A failing tail degrades auto mode to the per-level kernels only.
     monkeypatch.setattr(dep, "_TAIL_KERNEL_VERIFIED", False)
@@ -385,11 +393,13 @@ def test_level_kernel_selfcheck(monkeypatch):
 
 @pytest.mark.parametrize(
     "g0,nk,r,tile",
-    [(4, 64, 2, 2), (8, 32, 3, 4), (12, 96, 2, 6), (2, 64, 4, 2)],
+    [(8, 32, 3, 4), (12, 96, 2, 6), (2, 64, 4, 2)],
 )
 def test_tail_kernel_matches_xla(g0, nk, r, tile):
     """The fused multi-level tail kernel (interpret mode) is
-    bit-identical to per-tile XLA levels + value hash, in tiled order."""
+    bit-identical to per-tile XLA levels + value hash, in tiled order.
+    (The minimal r=1 multi-tile case lives in the fast tier,
+    `test_pallas_fast.py`.)"""
     from distributed_point_functions_tpu.ops.expand_planes_pallas import (
         expand_tail_planes_pallas,
     )
@@ -421,23 +431,34 @@ def test_tail_kernel_matches_xla(g0, nk, r, tile):
         jnp.asarray(RNG.integers(0, 1 << 32, (nk, 4), dtype=np.uint32))
     )
 
-    # XLA twin: per tile, r global-order levels then the value hash.
-    outs = []
-    out_ctrls = []
-    for lo in range(0, g0, tile):
-        s = state[:, :, lo : lo + tile]
-        c = ctrl[lo : lo + tile]
+    # XLA twin: per tile, r global-order levels then the value hash —
+    # jitted per tile shape (one compile, reused across tiles; the eager
+    # bitsliced-AES dispatch is what made this module cost minutes).
+    @jax.jit
+    def twin_tile(s, c, cwp_all, cwl_all, cwr_all, vc):
         for i in range(r):
             g2 = 2 * s.shape[-1]
             s, c = expand_level_planes(
                 s,
                 c,
-                _tile_keys(cwp_kg[i], g2),
-                _tile_keys(cwl_kg[i], g2 // 2),
-                _tile_keys(cwr_kg[i], g2 // 2),
+                _tile_keys(cwp_all[i], g2),
+                _tile_keys(cwl_all[i], g2 // 2),
+                _tile_keys(cwr_all[i], g2 // 2),
             )
         v = mmo_hash_planes(fixed_keys.RK_VALUE, s) ^ (
-            _tile_keys(vc_kg, s.shape[-1]) & c[None, None, :]
+            _tile_keys(vc, s.shape[-1]) & c[None, None, :]
+        )
+        return v, c
+
+    cwp_st = jnp.stack(cwp_kg)
+    cwl_st = jnp.stack(cwl_kg)
+    cwr_st = jnp.stack(cwr_kg)
+    outs = []
+    out_ctrls = []
+    for lo in range(0, g0, tile):
+        v, c = twin_tile(
+            state[:, :, lo : lo + tile], ctrl[lo : lo + tile],
+            cwp_st, cwl_st, cwr_st, vc_kg,
         )
         outs.append(v)
         out_ctrls.append(c)
@@ -479,10 +500,17 @@ def test_serving_expansion_with_tail_kernel(monkeypatch):
         dep, "expand_tail_planes_pallas",
         functools.partial(dep.expand_tail_planes_pallas, interpret=True),
     )
+    monkeypatch.setattr(
+        dep, "expand_head_planes_pallas",
+        functools.partial(dep.expand_head_planes_pallas, interpret=True),
+    )
     monkeypatch.setenv("DPF_TPU_LEVEL_KERNEL", "tail")
     monkeypatch.setenv("DPF_TPU_TAIL_LEVELS", "3")
     # Tiny tiles so several tail calls + the cross-tile order run.
     monkeypatch.setenv("DPF_TPU_TAIL_TILE_LANES", "8")
+    # Fused head over the first two levels: head -> per-level -> tail in
+    # one serving program.
+    monkeypatch.setenv("DPF_TPU_HEAD_LEVELS", "2")
 
     num_records = 35 * 128  # odd block count: exercises truncation
     nq = 96  # key padding (96 -> kg 3) alongside the tail tiling
@@ -542,8 +570,11 @@ def test_hierarchical_expansion_with_tail_kernel(monkeypatch):
     monkeypatch.setenv("DPF_TPU_LEVEL_KERNEL", "tail")
     monkeypatch.setenv("DPF_TPU_TAIL_LEVELS", "3")
     monkeypatch.setenv("DPF_TPU_TAIL_TILE_LANES", "16")
+    # Fused head over the first two plane levels: head -> per-level ->
+    # tail in the one hierarchical program.
+    monkeypatch.setenv("DPF_TPU_HEAD_LEVELS", "2")
     for name in ("expand_level_planes_pallas", "value_hash_planes_pallas",
-                 "expand_tail_planes_pallas"):
+                 "expand_tail_planes_pallas", "expand_head_planes_pallas"):
         monkeypatch.setattr(
             epp, name, functools.partial(getattr(epp, name), interpret=True)
         )

@@ -1,0 +1,289 @@
+"""Fast-tier Pallas kernel signal: tiny-shape interpret-mode differential
+cases of every serving kernel family (level / path / tail / v2 inner
+product), sized for the presubmit's <3 min budget.
+
+The full differential sweeps live in `tests/test_expand_pallas.py` and
+`tests/test_pallas.py`; this module exists because a presubmit whose fast
+tier skips every Pallas kernel is blind to the code the serving path
+actually runs (VERDICT r03). Twins are jitted — an eager bitsliced-AES
+twin pays thousands of per-op CPU dispatches and would blow the budget.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_point_functions_tpu import keys as fixed_keys
+from distributed_point_functions_tpu.ops.aes_bitslice import (
+    mmo_hash_planes,
+)
+from distributed_point_functions_tpu.ops.expand_planes_pallas import (
+    expand_level_planes_pallas,
+    expand_tail_planes_pallas,
+    path_level_planes_pallas,
+    value_hash_planes_pallas,
+)
+from distributed_point_functions_tpu.pir.dense_eval_planes import (
+    _tile_keys,
+    expand_level_planes,
+    pack_key_bits,
+    pack_key_planes,
+)
+
+RNG = np.random.default_rng(71)
+
+
+def _inputs(g, nk):
+    state = RNG.integers(0, 1 << 32, (16, 8, g), dtype=np.uint32)
+    ctrl = RNG.integers(0, 1 << 32, (g,), dtype=np.uint32)
+    cw = RNG.integers(0, 1 << 32, (nk, 4), dtype=np.uint32)
+    cwl = RNG.integers(0, 2, (nk,), dtype=np.uint32)
+    cwr = RNG.integers(0, 2, (nk,), dtype=np.uint32)
+    return state, ctrl, cw, cwl, cwr
+
+
+def test_level_kernel_tiny():
+    g, nk = 2, 64
+    state, ctrl, cw, cwl, cwr = _inputs(g, nk)
+    cwp_kg = pack_key_planes(jnp.asarray(cw))
+    cwl_kg = pack_key_bits(jnp.asarray(cwl))
+    cwr_kg = pack_key_bits(jnp.asarray(cwr))
+    want_s, want_c = jax.jit(expand_level_planes)(
+        jnp.asarray(state), jnp.asarray(ctrl),
+        _tile_keys(cwp_kg, 2 * g), _tile_keys(cwl_kg, g),
+        _tile_keys(cwr_kg, g),
+    )
+    got_s, got_c = expand_level_planes_pallas(
+        jnp.asarray(state), jnp.asarray(ctrl), cwp_kg, cwl_kg, cwr_kg,
+        interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got_s), np.asarray(want_s))
+    np.testing.assert_array_equal(np.asarray(got_c), np.asarray(want_c))
+
+
+def test_value_kernel_tiny():
+    g, nk = 2, 64
+    state, ctrl, cw, _, _ = _inputs(g, nk)
+    vc_kg = pack_key_planes(jnp.asarray(cw))
+
+    @jax.jit
+    def twin(state, ctrl, vc):
+        out = mmo_hash_planes(fixed_keys.RK_VALUE, state)
+        return out ^ (_tile_keys(vc, g) & ctrl[None, None, :])
+
+    want = twin(jnp.asarray(state), jnp.asarray(ctrl), vc_kg)
+    got = value_hash_planes_pallas(
+        jnp.asarray(state), jnp.asarray(ctrl), vc_kg, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_path_kernel_tiny():
+    from distributed_point_functions_tpu.ops.aes_bitslice import (
+        aes_rounds_select_planes,
+        sigma_planes,
+    )
+
+    g, nk = 2, 64
+    state, ctrl, cw, cwl, cwr = _inputs(g, nk)
+    sel = RNG.integers(0, 1 << 32, (g,), dtype=np.uint32)
+    cwp = pack_key_planes(jnp.asarray(cw))
+    cwlb = pack_key_bits(jnp.asarray(cwl))
+    cwrb = pack_key_bits(jnp.asarray(cwr))
+
+    @jax.jit
+    def twin(state, ctrl, sel, cwp, cwlb, cwrb):
+        sig = sigma_planes(state)
+        h = aes_rounds_select_planes(
+            fixed_keys.RK_LEFT, fixed_keys.RK_RIGHT, sel, sig
+        ) ^ sig
+        h = h ^ (_tile_keys(cwp, g) & ctrl[None, None, :])
+        t_new = h[0, 0]
+        out_s = h.at[0, 0].set(jnp.zeros_like(t_new))
+        cw_dir = (sel & _tile_keys(cwrb, g)) | (~sel & _tile_keys(cwlb, g))
+        return out_s, t_new ^ (ctrl & cw_dir)
+
+    want_s, want_c = twin(
+        jnp.asarray(state), jnp.asarray(ctrl), jnp.asarray(sel),
+        cwp, cwlb, cwrb,
+    )
+    got_s, got_c = path_level_planes_pallas(
+        jnp.asarray(state), jnp.asarray(ctrl), jnp.asarray(sel),
+        cwp, cwlb, cwrb, per_seed=False, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got_s), np.asarray(want_s))
+    np.testing.assert_array_equal(np.asarray(got_c), np.asarray(want_c))
+
+
+def test_tail_kernel_tiny():
+    """One fused tail level + value hash over two tiles — the multi-tile
+    assembly and the in-kernel doubling at minimum interpret cost."""
+    g0, nk, r, tile = 4, 32, 1, 2
+    state, ctrl, cw, cwl, cwr = _inputs(g0, nk)
+    cwp_kg = pack_key_planes(jnp.asarray(cw))[None]
+    cwl_kg = pack_key_bits(jnp.asarray(cwl))[None]
+    cwr_kg = pack_key_bits(jnp.asarray(cwr))[None]
+    vc = RNG.integers(0, 1 << 32, (nk, 4), dtype=np.uint32)
+    vc_kg = pack_key_planes(jnp.asarray(vc))
+
+    @functools.partial(jax.jit, static_argnames=("lo",))
+    def twin_tile(state, ctrl, cwp, cwlb, cwrb, vc, lo):
+        s = jax.lax.slice_in_dim(state, lo, lo + tile, axis=2)
+        c = jax.lax.slice_in_dim(ctrl, lo, lo + tile)
+        s, c = expand_level_planes(
+            s, c, _tile_keys(cwp[0], 2 * tile), _tile_keys(cwlb[0], tile),
+            _tile_keys(cwrb[0], tile),
+        )
+        v = mmo_hash_planes(fixed_keys.RK_VALUE, s) ^ (
+            _tile_keys(vc, s.shape[-1]) & c[None, None, :]
+        )
+        return v, c
+
+    want_v, want_c = [], []
+    for lo in range(0, g0, tile):
+        v, c = twin_tile(
+            jnp.asarray(state), jnp.asarray(ctrl), cwp_kg, cwl_kg,
+            cwr_kg, vc_kg, lo,
+        )
+        want_v.append(np.asarray(v))
+        want_c.append(np.asarray(c))
+    got_v, got_c = expand_tail_planes_pallas(
+        jnp.asarray(state), jnp.asarray(ctrl), cwp_kg, cwl_kg, cwr_kg,
+        vc_kg, tile_lanes=tile, interpret=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got_v), np.concatenate(want_v, axis=-1)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got_c), np.concatenate(want_c)
+    )
+
+
+def test_head_kernel_tiny():
+    """The fused head (first r levels, one launch) is bit-identical to
+    sequential XLA levels — no exit permutation, single tile."""
+    from distributed_point_functions_tpu.ops.expand_planes_pallas import (
+        expand_head_planes_pallas,
+    )
+
+    g0, nk, r = 2, 64, 2
+    state, ctrl, _, _, _ = _inputs(g0, nk)
+    cwp = [
+        pack_key_planes(jnp.asarray(
+            RNG.integers(0, 1 << 32, (nk, 4), dtype=np.uint32)
+        ))
+        for _ in range(r)
+    ]
+    cwl = [
+        pack_key_bits(jnp.asarray(
+            RNG.integers(0, 2, (nk,), dtype=np.uint32)
+        ))
+        for _ in range(r)
+    ]
+    cwr = [
+        pack_key_bits(jnp.asarray(
+            RNG.integers(0, 2, (nk,), dtype=np.uint32)
+        ))
+        for _ in range(r)
+    ]
+
+    @jax.jit
+    def twin(s, c, cwp_st, cwl_st, cwr_st):
+        for i in range(r):
+            g2 = 2 * s.shape[-1]
+            s, c = expand_level_planes(
+                s, c, _tile_keys(cwp_st[i], g2),
+                _tile_keys(cwl_st[i], g2 // 2),
+                _tile_keys(cwr_st[i], g2 // 2),
+            )
+        return s, c
+
+    want_s, want_c = twin(
+        jnp.asarray(state), jnp.asarray(ctrl), jnp.stack(cwp),
+        jnp.stack(cwl), jnp.stack(cwr),
+    )
+    got_s, got_c = expand_head_planes_pallas(
+        jnp.asarray(state), jnp.asarray(ctrl), jnp.stack(cwp),
+        jnp.stack(cwl), jnp.stack(cwr), interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got_s), np.asarray(want_s))
+    np.testing.assert_array_equal(np.asarray(got_c), np.asarray(want_c))
+
+
+def test_head_split_policy():
+    """_head_split: honors the env override even unverified (forced A/B
+    legs), requires verification in auto, caps by VMEM lanes, and never
+    returns a 1-level head."""
+    from distributed_point_functions_tpu.pir import dense_eval_planes as dep
+
+    # Unverified auto -> no head.
+    assert dep._head_split(4, 13) == 0
+    # Explicit env override works unverified (clamped to a_levels).
+    import os
+
+    os.environ["DPF_TPU_HEAD_LEVELS"] = "6"
+    try:
+        assert dep._head_split(4, 13) == 6
+        assert dep._head_split(4, 3) == 3
+    finally:
+        del os.environ["DPF_TPU_HEAD_LEVELS"]
+    # Verified auto: fill until the 2048-lane cap (kg=4 -> 9 levels).
+    old_v, old_f = dep._HEAD_KERNEL_VERIFIED, dep._HEAD_KERNEL_FAILED
+    dep._HEAD_KERNEL_VERIFIED, dep._HEAD_KERNEL_FAILED = True, False
+    try:
+        assert dep._head_split(4, 13) == 9
+        assert dep._head_split(4, 2) == 2
+        assert dep._head_split(2048, 5) == 0  # cap below 2 levels
+        # A remembered failure disables the auto head.
+        dep._HEAD_KERNEL_FAILED = True
+        assert dep._head_split(4, 13) == 0
+    finally:
+        dep._HEAD_KERNEL_VERIFIED, dep._HEAD_KERNEL_FAILED = old_v, old_f
+
+
+@pytest.mark.parametrize("num_words", [2, 16])
+def test_ip_v2_tiny(num_words):
+    """v2 MXU inner product at a narrow (j_chunk=1 regression shape) and
+    a regular width."""
+    from distributed_point_functions_tpu.ops.inner_product import (
+        pack_selection_bits_np,
+        xor_inner_product_np,
+    )
+    from distributed_point_functions_tpu.ops.inner_product_pallas import (
+        permute_db_bitmajor,
+        xor_inner_product_pallas2_staged,
+    )
+
+    num_records, nq = 512, 4
+    db = RNG.integers(0, 1 << 32, (num_records, num_words), dtype=np.uint32)
+    bits = RNG.integers(0, 2, (nq, num_records), dtype=np.uint32)
+    sel = pack_selection_bits_np(bits)
+    db_perm = np.asarray(permute_db_bitmajor(db))
+    got = np.asarray(
+        xor_inner_product_pallas2_staged(db_perm, sel, interpret=True)
+    )
+    np.testing.assert_array_equal(got, xor_inner_product_np(db, sel))
+
+
+def test_tail_failure_demotes_tail_mode(monkeypatch):
+    """A remembered tail failure must demote tail mode everywhere —
+    FAILED wins over a stale VERIFIED flag, in both the eager self-check
+    and the traced-context branch (ADVICE r03 medium)."""
+    from distributed_point_functions_tpu.pir import dense_eval_planes as dep
+
+    monkeypatch.setattr(dep, "_TAIL_KERNEL_VERIFIED", True)
+    monkeypatch.setattr(dep, "_TAIL_KERNEL_FAILED", True)
+    assert dep._tail_kernel_selfcheck() is False
+
+    monkeypatch.setenv("DPF_TPU_LEVEL_KERNEL", "auto")
+    monkeypatch.setattr(dep.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(dep, "_LEVEL_KERNEL_FAILED", False)
+    monkeypatch.setattr(dep, "_LEVEL_KERNEL_VERIFIED", True)
+    monkeypatch.setattr(dep, "_trace_state_clean", lambda: False)
+    assert dep._level_kernel_enabled() == "pallas"
+    monkeypatch.setattr(dep, "_TAIL_KERNEL_FAILED", False)
+    assert dep._level_kernel_enabled() == "tail"
