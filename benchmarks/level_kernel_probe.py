@@ -149,6 +149,55 @@ def main() -> None:
     for g0, kg, r, tile in tail_cases:
         print(json.dumps(tail_case(g0, kg, r, tile)), flush=True)
 
+    # Fused head kernel (first r levels in ONE launch from a narrow
+    # entry): Mosaic legality at the naturally narrow entry widths and
+    # compile cost vs depth. q128 serving is kg=4 entry, r=9 to the
+    # 2048-lane cap; hierarchical single-key is kg=1 entry.
+    from distributed_point_functions_tpu.ops.expand_planes_pallas import (
+        expand_head_planes_pallas,
+    )
+
+    def head_case(g0: int, kg: int, r: int) -> dict:
+        state = jnp.asarray(
+            rng.integers(0, 1 << 32, (16, 8, g0), dtype=np.uint32)
+        )
+        ctrl = jnp.asarray(
+            rng.integers(0, 1 << 32, (g0,), dtype=np.uint32)
+        )
+        cwp = jnp.asarray(
+            rng.integers(0, 1 << 32, (r, 16, 8, kg), dtype=np.uint32)
+        )
+        cwb = jnp.asarray(
+            rng.integers(0, 1 << 32, (r, kg), dtype=np.uint32)
+        )
+        tag = {"kernel": "head", "g0": g0, "kg": kg, "r": r,
+               "out_lanes": g0 << r}
+        t0 = time.perf_counter()
+        try:
+            out = expand_head_planes_pallas(state, ctrl, cwp, cwb, cwb)
+            jax.block_until_ready(out)
+            t1 = time.perf_counter()
+            jax.block_until_ready(
+                expand_head_planes_pallas(state, ctrl, cwp, cwb, cwb)
+            )
+            return {**tag, "ok": True,
+                    "compile_s": round(t1 - t0, 1),
+                    "run_ms": round((time.perf_counter() - t1) * 1e3, 2)}
+        except Exception as e:  # noqa: BLE001
+            return {**tag, "ok": False,
+                    "error": str(e).splitlines()[0][:160]}
+
+    head_cases = [
+        (4, 4, 9),    # q128 serving head: 4 -> 2048 lanes
+        (2, 2, 10),   # q64 serving head: 2 -> 2048 lanes
+        (8, 8, 8),    # q256 serving head: 8 -> 2048 lanes
+        (4, 4, 5),    # shallower split (compile-cost scaling point)
+        (1, 1, 11),   # hierarchical single-key entry: 1 -> 2048 lanes
+        (4, 4, 10),   # cap probe: 4 -> 4096 lanes (~12 MB working set)
+    ]
+    for g0, kg, r in head_cases:
+        print(json.dumps(head_case(g0, kg, r)), flush=True)
+
 
 if __name__ == "__main__":
     main()
