@@ -495,22 +495,7 @@ def main():
             )
             return False
 
-    _PROGRESS["stage"] = "pallas-check"
-    # Run the level-kernel self-checks EAGERLY before anything traces the
-    # expansion: inside jax.jit the check cannot run, and a fresh process
-    # would silently serve the XLA levels (this is why the r02 headline
-    # never engaged the fused kernels despite auto mode).
-    try:
-        from distributed_point_functions_tpu.pir import (
-            dense_eval_planes as _dep,
-        )
-
-        _log(f"level kernels: eager mode={_dep.warm_level_kernels()!r}")
-    except Exception as e:  # noqa: BLE001 - observability only
-        _log(
-            "level-kernel warmup failed: "
-            f"{(str(e).splitlines() or ['<no message>'])[0]}"
-        )
+    _PROGRESS["stage"] = "ip-check"
     no_pallas = os.environ.get("BENCH_NO_PALLAS", "") == "1"
     use_pallas2 = (
         not no_pallas
@@ -605,6 +590,7 @@ def main():
         f"expand={expand_levels}"
     )
     timings = {}
+    latencies = {}
     outputs = {}
     candidates = {}
     # Lazily-built party-1 staging for the share-correctness check.
@@ -650,12 +636,96 @@ def main():
         if ok:
             _log(f"share-correctness[{name}]: ok "
                  f"({num_queries} queries reconstructed exactly)")
+            share_state.setdefault("checked", set()).add(name)
         else:
             _log(f"WARNING: {name} pipeline fails share-correctness "
                  "on device; dropping")
             del candidates[name]
+            # Drop any banked measurement with it: a stale timings entry
+            # would let `best = min(timings)` select a candidate that no
+            # longer exists and KeyError at serving-selection time.
+            timings.pop(name, None)
+            latencies.pop(name, None)
         return ok
 
+    def _bank(name):
+        # Measure a candidate the moment it is trusted and record the
+        # provisional q/s, so the stall watchdog always has the best
+        # measured figure to emit — r04 stage-1 lesson: a valid limb
+        # measurement existed, yet the watchdog reported 0.0 because
+        # nothing was banked until after the (never-finished) retry.
+        per, lat = _slope_time(
+            lambda: candidates[name](*staged, db_words), iters
+        )
+        if per is not None:
+            timings[name] = per
+            latencies[name] = lat
+            qps = num_queries / (per + host_walk_s)
+            _log(
+                f"expansion[{name}]: per-batch {per * 1e3:.3f} ms "
+                f"({qps:.0f} q/s) [banked]"
+            )
+            if qps > (_PROGRESS["qps"] or 0.0):
+                _PROGRESS["qps"] = qps
+
+    auto_mode = os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto") == "auto"
+    if auto_mode and "planes" in candidate_defs:
+        # Bank the proven-reliable mode FIRST: planes expansion on the
+        # plain XLA levels (the r02 headline mode, 6,601.9 q/s) compiles
+        # and measures before any Pallas self-check or auto-pipeline
+        # compile spends the budget — on r04 hardware the auto pipeline
+        # failed Mosaic compile at serving shape after its self-checks
+        # passed, and the old try-fancy-first order left the watchdog
+        # with nothing. The auto candidate still runs below and serves
+        # the headline if it measures faster.
+        _PROGRESS["stage"] = "compile-xla-first"
+        os.environ["DPF_TPU_LEVEL_KERNEL"] = "xla"
+        try:
+            step_xla = make_pir_step(
+                functools.partial(
+                    evaluate_selection_blocks_planes, force_planes=True
+                )
+            )
+            if _try_compile("planes_xla", step_xla) and _share_check(
+                "planes_xla"
+            ):
+                _bank("planes_xla")
+        finally:
+            os.environ["DPF_TPU_LEVEL_KERNEL"] = "auto"
+
+    _PROGRESS["stage"] = "pallas-check"
+    # Run the level-kernel self-checks EAGERLY before anything traces the
+    # expansion: inside jax.jit the check cannot run, and a fresh process
+    # would silently serve the XLA levels (this is why the r02 headline
+    # never engaged the fused kernels despite auto mode).
+    eager_kernel_mode = None
+    try:
+        from distributed_point_functions_tpu.pir import (
+            dense_eval_planes as _dep,
+        )
+
+        eager_kernel_mode = _dep.warm_level_kernels()
+        _log(f"level kernels: eager mode={eager_kernel_mode!r}")
+    except Exception as e:  # noqa: BLE001 - observability only
+        _log(
+            "level-kernel warmup failed: "
+            f"{(str(e).splitlines() or ['<no message>'])[0]}"
+        )
+    if (
+        auto_mode
+        and "planes_xla" in candidates
+        and not eager_kernel_mode
+        and "planes" in candidate_defs
+    ):
+        # Every kernel tier demoted (or never verified): the auto planes
+        # pipeline would trace the exact XLA-levels HLO already compiled
+        # and banked as planes_xla under a different jit identity —
+        # skip the redundant multi-minute compile.
+        _log("auto planes == XLA levels (kernels demoted); "
+             "skipping duplicate compile")
+        del candidate_defs["planes"]
+
+    _PROGRESS["stage"] = "compile"
     for name, step in candidate_defs.items():
         _try_compile(name, step)
     try:
@@ -666,7 +736,7 @@ def main():
         _log(f"level kernels: {level_kernel_status()}")
     except Exception:  # noqa: BLE001 - observability only
         pass
-    if len(outputs) == 2 and not np.array_equal(
+    if "limb" in outputs and "planes" in outputs and not np.array_equal(
         outputs["limb"], outputs["planes"]
     ):
         _log("WARNING: planes/limb outputs differ on device; "
@@ -675,7 +745,8 @@ def main():
 
     _PROGRESS["stage"] = "share-check"
     for name in list(candidates):
-        _share_check(name)
+        if name not in share_state.get("checked", set()):
+            _share_check(name)
     if not candidates and "limb" not in candidate_defs:
         # The default single-config run must not die with the planes
         # kernel — whether it failed to compile or failed the share
@@ -710,13 +781,15 @@ def main():
             _log(f"xprof capture failed: {str(e).splitlines()[0]}")
 
     _PROGRESS["stage"] = "measure"
-    latencies = {}
     for name, step in candidates.items():
         per, lat = _slope_time(lambda s=step: s(*staged, db_words), iters)
         if per is not None:
             timings[name] = per
             latencies[name] = lat
+            qps = num_queries / (per + host_walk_s)
             _log(f"expansion[{name}]: per-batch {per * 1e3:.3f} ms")
+            if qps > (_PROGRESS["qps"] or 0.0):
+                _PROGRESS["qps"] = qps
     if not timings:
         # Refuse to report an inflated figure from a degenerate slope.
         _log("ERROR: slope still non-positive; reporting value 0")
@@ -724,77 +797,16 @@ def main():
         return
     best = min(timings, key=timings.get)
     per_batch = timings[best]
-
-    # Regression insurance for the auto kernel modes: the r02 headline
-    # (6,601.9 q/s) was measured on the XLA levels; if the auto-selected
-    # Pallas mode serves measurably WORSE than that at the exact headline
-    # config, re-measure once with the kernels disabled and keep the
-    # faster. Only in auto mode (explicit DPF_TPU_LEVEL_KERNEL legs are
-    # A/B runs that must report their own mode).
-    try:
-        retry_below = float(os.environ.get("BENCH_XLA_RETRY_BELOW", "nan"))
-    except ValueError:
-        retry_below = float("nan")
-    if retry_below != retry_below:  # NaN -> default: the r02 XLA captures
-        # Floors sit just below the committed r02 XLA-level measurements
-        # (bench_q{64,128,256}_20260731_031646.json: 5601 / 6602 / 5065
-        # q/s at 2^20 x 256 B), so ANY driver/capture config in that
-        # family gets the regression insurance, not only q128.
-        retry_below = 0.0
-        if num_records == (1 << 20) and record_bytes == 256:
-            # q/s scales with batch size, so the catch-all floor only
-            # applies from the smallest measured batch up — tiny batches
-            # sit below any healthy floor by arithmetic alone.
-            retry_below = {64: 5300.0, 128: 5800.0, 256: 4800.0}.get(
-                num_queries, 4500.0 if num_queries >= 64 else 0.0
-            )
-    if (
-        os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto") == "auto"
-        and num_queries / (per_batch + host_walk_s) < retry_below
-    ):
-        _PROGRESS["stage"] = "xla-retry"
-        _log(
-            f"auto kernels give "
-            f"{num_queries / (per_batch + host_walk_s):.0f} q/s, below "
-            "the r02 XLA-level capture; re-measuring with XLA levels"
-        )
+    # The xla-first bank above replaced the old below-floor XLA retry:
+    # in auto mode the XLA-level candidate is always compiled and
+    # measured up front, so the headline is a measured max over
+    # {planes_xla, auto planes, limb} rather than hope plus insurance.
+    # When the XLA candidate wins, every later measurement of it (split
+    # timing, ns/leaf) must keep dispatching under the XLA mode —
+    # leaving "auto" would silently re-enable the kernels for the very
+    # path the headline just rejected.
+    if auto_mode and best == "planes_xla":
         os.environ["DPF_TPU_LEVEL_KERNEL"] = "xla"
-        try:
-            step_xla = make_pir_step(
-                functools.partial(
-                    evaluate_selection_blocks_planes, force_planes=True
-                )
-            )
-            outputs["planes_xla"] = np.asarray(
-                step_xla(*staged, db_words)
-            )
-            candidates["planes_xla"] = step_xla
-            # The retry candidate passes the same share-correctness gate
-            # as every other candidate before it may serve the headline.
-            if _share_check("planes_xla"):
-                per_xla, lat_xla = _slope_time(
-                    lambda: step_xla(*staged, db_words), iters
-                )
-                if per_xla is not None:
-                    _log(f"XLA levels: per-batch {per_xla * 1e3:.3f} ms "
-                         f"(kernels: {per_batch * 1e3:.3f} ms)")
-                    if per_xla < per_batch:
-                        timings["planes_xla"] = per_xla
-                        latencies["planes_xla"] = lat_xla
-                        best = "planes_xla"
-                        per_batch = per_xla
-        except Exception as e:  # noqa: BLE001
-            _log(
-                "XLA-level retry failed: "
-                f"{(str(e).splitlines() or ['<no message>'])[0]}"
-            )
-        finally:
-            # When the XLA candidate wins, every later measurement of it
-            # (split timing, ns/leaf) must keep dispatching under the XLA
-            # mode — restoring "auto" here would silently re-enable the
-            # kernels for the very path the headline just rejected.
-            if best != "planes_xla":
-                os.environ["DPF_TPU_LEVEL_KERNEL"] = "auto"
 
     latency = latencies[best]
     pir_step = candidates[best]
@@ -816,12 +828,19 @@ def main():
     ip_ms = None
     ip_alt_ms = None
     try:
+        # force_planes mirrors the candidate definition: without it the
+        # small-batch padding guard could reroute tiny query counts to
+        # the limb kernel and mislabel the split as the planes path.
+        expand_kwargs = (
+            {"force_planes": True} if best.startswith("planes") else {}
+        )
         expand_only = jax.jit(
             lambda s0, c0, cs, cl, cr, vc: evaluate_selection_blocks_best(
                 s0, c0, cs, cl, cr, vc,
                 walk_levels=walk_levels,
                 expand_levels=expand_levels,
                 num_blocks=num_blocks,
+                **expand_kwargs,
             )
         )
         sel_fixed = jax.block_until_ready(expand_only(*staged))
@@ -922,4 +941,8 @@ if __name__ == "__main__":
         import traceback
 
         traceback.print_exc()
-        _emit(0.0, 0.0, error=e)
+        # A crash after a successful bank must still report the banked
+        # figure (same contract as the watchdog): a transient fault in a
+        # later stage must not zero out a valid earlier measurement.
+        banked = _PROGRESS["qps"] or 0.0
+        _emit(banked, banked / BASELINE_QPS, error=e)
