@@ -142,8 +142,10 @@ def _start_watchdog():
     init_budget = float(os.environ.get("BENCH_INIT_BUDGET", 300))
     init_deadline = time.monotonic() + init_budget + 120
 
+    _PROGRESS["deadline"] = time.monotonic() + timeout
+
     def watch():
-        deadline = time.monotonic() + timeout
+        deadline = _PROGRESS["deadline"]
         while time.monotonic() < deadline:
             time.sleep(5)
             if _PROGRESS["done"]:
@@ -727,7 +729,74 @@ def main():
 
     _PROGRESS["stage"] = "compile"
     for name, step in candidate_defs.items():
-        _try_compile(name, step)
+        ok = _try_compile(name, step)
+        if ok or name != "planes" or not auto_mode:
+            continue
+        # Evidence-based kernel demotion at the OUTER jit level: the
+        # eager degradation chain inside evaluate_selection_blocks_planes
+        # cannot catch compile failures here (the inner jit traces inline
+        # and the Mosaic failure surfaces at the outer jit's compile), so
+        # the auto pipeline's failure teaches nothing by itself. Retry
+        # head-off, then per-level-only; the first success attributes the
+        # failure and persists the verdict for later processes. Each
+        # doomed attempt costs minutes of remote compile, so the ladder
+        # only runs while enough watchdog budget remains.
+        try:
+            from distributed_point_functions_tpu.pir import (
+                dense_eval_planes as _dep,
+            )
+        except Exception:  # noqa: BLE001
+            continue
+        remaining = _PROGRESS.get("deadline", 0) - time.monotonic()
+        status = _dep.level_kernel_status()
+        head_on = status["head_verified"] and not status["head_failed"]
+        ladder = []
+        if head_on:
+            ladder.append(("head", {"DPF_TPU_HEAD_LEVELS": "0"}))
+        if status["tail_verified"] and not status["tail_failed"]:
+            ladder.append(
+                ("tail", {"DPF_TPU_HEAD_LEVELS": "0",
+                          "DPF_TPU_LEVEL_KERNEL": "pallas"})
+            )
+        for tier, env in ladder:
+            if remaining < 420:
+                _log("kernel-demotion ladder skipped (watchdog budget)")
+                break
+            saved = {k: os.environ.get(k) for k in env}
+            os.environ.update(env)
+            try:
+                retry_ok = _try_compile(
+                    "planes", make_pir_step(functools.partial(
+                        evaluate_selection_blocks_planes,
+                        force_planes=True,
+                    ))
+                )
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+            remaining = _PROGRESS.get("deadline", 0) - time.monotonic()
+            if retry_ok:
+                if tier == "head":
+                    _dep._HEAD_KERNEL_FAILED = True
+                    _log("auto pipeline compiles without the head: "
+                         "demoting the fused head (persisted)")
+                else:
+                    _dep._HEAD_KERNEL_FAILED = True
+                    _dep._TAIL_KERNEL_FAILED = True
+                    _log("auto pipeline compiles per-level only: "
+                         "demoting head+tail (persisted)")
+                _dep.record_kernel_verdicts()
+                break
+        else:
+            if ladder and remaining >= 420:
+                # Every composition failed: the per-level family itself
+                # is unusable at this serving shape.
+                _dep._remember_level_kernel_failure()
+                _log("no kernel composition compiles at serving shape; "
+                     "level-kernel family demoted (persisted)")
     try:
         from distributed_point_functions_tpu.pir.dense_eval_planes import (
             level_kernel_status,

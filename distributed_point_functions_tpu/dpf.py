@@ -646,6 +646,7 @@ def _expand_levels_fn(num_levels: int, hash_leaves: bool = False):
                     e = e2
                 else:
                     _dep._HEAD_KERNEL_FAILED = True
+                    _dep.record_kernel_verdicts()
                     _warnings.warn(
                         "fused head kernel failed in hierarchical "
                         "expansion; serving without it "
@@ -668,6 +669,7 @@ def _expand_levels_fn(num_levels: int, hash_leaves: bool = False):
                     e = e2
                 else:
                     _dep._TAIL_KERNEL_FAILED = True
+                    _dep.record_kernel_verdicts()
                     _warnings.warn(
                         "fused tail kernel failed in hierarchical "
                         "expansion; serving with the per-level kernels "

@@ -230,6 +230,7 @@ def evaluate_selection_blocks_planes(
                     e = e2
                 else:
                     _HEAD_KERNEL_FAILED = True
+                    record_kernel_verdicts()
                     warnings.warn(
                         "fused head kernel failed at serving shape; "
                         "serving without it "
@@ -255,6 +256,7 @@ def evaluate_selection_blocks_planes(
                     e = e2
                 else:
                     _TAIL_KERNEL_FAILED = True
+                    record_kernel_verdicts()
                     warnings.warn(
                         "fused tail kernel failed at serving shape; "
                         "serving with the per-level kernels "
@@ -297,6 +299,140 @@ def _remember_level_kernel_failure() -> None:
     batch)."""
     global _LEVEL_KERNEL_FAILED
     _LEVEL_KERNEL_FAILED = True
+    record_kernel_verdicts()
+
+
+_VERDICTS_LOADED = False
+_VERDICT_FLAGS = (
+    "_LEVEL_KERNEL_VERIFIED", "_LEVEL_KERNEL_FAILED",
+    "_TAIL_KERNEL_VERIFIED", "_TAIL_KERNEL_FAILED",
+    "_HEAD_KERNEL_VERIFIED", "_HEAD_KERNEL_FAILED",
+)
+
+
+def _verdict_cache_path():
+    """Where self-check verdicts persist across processes.
+
+    A Mosaic compile *failure* costs minutes of doomed remote-compile
+    per fresh process (r04 hardware: the failing tail self-check alone
+    burned ~4 minutes of every bench run before this cache existed);
+    XLA's compilation cache memoizes successes but never failures.
+    DPF_TPU_VERDICT_CACHE overrides the location; 0/off disables."""
+    raw = os.environ.get("DPF_TPU_VERDICT_CACHE", "")
+    if raw.lower() in ("0", "off", "none"):
+        return None
+    if raw:
+        return raw
+    return os.path.join(
+        os.environ.get(
+            "BENCH_CACHE_DIR", os.path.expanduser("~/.cache/jax_bench")
+        ),
+        "kernel_verdicts.json",
+    )
+
+
+def _verdict_key():
+    """Verdicts are only valid for the exact (device kind, jax/jaxlib/
+    runtime version, kernel source) tuple — Mosaic lives in jaxlib and
+    the platform runtime, so a toolchain upgrade must re-probe: a stale
+    VERIFIED would skip the bit-identity check under a compiler that may
+    now miscompile, and a stale FAILED would demote kernels forever
+    after the upgrade fixes the compile."""
+    try:
+        import hashlib
+
+        import jaxlib
+
+        from ..ops import aes_bitslice as _abs
+        from ..ops import expand_planes_pallas as _epp
+
+        h = hashlib.sha256()
+        for mod in (_epp, _abs):  # kernels + the gate circuit they call
+            with open(mod.__file__, "rb") as f:
+                h.update(f.read())
+        dev = jax.devices()[0]
+        try:
+            runtime = dev.client.platform_version
+        except Exception:  # noqa: BLE001 - backend without the attr
+            runtime = ""
+        return (
+            f"{dev.device_kind}|{jax.__version__}|{jaxlib.__version__}"
+            f"|{runtime}|{h.hexdigest()[:16]}"
+        )
+    except Exception:  # noqa: BLE001 - cache is best-effort
+        return None
+
+
+def _load_kernel_verdicts() -> None:
+    """Apply persisted verdicts (once per process) before self-checks."""
+    global _VERDICTS_LOADED
+    if _VERDICTS_LOADED:
+        return
+    _VERDICTS_LOADED = True
+    path = _verdict_cache_path()
+    if not path:
+        return
+    key = _verdict_key()
+    if not key:
+        return
+    try:
+        import json
+
+        with open(path) as f:
+            stored = json.load(f).get(key)
+    except Exception:  # noqa: BLE001 - missing/corrupt cache = re-probe
+        return
+    if not isinstance(stored, dict):
+        return
+    for flag in _VERDICT_FLAGS:
+        if stored.get(flag) is True:
+            globals()[flag] = True
+
+
+_LAST_RECORDED = None
+
+
+def record_kernel_verdicts() -> None:
+    """Merge the current self-check flags into the persistent cache.
+
+    Called after every verdict change (self-check pass/fail and
+    serve-shape demotions, including dpf.py's hierarchical path), so
+    the next process skips known-failing Mosaic compiles instantly."""
+    global _LAST_RECORDED
+    snapshot = tuple(bool(globals()[f]) for f in _VERDICT_FLAGS)
+    if snapshot == _LAST_RECORDED:
+        # Repeated eager dispatches land here after every successful
+        # _level_kernel_enabled(); skip the re-hash + rewrite when
+        # nothing changed.
+        return
+    path = _verdict_cache_path()
+    if not path:
+        return
+    key = _verdict_key()
+    if not key:
+        return
+    try:
+        import json
+
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except Exception:  # noqa: BLE001
+            data = {}
+        if not isinstance(data, dict):
+            data = {}
+        entry = data.setdefault(key, {})
+        for flag in _VERDICT_FLAGS:
+            if globals()[flag]:
+                entry[flag] = True
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1)
+        os.replace(tmp, path)
+        _LAST_RECORDED = snapshot
+    except Exception:  # noqa: BLE001 - cache is best-effort
+        pass
 
 
 _LEVEL_KERNEL_VERIFIED = False
@@ -672,7 +808,10 @@ def _level_kernel_enabled():
         return mode
     if mode == "xla":
         return False
-    if _LEVEL_KERNEL_FAILED or jax.default_backend() != "tpu":
+    if jax.default_backend() != "tpu":
+        return False
+    _load_kernel_verdicts()
+    if _LEVEL_KERNEL_FAILED:
         return False
     if not _trace_state_clean():
         # Reached while an outer jit is being traced (e.g. the fused DCF
@@ -725,6 +864,7 @@ def _level_kernel_enabled():
     # failure degrades to the per-level kernels, not to XLA.
     try:
         if _tail_kernel_selfcheck():
+            record_kernel_verdicts()
             return "tail"
     except Exception as e:  # noqa: BLE001 - never break serving
         _TAIL_KERNEL_FAILED = True
@@ -733,6 +873,7 @@ def _level_kernel_enabled():
             f"serving via the per-level kernels "
             f"({str(e).splitlines()[0][:200]})"
         )
+    record_kernel_verdicts()
     return "pallas"
 
 

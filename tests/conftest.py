@@ -17,6 +17,9 @@ Two subtleties:
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Kernel self-check verdicts must never leak between the developer's
+# machine state and the suite (tests monkeypatch the verdict flags).
+os.environ.setdefault("DPF_TPU_VERDICT_CACHE", "off")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -287,3 +287,42 @@ def test_tail_failure_demotes_tail_mode(monkeypatch):
     assert dep._level_kernel_enabled() == "pallas"
     monkeypatch.setattr(dep, "_TAIL_KERNEL_FAILED", False)
     assert dep._level_kernel_enabled() == "tail"
+
+
+def test_kernel_verdict_cache_roundtrip(tmp_path, monkeypatch):
+    """A recorded Mosaic failure verdict must be re-applied in a fresh
+    process (simulated by resetting the flags + the loaded marker):
+    re-attempting a known-failing kernel compile costs minutes of
+    remote-compile on hardware, which the persistent cache exists to
+    skip."""
+    from distributed_point_functions_tpu.pir import dense_eval_planes as dep
+
+    cache = tmp_path / "verdicts.json"
+    monkeypatch.setenv("DPF_TPU_VERDICT_CACHE", str(cache))
+    monkeypatch.setattr(dep, "_LAST_RECORDED", None)
+    monkeypatch.setattr(dep, "_TAIL_KERNEL_FAILED", True)
+    monkeypatch.setattr(dep, "_HEAD_KERNEL_VERIFIED", True)
+    monkeypatch.setattr(dep, "_LEVEL_KERNEL_VERIFIED", True)
+    dep.record_kernel_verdicts()
+    assert cache.exists()
+
+    # "Fresh process": all flags cleared, loader not yet run.
+    for flag in dep._VERDICT_FLAGS:
+        monkeypatch.setattr(dep, flag, False)
+    monkeypatch.setattr(dep, "_VERDICTS_LOADED", False)
+    dep._load_kernel_verdicts()
+    assert dep._TAIL_KERNEL_FAILED is True
+    assert dep._HEAD_KERNEL_VERIFIED is True
+    assert dep._LEVEL_KERNEL_VERIFIED is True
+    # Never-set flags stay clear.
+    assert dep._LEVEL_KERNEL_FAILED is False
+    assert dep._HEAD_KERNEL_FAILED is False
+
+    # A second record merges (does not clear) earlier verdicts.
+    monkeypatch.setattr(dep, "_HEAD_KERNEL_FAILED", True)
+    dep.record_kernel_verdicts()
+    monkeypatch.setattr(dep, "_VERDICTS_LOADED", False)
+    monkeypatch.setattr(dep, "_TAIL_KERNEL_FAILED", False)
+    dep._load_kernel_verdicts()
+    assert dep._TAIL_KERNEL_FAILED is True
+    assert dep._HEAD_KERNEL_FAILED is True
