@@ -1,16 +1,26 @@
-"""Isolate the Mosaic compile failure of the fused level kernel at
-large group counts (expand_profile: level 9, G=2048, q64 -> kg=2 crashes
-tpu_compile_helper; levels <= 7 with the same kg succeed).
+"""Per-shape Mosaic legality/timing probe of the fused expansion kernels.
 
-Runs the level kernel compiled at a sweep of (G, kg) shapes and reports
-ok/crash per shape, then the same for the value-hash kernel. Each case
-is its own jit cache entry; crashes surface as INTERNAL remote_compile
-errors. Run on the real chip between capture stages.
+Runs each kernel family at a sweep of serving-geometry shapes and
+reports ok/crash (+compile seconds, +per-call ms) per shape, one JSON
+line each. Families: the fixed-width walk-descent (the doubling-free
+redesign), the per-level kernel, the value-hash kernel, the fused tail,
+and the fused head. Crashes surface as INTERNAL remote_compile errors.
+
+Each case runs in its OWN SUBPROCESS under a hard timeout: on the
+2026-08-01 toolchain a doomed fused-tail compile HANGS tpu_compile_helper
+for 20+ minutes (it never errors) and wedges the single-client tunnel
+for following processes — an in-process sweep would lose every case
+after the first hang. `--one <idx>` runs a single case (the child
+mode); the default parent mode spawns children sequentially, ordered
+walk first (the redesign needs data most) and the hang-prone tail/head
+last so their timeouts cannot starve the rest.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 from pathlib import Path
@@ -19,185 +29,175 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import numpy as np  # noqa: E402
 
+# Seconds a child may spend on one case (init + compile + two runs).
+# Legal compiles take <= ~120 s cold; a hang means Mosaic is stuck, and
+# killing the child is the only way the rest of the sweep survives.
+CASE_TIMEOUT = float(os.environ.get("PROBE_CASE_TIMEOUT", "420"))
 
-def main() -> None:
+# (kind, params). Walk: the fixed-width descent at q128/q64 serving
+# geometries (kg=4 / kg=2), the head-replacement single launch, and the
+# whole-expansion-as-fixed-tiles upper bound. Level/value: the chunked
+# per-level design at serving widths. Tail/head: the doubling designs
+# that fail on the 2026-08-01 toolchain — kept to map WHERE they fail,
+# but last in line.
+CASES = [
+    ("walk", dict(g0=8192, kg=4, r=2, tile=2048, value=True)),
+    ("walk", dict(g0=2048, kg=4, r=4, tile=2048, value=True)),
+    ("walk", dict(g0=4, kg=4, r=9, tile=2048, value=False)),
+    ("walk", dict(g0=4, kg=4, r=13, tile=2048, value=True)),
+    ("walk", dict(g0=2, kg=2, r=10, tile=2048, value=False)),
+    ("walk", dict(g0=1024, kg=2, r=4, tile=1024, value=True)),
+    ("level", dict(g=2048, kg=2, tile=2048)),
+    ("level", dict(g=2048, kg=4, tile=None)),
+    ("level", dict(g=8192, kg=4, tile=None)),
+    ("level", dict(g=16384, kg=2, tile=None)),
+    ("value", dict(g=16384, kg=2)),
+    ("value", dict(g=32768, kg=4)),
+    # Known hang-prone doubling designs: one canary each (a hang costs
+    # a full CASE_TIMEOUT, so the sweep carries no more than two).
+    ("tail", dict(g0=2048, kg=4, r=4, tile=512)),
+    ("head", dict(g0=4, kg=4, r=9)),
+]
+
+
+def run_one(idx: int) -> dict:
+    """Child mode: full backend init + one case. Returns the result tag."""
+    kind, p = CASES[idx]
     from benchmarks.common import setup_compilation_cache
 
     setup_compilation_cache()
-    import jax  # noqa: E402
-    import jax.numpy as jnp  # noqa: E402
+    import jax
+    import jax.numpy as jnp
 
-    print(f"devices: {jax.devices()}", file=sys.stderr)
-
-    from distributed_point_functions_tpu.ops.expand_planes_pallas import (
-        expand_level_planes_pallas,
-        value_hash_planes_pallas,
-    )
-
-    rng = np.random.default_rng(11)
-
-    def case(g: int, kg: int, which: str, tile: int | None = None) -> dict:
-        state = jnp.asarray(
-            rng.integers(0, 1 << 32, (16, 8, g), dtype=np.uint32)
-        )
-        ctrl = jnp.asarray(rng.integers(0, 1 << 32, (g,), dtype=np.uint32))
-        cwp = jnp.asarray(
-            rng.integers(0, 1 << 32, (16, 8, kg), dtype=np.uint32)
-        )
-        cwb = jnp.asarray(rng.integers(0, 1 << 32, (kg,), dtype=np.uint32))
-        tag = {"kernel": which, "g": g, "kg": kg}
-        if tile is not None:
-            tag["tile"] = tile
-        t0 = time.perf_counter()
-        try:
-            if which == "level":
-                out = expand_level_planes_pallas(
-                    state, ctrl, cwp, cwb, cwb, tile_lanes=tile
-                )
-                jax.block_until_ready(out)
-            else:
-                out = value_hash_planes_pallas(state, ctrl, cwp)
-                jax.block_until_ready(out)
-            return {**tag, "ok": True,
-                    "compile_s": round(time.perf_counter() - t0, 1)}
-        except Exception as e:  # noqa: BLE001
-            return {**tag, "ok": False, "error": str(e).splitlines()[0][:160]}
-
-    # The 2026-07-31 expand_profile found the level kernel fine through
-    # G=1024 (one grid step) and crashing tpu_compile_helper at G=2048
-    # (the first multi-step lane grid). The kernels now chunk in XLA
-    # (grid-(1,) pallas_call per lane slice); this probe validates the
-    # chunked design at the serving widths and maps the single-block
-    # VMEM ceiling.
-    cases = [
-        # two chunks at a size known-good as one:
-        ("level", 1024, 2, 512),
-        # one big block at the size that used to crash as a 2-step grid:
-        ("level", 2048, 2, 2048),
-        # chunked defaults at the previously-crashing widths:
-        ("level", 2048, 2, None),
-        ("level", 16384, 2, None),
-        # single-block VMEM ceiling:
-        ("level", 4096, 2, 4096),
-        # wide correction sources (small in-kernel repeat factors):
-        ("level", 2048, 128, None),
-        ("level", 8192, 128, None),
-        # value-hash kernel at the bench's real leaf width:
-        ("value", 2048, 2, None),
-        ("value", 16384, 2, None),
-    ]
-    for which, g, kg, tile in cases:
-        print(json.dumps(case(g, kg, which, tile)), flush=True)
-
-    # Fused tail kernel (last r levels + value hash per subtree tile):
-    # map the VMEM ceiling over (entry width, r, tile). q128 serving is
-    # kg=4, g0=2048, r=4; q64 is kg=2, g0=1024.
-    from distributed_point_functions_tpu.ops.expand_planes_pallas import (
-        expand_tail_planes_pallas,
-    )
-
-    def tail_case(g0: int, kg: int, r: int, tile: int) -> dict:
-        state = jnp.asarray(
-            rng.integers(0, 1 << 32, (16, 8, g0), dtype=np.uint32)
-        )
-        ctrl = jnp.asarray(
-            rng.integers(0, 1 << 32, (g0,), dtype=np.uint32)
-        )
-        cwp = jnp.asarray(
-            rng.integers(0, 1 << 32, (r, 16, 8, kg), dtype=np.uint32)
-        )
-        cwb = jnp.asarray(
-            rng.integers(0, 1 << 32, (r, kg), dtype=np.uint32)
-        )
-        vc = jnp.asarray(
-            rng.integers(0, 1 << 32, (16, 8, kg), dtype=np.uint32)
-        )
-        tag = {"kernel": "tail", "g0": g0, "kg": kg, "r": r, "tile": tile,
-               "out_lanes": tile << r}
-        t0 = time.perf_counter()
-        try:
-            out = expand_tail_planes_pallas(
-                state, ctrl, cwp, cwb, cwb, vc, tile_lanes=tile
-            )
-            jax.block_until_ready(out)
-            # Per-call time after compile (whole-width launch set).
-            t1 = time.perf_counter()
-            jax.block_until_ready(
-                expand_tail_planes_pallas(
-                    state, ctrl, cwp, cwb, cwb, vc, tile_lanes=tile
-                )
-            )
-            return {**tag, "ok": True,
-                    "compile_s": round(t1 - t0, 1),
-                    "run_ms": round((time.perf_counter() - t1) * 1e3, 2)}
-        except Exception as e:  # noqa: BLE001
-            return {**tag, "ok": False,
-                    "error": str(e).splitlines()[0][:160]}
-
-    tail_cases = [
-        # q128 serving split (kg=4): vary tile -> out_lanes 2048..8192
-        (2048, 4, 4, 128),
-        (2048, 4, 4, 256),
-        (2048, 4, 4, 512),
-        # q64 serving (kg=2), deeper tails from a smaller split:
-        (1024, 2, 4, 128),
-        (512, 2, 5, 128),
-        (256, 2, 6, 128),
-        # VMEM ceiling: out 16384 lanes (8 MB) in one call
-        (2048, 4, 4, 1024),
-    ]
-    for g0, kg, r, tile in tail_cases:
-        print(json.dumps(tail_case(g0, kg, r, tile)), flush=True)
-
-    # Fused head kernel (first r levels in ONE launch from a narrow
-    # entry): Mosaic legality at the naturally narrow entry widths and
-    # compile cost vs depth. q128 serving is kg=4 entry, r=9 to the
-    # 2048-lane cap; hierarchical single-key is kg=1 entry.
     from distributed_point_functions_tpu.ops.expand_planes_pallas import (
         expand_head_planes_pallas,
+        expand_level_planes_pallas,
+        expand_tail_planes_pallas,
+        value_hash_planes_pallas,
+        walk_descend_planes_pallas,
     )
 
-    def head_case(g0: int, kg: int, r: int) -> dict:
-        state = jnp.asarray(
-            rng.integers(0, 1 << 32, (16, 8, g0), dtype=np.uint32)
-        )
-        ctrl = jnp.asarray(
-            rng.integers(0, 1 << 32, (g0,), dtype=np.uint32)
-        )
-        cwp = jnp.asarray(
-            rng.integers(0, 1 << 32, (r, 16, 8, kg), dtype=np.uint32)
-        )
-        cwb = jnp.asarray(
-            rng.integers(0, 1 << 32, (r, kg), dtype=np.uint32)
-        )
-        tag = {"kernel": "head", "g0": g0, "kg": kg, "r": r,
-               "out_lanes": g0 << r}
-        t0 = time.perf_counter()
-        try:
-            out = expand_head_planes_pallas(state, ctrl, cwp, cwb, cwb)
-            jax.block_until_ready(out)
-            t1 = time.perf_counter()
-            jax.block_until_ready(
-                expand_head_planes_pallas(state, ctrl, cwp, cwb, cwb)
-            )
-            return {**tag, "ok": True,
-                    "compile_s": round(t1 - t0, 1),
-                    "run_ms": round((time.perf_counter() - t1) * 1e3, 2)}
-        except Exception as e:  # noqa: BLE001
-            return {**tag, "ok": False,
-                    "error": str(e).splitlines()[0][:160]}
+    rng = np.random.default_rng(11 + idx)
 
-    head_cases = [
-        (4, 4, 9),    # q128 serving head: 4 -> 2048 lanes
-        (2, 2, 10),   # q64 serving head: 2 -> 2048 lanes
-        (8, 8, 8),    # q256 serving head: 8 -> 2048 lanes
-        (4, 4, 5),    # shallower split (compile-cost scaling point)
-        (1, 1, 11),   # hierarchical single-key entry: 1 -> 2048 lanes
-        (4, 4, 10),   # cap probe: 4 -> 4096 lanes (~12 MB working set)
-    ]
-    for g0, kg, r in head_cases:
-        print(json.dumps(head_case(g0, kg, r)), flush=True)
+    def u32(*shape):
+        return jnp.asarray(
+            rng.integers(0, 1 << 32, shape, dtype=np.uint32)
+        )
+
+    tag = {"kernel": kind, **{k: v for k, v in p.items()}}
+    t0 = time.perf_counter()
+    try:
+        if kind == "level":
+            g, kg, tile = p["g"], p["kg"], p["tile"]
+            out = expand_level_planes_pallas(
+                u32(16, 8, g), u32(g), u32(16, 8, kg), u32(kg), u32(kg),
+                tile_lanes=tile,
+            )
+            jax.block_until_ready(out)
+            return {**tag, "ok": True,
+                    "compile_s": round(time.perf_counter() - t0, 1)}
+        if kind == "value":
+            g, kg = p["g"], p["kg"]
+            out = value_hash_planes_pallas(
+                u32(16, 8, g), u32(g), u32(16, 8, kg)
+            )
+            jax.block_until_ready(out)
+            return {**tag, "ok": True,
+                    "compile_s": round(time.perf_counter() - t0, 1)}
+        if kind == "tail":
+            g0, kg, r, tile = p["g0"], p["kg"], p["r"], p["tile"]
+            args = (u32(16, 8, g0), u32(g0), u32(r, 16, 8, kg),
+                    u32(r, kg), u32(r, kg), u32(16, 8, kg))
+
+            def call():
+                return expand_tail_planes_pallas(*args, tile_lanes=tile)
+        elif kind == "head":
+            g0, kg, r = p["g0"], p["kg"], p["r"]
+            args = (u32(16, 8, g0), u32(g0), u32(r, 16, 8, kg),
+                    u32(r, kg), u32(r, kg))
+
+            def call():
+                return expand_head_planes_pallas(*args)
+        else:  # walk
+            g0, kg, r = p["g0"], p["kg"], p["r"]
+            tile, value = p["tile"], p["value"]
+            args = (u32(16, 8, g0), u32(g0), u32(r, 16, 8, kg),
+                    u32(r, kg), u32(r, kg),
+                    u32(16, 8, kg) if value else None)
+
+            def call():
+                return walk_descend_planes_pallas(
+                    *args, r=r, tile_lanes=tile, value_hash=value
+                )
+
+        jax.block_until_ready(call())
+        t1 = time.perf_counter()
+        jax.block_until_ready(call())
+        return {**tag, "ok": True,
+                "compile_s": round(t1 - t0, 1),
+                "run_ms": round((time.perf_counter() - t1) * 1e3, 2)}
+    except Exception as e:  # noqa: BLE001
+        return {**tag, "ok": False, "error": str(e).splitlines()[0][:160]}
+
+
+def main() -> None:
+    import signal
+
+    # A SIGTERM to this parent (the stage's outer `timeout` expiring)
+    # must not orphan a live child onto the single-client tunnel.
+    active = {"proc": None}
+
+    def _reap(signum, frame):
+        p = active["proc"]
+        if p is not None:
+            try:
+                p.kill()
+            except Exception:  # noqa: BLE001
+                pass
+        sys.exit(1)
+
+    signal.signal(signal.SIGTERM, _reap)
+    signal.signal(signal.SIGINT, _reap)
+
+    consecutive_timeouts = 0
+    for i, (kind, p) in enumerate(CASES):
+        if consecutive_timeouts >= 3:
+            print(json.dumps({"kernel": kind, **p, "ok": False,
+                              "error": "skipped: tunnel wedged "
+                              "(3 consecutive case timeouts)"}),
+                  flush=True)
+            continue
+        proc = subprocess.Popen(
+            [sys.executable, __file__, "--one", str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        active["proc"] = proc
+        try:
+            stdout, stderr = proc.communicate(timeout=CASE_TIMEOUT)
+            active["proc"] = None
+            out = (stdout or "").strip().splitlines()
+            if out:
+                print(out[-1], flush=True)
+                consecutive_timeouts = 0
+            else:
+                err = (stderr or "").strip().splitlines()
+                print(json.dumps({"kernel": kind, **p, "ok": False,
+                                  "error": "child died rc="
+                                  f"{proc.returncode}: "
+                                  f"{err[-1][:120] if err else ''}"}),
+                      flush=True)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+            active["proc"] = None
+            consecutive_timeouts += 1
+            print(json.dumps({"kernel": kind, **p, "ok": False,
+                              "error": f"timeout {CASE_TIMEOUT:.0f}s "
+                              "(hung Mosaic compile)"}), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--one":
+        print(json.dumps(run_one(int(sys.argv[2]))), flush=True)
+    else:
+        main()

@@ -96,6 +96,31 @@ def _sigma(state: jnp.ndarray) -> jnp.ndarray:
     return jnp.concatenate([hi, hi ^ lo], axis=0)
 
 
+def _aes_select_planes(
+    masks: jnp.ndarray, selb: jnp.ndarray, sig: jnp.ndarray
+) -> jnp.ndarray:
+    """Select-key AES rounds + MMO feed-forward on [16, 8, T] planes:
+    each lane's round keys come from `masks[0]` (left) or `masks[1]`
+    (right) per its `selb` mask word — the per-lane key select of
+    `dpf/internal/aes_128_fixed_key_hash_hwy.h:123-155`. Shared by the
+    path-walk and walk-descent kernels."""
+
+    def ark(st, rnd):
+        m0 = masks[0, rnd]
+        m1 = masks[1, rnd]
+        return st ^ ((m0 & ~selb) | (m1 & selb))
+
+    st = ark(sig, 0)
+    for rnd in range(1, 10):
+        st = _sub_bytes_planes(st)
+        st = _shift_rows_static(st)
+        st = _mix_columns_planes(st)
+        st = ark(st, rnd)
+    st = _sub_bytes_planes(st)
+    st = _shift_rows_static(st)
+    return ark(st, 10) ^ sig
+
+
 def _zero_lsb_plane(state: jnp.ndarray) -> jnp.ndarray:
     """state with plane [0, 0] (the seed LSB = embedded control bit)
     zeroed, built from static slices + leading-axis concatenates:
@@ -565,6 +590,203 @@ def expand_tail_planes_pallas(
     return jnp.concatenate(vs, axis=-1), jnp.concatenate(cs)
 
 
+def _walk_kernel(
+    state_ref,
+    ctrl_ref,
+    off_ref,
+    cwp_ref,
+    cwl_ref,
+    cwr_ref,
+    vc_ref,
+    masks_lr_ref,
+    masks_v_ref,
+    out_ref,
+    outc_ref,
+    *,
+    kg: int,
+    r: int,
+    value_hash: bool,
+):
+    """Constant-width descent: `r` levels + optional leaf value hash at a
+    FIXED lane width, using the per-lane select-key AES of `_path_kernel`
+    instead of the twin left/right hashes of `_tail_kernel`/`_head_kernel`.
+
+    Entry seeds arrive pre-replicated (every lane already holds the seed
+    of its leaf's ancestor at the split level), so the per-level
+    [all-left; all-right] lane concatenation — the doubling-width
+    construct Mosaic rejects at serving shapes on the 2026-08-01 v5e
+    toolchain — disappears: every intermediate is the same [16, 8, W]
+    tile-aligned shape, and leaves exit in NATURAL order (no exit
+    permutation). Each level hashes once with per-lane key select
+    (`dpf/internal/aes_128_fixed_key_hash_hwy.h:123-155` semantics), so
+    the gate work per level is HALF the twin-hash kernels'; the
+    replication inflates total gate work by ~r/2 over perfect doubling,
+    which is noise against the HBM traffic both designs already save.
+
+    off_ref: uint32[1, W] leaf offset of each lane within its entry
+    node's 2^r block (precomputed outside; bit r-1-i selects the key at
+    level i — MSB first). Everything else matches `_tail_kernel`.
+    """
+    state = state_ref[:]
+    ctrl = ctrl_ref[:][0]  # [W] packed control bits
+    off = off_ref[:]  # [1, W]
+    masks = masks_lr_ref[:]  # [2, 11, 16, 8, 1]
+    cwp_all = cwp_ref[:]  # [r, 16, 8, kg]
+    cwl_all = cwl_ref[:]  # [r, kg]
+    cwr_all = cwr_ref[:]  # [r, kg]
+    w = state.shape[-1]
+    reps = w // kg
+    zero = jnp.uint32(0)
+    for i in range(r):
+        bit = (off >> (r - 1 - i)) & jnp.uint32(1)  # [1, W]
+        selw = zero - bit  # 0x0 / 0xFFFFFFFF per lane
+        selb = selw[0][None, None, :]
+        h = _aes_select_planes(masks, selb, _sigma(state))
+        cwp = pltpu.repeat(cwp_all[i], reps, axis=2)  # [16, 8, W]
+        h = h ^ (cwp & ctrl[None, None, :])
+        t_new = h[0, 0]
+        state = _zero_lsb_plane(h)
+        cwl = pltpu.repeat(cwl_all[i][None, :], reps, axis=1)[0]
+        cwr = pltpu.repeat(cwr_all[i][None, :], reps, axis=1)[0]
+        cw_dir = (cwl & ~selw[0]) | (cwr & selw[0])
+        ctrl = t_new ^ (ctrl & cw_dir)
+    if value_hash:
+        sig = _sigma(state)
+        values = _aes_fixed_planes(masks_v_ref[:], sig) ^ sig
+        vc = pltpu.repeat(vc_ref[:], reps, axis=2)
+        out_ref[:] = values ^ (vc & ctrl[None, None, :])
+    else:
+        out_ref[:] = state
+    outc_ref[:] = ctrl[None, :]
+
+
+def replicate_entry_planes(
+    state: jnp.ndarray, ctrl: jnp.ndarray, kg: int, times: int
+) -> tuple:
+    """[16, 8, n*kg] entry planes -> [16, 8, n*times*kg] with each
+    node's kg-lane block repeated `times` consecutively (and likewise
+    for the packed control words), so lane (node*times + j)*kg + kw
+    holds node's seed for every j — the wide-walk entry layout."""
+    p, q, g = state.shape
+    n = g // kg
+    state_r = jnp.broadcast_to(
+        state.reshape(p, q, n, 1, kg), (p, q, n, times, kg)
+    ).reshape(p, q, n * times * kg)
+    ctrl_r = jnp.broadcast_to(
+        ctrl.reshape(n, 1, kg), (n, times, kg)
+    ).reshape(n * times * kg)
+    return state_r, ctrl_r
+
+
+@functools.partial(
+    jax.jit, static_argnames=("r", "tile_lanes", "value_hash", "interpret")
+)
+def walk_descend_planes_pallas(
+    state: jnp.ndarray,
+    ctrl: jnp.ndarray,
+    cwp_all: jnp.ndarray,
+    cwl_all: jnp.ndarray,
+    cwr_all: jnp.ndarray,
+    vc_kg: jnp.ndarray | None = None,
+    *,
+    r: int,
+    tile_lanes: int | None = None,
+    value_hash: bool = False,
+    interpret: bool = False,
+) -> tuple:
+    """Fixed-width fused descent of the last (or first) `r` expansion
+    levels, optionally ending in the leaf value hash.
+
+    state: uint32[16, 8, G0] planes at the split level; ctrl:
+    uint32[G0]; cwp_all: uint32[r, 16, 8, KG]; cwl_all / cwr_all:
+    uint32[r, KG]; vc_kg (with value_hash): uint32[16, 8, KG]. Returns
+    (out uint32[16, 8, G0 << r], ctrl uint32[G0 << r]) in NATURAL leaf
+    order (leaf g = entry_node * 2^r + offset) — no exit permutation.
+
+    The entry is replicated 2^r-fold outside the kernel, then each
+    `tile_lanes` output tile descends independently at constant width.
+    The replication materializes full-width in HBM (one extra
+    write+read of W lanes ~= the kernel's own output traffic — ~40 us
+    at the q128 serving width, noise against the layout traffic this
+    design deletes; an in-kernel offset-major repeat could remove it
+    later). Reference semantics: `ExpandSeeds` + `HashExpandedSeeds`
+    (`dpf/distributed_point_function.cc:289-372,523-547`), evaluated as
+    a per-leaf path walk (`dpf/internal/evaluate_prg_hwy.cc:150-539`).
+    """
+    _, _, g0 = state.shape
+    kg = cwp_all.shape[-1]
+    if g0 % kg:
+        raise ValueError(
+            f"entry lanes {g0} must be a multiple of key groups {kg}"
+        )
+    if value_hash and vc_kg is None:
+        raise ValueError(
+            "value_hash=True requires vc_kg (a zero correction would "
+            "silently break share reconstruction)"
+        )
+    w = g0 << r
+    state_r, ctrl_r = replicate_entry_planes(state, ctrl, kg, 1 << r)
+    # Leaf offset of each lane within its entry node's 2^r block.
+    off_np = np.tile(
+        np.repeat(np.arange(1 << r, dtype=np.uint32), kg), g0 // kg
+    )
+    off = jnp.asarray(off_np[None, :])
+    if tile_lanes is None:
+        tile = _pick_tile(w, kg)
+    else:
+        tile = tile_lanes
+    _check_tile(tile, w, kg)
+    if vc_kg is None:
+        vc_kg = jnp.zeros((16, 8, kg), U32)
+    masks_v = jnp.asarray(_MASKS_VALUE)
+    ctrl2 = ctrl_r[None, :]
+
+    def call(state_c, ctrl_c, off_c):
+        t = state_c.shape[-1]
+        return pl.pallas_call(
+            functools.partial(
+                _walk_kernel, kg=kg, r=r, value_hash=value_hash
+            ),
+            grid=(1,),
+            in_specs=[
+                pl.BlockSpec((16, 8, t), lambda l: (0, 0, 0)),
+                pl.BlockSpec((1, t), lambda l: (0, 0)),
+                pl.BlockSpec((1, t), lambda l: (0, 0)),
+                pl.BlockSpec((r, 16, 8, kg), lambda l: (0, 0, 0, 0)),
+                pl.BlockSpec((r, kg), lambda l: (0, 0)),
+                pl.BlockSpec((r, kg), lambda l: (0, 0)),
+                pl.BlockSpec((16, 8, kg), lambda l: (0, 0, 0)),
+                pl.BlockSpec(
+                    (2, 11, 16, 8, 1), lambda l: (0, 0, 0, 0, 0)
+                ),
+                pl.BlockSpec((11, 16, 8, 1), lambda l: (0, 0, 0, 0)),
+            ],
+            out_specs=(
+                pl.BlockSpec((16, 8, t), lambda l: (0, 0, 0)),
+                pl.BlockSpec((1, t), lambda l: (0, 0)),
+            ),
+            out_shape=(
+                jax.ShapeDtypeStruct((16, 8, t), U32),
+                jax.ShapeDtypeStruct((1, t), U32),
+            ),
+            interpret=interpret,
+        )(
+            state_c, ctrl_c, off_c, cwp_all, cwl_all, cwr_all, vc_kg,
+            _MASKS_LR, masks_v,
+        )
+
+    outs, cs = [], []
+    for lo in range(0, w, tile):
+        o, c = call(
+            state_r[:, :, lo : lo + tile],
+            ctrl2[:, lo : lo + tile],
+            off[:, lo : lo + tile],
+        )
+        outs.append(o)
+        cs.append(c[0])
+    return jnp.concatenate(outs, axis=-1), jnp.concatenate(cs)
+
+
 def _path_kernel(
     state_ref,
     ctrl_ref,
@@ -590,21 +812,7 @@ def _path_kernel(
     masks = masks_ref[:]  # [2, 11, 16, 8, 1] left/right plane masks
     sel = sel_ref[:]  # [1, T] packed path bits
     selb = sel[0][None, None, :]
-
-    def ark(st, rnd):
-        m0 = masks[0, rnd]
-        m1 = masks[1, rnd]
-        return st ^ ((m0 & ~selb) | (m1 & selb))
-
-    st = ark(sig, 0)
-    for rnd in range(1, 10):
-        st = _sub_bytes_planes(st)
-        st = _shift_rows_static(st)
-        st = _mix_columns_planes(st)
-        st = ark(st, rnd)
-    st = _sub_bytes_planes(st)
-    st = _shift_rows_static(st)
-    h = ark(st, 10) ^ sig
+    h = _aes_select_planes(masks, selb, sig)
 
     ctrl = ctrl_ref[:]  # [1, T]
     if per_seed:

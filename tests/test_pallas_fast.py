@@ -289,6 +289,113 @@ def test_tail_failure_demotes_tail_mode(monkeypatch):
     assert dep._level_kernel_enabled() == "tail"
 
 
+@pytest.mark.parametrize("value_hash", [False, True])
+def test_walk_descend_kernel_tiny(value_hash):
+    """Fixed-width walk-descent vs the doubling expansion: 2 levels from
+    2 entry nodes, natural leaf order (the doubling twin's [all-left;
+    all-right] order is mapped through tail_node_permutation)."""
+    from distributed_point_functions_tpu.ops.expand_planes_pallas import (
+        tail_node_permutation,
+        walk_descend_planes_pallas,
+    )
+
+    nk, r = 32, 2
+    kg = 1
+    n_entry = 2
+    g0 = n_entry * kg
+    state, ctrl, cw, cwl, cwr = _inputs(g0, nk)
+    cwp_all = jnp.stack(
+        [pack_key_planes(jnp.asarray(
+            RNG.integers(0, 1 << 32, (nk, 4), dtype=np.uint32)
+        )) for _ in range(r)]
+    )
+    cwl_all = jnp.stack(
+        [pack_key_bits(jnp.asarray(
+            RNG.integers(0, 2, (nk,), dtype=np.uint32)
+        )) for _ in range(r)]
+    )
+    cwr_all = jnp.stack(
+        [pack_key_bits(jnp.asarray(
+            RNG.integers(0, 2, (nk,), dtype=np.uint32)
+        )) for _ in range(r)]
+    )
+    vc = pack_key_planes(jnp.asarray(
+        RNG.integers(0, 1 << 32, (nk, 4), dtype=np.uint32)
+    ))
+
+    @jax.jit
+    def twin_doubling(state, ctrl):
+        s, c = jnp.asarray(state), jnp.asarray(ctrl)
+        for i in range(r):
+            g2 = 2 * s.shape[-1]
+            s, c = expand_level_planes(
+                s, c, _tile_keys(cwp_all[i], g2),
+                _tile_keys(cwl_all[i], g2 // 2),
+                _tile_keys(cwr_all[i], g2 // 2),
+            )
+        if value_hash:
+            s = mmo_hash_planes(fixed_keys.RK_VALUE, s) ^ (
+                _tile_keys(vc, s.shape[-1]) & c[None, None, :]
+            )
+        return s, c
+
+    want_s, want_c = twin_doubling(state, ctrl)
+    # Map the doubling twin's global [all-left; all-right] node order to
+    # the walk kernel's natural leaf order.
+    order, _ = tail_node_permutation(np.arange(n_entry), r, n_entry)
+    pos_of_leaf = np.argsort(order)
+    lane_gather = (
+        pos_of_leaf[:, None] * kg + np.arange(kg)[None, :]
+    ).reshape(-1)
+    want_s = np.asarray(want_s)[:, :, lane_gather]
+    want_c = np.asarray(want_c)[lane_gather]
+
+    got_s, got_c = walk_descend_planes_pallas(
+        jnp.asarray(state), jnp.asarray(ctrl), cwp_all, cwl_all,
+        cwr_all, vc if value_hash else None, r=r,
+        tile_lanes=g0 << r, value_hash=value_hash, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got_s), want_s)
+    np.testing.assert_array_equal(np.asarray(got_c), want_c)
+
+
+def test_walk_descend_multi_tile():
+    """Tile boundaries inside and across the 2^r leaf blocks must not
+    change the result (per-lane descent is tile-local)."""
+    from distributed_point_functions_tpu.ops.expand_planes_pallas import (
+        walk_descend_planes_pallas,
+    )
+
+    nk, r, kg, n_entry = 64, 2, 2, 2
+    g0 = n_entry * kg
+    state, ctrl, _, _, _ = _inputs(g0, nk)
+    cwp_all = jnp.stack(
+        [pack_key_planes(jnp.asarray(
+            RNG.integers(0, 1 << 32, (nk, 4), dtype=np.uint32)
+        )) for _ in range(r)]
+    )
+    cwl_all = jnp.stack(
+        [pack_key_bits(jnp.asarray(
+            RNG.integers(0, 2, (nk,), dtype=np.uint32)
+        )) for _ in range(r)]
+    )
+    cwr_all = jnp.stack(
+        [pack_key_bits(jnp.asarray(
+            RNG.integers(0, 2, (nk,), dtype=np.uint32)
+        )) for _ in range(r)]
+    )
+    full, full_c = walk_descend_planes_pallas(
+        jnp.asarray(state), jnp.asarray(ctrl), cwp_all, cwl_all,
+        cwr_all, r=r, tile_lanes=g0 << r, interpret=True,
+    )
+    tiled, tiled_c = walk_descend_planes_pallas(
+        jnp.asarray(state), jnp.asarray(ctrl), cwp_all, cwl_all,
+        cwr_all, r=r, tile_lanes=kg * 2, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(tiled))
+    np.testing.assert_array_equal(np.asarray(full_c), np.asarray(tiled_c))
+
+
 def test_kernel_verdict_cache_roundtrip(tmp_path, monkeypatch):
     """A recorded Mosaic failure verdict must be re-applied in a fresh
     process (simulated by resetting the flags + the loaded marker):
