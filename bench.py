@@ -747,56 +747,70 @@ def main():
             )
         except Exception:  # noqa: BLE001
             continue
-        remaining = _PROGRESS.get("deadline", 0) - time.monotonic()
-        status = _dep.level_kernel_status()
-        head_on = status["head_verified"] and not status["head_failed"]
-        ladder = []
-        if head_on:
-            ladder.append(("head", {"DPF_TPU_HEAD_LEVELS": "0"}))
-        if status["tail_verified"] and not status["tail_failed"]:
-            ladder.append(
-                ("tail", {"DPF_TPU_HEAD_LEVELS": "0",
-                          "DPF_TPU_LEVEL_KERNEL": "pallas"})
-            )
-        for tier, env in ladder:
-            if remaining < 420:
-                _log("kernel-demotion ladder skipped (watchdog budget)")
-                break
-            saved = {k: os.environ.get(k) for k in env}
-            os.environ.update(env)
-            try:
-                retry_ok = _try_compile(
-                    "planes", make_pir_step(functools.partial(
-                        evaluate_selection_blocks_planes,
-                        force_planes=True,
-                    ))
-                )
-            finally:
-                for k, v in saved.items():
-                    if v is None:
-                        os.environ.pop(k, None)
-                    else:
-                        os.environ[k] = v
+        # Tiers demote cumulatively (each retry keeps the earlier
+        # demotions): the original attempt already proved the full
+        # composition fails, so stripping tiers until a compile lands
+        # both attributes the failure and leaves serving on the best
+        # surviving tier. The next tier is re-chosen from live status
+        # each round (demoting walk re-warms the self-checks, which can
+        # newly verify the tail tier). Verdicts persist ONLY with
+        # evidence: a landing records the removed tiers; exhausting
+        # every tier records the family failure; a budget abort resets
+        # the speculative flags and records nothing.
+        tried = []
+        landed = exhausted = False
+        while True:
             remaining = _PROGRESS.get("deadline", 0) - time.monotonic()
-            if retry_ok:
-                if tier == "head":
-                    _dep._HEAD_KERNEL_FAILED = True
-                    _log("auto pipeline compiles without the head: "
-                         "demoting the fused head (persisted)")
-                else:
-                    _dep._HEAD_KERNEL_FAILED = True
-                    _dep._TAIL_KERNEL_FAILED = True
-                    _log("auto pipeline compiles per-level only: "
-                         "demoting head+tail (persisted)")
-                _dep.record_kernel_verdicts()
+            if remaining < 420:
+                _log("kernel-demotion ladder stopped (watchdog budget)")
                 break
+            status = _dep.level_kernel_status()
+            if status["walk_verified"] and not status["walk_failed"]:
+                tier, flag = "walk", "_WALK_KERNEL_FAILED"
+            elif status["head_verified"] and not status["head_failed"]:
+                tier, flag = "head", "_HEAD_KERNEL_FAILED"
+            elif status["tail_verified"] and not status["tail_failed"]:
+                tier, flag = "tail", "_TAIL_KERNEL_FAILED"
+            else:
+                exhausted = True
+                break
+            setattr(_dep, flag, True)
+            tried.append(flag)
+            if tier == "walk":
+                # Walk won auto before the tail self-check ever ran;
+                # re-warm so the traced retry can resolve to a newly
+                # verified tail instead of silently skipping it.
+                try:
+                    _dep.warm_level_kernels()
+                except Exception:  # noqa: BLE001
+                    pass
+            retry_ok = _try_compile(
+                "planes", make_pir_step(functools.partial(
+                    evaluate_selection_blocks_planes,
+                    force_planes=True,
+                ))
+            )
+            if retry_ok:
+                _log(f"auto pipeline compiles without the {tier} "
+                     "tier; demotion persisted")
+                landed = True
+                break
+        if landed:
+            _dep.record_kernel_verdicts()
+        elif exhausted:
+            # Reached with tried empty when the original failing
+            # attempt was already the bare per-level composition.
+            # Every composition failed: the per-level family itself
+            # is unusable at this serving shape.
+            _dep._remember_level_kernel_failure()
+            _log("no kernel composition compiles at serving shape; "
+                 "level-kernel family demoted (persisted)")
+            _dep.record_kernel_verdicts()
         else:
-            if ladder and remaining >= 420:
-                # Every composition failed: the per-level family itself
-                # is unusable at this serving shape.
-                _dep._remember_level_kernel_failure()
-                _log("no kernel composition compiles at serving shape; "
-                     "level-kernel family demoted (persisted)")
+            # No attribution evidence (budget abort, or nothing to
+            # try): a tier must not stay demoted on zero evidence.
+            for flag in tried:
+                setattr(_dep, flag, False)
     try:
         from distributed_point_functions_tpu.pir.dense_eval_planes import (
             level_kernel_status,

@@ -160,6 +160,7 @@ def main() -> None:
     signal.signal(signal.SIGINT, _reap)
 
     consecutive_timeouts = 0
+    results = []
     for i, (kind, p) in enumerate(CASES):
         if consecutive_timeouts >= 3:
             print(json.dumps({"kernel": kind, **p, "ok": False,
@@ -179,6 +180,10 @@ def main() -> None:
             if out:
                 print(out[-1], flush=True)
                 consecutive_timeouts = 0
+                try:
+                    results.append(json.loads(out[-1]))
+                except ValueError:
+                    pass
             else:
                 err = (stderr or "").strip().splitlines()
                 print(json.dumps({"kernel": kind, **p, "ok": False,
@@ -186,6 +191,7 @@ def main() -> None:
                                   f"{proc.returncode}: "
                                   f"{err[-1][:120] if err else ''}"}),
                       flush=True)
+                results.append({"kernel": kind, "ok": False})
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.communicate()
@@ -194,10 +200,47 @@ def main() -> None:
             print(json.dumps({"kernel": kind, **p, "ok": False,
                               "error": f"timeout {CASE_TIMEOUT:.0f}s "
                               "(hung Mosaic compile)"}), flush=True)
+            results.append({"kernel": kind, "ok": False})
+
+    # Persist failure verdicts so serving/bench processes skip the
+    # doomed compiles this sweep just paid for. Failures only — the
+    # probe checks compile/run, not bit identity, so it must never set
+    # a VERIFIED flag. Runs in a bounded child (recording needs a
+    # backend init, which hangs when the tunnel is wedged).
+    fams = {}
+    for res in results:
+        fams.setdefault(res["kernel"], []).append(res["ok"])
+    failed = [
+        k for k in ("walk", "tail", "head")
+        if k in fams and not any(fams[k])
+    ]
+    if failed:
+        try:
+            subprocess.run(
+                [sys.executable, __file__, "--record",
+                 ",".join(failed)],
+                timeout=120, capture_output=True,
+            )
+            print(json.dumps({"recorded_failures": failed}), flush=True)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def record_failures(families: list) -> None:
+    """Child mode: persist FAILED flags for whole kernel families whose
+    every probed case failed (see the verdict cache in
+    dense_eval_planes — serving skips known-doomed Mosaic compiles)."""
+    from distributed_point_functions_tpu.pir import dense_eval_planes as dep
+
+    for fam in families:
+        setattr(dep, f"_{fam.upper()}_KERNEL_FAILED", True)
+    dep.record_kernel_verdicts()
 
 
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--one":
         print(json.dumps(run_one(int(sys.argv[2]))), flush=True)
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--record":
+        record_failures(sys.argv[2].split(","))
     else:
         main()

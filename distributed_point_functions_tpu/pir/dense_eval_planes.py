@@ -62,6 +62,7 @@ from ..ops.expand_planes_pallas import (
     expand_tail_planes_pallas,
     tail_node_permutation,
     value_hash_planes_pallas,
+    walk_descend_planes_pallas,
 )
 from .dense_eval import _walk_zeros
 
@@ -80,6 +81,17 @@ def bitrev_permutation(levels: int) -> np.ndarray:
             x >>= 1
         perm[g] = r
     return perm
+
+
+def walk_leaf_order(entry_order: np.ndarray, r: int) -> np.ndarray:
+    """Leaf order after a fixed-width walk-descent of `r` levels: each
+    entry node's 2^r leaves exit consecutively in natural offset order
+    (`walk_descend_planes_pallas`), so order[p * 2^r + off] =
+    entry_order[p] * 2^r + off."""
+    m = np.asarray(entry_order, dtype=np.int64)
+    return (
+        m[:, None] * (1 << r) + np.arange(1 << r, dtype=np.int64)[None, :]
+    ).reshape(-1)
 
 
 def pack_key_planes(cw: jnp.ndarray) -> jnp.ndarray:
@@ -181,19 +193,33 @@ def evaluate_selection_blocks_planes(
         )
     mode = _level_kernel_enabled()
     if mode:
-        # Tail mode fuses the last levels + value hash per subtree tile
+        # Walk mode runs the fixed-width descent kernels (head + tail);
+        # tail mode fuses the last levels + value hash per subtree tile
         # (one kernel launch each); the fused head covers the narrow
         # entry levels in one launch; the per-level kernels (if any
         # levels remain) cover the middle.
         tail_levels = tile_nodes = 0
+        tail_kind = head_kind = "concat"
         kg = padded // 32
-        if mode == "tail" and not bitrev_leaves:
-            tail_levels, tile_nodes = _tail_split(kg, expand_levels)
-        head_levels = _head_split(kg, expand_levels - tail_levels)
+        if mode == "walk" and not bitrev_leaves:
+            # The walk kernels exit in natural leaf order, which the
+            # exit gather absorbs; the bitrev-staged serving path
+            # (bitrev_leaves=True) assumes doubling order, so walk
+            # stays off there until staging is order-aware.
+            tail_kind = head_kind = "walk"
+            tail_levels = min(_tail_levels_requested(), expand_levels)
+            head_levels = _walk_head_split(
+                kg, expand_levels - tail_levels
+            )
+        else:
+            if mode == "tail" and not bitrev_leaves:
+                tail_levels, tile_nodes = _tail_split(kg, expand_levels)
+            head_levels = _head_split(kg, expand_levels - tail_levels)
         forced = os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto") in (
-            "pallas", "tail"
+            "pallas", "tail", "walk"
         )
         global _HEAD_KERNEL_FAILED, _TAIL_KERNEL_FAILED
+        global _WALK_KERNEL_FAILED
         try:
             return _evaluate_selection_blocks_planes_jit(
                 seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
@@ -205,10 +231,33 @@ def evaluate_selection_blocks_planes(
                 tail_levels=tail_levels,
                 tail_tile_nodes=tile_nodes,
                 head_levels=head_levels,
+                tail_kind=tail_kind,
+                head_kind=head_kind,
             )
         except Exception as e:  # noqa: BLE001 - degrade, don't die
             if forced:
                 raise
+            if tail_kind == "walk":
+                # Walk-mode failure: demote the walk family and re-enter
+                # the dispatcher, which now resolves to the concat/
+                # per-level tiers (their own degradation chain below
+                # handles any further failures).
+                _WALK_KERNEL_FAILED = True
+                record_kernel_verdicts()
+                warnings.warn(
+                    "walk-descent kernels failed at serving shape; "
+                    "re-dispatching without them "
+                    f"({str(e).splitlines()[0][:200]})"
+                )
+                return evaluate_selection_blocks_planes(
+                    seeds0, control0, cw_seeds, cw_left,
+                    cw_right, last_vc,
+                    walk_levels=walk_levels,
+                    expand_levels=expand_levels,
+                    num_blocks=num_blocks,
+                    bitrev_leaves=bitrev_leaves,
+                    force_planes=force_planes,
+                )
             if head_levels:
                 # Retry without the head, keeping the tail. The head is
                 # demoted ONLY when this retry succeeds — a shared
@@ -307,6 +356,7 @@ _VERDICT_FLAGS = (
     "_LEVEL_KERNEL_VERIFIED", "_LEVEL_KERNEL_FAILED",
     "_TAIL_KERNEL_VERIFIED", "_TAIL_KERNEL_FAILED",
     "_HEAD_KERNEL_VERIFIED", "_HEAD_KERNEL_FAILED",
+    "_WALK_KERNEL_VERIFIED", "_WALK_KERNEL_FAILED",
 )
 
 
@@ -629,6 +679,101 @@ def _head_kernel_selfcheck() -> bool:
     return True
 
 
+_WALK_KERNEL_VERIFIED = False
+_WALK_KERNEL_FAILED = False
+
+
+def _walk_head_split(key_groups: int, a_levels: int) -> int:
+    """Head depth for walk mode: same VMEM-cap fill rule as the concat
+    head (`_head_split`) but gated on the walk flags (the walk kernels
+    are their own Mosaic program family). DPF_TPU_HEAD_LEVELS forces."""
+    if a_levels <= 0:
+        return 0
+    raw = os.environ.get("DPF_TPU_HEAD_LEVELS", "auto")
+    if raw != "auto":
+        try:
+            return max(0, min(int(raw), a_levels))
+        except ValueError:
+            pass
+    return _auto_head_count(_head_max_lanes(), key_groups, a_levels)
+
+
+def _walk_kernel_selfcheck() -> bool:
+    """One-time on-device bit-identity check of the fixed-width
+    walk-descent kernel (2 levels + value hash, 2 tiles) against the
+    doubling XLA twin, at a >=128-lane tile like the shapes it serves."""
+    global _WALK_KERNEL_VERIFIED, _WALK_KERNEL_FAILED
+    if _WALK_KERNEL_FAILED:
+        return False
+    if _WALK_KERNEL_VERIFIED:
+        return True
+    import numpy as _np
+
+    rng = _np.random.default_rng(2468)
+    g0, nk, r, tile = 128, 64, 2, 256
+    kg = nk // 32
+    state = jnp.asarray(
+        rng.integers(0, 1 << 32, (16, 8, g0), dtype=_np.uint32)
+    )
+    ctrl = jnp.asarray(rng.integers(0, 1 << 32, (g0,), dtype=_np.uint32))
+    cwp = [
+        pack_key_planes(jnp.asarray(
+            rng.integers(0, 1 << 32, (nk, 4), dtype=_np.uint32)
+        ))
+        for _ in range(r)
+    ]
+    cwl = [
+        pack_key_bits(jnp.asarray(
+            rng.integers(0, 2, (nk,), dtype=_np.uint32)
+        ))
+        for _ in range(r)
+    ]
+    cwr = [
+        pack_key_bits(jnp.asarray(
+            rng.integers(0, 2, (nk,), dtype=_np.uint32)
+        ))
+        for _ in range(r)
+    ]
+    vc = pack_key_planes(jnp.asarray(
+        rng.integers(0, 1 << 32, (nk, 4), dtype=_np.uint32)
+    ))
+    s, c = state, ctrl
+    for i in range(r):
+        g2 = 2 * s.shape[-1]
+        s, c = expand_level_planes(
+            s, c, _tile_keys(cwp[i], g2), _tile_keys(cwl[i], g2 // 2),
+            _tile_keys(cwr[i], g2 // 2),
+        )
+    want_v = mmo_hash_planes(fixed_keys.RK_VALUE, s) ^ (
+        _tile_keys(vc, s.shape[-1]) & c[None, None, :]
+    )
+    # Map the doubling twin's [all-left; all-right] node order to the
+    # walk kernel's natural order.
+    n_entry = g0 // kg
+    order = tail_node_permutation(
+        _np.arange(n_entry), r, n_entry
+    )[0]
+    pos_of_leaf = _np.argsort(order)
+    lanes = (
+        pos_of_leaf[:, None] * kg + _np.arange(kg)[None, :]
+    ).reshape(-1)
+    got_v, got_c = walk_descend_planes_pallas(
+        state, ctrl, jnp.stack(cwp), jnp.stack(cwl), jnp.stack(cwr),
+        vc, r=r, tile_lanes=tile, value_hash=True,
+    )
+    if not (
+        _np.array_equal(
+            _np.asarray(got_v), _np.asarray(want_v)[:, :, lanes]
+        )
+        and _np.array_equal(
+            _np.asarray(got_c), _np.asarray(c)[lanes]
+        )
+    ):
+        raise RuntimeError("walk kernel/XLA bit mismatch on this device")
+    _WALK_KERNEL_VERIFIED = True
+    return True
+
+
 def _tail_kernel_selfcheck() -> bool:
     """One-time on-device bit-identity check of the fused tail kernel
     (2 levels + value hash over 2 tiles) against the XLA twin. Separate
@@ -733,6 +878,8 @@ def level_kernel_status() -> dict:
         "tail_failed": _TAIL_KERNEL_FAILED,
         "head_verified": _HEAD_KERNEL_VERIFIED,
         "head_failed": _HEAD_KERNEL_FAILED,
+        "walk_verified": _WALK_KERNEL_VERIFIED,
+        "walk_failed": _WALK_KERNEL_FAILED,
     }
 
 
@@ -795,16 +942,17 @@ def _tail_split(
 
 def _level_kernel_enabled():
     """Whether (and how) the fused Pallas kernels serve the expansion:
-    False, "pallas" (per-level kernels), or "tail" (per-level kernels
-    plus the fused multi-level tail + value hash).
+    False, "pallas" (per-level kernels), "tail" (per-level kernels plus
+    the fused multi-level tail + value hash), or "walk" (fixed-width
+    walk-descent head + tail).
 
-    DPF_TPU_LEVEL_KERNEL=pallas|tail forces the mode (errors propagate),
-    =xla disables it; auto uses the per-level kernels on TPU after a
-    one-time on-device bit-identity self-check, until a remembered
-    failure."""
-    global _TAIL_KERNEL_FAILED
+    DPF_TPU_LEVEL_KERNEL=pallas|tail|walk forces the mode (errors
+    propagate), =xla disables it; auto prefers walk > tail > per-level
+    on TPU after one-time on-device bit-identity self-checks, until a
+    remembered failure."""
+    global _TAIL_KERNEL_FAILED, _WALK_KERNEL_FAILED
     mode = os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto")
-    if mode in ("pallas", "tail"):
+    if mode in ("pallas", "tail", "walk"):
         return mode
     if mode == "xla":
         return False
@@ -833,6 +981,8 @@ def _level_kernel_enabled():
                     "context before building traced programs"
                 )
             return False
+        if _WALK_KERNEL_VERIFIED and not _WALK_KERNEL_FAILED:
+            return "walk"
         return (
             "tail"
             if _TAIL_KERNEL_VERIFIED and not _TAIL_KERNEL_FAILED
@@ -860,8 +1010,19 @@ def _level_kernel_enabled():
             "fused head kernel failed its on-device self-check; "
             f"serving without it ({str(e).splitlines()[0][:200]})"
         )
-    # Prefer the fused tail when it verifies on this device; a tail-only
-    # failure degrades to the per-level kernels, not to XLA.
+    # Prefer the walk-descent kernels (fixed-width, no doubling
+    # constructs) when they verify on this device; then the fused tail;
+    # a fused-kernel failure degrades to the per-level kernels, not XLA.
+    try:
+        if _walk_kernel_selfcheck():
+            record_kernel_verdicts()
+            return "walk"
+    except Exception as e:  # noqa: BLE001 - never break serving
+        _WALK_KERNEL_FAILED = True
+        warnings.warn(
+            "walk-descent kernel failed its on-device self-check; "
+            f"trying the fused tail ({str(e).splitlines()[0][:200]})"
+        )
     try:
         if _tail_kernel_selfcheck():
             record_kernel_verdicts()
@@ -882,6 +1043,7 @@ def _level_kernel_enabled():
     static_argnames=(
         "walk_levels", "expand_levels", "num_blocks", "bitrev_leaves",
         "level_kernel", "tail_levels", "tail_tile_nodes", "head_levels",
+        "tail_kind", "head_kind",
     ),
 )
 def _evaluate_selection_blocks_planes_jit(
@@ -900,6 +1062,8 @@ def _evaluate_selection_blocks_planes_jit(
     tail_levels: int = 0,
     tail_tile_nodes: int = 0,
     head_levels: int = 0,
+    tail_kind: str = "concat",
+    head_kind: str = "concat",
 ) -> jnp.ndarray:
     """Drop-in for `dense_eval.evaluate_selection_blocks` (bit-identical
     output), computed with the plane-resident expansion.
@@ -930,28 +1094,41 @@ def _evaluate_selection_blocks_planes_jit(
     ctrl = pack_key_bits(control.astype(U32))  # [key_groups]
 
     a_levels = expand_levels - tail_levels
+    # Leaf order bookkeeping (static numpy): each phase appends its own
+    # node order; the exit gather is argsort of the composition.
+    leaf_order = np.zeros(1, dtype=np.int64)
     start = 0
     if head_levels:
         # Fused head: the first levels in ONE launch over the (narrow)
-        # full width — bit-identical to the per-level sequence, so the
-        # rest of the pipeline is unchanged.
+        # full width. The concat head is bit-identical to the per-level
+        # sequence (doubling order); the walk head exits in natural
+        # order, which the exit gather absorbs.
         hs = walk_levels
-        state, ctrl = expand_head_planes_pallas(
-            state,
-            ctrl,
-            jnp.stack(
-                [pack_key_planes(cw_seeds[hs + j])
-                 for j in range(head_levels)]
-            ),
-            jnp.stack(
-                [pack_key_bits(cw_left[hs + j])
-                 for j in range(head_levels)]
-            ),
-            jnp.stack(
-                [pack_key_bits(cw_right[hs + j])
-                 for j in range(head_levels)]
-            ),
+        cwp_head = jnp.stack(
+            [pack_key_planes(cw_seeds[hs + j])
+             for j in range(head_levels)]
         )
+        cwl_head = jnp.stack(
+            [pack_key_bits(cw_left[hs + j])
+             for j in range(head_levels)]
+        )
+        cwr_head = jnp.stack(
+            [pack_key_bits(cw_right[hs + j])
+             for j in range(head_levels)]
+        )
+        if head_kind == "walk":
+            state, ctrl = walk_descend_planes_pallas(
+                state, ctrl, cwp_head, cwl_head, cwr_head,
+                r=head_levels,
+            )
+            leaf_order = walk_leaf_order(leaf_order, head_levels)
+        else:
+            state, ctrl = expand_head_planes_pallas(
+                state, ctrl, cwp_head, cwl_head, cwr_head
+            )
+            leaf_order = tail_node_permutation(
+                leaf_order, head_levels, leaf_order.size
+            )[0]
         start = head_levels
     for i in range(start, a_levels):
         lvl = walk_levels + i
@@ -973,6 +1150,12 @@ def _evaluate_selection_blocks_planes_jit(
             _tile_keys(pack_key_bits(cw_right[lvl]), groups2 // 2),
         )
 
+    # The per-level phase appends [all-left; all-right] once per level.
+    if a_levels > start:
+        leaf_order = tail_node_permutation(
+            leaf_order, a_levels - start, leaf_order.size
+        )[0]
+
     # Leaf value blocks: output PRG + XOR value correction (party
     # negation is the identity for XOR shares).
     tile_nodes = tail_tile_nodes
@@ -991,15 +1174,26 @@ def _evaluate_selection_blocks_planes_jit(
             [pack_key_bits(cw_right[base + j])
              for j in range(tail_levels)]
         )
-        values, _ = expand_tail_planes_pallas(
-            state,
-            ctrl,
-            cwp_tail,
-            cwl_tail,
-            cwr_tail,
-            pack_key_planes(last_vc),
-            tile_lanes=tile_nodes * key_groups,
-        )
+        if tail_kind == "walk":
+            values, _ = walk_descend_planes_pallas(
+                state, ctrl, cwp_tail, cwl_tail, cwr_tail,
+                pack_key_planes(last_vc),
+                r=tail_levels, value_hash=True,
+            )
+            leaf_order = walk_leaf_order(leaf_order, tail_levels)
+        else:
+            values, _ = expand_tail_planes_pallas(
+                state,
+                ctrl,
+                cwp_tail,
+                cwl_tail,
+                cwr_tail,
+                pack_key_planes(last_vc),
+                tile_lanes=tile_nodes * key_groups,
+            )
+            leaf_order = tail_node_permutation(
+                leaf_order, tail_levels, tile_nodes
+            )[0]
     elif level_kernel:
         values = value_hash_planes_pallas(
             state, ctrl, pack_key_planes(last_vc)
@@ -1014,16 +1208,11 @@ def _evaluate_selection_blocks_planes_jit(
     out = planes_to_limbs(values).reshape(w, nkp, 4)
     out = jnp.moveaxis(out, 0, 1)
     if not bitrev_leaves:
-        if tail_levels:
-            # The tiled tail's leaf order composes phase A's bit-reversal
-            # with per-tile plane order; tail_node_permutation mirrors
-            # the exact concatenation structure.
-            _, perm_np = tail_node_permutation(
-                bitrev_permutation(a_levels), tail_levels, tile_nodes
-            )
-            perm = jnp.asarray(perm_np)
-        else:
-            perm = jnp.asarray(bitrev_permutation(expand_levels))
+        # The exit gather is argsort of the composed per-phase leaf
+        # order (doubling phases append [all-left; all-right]; walk
+        # phases emit natural offsets) — for pure doubling this equals
+        # the classic bit-reversal permutation.
+        perm = jnp.asarray(np.argsort(leaf_order))
         out = out[:, perm, :][:, :num_blocks, :]
         if out.shape[1] < num_blocks:
             # Blocks beyond the tree's capacity (mesh-padded databases)
