@@ -759,42 +759,51 @@ def main():
         # the speculative flags and records nothing.
         tried = []
         landed = exhausted = False
-        while True:
-            remaining = _PROGRESS.get("deadline", 0) - time.monotonic()
-            if remaining < 420:
-                _log("kernel-demotion ladder stopped (watchdog budget)")
-                break
-            status = _dep.level_kernel_status()
-            if status["walk_verified"] and not status["walk_failed"]:
-                tier, flag = "walk", "_WALK_KERNEL_FAILED"
-            elif status["head_verified"] and not status["head_failed"]:
-                tier, flag = "head", "_HEAD_KERNEL_FAILED"
-            elif status["tail_verified"] and not status["tail_failed"]:
-                tier, flag = "tail", "_TAIL_KERNEL_FAILED"
-            else:
-                exhausted = True
-                break
-            setattr(_dep, flag, True)
-            tried.append(flag)
-            if tier == "walk":
-                # Walk won auto before the tail self-check ever ran;
-                # re-warm so the traced retry can resolve to a newly
-                # verified tail instead of silently skipping it.
-                try:
-                    _dep.warm_level_kernels()
-                except Exception:  # noqa: BLE001
-                    pass
-            retry_ok = _try_compile(
-                "planes", make_pir_step(functools.partial(
-                    evaluate_selection_blocks_planes,
-                    force_planes=True,
-                ))
-            )
-            if retry_ok:
-                _log(f"auto pipeline compiles without the {tier} "
-                     "tier; demotion persisted")
-                landed = True
-                break
+        # The whole speculative region runs with verdict recording
+        # suspended: the retries themselves re-enter
+        # _level_kernel_enabled/warm_level_kernels, which would
+        # otherwise persist the speculative FAILED flags even when the
+        # ladder later aborts without evidence.
+        with _dep.suspend_verdict_recording():
+            while True:
+                remaining = (
+                    _PROGRESS.get("deadline", 0) - time.monotonic()
+                )
+                if remaining < 420:
+                    _log("kernel-demotion ladder stopped "
+                         "(watchdog budget)")
+                    break
+                status = _dep.level_kernel_status()
+                if status["walk_verified"] and not status["walk_failed"]:
+                    tier, flag = "walk", "_WALK_KERNEL_FAILED"
+                elif status["head_verified"] and not status["head_failed"]:
+                    tier, flag = "head", "_HEAD_KERNEL_FAILED"
+                elif status["tail_verified"] and not status["tail_failed"]:
+                    tier, flag = "tail", "_TAIL_KERNEL_FAILED"
+                else:
+                    exhausted = True
+                    break
+                setattr(_dep, flag, True)
+                tried.append(flag)
+                if tier == "walk":
+                    # Walk won auto before the tail self-check ever
+                    # ran; re-warm so the traced retry can resolve to
+                    # a newly verified tail instead of skipping it.
+                    try:
+                        _dep.warm_level_kernels()
+                    except Exception:  # noqa: BLE001
+                        pass
+                retry_ok = _try_compile(
+                    "planes", make_pir_step(functools.partial(
+                        evaluate_selection_blocks_planes,
+                        force_planes=True,
+                    ))
+                )
+                if retry_ok:
+                    _log(f"auto pipeline compiles without the {tier} "
+                         "tier; demotion persisted")
+                    landed = True
+                    break
         if landed:
             _dep.record_kernel_verdicts()
         elif exhausted:

@@ -380,7 +380,9 @@ def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False,
                              tail_req: int = 0,
                              tail_tile_target: int = 0,
                              head_req: int = 0,
-                             head_cap: int = 0):
+                             head_cap: int = 0,
+                             tail_kind: str = "concat",
+                             head_kind: str = "concat"):
     """`_expand_levels_limb_fn` computed in bitsliced plane layout (see
     `pir/dense_eval_planes.py` for the design): children are appended
     [all-left; all-right] per level so the lane order ends up
@@ -447,13 +449,18 @@ def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False,
         # arrive as cache keys, so the trace never bakes stale values.
         tail_r = tile_nodes = 0
         if tail_req and level_kernel and hash_leaves and plane_levels > 0:
-            from .pir.dense_eval_planes import _tail_split
+            if tail_kind == "walk":
+                # The walk tail tiles internally at constant width; no
+                # entry-tile floor applies.
+                tail_r = min(tail_req, plane_levels)
+            else:
+                from .pir.dense_eval_planes import _tail_split
 
-            tail_r, tile_nodes = _tail_split(
-                n32 // 32, plane_levels,
-                requested_levels=tail_req,
-                target_lanes=tail_tile_target,
-            )
+                tail_r, tile_nodes = _tail_split(
+                    n32 // 32, plane_levels,
+                    requested_levels=tail_req,
+                    target_lanes=tail_tile_target,
+                )
         # Fused head (first plane levels in one launch over the narrow
         # width): head_req/head_cap arrive as dispatch-time cache keys
         # like the tail knobs, so the trace never bakes stale env state.
@@ -469,25 +476,31 @@ def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False,
         if head_r:
             from .ops.expand_planes_pallas import (
                 expand_head_planes_pallas,
+                walk_descend_planes_pallas,
             )
 
             h0 = limb_levels
-            state, ctrl = expand_head_planes_pallas(
-                state,
-                ctrl,
-                jnp.stack(
-                    [broadcast_cw_planes(cw_seeds[h0 + j])
-                     for j in range(head_r)]
-                ),
-                jnp.stack(
-                    [(U32(0) - (cw_left[h0 + j] & U32(1)))[None]
-                     for j in range(head_r)]
-                ),
-                jnp.stack(
-                    [(U32(0) - (cw_right[h0 + j] & U32(1)))[None]
-                     for j in range(head_r)]
-                ),
+            cwp_head = jnp.stack(
+                [broadcast_cw_planes(cw_seeds[h0 + j])
+                 for j in range(head_r)]
             )
+            cwl_head = jnp.stack(
+                [(U32(0) - (cw_left[h0 + j] & U32(1)))[None]
+                 for j in range(head_r)]
+            )
+            cwr_head = jnp.stack(
+                [(U32(0) - (cw_right[h0 + j] & U32(1)))[None]
+                 for j in range(head_r)]
+            )
+            if head_kind == "walk":
+                state, ctrl = walk_descend_planes_pallas(
+                    state, ctrl, cwp_head, cwl_head, cwr_head,
+                    r=head_r, node_lanes=n32 // 32,
+                )
+            else:
+                state, ctrl = expand_head_planes_pallas(
+                    state, ctrl, cwp_head, cwl_head, cwr_head
+                )
         for i in range(limb_levels + head_r, num_levels - tail_r):
             if level_kernel:
                 state, ctrl = expand_level_planes_pallas(
@@ -509,6 +522,7 @@ def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False,
         if tail_r:
             from .ops.expand_planes_pallas import (
                 expand_tail_planes_pallas,
+                walk_descend_planes_pallas,
             )
 
             base = num_levels - tail_r
@@ -527,15 +541,23 @@ def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False,
             # Zero value-correction planes: the kernel reduces to the
             # pure MMO output hash (correction is arithmetic here and
             # stays in the leaf stage).
-            state, ctrl = expand_tail_planes_pallas(
-                state,
-                ctrl,
-                cwp_tail,
-                cwl_tail,
-                cwr_tail,
-                jnp.zeros((16, 8, 1), dtype=U32),
-                tile_lanes=tile_nodes * (n32 // 32),
-            )
+            if tail_kind == "walk":
+                state, ctrl = walk_descend_planes_pallas(
+                    state, ctrl, cwp_tail, cwl_tail, cwr_tail,
+                    jnp.zeros((16, 8, 1), dtype=U32),
+                    r=tail_r, value_hash=True,
+                    node_lanes=n32 // 32,
+                )
+            else:
+                state, ctrl = expand_tail_planes_pallas(
+                    state,
+                    ctrl,
+                    cwp_tail,
+                    cwl_tail,
+                    cwr_tail,
+                    jnp.zeros((16, 8, 1), dtype=U32),
+                    tile_lanes=tile_nodes * (n32 // 32),
+                )
         elif hash_leaves:
             if level_kernel:
                 # (same zero-correction reduction as the tail)
@@ -550,15 +572,28 @@ def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False,
         # interleaved); natural index = prefix * 2^PL + path. Static per
         # specialization. Without the tail, position = bit-reversal; the
         # tiled tail composes per-tile plane order on top.
-        if tail_r:
-            from .ops.expand_planes_pallas import tail_node_permutation
+        from .ops.expand_planes_pallas import tail_node_permutation
+        from .pir.dense_eval_planes import walk_leaf_order
 
-            _, pos = tail_node_permutation(
-                bitrev_permutation(plane_levels - tail_r), tail_r,
-                tile_nodes,
-            )
-        else:
-            pos = bitrev_permutation(plane_levels)
+        # Compose each phase's node order (walk phases emit natural
+        # offsets; doubling phases append [all-left; all-right]); the
+        # exit gather is argsort of the composition. Pure doubling
+        # degenerates to the classic bit-reversal.
+        order = np.zeros(1, dtype=np.int64)
+        if head_r:
+            if head_kind == "walk":
+                order = walk_leaf_order(order, head_r)
+            else:
+                order = tail_node_permutation(order, head_r, order.size)[0]
+        mid = plane_levels - head_r - tail_r
+        if mid > 0:
+            order = tail_node_permutation(order, mid, order.size)[0]
+        if tail_r:
+            if tail_kind == "walk":
+                order = walk_leaf_order(order, tail_r)
+            else:
+                order = tail_node_permutation(order, tail_r, tile_nodes)[0]
+        pos = np.argsort(order)
         path = np.arange(1 << plane_levels)
         lane = pos[path][:, None] * n32 + np.arange(n0)[None, :]
         perm = jnp.asarray(
@@ -586,7 +621,10 @@ def _expand_levels_fn(num_levels: int, hash_leaves: bool = False):
     if not mode:
         return _expand_levels_planes_fn(num_levels,
                                         hash_leaves=hash_leaves)
-    if mode == "tail" and hash_leaves:
+    kinds = {}
+    if mode == "walk":
+        kinds = {"tail_kind": "walk", "head_kind": "walk"}
+    if mode in ("tail", "walk") and hash_leaves:
         # Knobs only enter the cache key when the tail can actually run
         # (hash_leaves), so no-tail programs aren't re-traced per tuple.
         from .pir.dense_eval_planes import (
@@ -606,7 +644,11 @@ def _expand_levels_fn(num_levels: int, hash_leaves: bool = False):
             head_req = max(0, int(raw_head))
         except ValueError:
             head_req = 0
-    elif _dep._HEAD_KERNEL_VERIFIED and not _dep._HEAD_KERNEL_FAILED:
+    elif mode == "walk" or (
+        _dep._HEAD_KERNEL_VERIFIED and not _dep._HEAD_KERNEL_FAILED
+    ):
+        # Walk mode's head runs the walk kernel family (gated by the
+        # walk flags via the mode itself), not the concat head.
         head_req = -1  # auto: fill to head_cap lanes
     else:
         head_req = 0
@@ -615,7 +657,8 @@ def _expand_levels_fn(num_levels: int, hash_leaves: bool = False):
                                     tail_req=tail_req,
                                     tail_tile_target=tail_tile,
                                     head_req=head_req,
-                                    head_cap=head_cap)
+                                    head_cap=head_cap,
+                                    **kinds)
 
     def run_with_fallback(*args):
         import os as _os
@@ -625,9 +668,31 @@ def _expand_levels_fn(num_levels: int, hash_leaves: bool = False):
             return fast(*args)
         except Exception as e:  # noqa: BLE001 - degrade, don't die
             if _os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto") in (
-                "pallas", "tail"
+                "pallas", "tail", "walk"
             ):
                 raise
+            if kinds:
+                # Walk-mode failure: re-dispatch on the concat/
+                # per-level tiers (their own fallback chain handles
+                # further failures); the walk demotion persists ONLY
+                # after the re-dispatch succeeds — a shared/transient
+                # failure must not burn the fastest tier's
+                # cross-process flag on zero walk-specific evidence.
+                _dep._WALK_KERNEL_FAILED = True
+                try:
+                    out = _expand_levels_fn(
+                        num_levels, hash_leaves=hash_leaves
+                    )(*args)
+                except Exception:  # noqa: BLE001
+                    _dep._WALK_KERNEL_FAILED = False
+                    raise
+                _dep.record_kernel_verdicts()
+                _warnings.warn(
+                    "walk-descent kernels failed in hierarchical "
+                    "expansion; serving without them "
+                    f"({str(e).splitlines()[0][:200]})"
+                )
+                return out
             if head_req:
                 # Retry without the head, keeping the tail/per-level
                 # kernels. The head is demoted ONLY when the retry

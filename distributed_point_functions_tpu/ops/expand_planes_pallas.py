@@ -679,7 +679,10 @@ def replicate_entry_planes(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("r", "tile_lanes", "value_hash", "interpret")
+    jax.jit,
+    static_argnames=(
+        "r", "tile_lanes", "value_hash", "node_lanes", "interpret"
+    ),
 )
 def walk_descend_planes_pallas(
     state: jnp.ndarray,
@@ -692,6 +695,7 @@ def walk_descend_planes_pallas(
     r: int,
     tile_lanes: int | None = None,
     value_hash: bool = False,
+    node_lanes: int | None = None,
     interpret: bool = False,
 ) -> tuple:
     """Fixed-width fused descent of the last (or first) `r` expansion
@@ -702,6 +706,11 @@ def walk_descend_planes_pallas(
     uint32[r, KG]; vc_kg (with value_hash): uint32[16, 8, KG]. Returns
     (out uint32[16, 8, G0 << r], ctrl uint32[G0 << r]) in NATURAL leaf
     order (leaf g = entry_node * 2^r + offset) — no exit permutation.
+    `node_lanes` is the lane width of one tree node's block (defaults
+    to KG — the dense-serving layout where a node spans the per-key
+    correction words; the hierarchical single-key layout packs 32
+    prefixes per word instead, with KG=1 shared corrections, so a node
+    spans prefix_words lanes there).
 
     The entry is replicated 2^r-fold outside the kernel, then each
     `tile_lanes` output tile descends independently at constant width.
@@ -715,9 +724,12 @@ def walk_descend_planes_pallas(
     """
     _, _, g0 = state.shape
     kg = cwp_all.shape[-1]
-    if g0 % kg:
+    if node_lanes is None:
+        node_lanes = kg
+    if g0 % node_lanes or node_lanes % kg:
         raise ValueError(
-            f"entry lanes {g0} must be a multiple of key groups {kg}"
+            f"entry lanes {g0} must be a multiple of node lanes "
+            f"{node_lanes}, which must be a multiple of key groups {kg}"
         )
     if value_hash and vc_kg is None:
         raise ValueError(
@@ -725,10 +737,13 @@ def walk_descend_planes_pallas(
             "silently break share reconstruction)"
         )
     w = g0 << r
-    state_r, ctrl_r = replicate_entry_planes(state, ctrl, kg, 1 << r)
+    state_r, ctrl_r = replicate_entry_planes(
+        state, ctrl, node_lanes, 1 << r
+    )
     # Leaf offset of each lane within its entry node's 2^r block.
     off_np = np.tile(
-        np.repeat(np.arange(1 << r, dtype=np.uint32), kg), g0 // kg
+        np.repeat(np.arange(1 << r, dtype=np.uint32), node_lanes),
+        g0 // node_lanes,
     )
     off = jnp.asarray(off_np[None, :])
     if tile_lanes is None:

@@ -40,6 +40,7 @@ subtree, as in `dense_eval.py`.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
 import warnings
@@ -208,8 +209,8 @@ def evaluate_selection_blocks_planes(
             # stays off there until staging is order-aware.
             tail_kind = head_kind = "walk"
             tail_levels = min(_tail_levels_requested(), expand_levels)
-            head_levels = _walk_head_split(
-                kg, expand_levels - tail_levels
+            head_levels = _head_split(
+                kg, expand_levels - tail_levels, gate_flags=False
             )
         else:
             if mode == "tail" and not bitrev_leaves:
@@ -238,26 +239,35 @@ def evaluate_selection_blocks_planes(
             if forced:
                 raise
             if tail_kind == "walk":
-                # Walk-mode failure: demote the walk family and re-enter
-                # the dispatcher, which now resolves to the concat/
-                # per-level tiers (their own degradation chain below
-                # handles any further failures).
+                # Walk-mode failure: re-enter the dispatcher without
+                # the walk family (the concat/per-level tiers' own
+                # degradation chain handles any further failures). The
+                # demotion is persisted ONLY after the re-dispatch
+                # succeeds — mirroring the head/tail attribution rule:
+                # a shared/transient failure must not burn the fastest
+                # tier's cross-process flag on zero walk-specific
+                # evidence.
                 _WALK_KERNEL_FAILED = True
+                try:
+                    out = evaluate_selection_blocks_planes(
+                        seeds0, control0, cw_seeds, cw_left,
+                        cw_right, last_vc,
+                        walk_levels=walk_levels,
+                        expand_levels=expand_levels,
+                        num_blocks=num_blocks,
+                        bitrev_leaves=bitrev_leaves,
+                        force_planes=force_planes,
+                    )
+                except Exception:  # noqa: BLE001
+                    _WALK_KERNEL_FAILED = False
+                    raise
                 record_kernel_verdicts()
                 warnings.warn(
                     "walk-descent kernels failed at serving shape; "
-                    "re-dispatching without them "
+                    "serving without them "
                     f"({str(e).splitlines()[0][:200]})"
                 )
-                return evaluate_selection_blocks_planes(
-                    seeds0, control0, cw_seeds, cw_left,
-                    cw_right, last_vc,
-                    walk_levels=walk_levels,
-                    expand_levels=expand_levels,
-                    num_blocks=num_blocks,
-                    bitrev_leaves=bitrev_leaves,
-                    force_planes=force_planes,
-                )
+                return out
             if head_levels:
                 # Retry without the head, keeping the tail. The head is
                 # demoted ONLY when this retry succeeds — a shared
@@ -440,6 +450,24 @@ def _load_kernel_verdicts() -> None:
 
 
 _LAST_RECORDED = None
+_RECORD_SUSPENDED = False
+
+
+@contextlib.contextmanager
+def suspend_verdict_recording():
+    """Silence the persistent verdict cache while a caller holds
+    SPECULATIVE flag state (the bench demotion ladder sets a tier's
+    FAILED flag before its attribution retry, and the retry itself
+    triggers record_kernel_verdicts via warm_level_kernels /
+    _level_kernel_enabled — without this guard a budget abort would
+    leave an evidence-free demotion on disk forever)."""
+    global _RECORD_SUSPENDED
+    prev = _RECORD_SUSPENDED
+    _RECORD_SUSPENDED = True
+    try:
+        yield
+    finally:
+        _RECORD_SUSPENDED = prev
 
 
 def record_kernel_verdicts() -> None:
@@ -449,6 +477,8 @@ def record_kernel_verdicts() -> None:
     serve-shape demotions, including dpf.py's hierarchical path), so
     the next process skips known-failing Mosaic compiles instantly."""
     global _LAST_RECORDED
+    if _RECORD_SUSPENDED:
+        return
     snapshot = tuple(bool(globals()[f]) for f in _VERDICT_FLAGS)
     if snapshot == _LAST_RECORDED:
         # Repeated eager dispatches land here after every successful
@@ -601,7 +631,9 @@ def _auto_head_count(cap: int, entry_lanes: int, avail: int) -> int:
     return head if head >= 2 else 0
 
 
-def _head_split(key_groups: int, a_levels: int) -> int:
+def _head_split(
+    key_groups: int, a_levels: int, gate_flags: bool = True
+) -> int:
     """How many entry levels the fused head kernel covers (0 = no head).
 
     The head runs from `key_groups` lanes until its exit width reaches
@@ -609,8 +641,11 @@ def _head_split(key_groups: int, a_levels: int) -> int:
     is just a worse per-level launch, so the minimum is 2.
     DPF_TPU_HEAD_LEVELS forces the count (0 disables) — honored even
     before the self-check has run, so forced A/B legs
-    (DPF_TPU_LEVEL_KERNEL=pallas|tail) can measure the head; a failure
-    then propagates (forced) or demotes the head (auto)."""
+    (DPF_TPU_LEVEL_KERNEL=pallas|tail|walk) can measure the head; a
+    failure then propagates (forced) or demotes the head (auto).
+    `gate_flags=False` skips the concat-head verification gate — walk
+    mode's head runs the walk kernel family, gated by the walk flags
+    through the mode itself."""
     if a_levels <= 0:
         return 0
     raw = os.environ.get("DPF_TPU_HEAD_LEVELS", "auto")
@@ -619,7 +654,7 @@ def _head_split(key_groups: int, a_levels: int) -> int:
             return max(0, min(int(raw), a_levels))
         except ValueError:
             pass
-    if _HEAD_KERNEL_FAILED or not _HEAD_KERNEL_VERIFIED:
+    if gate_flags and (_HEAD_KERNEL_FAILED or not _HEAD_KERNEL_VERIFIED):
         return 0
     return _auto_head_count(_head_max_lanes(), key_groups, a_levels)
 
@@ -681,21 +716,6 @@ def _head_kernel_selfcheck() -> bool:
 
 _WALK_KERNEL_VERIFIED = False
 _WALK_KERNEL_FAILED = False
-
-
-def _walk_head_split(key_groups: int, a_levels: int) -> int:
-    """Head depth for walk mode: same VMEM-cap fill rule as the concat
-    head (`_head_split`) but gated on the walk flags (the walk kernels
-    are their own Mosaic program family). DPF_TPU_HEAD_LEVELS forces."""
-    if a_levels <= 0:
-        return 0
-    raw = os.environ.get("DPF_TPU_HEAD_LEVELS", "auto")
-    if raw != "auto":
-        try:
-            return max(0, min(int(raw), a_levels))
-        except ValueError:
-            pass
-    return _auto_head_count(_head_max_lanes(), key_groups, a_levels)
 
 
 def _walk_kernel_selfcheck() -> bool:

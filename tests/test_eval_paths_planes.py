@@ -95,6 +95,59 @@ def test_expand_levels_planes_matches_limb(p, levels):
     np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
 
 
+@pytest.mark.parametrize("p,levels,head_req,tail_req", [
+    (8, 5, 2, 2),   # walk head (clipped to avail) + walk tail
+    (8, 7, 0, 3),   # walk tail with a per-level middle
+])
+def test_expand_levels_walk_kinds_match_limb(
+    monkeypatch, p, levels, head_req, tail_req
+):
+    """The hierarchical expansion with walk-kind head/tail must be
+    bit-identical to the limb program (incl. the fused leaf hash and
+    the composed exit order)."""
+    import functools as ft
+
+    from distributed_point_functions_tpu import dpf as dpf_mod
+    from distributed_point_functions_tpu.ops import (
+        expand_planes_pallas as epp,
+    )
+
+    monkeypatch.setattr(
+        epp, "walk_descend_planes_pallas",
+        ft.partial(epp.walk_descend_planes_pallas, interpret=True),
+    )
+    monkeypatch.setattr(
+        epp, "expand_level_planes_pallas",
+        ft.partial(epp.expand_level_planes_pallas, interpret=True),
+    )
+    monkeypatch.setattr(
+        epp, "value_hash_planes_pallas",
+        ft.partial(epp.value_hash_planes_pallas, interpret=True),
+    )
+    seeds = jnp.asarray(RNG.integers(0, 2**32, (p, 4), dtype=np.uint32))
+    control = jnp.asarray(RNG.integers(0, 2, p, dtype=np.uint32))
+    cw_s = jnp.asarray(
+        RNG.integers(0, 2**32, (levels, 4), dtype=np.uint32)
+    )
+    cw_l = jnp.asarray(RNG.integers(0, 2, levels, dtype=np.uint32))
+    cw_r = jnp.asarray(RNG.integers(0, 2, levels, dtype=np.uint32))
+    want = dpf_mod._expand_levels_limb_fn(levels, hash_leaves=True)(
+        seeds, control, cw_s, cw_l, cw_r
+    )
+    dpf_mod._expand_levels_planes_fn.cache_clear()
+    try:
+        got = dpf_mod._expand_levels_planes_fn(
+            levels, level_kernel=True, hash_leaves=True,
+            tail_req=tail_req, tail_tile_target=128,
+            head_req=head_req, head_cap=1 << 20,
+            tail_kind="walk", head_kind="walk",
+        )(seeds, control, cw_s, cw_l, cw_r)
+    finally:
+        dpf_mod._expand_levels_planes_fn.cache_clear()
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want[0]))
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want[1]))
+
+
 def test_hierarchical_eval_via_planes(monkeypatch):
     """evaluate_until with DPF_TPU_EXPAND_LEVELS=planes: share sums over
     a two-level hierarchy still reconstruct the point function."""

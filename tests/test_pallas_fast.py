@@ -488,6 +488,15 @@ def test_kernel_verdict_cache_roundtrip(tmp_path, monkeypatch):
     assert dep._LEVEL_KERNEL_FAILED is False
     assert dep._HEAD_KERNEL_FAILED is False
 
+    # Suspended recording must not leak speculative flags to disk.
+    monkeypatch.setattr(dep, "_WALK_KERNEL_FAILED", True)
+    with dep.suspend_verdict_recording():
+        dep.record_kernel_verdicts()
+    monkeypatch.setattr(dep, "_WALK_KERNEL_FAILED", False)
+    monkeypatch.setattr(dep, "_VERDICTS_LOADED", False)
+    dep._load_kernel_verdicts()
+    assert dep._WALK_KERNEL_FAILED is False
+
     # A second record merges (does not clear) earlier verdicts.
     monkeypatch.setattr(dep, "_HEAD_KERNEL_FAILED", True)
     dep.record_kernel_verdicts()
