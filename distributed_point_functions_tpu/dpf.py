@@ -408,10 +408,7 @@ def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False,
             expand_level_planes_pallas,
             value_hash_planes_pallas,
         )
-        from .pir.dense_eval_planes import (
-            bitrev_permutation,
-            expand_level_planes,
-        )
+        from .pir.dense_eval_planes import expand_level_planes
 
         # Plane layout carries its padding through every level (dead
         # lanes double along with live ones), so entering at the root
