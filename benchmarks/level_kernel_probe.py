@@ -47,6 +47,12 @@ CASES = [
     ("walk", dict(g0=4, kg=4, r=13, tile=2048, value=True)),
     ("walk", dict(g0=2, kg=2, r=10, tile=2048, value=False)),
     ("walk", dict(g0=1024, kg=2, r=4, tile=1024, value=True)),
+    # fori_loop body (one AES body regardless of depth): the program-
+    # size insurance if the unrolled deep instances fail/hang.
+    ("walk", dict(g0=4, kg=4, r=9, tile=2048, value=False,
+                  unroll=False)),
+    ("walk", dict(g0=2048, kg=4, r=4, tile=2048, value=True,
+                  unroll=False)),
     ("level", dict(g=2048, kg=2, tile=2048)),
     ("level", dict(g=2048, kg=4, tile=None)),
     ("level", dict(g=8192, kg=4, tile=None)),
@@ -121,13 +127,15 @@ def run_one(idx: int) -> dict:
         else:  # walk
             g0, kg, r = p["g0"], p["kg"], p["r"]
             tile, value = p["tile"], p["value"]
+            unroll = p.get("unroll", True)
             args = (u32(16, 8, g0), u32(g0), u32(r, 16, 8, kg),
                     u32(r, kg), u32(r, kg),
                     u32(16, 8, kg) if value else None)
 
             def call():
                 return walk_descend_planes_pallas(
-                    *args, r=r, tile_lanes=tile, value_hash=value
+                    *args, r=r, tile_lanes=tile, value_hash=value,
+                    unroll=unroll,
                 )
 
         jax.block_until_ready(call())

@@ -606,6 +606,7 @@ def _walk_kernel(
     kg: int,
     r: int,
     value_hash: bool,
+    unroll: bool = True,
 ):
     """Constant-width descent: `r` levels + optional leaf value hash at a
     FIXED lane width, using the per-lane select-key AES of `_path_kernel`
@@ -637,19 +638,41 @@ def _walk_kernel(
     w = state.shape[-1]
     reps = w // kg
     zero = jnp.uint32(0)
-    for i in range(r):
-        bit = (off >> (r - 1 - i)) & jnp.uint32(1)  # [1, W]
+
+    def level(i, state, ctrl, cwp_i, cwl_i, cwr_i):
+        bit = (off >> (jnp.uint32(r - 1) - i)) & jnp.uint32(1)  # [1, W]
         selw = zero - bit  # 0x0 / 0xFFFFFFFF per lane
         selb = selw[0][None, None, :]
         h = _aes_select_planes(masks, selb, _sigma(state))
-        cwp = pltpu.repeat(cwp_all[i], reps, axis=2)  # [16, 8, W]
+        cwp = pltpu.repeat(cwp_i, reps, axis=2)  # [16, 8, W]
         h = h ^ (cwp & ctrl[None, None, :])
         t_new = h[0, 0]
         state = _zero_lsb_plane(h)
-        cwl = pltpu.repeat(cwl_all[i][None, :], reps, axis=1)[0]
-        cwr = pltpu.repeat(cwr_all[i][None, :], reps, axis=1)[0]
+        cwl = pltpu.repeat(cwl_i[None, :], reps, axis=1)[0]
+        cwr = pltpu.repeat(cwr_i[None, :], reps, axis=1)[0]
         cw_dir = (cwl & ~selw[0]) | (cwr & selw[0])
-        ctrl = t_new ^ (ctrl & cw_dir)
+        return state, t_new ^ (ctrl & cw_dir)
+
+    if unroll:
+        for i in range(r):
+            state, ctrl = level(
+                jnp.uint32(i), state, ctrl,
+                cwp_all[i], cwl_all[i], cwr_all[i],
+            )
+    else:
+        # Constant width makes the level loop a real fori_loop: the
+        # program holds ONE select-key AES body regardless of depth,
+        # where the unrolled form at r=9..13 carries 10-14 of them —
+        # exactly the program-size regime where Mosaic has rejected or
+        # hung on the doubling kernels.
+        def body(i, carry):
+            state, ctrl = carry
+            return level(
+                i.astype(jnp.uint32), state, ctrl,
+                cwp_all[i], cwl_all[i], cwr_all[i],
+            )
+
+        state, ctrl = jax.lax.fori_loop(0, r, body, (state, ctrl))
     if value_hash:
         sig = _sigma(state)
         values = _aes_fixed_planes(masks_v_ref[:], sig) ^ sig
@@ -681,7 +704,8 @@ def replicate_entry_planes(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "r", "tile_lanes", "value_hash", "node_lanes", "interpret"
+        "r", "tile_lanes", "value_hash", "node_lanes", "unroll",
+        "interpret",
     ),
 )
 def walk_descend_planes_pallas(
@@ -696,6 +720,7 @@ def walk_descend_planes_pallas(
     tile_lanes: int | None = None,
     value_hash: bool = False,
     node_lanes: int | None = None,
+    unroll: bool = True,
     interpret: bool = False,
 ) -> tuple:
     """Fixed-width fused descent of the last (or first) `r` expansion
@@ -760,7 +785,8 @@ def walk_descend_planes_pallas(
         t = state_c.shape[-1]
         return pl.pallas_call(
             functools.partial(
-                _walk_kernel, kg=kg, r=r, value_hash=value_hash
+                _walk_kernel, kg=kg, r=r, value_hash=value_hash,
+                unroll=unroll,
             ),
             grid=(1,),
             in_specs=[

@@ -289,8 +289,11 @@ def test_tail_failure_demotes_tail_mode(monkeypatch):
     assert dep._level_kernel_enabled() == "tail"
 
 
-@pytest.mark.parametrize("value_hash", [False, True])
-def test_walk_descend_kernel_tiny(value_hash):
+@pytest.mark.parametrize(
+    "value_hash,unroll",
+    [(False, True), (True, True), (True, False), (False, False)]
+)
+def test_walk_descend_kernel_tiny(value_hash, unroll):
     """Fixed-width walk-descent vs the doubling expansion: 2 levels from
     2 entry nodes, natural leaf order (the doubling twin's [all-left;
     all-right] order is mapped through tail_node_permutation)."""
@@ -353,7 +356,8 @@ def test_walk_descend_kernel_tiny(value_hash):
     got_s, got_c = walk_descend_planes_pallas(
         jnp.asarray(state), jnp.asarray(ctrl), cwp_all, cwl_all,
         cwr_all, vc if value_hash else None, r=r,
-        tile_lanes=g0 << r, value_hash=value_hash, interpret=True,
+        tile_lanes=g0 << r, value_hash=value_hash, unroll=unroll,
+        interpret=True,
     )
     np.testing.assert_array_equal(np.asarray(got_s), want_s)
     np.testing.assert_array_equal(np.asarray(got_c), want_c)
