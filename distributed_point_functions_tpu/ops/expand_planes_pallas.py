@@ -183,8 +183,10 @@ def _check_tile(tile: int, g: int, kg: int) -> None:
         )
 
 
-def _pick_tile(num_lanes: int, key_groups: int) -> int:
-    tile = min(_TILE_LANES, num_lanes)
+def _pick_tile(
+    num_lanes: int, key_groups: int, cap: int = _TILE_LANES
+) -> int:
+    tile = min(cap, num_lanes)
     while tile > key_groups and (
         num_lanes % tile != 0 or tile % key_groups != 0
     ):
@@ -192,6 +194,12 @@ def _pick_tile(num_lanes: int, key_groups: int) -> int:
     if num_lanes % tile != 0 or tile % key_groups != 0:
         tile = num_lanes
     return tile
+
+
+# Walk-descent default tile: the working set is ~6 copies of a
+# [16, 8, tile] u32 state (~6 MB at 2048) and the hardware probe
+# validates the 2048-lane geometry, so the serving default matches it.
+_WALK_TILE_LANES = 2048
 
 
 @functools.partial(
@@ -772,7 +780,7 @@ def walk_descend_planes_pallas(
     )
     off = jnp.asarray(off_np[None, :])
     if tile_lanes is None:
-        tile = _pick_tile(w, kg)
+        tile = _pick_tile(w, kg, cap=_WALK_TILE_LANES)
     else:
         tile = tile_lanes
     _check_tile(tile, w, kg)
