@@ -721,7 +721,9 @@ _WALK_KERNEL_FAILED = False
 def _walk_kernel_selfcheck() -> bool:
     """One-time on-device bit-identity check of the fixed-width
     walk-descent kernel (2 levels + value hash, 2 tiles) against the
-    doubling XLA twin, at a >=128-lane tile like the shapes it serves."""
+    doubling XLA twin, at the SERVING tile width (2048 lanes — Mosaic
+    legality is shape-dependent, so a verdict from a smaller tile would
+    not cover the geometry the dispatcher actually picks)."""
     global _WALK_KERNEL_VERIFIED, _WALK_KERNEL_FAILED
     if _WALK_KERNEL_FAILED:
         return False
@@ -730,7 +732,7 @@ def _walk_kernel_selfcheck() -> bool:
     import numpy as _np
 
     rng = _np.random.default_rng(2468)
-    g0, nk, r, tile = 128, 64, 2, 256
+    g0, nk, r, tile = 1024, 64, 2, 2048
     kg = nk // 32
     state = jnp.asarray(
         rng.integers(0, 1 << 32, (16, 8, g0), dtype=_np.uint32)

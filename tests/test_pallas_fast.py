@@ -290,8 +290,7 @@ def test_tail_failure_demotes_tail_mode(monkeypatch):
 
 
 @pytest.mark.parametrize(
-    "value_hash,unroll",
-    [(False, True), (True, True), (True, False), (False, False)]
+    "value_hash,unroll", [(True, True), (False, False)]
 )
 def test_walk_descend_kernel_tiny(value_hash, unroll):
     """Fixed-width walk-descent vs the doubling expansion: 2 levels from
@@ -361,106 +360,6 @@ def test_walk_descend_kernel_tiny(value_hash, unroll):
     )
     np.testing.assert_array_equal(np.asarray(got_s), want_s)
     np.testing.assert_array_equal(np.asarray(got_c), want_c)
-
-
-def test_walk_descend_multi_tile():
-    """Tile boundaries inside and across the 2^r leaf blocks must not
-    change the result (per-lane descent is tile-local)."""
-    from distributed_point_functions_tpu.ops.expand_planes_pallas import (
-        walk_descend_planes_pallas,
-    )
-
-    nk, r, kg, n_entry = 64, 2, 2, 2
-    g0 = n_entry * kg
-    state, ctrl, _, _, _ = _inputs(g0, nk)
-    cwp_all = jnp.stack(
-        [pack_key_planes(jnp.asarray(
-            RNG.integers(0, 1 << 32, (nk, 4), dtype=np.uint32)
-        )) for _ in range(r)]
-    )
-    cwl_all = jnp.stack(
-        [pack_key_bits(jnp.asarray(
-            RNG.integers(0, 2, (nk,), dtype=np.uint32)
-        )) for _ in range(r)]
-    )
-    cwr_all = jnp.stack(
-        [pack_key_bits(jnp.asarray(
-            RNG.integers(0, 2, (nk,), dtype=np.uint32)
-        )) for _ in range(r)]
-    )
-    full, full_c = walk_descend_planes_pallas(
-        jnp.asarray(state), jnp.asarray(ctrl), cwp_all, cwl_all,
-        cwr_all, r=r, tile_lanes=g0 << r, interpret=True,
-    )
-    tiled, tiled_c = walk_descend_planes_pallas(
-        jnp.asarray(state), jnp.asarray(ctrl), cwp_all, cwl_all,
-        cwr_all, r=r, tile_lanes=kg * 2, interpret=True,
-    )
-    np.testing.assert_array_equal(np.asarray(full), np.asarray(tiled))
-    np.testing.assert_array_equal(np.asarray(full_c), np.asarray(tiled_c))
-
-
-@pytest.mark.parametrize(
-    "expand_levels,head,tail",
-    [
-        (5, 2, 3),  # walk head + walk tail, no middle
-        (6, 2, 2),  # walk head + PER-LEVEL middle + walk tail: the
-        #             production composition at serving shapes, where
-        #             the leaf-order bookkeeping appends doubling
-        #             between two natural-order walk phases
-    ],
-)
-def test_walk_dispatch_integration(monkeypatch, expand_levels, head, tail):
-    """The planes pipeline with walk-kind head+tail must be
-    bit-identical to the XLA pipeline — exercises the leaf-order
-    bookkeeping end to end."""
-    import functools as ft
-
-    from distributed_point_functions_tpu.ops import (
-        expand_planes_pallas as epp,
-    )
-    from distributed_point_functions_tpu.pir import dense_eval_planes as dep
-
-    monkeypatch.setattr(
-        dep, "walk_descend_planes_pallas",
-        ft.partial(epp.walk_descend_planes_pallas, interpret=True),
-    )
-    monkeypatch.setattr(
-        dep, "expand_level_planes_pallas",
-        ft.partial(epp.expand_level_planes_pallas, interpret=True),
-    )
-    nk = 32
-    num_blocks = 1 << expand_levels
-    rng = np.random.default_rng(55)
-    seeds0 = rng.integers(0, 1 << 32, (nk, 4), dtype=np.uint32)
-    control0 = rng.integers(0, 2, (nk,), dtype=np.uint32)
-    cw_seeds = rng.integers(
-        0, 1 << 32, (expand_levels, nk, 4), dtype=np.uint32
-    )
-    cw_left = rng.integers(0, 2, (expand_levels, nk), dtype=np.uint32)
-    cw_right = rng.integers(0, 2, (expand_levels, nk), dtype=np.uint32)
-    last_vc = rng.integers(0, 1 << 32, (nk, 4), dtype=np.uint32)
-    args = tuple(
-        jnp.asarray(a)
-        for a in (seeds0, control0, cw_seeds, cw_left, cw_right, last_vc)
-    )
-    kwargs = dict(
-        walk_levels=0, expand_levels=expand_levels, num_blocks=num_blocks
-    )
-    want = np.asarray(
-        dep._evaluate_selection_blocks_planes_jit(*args, **kwargs)
-    )
-    got = np.asarray(
-        dep._evaluate_selection_blocks_planes_jit(
-            *args, **kwargs,
-            level_kernel=True,
-            head_levels=head,
-            tail_levels=tail,
-            tail_kind="walk",
-            head_kind="walk",
-        )
-    )
-    np.testing.assert_array_equal(got, want)
 
 
 def test_kernel_verdict_cache_roundtrip(tmp_path, monkeypatch):
