@@ -57,8 +57,8 @@ BASELINE_QPS = 16.0
 # failure-path error messages so a tunnel outage at bench time cannot
 # erase the round's measured result. Update alongside new captures.
 LAST_CAPTURE_NOTE = (
-    "last captured rc=0 run (round 2): 6601.88 q/s at q128 "
-    "(benchmarks/results/bench_q128_20260731_031646.json)"
+    "last captured rc=0 run (2026-08-01): 7203.53 q/s at q128 "
+    "(benchmarks/results/bench_cold_20260801_082955.json)"
 )
 # Derived single-thread CPU figure for full-domain eval at 2^20 leaves:
 # ~2^21 fixed-key AES ops at ~16 ns plus leaf hashing => ~50 ns/leaf.
