@@ -158,6 +158,62 @@ def main():
 
     check("path_level_pallas", smoke_path)
 
+    def smoke_walk(unroll):
+        from distributed_point_functions_tpu.ops.expand_planes_pallas import (
+            tail_node_permutation,
+            walk_descend_planes_pallas,
+        )
+
+        nk, r = 64, 2
+        kg = nk // 32
+        g0 = 4 * kg
+        st = jnp.asarray(
+            rng.integers(0, 1 << 32, (16, 8, g0), dtype=np.uint32)
+        )
+        ct = jnp.asarray(
+            rng.integers(0, 1 << 32, (g0,), dtype=np.uint32)
+        )
+        cwp = jnp.asarray(
+            rng.integers(0, 1 << 32, (r, 16, 8, kg), dtype=np.uint32)
+        )
+        cwl = jnp.asarray(
+            rng.integers(0, 1 << 32, (r, kg), dtype=np.uint32)
+        )
+        cwr = jnp.asarray(
+            rng.integers(0, 1 << 32, (r, kg), dtype=np.uint32)
+        )
+        vc = jnp.asarray(
+            rng.integers(0, 1 << 32, (16, 8, kg), dtype=np.uint32)
+        )
+        s, c = st, ct
+        for i in range(r):
+            g2 = 2 * s.shape[-1]
+            s, c = expand_level_planes(
+                s, c, _tile_keys(cwp[i], g2), _tile_keys(cwl[i], g2 // 2),
+                _tile_keys(cwr[i], g2 // 2),
+            )
+        want = mmo_hash_planes(fixed_keys.RK_VALUE, s) ^ (
+            _tile_keys(vc, s.shape[-1]) & c[None, None, :]
+        )
+        n_entry = g0 // kg
+        pos_of_leaf = tail_node_permutation(
+            np.arange(n_entry), r, n_entry
+        )[1]
+        lanes = (
+            pos_of_leaf[:, None] * kg + np.arange(kg)[None, :]
+        ).reshape(-1)
+        got_v, got_c = walk_descend_planes_pallas(
+            st, ct, cwp, cwl, cwr, vc, r=r, tile_lanes=g0 << r,
+            value_hash=True, unroll=unroll,
+        )
+        assert np.array_equal(
+            np.asarray(got_v), np.asarray(want)[:, :, lanes]
+        )
+        assert np.array_equal(np.asarray(got_c), np.asarray(c)[lanes])
+
+    check("walk_descend_pallas", lambda: smoke_walk(True))
+    check("walk_descend_pallas_loop", lambda: smoke_walk(False))
+
 
 if __name__ == "__main__":
     main()
