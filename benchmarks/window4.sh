@@ -44,35 +44,50 @@ finish() {
     exit $fail
 }
 
-# Gate: wait for the tunnel to answer a trivial device op (a wedged
-# remote-compile helper hangs init indefinitely; each attempt runs in a
-# subprocess with its own timeout). Give up after ~75 min.
-echo "=== 0. tunnel gate ==="
-gate_ok=0
-for i in $(seq 1 25); do
-    if timeout 90 python - <<'EOF' >/dev/null 2>&1
+# Tunnel probe: one trivial device op in a bounded subprocess (a wedged
+# remote-compile helper hangs init indefinitely).
+tunnel_ok() {
+    timeout 90 python - <<'EOF' >/dev/null 2>&1
 import jax
 import jax.numpy as jnp
 assert jax.devices()[0].platform != "cpu"
 print(jnp.add(jnp.uint32(1), jnp.uint32(2)))
 EOF
-    then
-        gate_ok=1
-        echo "tunnel ok (attempt $i)"
-        break
-    fi
-    echo "tunnel not answering (attempt $i); sleeping 120s" >&2
-    sleep 120
-done
-if [ "$gate_ok" -ne 1 ]; then
+}
+
+# Wait (up to ~50 min) for the tunnel before a stage: a mid-window
+# outage or compile-wedge must PAUSE the queue, not cascade every
+# remaining stage into an init-hang death (window3's fate).
+wait_tunnel() {
+    for i in $(seq 1 15); do
+        tunnel_ok && return 0
+        echo "tunnel not answering (attempt $i); sleeping 120s" >&2
+        sleep 120
+    done
+    return 1
+}
+
+echo "=== 0. tunnel gate ==="
+if ! wait_tunnel; then
     echo '{"gate": "tunnel never answered"}' \
         > benchmarks/results/window4_gate_${stamp}.json
     commit_stage gate 1
     finish
 fi
+echo "tunnel ok"
 
-stage_fits 1900 || finish
-echo "=== 1. headline (auto: banks planes_xla first, maps kernel tiers) ==="
+# Probe BEFORE the headline: each case is subprocess-bounded, and the
+# failure verdicts it records protect the headline's in-process compile
+# attempts from known-doomed (possibly wedging) programs.
+stage_fits 3800 || finish
+echo "=== 1. per-shape kernel probe (subprocess-isolated, walk first) ==="
+timeout 3800 python benchmarks/level_kernel_probe.py \
+    2>benchmarks/results/level_probe_${stamp}.log \
+    | tee benchmarks/results/level_probe_${stamp}.json
+commit_stage level_probe $?
+
+{ wait_tunnel && stage_fits 1900; } || finish
+echo "=== 2. headline (auto: banks planes_xla first, maps kernel tiers) ==="
 timeout 1900 env BENCH_ITERS=16 BENCH_INIT_BUDGET=120 BENCH_TIMEOUT=1800 \
     BENCH_XPROF=benchmarks/results/xprof_w4_${stamp} python bench.py \
     2>benchmarks/results/bench_q128_${stamp}.log \
@@ -80,16 +95,9 @@ timeout 1900 env BENCH_ITERS=16 BENCH_INIT_BUDGET=120 BENCH_TIMEOUT=1800 \
 commit_stage headline $?
 tail -5 benchmarks/results/bench_q128_${stamp}.log
 
-stage_fits 3800 || finish
-echo "=== 2. per-shape kernel probe (subprocess-isolated, walk first) ==="
-timeout 3800 python benchmarks/level_kernel_probe.py \
-    2>benchmarks/results/level_probe_${stamp}.log \
-    | tee benchmarks/results/level_probe_${stamp}.json
-commit_stage level_probe $?
-
 echo "=== 3. batch sweep (q64 / q256 / q512, auto) ==="
 for q in 64 256 512; do
-    stage_fits 1300 || finish
+    { wait_tunnel && stage_fits 1300; } || finish
     rm -f benchmarks/results/bench_extra.json
     timeout 1300 env BENCH_QUERIES=$q BENCH_ITERS=8 \
         BENCH_INIT_BUDGET=120 BENCH_TIMEOUT=1200 python bench.py \
@@ -101,7 +109,7 @@ for q in 64 256 512; do
     commit_stage q$q $rc
 done
 
-stage_fits 3000 || finish
+{ wait_tunnel && stage_fits 3000; } || finish
 echo "=== 4. ns/leaf at log-domain 20 and 24 ==="
 for ld in 20 24; do
     timeout 1500 env BENCH_ONLY_NSLEAF=1 BENCH_NSLEAF_LD=$ld \
@@ -111,53 +119,53 @@ for ld in 20 24; do
     commit_stage nsleaf_ld$ld $?
 done
 
-stage_fits 3600 || finish
+{ wait_tunnel && stage_fits 3600; } || finish
 echo "=== 5. DCF/MIC reference sweeps on TPU ==="
 timeout 3600 python benchmarks/run_benchmarks.py --suite dcf,mic --big \
     2>benchmarks/results/dcf_mic_tpu_${stamp}.log \
     | tee benchmarks/results/dcf_mic_tpu_${stamp}.jsonl
 commit_stage dcf_mic $?
 
-stage_fits 3600 || finish
+{ wait_tunnel && stage_fits 3600; } || finish
 echo "=== 6. sparse PIR re-capture (native builder + batched queries) ==="
 timeout 3600 python benchmarks/baseline_suite.py --scale full \
     --suite sparse_big \
     2>&1 | tee benchmarks/results/sparse_big_${stamp}.json
 commit_stage sparse_big $?
 
-stage_fits 2700 || finish
+{ wait_tunnel && stage_fits 2700; } || finish
 echo "=== 7. synthetic hierarchical (reference experiments configs) ==="
 timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
     --log_domain_size 32 --log_num_nonzeros 20 --num_iterations 3 \
     2>&1 | tee benchmarks/results/synthetic_${stamp}.json
 commit_stage synthetic32 $?
-stage_fits 2700 || finish
+{ wait_tunnel && stage_fits 2700; } || finish
 timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
     --log_domain_size 32 --log_num_nonzeros 20 --only_nonzeros \
     --num_iterations 3 \
     2>&1 | tee benchmarks/results/only_nonzeros_${stamp}.json
 commit_stage direct32 $?
-stage_fits 3600 || finish
+{ wait_tunnel && stage_fits 3600; } || finish
 timeout 3600 python benchmarks/synthetic_data_benchmarks.py \
     --log_domain_size 128 --log_num_nonzeros 20 --num_iterations 2 \
     2>&1 | tee benchmarks/results/synthetic128_${stamp}.json
 commit_stage synthetic128 $?
 
-stage_fits 1800 || finish
+{ wait_tunnel && stage_fits 1800; } || finish
 echo "=== 8. inner-product tile matrix ==="
 timeout 1800 python benchmarks/ip_ab.py \
     2>benchmarks/results/ip_ab_${stamp}.log \
     | tee benchmarks/results/ip_ab_${stamp}.json
 commit_stage ip_ab $?
 
-stage_fits 3600 || finish
+{ wait_tunnel && stage_fits 3600; } || finish
 echo "=== 9. remaining sweeps (dpf/inner_product/int_mod_n) ==="
 timeout 3600 python benchmarks/run_benchmarks.py \
     --suite dpf,inner_product,int_mod_n --big \
     2>&1 | tee benchmarks/results/sweeps_${stamp}.json
 commit_stage sweeps $?
 
-stage_fits 1800 || finish
+{ wait_tunnel && stage_fits 1800; } || finish
 echo "=== 10. kernel smoke (shape envelope) ==="
 timeout 1800 python benchmarks/kernel_smoke.py \
     2>benchmarks/results/kernel_smoke_${stamp}.log \
