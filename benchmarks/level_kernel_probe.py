@@ -53,6 +53,12 @@ CASES = [
                   unroll=False)),
     ("walk", dict(g0=2048, kg=4, r=4, tile=2048, value=True,
                   unroll=False)),
+    # compact entry (in-kernel replication, no full-width HBM staging):
+    # the big-domain variant — replication traffic is ~0.7 ms at ld24.
+    ("walk", dict(g0=2048, kg=4, r=4, tile=2048, value=True,
+                  compact=True)),
+    ("walk", dict(g0=4, kg=4, r=9, tile=2048, value=False,
+                  compact=True)),
     ("level", dict(g=2048, kg=2, tile=2048)),
     ("level", dict(g=2048, kg=4, tile=None)),
     ("level", dict(g=8192, kg=4, tile=None)),
@@ -128,6 +134,7 @@ def run_one(idx: int) -> dict:
             g0, kg, r = p["g0"], p["kg"], p["r"]
             tile, value = p["tile"], p["value"]
             unroll = p.get("unroll", True)
+            compact = p.get("compact", False)
             args = (u32(16, 8, g0), u32(g0), u32(r, 16, 8, kg),
                     u32(r, kg), u32(r, kg),
                     u32(16, 8, kg) if value else None)
@@ -135,7 +142,7 @@ def run_one(idx: int) -> dict:
             def call():
                 return walk_descend_planes_pallas(
                     *args, r=r, tile_lanes=tile, value_hash=value,
-                    unroll=unroll,
+                    unroll=unroll, compact_entry=compact,
                 )
 
         jax.block_until_ready(call())

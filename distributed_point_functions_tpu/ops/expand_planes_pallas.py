@@ -615,6 +615,7 @@ def _walk_kernel(
     r: int,
     value_hash: bool,
     unroll: bool = True,
+    compact: bool = False,
 ):
     """Constant-width descent: `r` levels + optional leaf value hash at a
     FIXED lane width, using the per-lane select-key AES of `_path_kernel`
@@ -635,9 +636,18 @@ def _walk_kernel(
     off_ref: uint32[1, W] leaf offset of each lane within its entry
     node's 2^r block (precomputed outside; bit r-1-i selects the key at
     level i — MSB first). Everything else matches `_tail_kernel`.
+
+    With `compact` the entry arrives UNREPLICATED ([16, 8, W >> r]) and
+    the kernel replicates it 2^r-fold with the whole-array repeat (the
+    construct every serving kernel already uses for corrections) — the
+    tile's lane order is then offset-major, `off` is supplied to match,
+    and the full-width replicated array never touches HBM.
     """
     state = state_ref[:]
-    ctrl = ctrl_ref[:][0]  # [W] packed control bits
+    ctrl = ctrl_ref[:][0]  # [W] (or [W >> r] compact) packed bits
+    if compact:
+        state = pltpu.repeat(state, 1 << r, axis=2)
+        ctrl = pltpu.repeat(ctrl[None, :], 1 << r, axis=1)[0]
     off = off_ref[:]  # [1, W]
     masks = masks_lr_ref[:]  # [2, 11, 16, 8, 1]
     cwp_all = cwp_ref[:]  # [r, 16, 8, kg]
@@ -713,7 +723,7 @@ def replicate_entry_planes(
     jax.jit,
     static_argnames=(
         "r", "tile_lanes", "value_hash", "node_lanes", "unroll",
-        "interpret",
+        "compact_entry", "interpret",
     ),
 )
 def walk_descend_planes_pallas(
@@ -729,6 +739,7 @@ def walk_descend_planes_pallas(
     value_hash: bool = False,
     node_lanes: int | None = None,
     unroll: bool = True,
+    compact_entry: bool = False,
     interpret: bool = False,
 ) -> tuple:
     """Fixed-width fused descent of the last (or first) `r` expansion
@@ -745,13 +756,18 @@ def walk_descend_planes_pallas(
     prefixes per word instead, with KG=1 shared corrections, so a node
     spans prefix_words lanes there).
 
-    The entry is replicated 2^r-fold outside the kernel, then each
-    `tile_lanes` output tile descends independently at constant width.
-    The replication materializes full-width in HBM (one extra
+    Default mode replicates the entry 2^r-fold outside the kernel, then
+    each `tile_lanes` output tile descends independently at constant
+    width; the replication materializes full-width in HBM (one extra
     write+read of W lanes ~= the kernel's own output traffic — ~40 us
-    at the q128 serving width, noise against the layout traffic this
-    design deletes; an in-kernel offset-major repeat could remove it
-    later). Reference semantics: `ExpandSeeds` + `HashExpandedSeeds`
+    at the q128 serving width, but ~0.7 ms at the ld24 hierarchical
+    width). `compact_entry=True` removes it: each tile reads only its
+    UNREPLICATED entry chunk ([16, 8, tile >> r]) and the kernel
+    replicates in VMEM with the whole-array repeat; the tile's lanes
+    are then offset-major and the RETURN IS NOT NATURAL ORDER — callers
+    compose `walk_compact_leaf_order` into their exit gather. Requires
+    tile % (node_lanes << r) == 0. Reference semantics: `ExpandSeeds` +
+    `HashExpandedSeeds`
     (`dpf/distributed_point_function.cc:289-372,523-547`), evaluated as
     a per-leaf path walk (`dpf/internal/evaluate_prg_hwy.cc:150-539`).
     """
@@ -770,36 +786,59 @@ def walk_descend_planes_pallas(
             "silently break share reconstruction)"
         )
     w = g0 << r
-    state_r, ctrl_r = replicate_entry_planes(
-        state, ctrl, node_lanes, 1 << r
-    )
-    # Leaf offset of each lane within its entry node's 2^r block.
-    off_np = np.tile(
-        np.repeat(np.arange(1 << r, dtype=np.uint32), node_lanes),
-        g0 // node_lanes,
-    )
-    off = jnp.asarray(off_np[None, :])
     if tile_lanes is None:
-        tile = _pick_tile(w, kg, cap=_WALK_TILE_LANES)
+        if compact_entry:
+            # Compact tiles must cover whole node blocks; pick the
+            # largest multiple of node_lanes<<r within the cap, or the
+            # whole width when one block alone exceeds the cap.
+            block = node_lanes << r
+            tile = min(w, max(block, (_WALK_TILE_LANES // block) * block))
+            while w % tile:
+                tile -= block
+        else:
+            tile = _pick_tile(w, kg, cap=_WALK_TILE_LANES)
     else:
         tile = tile_lanes
     _check_tile(tile, w, kg)
+    if compact_entry:
+        if tile % (node_lanes << r) or w % tile:
+            raise ValueError(
+                f"compact_entry requires tile {tile} to cover whole "
+                f"node blocks: multiple of node_lanes<<r "
+                f"({node_lanes << r}) dividing {w}"
+            )
+        # Offset-major within each tile, matching the in-kernel
+        # whole-array repeat: lane = off * entry_chunk + entry_lane.
+        e = tile >> r
+        off_np = np.repeat(np.arange(1 << r, dtype=np.uint32), e)
+        state_r, ctrl_r = state, ctrl
+    else:
+        state_r, ctrl_r = replicate_entry_planes(
+            state, ctrl, node_lanes, 1 << r
+        )
+        # Leaf offset of each lane within its entry node's 2^r block.
+        off_np = np.tile(
+            np.repeat(np.arange(1 << r, dtype=np.uint32), node_lanes),
+            g0 // node_lanes,
+        )
+    off = jnp.asarray(off_np[None, :])
     if vc_kg is None:
         vc_kg = jnp.zeros((16, 8, kg), U32)
     masks_v = jnp.asarray(_MASKS_VALUE)
     ctrl2 = ctrl_r[None, :]
 
     def call(state_c, ctrl_c, off_c):
-        t = state_c.shape[-1]
+        t = off_c.shape[-1]
+        te = state_c.shape[-1]  # == t >> r when compact, else t
         return pl.pallas_call(
             functools.partial(
                 _walk_kernel, kg=kg, r=r, value_hash=value_hash,
-                unroll=unroll,
+                unroll=unroll, compact=compact_entry,
             ),
             grid=(1,),
             in_specs=[
-                pl.BlockSpec((16, 8, t), lambda l: (0, 0, 0)),
-                pl.BlockSpec((1, t), lambda l: (0, 0)),
+                pl.BlockSpec((16, 8, te), lambda l: (0, 0, 0)),
+                pl.BlockSpec((1, te), lambda l: (0, 0)),
                 pl.BlockSpec((1, t), lambda l: (0, 0)),
                 pl.BlockSpec((r, 16, 8, kg), lambda l: (0, 0, 0, 0)),
                 pl.BlockSpec((r, kg), lambda l: (0, 0)),
@@ -826,14 +865,43 @@ def walk_descend_planes_pallas(
 
     outs, cs = [], []
     for lo in range(0, w, tile):
-        o, c = call(
-            state_r[:, :, lo : lo + tile],
-            ctrl2[:, lo : lo + tile],
-            off[:, lo : lo + tile],
-        )
+        if compact_entry:
+            e = tile >> r
+            lo_e = lo >> r
+            o, c = call(
+                state_r[:, :, lo_e : lo_e + e],
+                ctrl2[:, lo_e : lo_e + e],
+                off,
+            )
+        else:
+            o, c = call(
+                state_r[:, :, lo : lo + tile],
+                ctrl2[:, lo : lo + tile],
+                off[:, lo : lo + tile],
+            )
         outs.append(o)
         cs.append(c[0])
     return jnp.concatenate(outs, axis=-1), jnp.concatenate(cs)
+
+
+def walk_compact_leaf_order(
+    entry_order: np.ndarray, r: int, nodes_per_tile: int
+) -> np.ndarray:
+    """Leaf order after a compact-entry walk-descent: each tile of
+    `nodes_per_tile` entry nodes exits offset-major (all nodes' offset
+    0, then offset 1, ...), tiles concatenating in entry order:
+    order[t * npt * 2^r + off * npt + p] =
+    entry_order[t * npt + p] * 2^r + off."""
+    npt = nodes_per_tile
+    m = np.asarray(entry_order, dtype=np.int64)
+    chunks = []
+    for lo in range(0, len(m), npt):
+        blk = m[lo : lo + npt]
+        chunks.append(
+            (blk[None, :] * (1 << r)
+             + np.arange(1 << r, dtype=np.int64)[:, None]).reshape(-1)
+        )
+    return np.concatenate(chunks)
 
 
 def _path_kernel(

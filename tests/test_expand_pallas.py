@@ -692,3 +692,63 @@ def test_walk_dispatch_integration(monkeypatch, expand_levels, head, tail):
         )
     )
     np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("tiles", [1, 2])
+def test_walk_compact_entry_matches_replicated(tiles):
+    """compact_entry reads the unreplicated entry per tile and exits
+    offset-major; through walk_compact_leaf_order it must be
+    bit-identical to the replicated natural-order mode."""
+    from distributed_point_functions_tpu.ops.expand_planes_pallas import (
+        walk_compact_leaf_order,
+        walk_descend_planes_pallas,
+    )
+
+    nk, r, kg = 64, 2, 2
+    n_entry = 4
+    g0 = n_entry * kg
+    w = g0 << r
+    tile = w // tiles
+    state, ctrl, _, _, _ = _random_inputs(g0, nk)
+    cwp_all = jnp.stack([
+        pack_key_planes(jnp.asarray(
+            RNG.integers(0, 1 << 32, (nk, 4), dtype=np.uint32)
+        )) for _ in range(r)
+    ])
+    cwl_all = jnp.stack([
+        pack_key_bits(jnp.asarray(
+            RNG.integers(0, 2, (nk,), dtype=np.uint32)
+        )) for _ in range(r)
+    ])
+    cwr_all = jnp.stack([
+        pack_key_bits(jnp.asarray(
+            RNG.integers(0, 2, (nk,), dtype=np.uint32)
+        )) for _ in range(r)
+    ])
+    vc = pack_key_planes(jnp.asarray(
+        RNG.integers(0, 1 << 32, (nk, 4), dtype=np.uint32)
+    ))
+    nat_v, nat_c = walk_descend_planes_pallas(
+        jnp.asarray(state), jnp.asarray(ctrl), cwp_all, cwl_all,
+        cwr_all, vc, r=r, tile_lanes=w, value_hash=True, interpret=True,
+    )
+    got_v, got_c = walk_descend_planes_pallas(
+        jnp.asarray(state), jnp.asarray(ctrl), cwp_all, cwl_all,
+        cwr_all, vc, r=r, tile_lanes=tile, value_hash=True,
+        compact_entry=True, interpret=True,
+    )
+    # Natural-mode output: leaf g at node-position g. Compact output:
+    # position per walk_compact_leaf_order; gather compact -> natural.
+    order = walk_compact_leaf_order(
+        np.arange(n_entry), r, (tile >> r) // kg
+    )
+    pos_of_leaf = np.argsort(order)
+    lanes = (
+        pos_of_leaf[:, None] * kg + np.arange(kg)[None, :]
+    ).reshape(-1)
+    np.testing.assert_array_equal(
+        np.asarray(got_v)[:, :, lanes], np.asarray(nat_v)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got_c)[lanes], np.asarray(nat_c)
+    )
