@@ -55,8 +55,12 @@ CASES = [
                   unroll=False)),
     # compact entry (in-kernel replication, no full-width HBM staging):
     # the big-domain variant — replication traffic is ~0.7 ms at ld24.
+    # Matched pairs against the plain cases above (same unroll flag) so
+    # the A/B isolates the replication traffic, not codegen.
     ("walk", dict(g0=2048, kg=4, r=4, tile=2048, value=True,
                   compact=True)),
+    ("walk", dict(g0=2048, kg=4, r=4, tile=2048, value=True,
+                  compact=True, unroll=False)),
     ("walk", dict(g0=4, kg=4, r=9, tile=2048, value=False,
                   compact=True)),
     ("level", dict(g=2048, kg=2, tile=2048)),

@@ -382,7 +382,8 @@ def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False,
                              head_req: int = 0,
                              head_cap: int = 0,
                              tail_kind: str = "concat",
-                             head_kind: str = "concat"):
+                             head_kind: str = "concat",
+                             walk_compact: bool = False):
     """`_expand_levels_limb_fn` computed in bitsliced plane layout (see
     `pir/dense_eval_planes.py` for the design): children are appended
     [all-left; all-right] per level so the lane order ends up
@@ -490,9 +491,17 @@ def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False,
                  for j in range(head_r)]
             )
             if head_kind == "walk":
+                from .ops.expand_planes_pallas import walk_plan
+
+                nl = n32 // 32
+                tile_h, compact_h, _ = walk_plan(
+                    state.shape[-1] << head_r, 1, nl, head_r,
+                    walk_compact,
+                )
                 state, ctrl = walk_descend_planes_pallas(
                     state, ctrl, cwp_head, cwl_head, cwr_head,
-                    r=head_r, node_lanes=n32 // 32,
+                    r=head_r, tile_lanes=tile_h, node_lanes=nl,
+                    compact_entry=compact_h,
                 )
             else:
                 state, ctrl = expand_head_planes_pallas(
@@ -539,11 +548,18 @@ def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False,
             # pure MMO output hash (correction is arithmetic here and
             # stays in the leaf stage).
             if tail_kind == "walk":
+                from .ops.expand_planes_pallas import walk_plan
+
+                nl = n32 // 32
+                tile_t, compact_t, _ = walk_plan(
+                    state.shape[-1] << tail_r, 1, nl, tail_r,
+                    walk_compact,
+                )
                 state, ctrl = walk_descend_planes_pallas(
                     state, ctrl, cwp_tail, cwl_tail, cwr_tail,
                     jnp.zeros((16, 8, 1), dtype=U32),
-                    r=tail_r, value_hash=True,
-                    node_lanes=n32 // 32,
+                    r=tail_r, tile_lanes=tile_t, value_hash=True,
+                    node_lanes=nl, compact_entry=compact_t,
                 )
             else:
                 state, ctrl = expand_tail_planes_pallas(
@@ -569,17 +585,31 @@ def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False,
         # interleaved); natural index = prefix * 2^PL + path. Static per
         # specialization. Without the tail, position = bit-reversal; the
         # tiled tail composes per-tile plane order on top.
-        from .ops.expand_planes_pallas import tail_node_permutation
-        from .pir.dense_eval_planes import walk_leaf_order
+        from .ops.expand_planes_pallas import (
+            compose_walk_leaf_order,
+            tail_node_permutation,
+            walk_plan,
+        )
 
         # Compose each phase's node order (walk phases emit natural
-        # offsets; doubling phases append [all-left; all-right]); the
-        # exit gather is argsort of the composition. Pure doubling
-        # degenerates to the classic bit-reversal.
+        # offsets, or offset-major tiles in compact mode; doubling
+        # phases append [all-left; all-right]); the exit gather is
+        # argsort of the composition. Pure doubling degenerates to the
+        # classic bit-reversal. walk_plan mirrors the kernel call
+        # sites exactly, so the order can never disagree with the
+        # launched tiles.
+        nl = n32 // 32
+
+        def walk_order(order, r):
+            _, compact, npt = walk_plan(
+                order.size * nl << r, 1, nl, r, walk_compact
+            )
+            return compose_walk_leaf_order(order, r, compact, npt)
+
         order = np.zeros(1, dtype=np.int64)
         if head_r:
             if head_kind == "walk":
-                order = walk_leaf_order(order, head_r)
+                order = walk_order(order, head_r)
             else:
                 order = tail_node_permutation(order, head_r, order.size)[0]
         mid = plane_levels - head_r - tail_r
@@ -587,7 +617,7 @@ def _expand_levels_planes_fn(num_levels: int, level_kernel: bool = False,
             order = tail_node_permutation(order, mid, order.size)[0]
         if tail_r:
             if tail_kind == "walk":
-                order = walk_leaf_order(order, tail_r)
+                order = walk_order(order, tail_r)
             else:
                 order = tail_node_permutation(order, tail_r, tile_nodes)[0]
         pos = np.argsort(order)
@@ -620,7 +650,11 @@ def _expand_levels_fn(num_levels: int, hash_leaves: bool = False):
                                         hash_leaves=hash_leaves)
     kinds = {}
     if mode == "walk":
-        kinds = {"tail_kind": "walk", "head_kind": "walk"}
+        kinds = {
+            "tail_kind": "walk",
+            "head_kind": "walk",
+            "walk_compact": _dep._walk_compact_enabled(),
+        }
     if mode in ("tail", "walk") and hash_leaves:
         # Knobs only enter the cache key when the tail can actually run
         # (hash_leaves), so no-tail programs aren't re-traced per tuple.

@@ -202,6 +202,54 @@ def _pick_tile(
 _WALK_TILE_LANES = 2048
 
 
+def pick_walk_tile(
+    w: int, kg: int, node_lanes: int, compact_entry: bool, r: int
+) -> int:
+    """The walk-descent wrapper's default tile choice, exposed so
+    callers that must compose the exit order (which depends on the
+    tile in compact mode) can compute the same value."""
+    if not compact_entry:
+        return _pick_tile(w, kg, cap=_WALK_TILE_LANES)
+    # Compact tiles must cover whole node blocks; pick the largest
+    # multiple of node_lanes<<r within the cap, or the whole width
+    # when one block alone exceeds the cap.
+    block = node_lanes << r
+    tile = min(w, max(block, (_WALK_TILE_LANES // block) * block))
+    while w % tile:
+        tile -= block
+    return tile
+
+
+def walk_plan(
+    w: int, kg: int, node_lanes: int, r: int, want_compact: bool
+) -> tuple:
+    """(tile, compact, nodes_per_tile) for one walk phase — the ONE
+    place the tile/mode decision lives, so the kernel call and the
+    exit-order composition can never disagree. Compact is declined
+    when a single node block (node_lanes << r lanes) exceeds the tile
+    cap: the compact tile would blow the probed VMEM envelope and fail
+    a compile the replicated mode (which tiles freely) survives."""
+    block = node_lanes << r
+    if want_compact and block <= _WALK_TILE_LANES:
+        tile = pick_walk_tile(w, kg, node_lanes, True, r)
+        return tile, True, (tile >> r) // node_lanes
+    return pick_walk_tile(w, kg, node_lanes, False, r), False, 0
+
+
+def compose_walk_leaf_order(
+    entry_order: np.ndarray, r: int, compact: bool, nodes_per_tile: int
+) -> np.ndarray:
+    """Exit leaf order of a walk phase planned by `walk_plan`: natural
+    per-node offsets (replicated mode) or offset-major tiles (compact),
+    composed over the entry order."""
+    if compact:
+        return walk_compact_leaf_order(entry_order, r, nodes_per_tile)
+    m = np.asarray(entry_order, dtype=np.int64)
+    return (
+        m[:, None] * (1 << r) + np.arange(1 << r, dtype=np.int64)[None, :]
+    ).reshape(-1)
+
+
 @functools.partial(
     jax.jit, static_argnames=("interpret", "tile_lanes")
 )
@@ -787,16 +835,7 @@ def walk_descend_planes_pallas(
         )
     w = g0 << r
     if tile_lanes is None:
-        if compact_entry:
-            # Compact tiles must cover whole node blocks; pick the
-            # largest multiple of node_lanes<<r within the cap, or the
-            # whole width when one block alone exceeds the cap.
-            block = node_lanes << r
-            tile = min(w, max(block, (_WALK_TILE_LANES // block) * block))
-            while w % tile:
-                tile -= block
-        else:
-            tile = _pick_tile(w, kg, cap=_WALK_TILE_LANES)
+        tile = pick_walk_tile(w, kg, node_lanes, compact_entry, r)
     else:
         tile = tile_lanes
     _check_tile(tile, w, kg)

@@ -95,6 +95,37 @@ def walk_leaf_order(entry_order: np.ndarray, r: int) -> np.ndarray:
     ).reshape(-1)
 
 
+def _walk_compact_enabled() -> bool:
+    """DPF_TPU_WALK_COMPACT=1 routes the walk kernels through the
+    compact-entry mode (in-kernel replication, no full-width HBM
+    staging of the replicated entry). Default off until the mode is
+    hardware-proven; read at dispatch time like the other knobs."""
+    return os.environ.get("DPF_TPU_WALK_COMPACT", "") == "1"
+
+
+def _walk_phase(state, ctrl, cwp, cwl, cwr, vc, *, r, node_lanes,
+                leaf_order, compact, value_hash=False):
+    """One walk-descent phase (head or tail) plus its leaf-order
+    composition. `compact` arrives as a trace-time-static flag (the
+    dispatcher reads the env knob); walk_plan is the single source of
+    truth for the tile/mode pair. Returns ((state, ctrl),
+    new_leaf_order)."""
+    from ..ops.expand_planes_pallas import (
+        compose_walk_leaf_order,
+        walk_plan,
+    )
+
+    kg = cwp.shape[-1]
+    w = state.shape[-1] << r
+    tile, compact, npt = walk_plan(w, kg, node_lanes, r, compact)
+    out = walk_descend_planes_pallas(
+        state, ctrl, cwp, cwl, cwr, vc,
+        r=r, tile_lanes=tile, value_hash=value_hash,
+        node_lanes=node_lanes, compact_entry=compact,
+    )
+    return out, compose_walk_leaf_order(leaf_order, r, compact, npt)
+
+
 def pack_key_planes(cw: jnp.ndarray) -> jnp.ndarray:
     """uint32[nk, 4] per-key 128-bit words -> uint32[16, 8, nk/32] planes
     packed over the key axis (word m bit i = key 32m+i's bit).
@@ -234,6 +265,9 @@ def evaluate_selection_blocks_planes(
                 head_levels=head_levels,
                 tail_kind=tail_kind,
                 head_kind=head_kind,
+                walk_compact=(
+                    tail_kind == "walk" and _walk_compact_enabled()
+                ),
             )
         except Exception as e:  # noqa: BLE001 - degrade, don't die
             if forced:
@@ -1065,7 +1099,7 @@ def _level_kernel_enabled():
     static_argnames=(
         "walk_levels", "expand_levels", "num_blocks", "bitrev_leaves",
         "level_kernel", "tail_levels", "tail_tile_nodes", "head_levels",
-        "tail_kind", "head_kind",
+        "tail_kind", "head_kind", "walk_compact",
     ),
 )
 def _evaluate_selection_blocks_planes_jit(
@@ -1086,6 +1120,7 @@ def _evaluate_selection_blocks_planes_jit(
     head_levels: int = 0,
     tail_kind: str = "concat",
     head_kind: str = "concat",
+    walk_compact: bool = False,
 ) -> jnp.ndarray:
     """Drop-in for `dense_eval.evaluate_selection_blocks` (bit-identical
     output), computed with the plane-resident expansion.
@@ -1139,11 +1174,11 @@ def _evaluate_selection_blocks_planes_jit(
              for j in range(head_levels)]
         )
         if head_kind == "walk":
-            state, ctrl = walk_descend_planes_pallas(
-                state, ctrl, cwp_head, cwl_head, cwr_head,
-                r=head_levels,
+            (state, ctrl), leaf_order = _walk_phase(
+                state, ctrl, cwp_head, cwl_head, cwr_head, None,
+                r=head_levels, node_lanes=key_groups,
+                leaf_order=leaf_order, compact=walk_compact,
             )
-            leaf_order = walk_leaf_order(leaf_order, head_levels)
         else:
             state, ctrl = expand_head_planes_pallas(
                 state, ctrl, cwp_head, cwl_head, cwr_head
@@ -1197,12 +1232,13 @@ def _evaluate_selection_blocks_planes_jit(
              for j in range(tail_levels)]
         )
         if tail_kind == "walk":
-            values, _ = walk_descend_planes_pallas(
+            (values, _), leaf_order = _walk_phase(
                 state, ctrl, cwp_tail, cwl_tail, cwr_tail,
                 pack_key_planes(last_vc),
-                r=tail_levels, value_hash=True,
+                r=tail_levels, node_lanes=key_groups,
+                leaf_order=leaf_order, compact=walk_compact,
+                value_hash=True,
             )
-            leaf_order = walk_leaf_order(leaf_order, tail_levels)
         else:
             values, _ = expand_tail_planes_pallas(
                 state,

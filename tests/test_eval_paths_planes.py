@@ -95,12 +95,14 @@ def test_expand_levels_planes_matches_limb(p, levels):
     np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
 
 
-@pytest.mark.parametrize("p,levels,head_req,tail_req", [
-    (8, 5, 2, 2),   # walk head (clipped to avail) + walk tail
-    (8, 7, 0, 3),   # walk tail with a per-level middle
+@pytest.mark.parametrize("p,levels,head_req,tail_req,compact", [
+    (8, 5, 2, 2, False),  # walk head (clipped to avail) + walk tail
+    (8, 7, 0, 3, False),  # walk tail with a per-level middle
+    (8, 7, 0, 3, True),   # compact-entry walk tail
+    (8, 5, 2, 2, True),   # compact-entry walk head + tail
 ])
 def test_expand_levels_walk_kinds_match_limb(
-    monkeypatch, p, levels, head_req, tail_req
+    monkeypatch, p, levels, head_req, tail_req, compact
 ):
     """The hierarchical expansion with walk-kind head/tail must be
     bit-identical to the limb program (incl. the fused leaf hash and
@@ -141,6 +143,7 @@ def test_expand_levels_walk_kinds_match_limb(
             tail_req=tail_req, tail_tile_target=128,
             head_req=head_req, head_cap=1 << 20,
             tail_kind="walk", head_kind="walk",
+            walk_compact=compact,
         )(seeds, control, cw_s, cw_l, cw_r)
     finally:
         dpf_mod._expand_levels_planes_fn.cache_clear()

@@ -632,16 +632,21 @@ def test_walk_descend_multi_tile():
 
 
 @pytest.mark.parametrize(
-    "expand_levels,head,tail",
+    "expand_levels,head,tail,compact",
     [
-        (5, 2, 3),  # walk head + walk tail, no middle
-        (6, 2, 2),  # walk head + PER-LEVEL middle + walk tail: the
-        #             production composition at serving shapes, where
-        #             the leaf-order bookkeeping appends doubling
-        #             between two natural-order walk phases
+        (5, 2, 3, False),  # walk head + walk tail, no middle
+        (6, 2, 2, False),  # walk head + PER-LEVEL middle + walk tail:
+        #                    the production composition at serving
+        #                    shapes, where the leaf-order bookkeeping
+        #                    appends doubling between two natural-order
+        #                    walk phases
+        (6, 2, 2, True),   # same, compact-entry mode (offset-major
+        #                    tiles composed into the exit gather)
     ],
 )
-def test_walk_dispatch_integration(monkeypatch, expand_levels, head, tail):
+def test_walk_dispatch_integration(
+    monkeypatch, expand_levels, head, tail, compact
+):
     """The planes pipeline with walk-kind head+tail must be
     bit-identical to the XLA pipeline — exercises the leaf-order
     bookkeeping end to end."""
@@ -689,6 +694,7 @@ def test_walk_dispatch_integration(monkeypatch, expand_levels, head, tail):
             tail_levels=tail,
             tail_kind="walk",
             head_kind="walk",
+            walk_compact=compact,
         )
     )
     np.testing.assert_array_equal(got, want)
