@@ -1,0 +1,78 @@
+"""End-to-end exercise of bench.py's kernel-demotion ladder on CPU.
+
+The ladder only runs when the auto pipeline's outer-jit compile fails —
+a hardware-only event in production — so without this test its code
+path ships unexecuted. A simulated walk-mode failure must: bank the
+XLA-levels candidate first, demote the walk tier with attribution
+evidence, persist the verdict, and still emit a valid headline JSON.
+"""
+
+import io
+import json
+import os
+import sys
+
+import pytest
+
+
+@pytest.fixture()
+def bench_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("BENCH_PLATFORM", "cpu")
+    monkeypatch.setenv("BENCH_RECORDS", "4096")
+    monkeypatch.setenv("BENCH_RECORD_BYTES", "64")
+    monkeypatch.setenv("BENCH_QUERIES", "8")
+    monkeypatch.setenv("BENCH_ITERS", "1")
+    # Must leave >420 s of watchdog budget or the ladder's guard
+    # (correctly) refuses to spend compile time on demotion retries.
+    monkeypatch.setenv("BENCH_TIMEOUT", "1200")
+    monkeypatch.setenv("BENCH_NO_PALLAS", "1")
+    monkeypatch.setenv(
+        "DPF_TPU_VERDICT_CACHE", str(tmp_path / "verdicts.json")
+    )
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+def test_ladder_demotes_walk_with_evidence(bench_env, monkeypatch):
+    from distributed_point_functions_tpu.pir import dense_eval_planes as dep
+
+    import bench
+
+    real = dep.evaluate_selection_blocks_planes
+
+    def flaky(*args, **kwargs):
+        # Fail exactly the auto walk-mode composition: the XLA bank
+        # runs under DPF_TPU_LEVEL_KERNEL=xla and must succeed; the
+        # ladder's retry runs with the walk flag demoted and must
+        # succeed.
+        if (
+            os.environ.get("DPF_TPU_LEVEL_KERNEL", "auto") == "auto"
+            and dep._WALK_KERNEL_VERIFIED
+            and not dep._WALK_KERNEL_FAILED
+        ):
+            raise RuntimeError("simulated Mosaic serving-shape failure")
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(dep, "evaluate_selection_blocks_planes", flaky)
+    monkeypatch.setattr(dep, "warm_level_kernels", lambda: "walk")
+    monkeypatch.setattr(dep, "_WALK_KERNEL_VERIFIED", True)
+    monkeypatch.setattr(dep, "_WALK_KERNEL_FAILED", False)
+    monkeypatch.setattr(dep, "_LEVEL_KERNEL_VERIFIED", True)
+    monkeypatch.setattr(dep, "_VERDICTS_LOADED", True)
+    monkeypatch.setattr(dep, "_LAST_RECORDED", None)
+
+    out = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    bench.main()
+
+    line = out.getvalue().strip().splitlines()[-1]
+    result = json.loads(line)
+    assert result["value"] > 0, result
+    assert "error" not in result, result
+
+    # The ladder demoted walk with evidence and persisted it.
+    assert dep._WALK_KERNEL_FAILED is True
+    with open(bench_env / "verdicts.json") as f:
+        stored = json.load(f)
+    (entry,) = stored.values()
+    assert entry.get("_WALK_KERNEL_FAILED") is True
