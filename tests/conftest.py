@@ -33,6 +33,25 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _isolate_kernel_verdict_flags():
+    """Snapshot/restore the kernel self-check flags around EVERY test.
+
+    The flags are plain module globals mutated by dispatch/self-check
+    code paths (not only by tests), so a test that triggers a demotion
+    without monkeypatching the flag leaks state into every later test —
+    observed: test_level_kernel_selfcheck's un-stubbed walk self-check
+    failing on CPU left _WALK_KERNEL_FAILED=True suite-wide."""
+    from distributed_point_functions_tpu.pir import dense_eval_planes as dep
+
+    saved = {f: getattr(dep, f) for f in dep._VERDICT_FLAGS}
+    saved["_VERDICTS_LOADED"] = dep._VERDICTS_LOADED
+    saved["_LAST_RECORDED"] = dep._LAST_RECORDED
+    yield
+    for name, value in saved.items():
+        setattr(dep, name, value)
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Drop compiled executables between test modules.

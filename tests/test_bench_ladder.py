@@ -26,6 +26,12 @@ def bench_env(monkeypatch, tmp_path):
     # (correctly) refuses to spend compile time on demotion retries.
     monkeypatch.setenv("BENCH_TIMEOUT", "1200")
     monkeypatch.setenv("BENCH_NO_PALLAS", "1")
+    # bench.main() mutates this env var in place (the xla-first bank and
+    # the winner pinning); setting it here lets monkeypatch restore it.
+    monkeypatch.setenv("DPF_TPU_LEVEL_KERNEL", "auto")
+    # Keep the XLA compilation cache out of the developer's real
+    # ~/.cache/jax_bench.
+    monkeypatch.setenv("BENCH_CACHE_DIR", str(tmp_path / "jax_cache"))
     monkeypatch.setenv(
         "DPF_TPU_VERDICT_CACHE", str(tmp_path / "verdicts.json")
     )
@@ -63,7 +69,17 @@ def test_ladder_demotes_walk_with_evidence(bench_env, monkeypatch):
 
     out = io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
-    bench.main()
+    try:
+        bench.main()
+    finally:
+        # The daemon watchdog os._exit()s the WHOLE pytest process at
+        # BENCH_TIMEOUT unless told the run completed; a failure above
+        # must not nuke the rest of the suite. Also detach the jax
+        # compilation-cache config main() installed.
+        bench._PROGRESS["done"] = True
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", None)
 
     line = out.getvalue().strip().splitlines()[-1]
     result = json.loads(line)

@@ -343,9 +343,12 @@ def test_level_kernel_selfcheck(monkeypatch):
     monkeypatch.setattr(dep, "_TAIL_KERNEL_VERIFIED", False)
     monkeypatch.setattr(dep, "_HEAD_KERNEL_FAILED", False)
     monkeypatch.setattr(dep, "_HEAD_KERNEL_VERIFIED", False)
+    monkeypatch.setattr(dep, "_WALK_KERNEL_FAILED", False)
+    monkeypatch.setattr(dep, "_WALK_KERNEL_VERIFIED", False)
 
     # Interpret-mode kernels: the self-checks pass and auto mode prefers
-    # the fused tail (with the fused head verified alongside).
+    # the walk-descent kernels (tail/head verified alongside would be
+    # skipped — walk wins first).
     for name in ("expand_level_planes_pallas", "value_hash_planes_pallas",
                  "path_level_planes_pallas"):
         monkeypatch.setattr(
@@ -359,8 +362,25 @@ def test_level_kernel_selfcheck(monkeypatch):
         dep, "expand_head_planes_pallas",
         functools.partial(dep.expand_head_planes_pallas, interpret=True),
     )
-    assert dep._level_kernel_enabled() == "tail"
+    monkeypatch.setattr(
+        dep, "walk_descend_planes_pallas",
+        functools.partial(dep.walk_descend_planes_pallas, interpret=True),
+    )
+    assert dep._level_kernel_enabled() == "walk"
     assert dep._LEVEL_KERNEL_VERIFIED is True
+    assert dep._WALK_KERNEL_VERIFIED is True
+
+    # A broken walk kernel demotes to the fused tail, not to XLA.
+    monkeypatch.setattr(dep, "_WALK_KERNEL_FAILED", False)
+    monkeypatch.setattr(dep, "_WALK_KERNEL_VERIFIED", False)
+
+    def walk_boom(*a, **k):
+        raise RuntimeError("mosaic says no")
+
+    monkeypatch.setattr(dep, "walk_descend_planes_pallas", walk_boom)
+    with pytest.warns(UserWarning, match="walk-descent"):
+        assert dep._level_kernel_enabled() == "tail"
+    assert dep._WALK_KERNEL_FAILED is True
     assert dep._TAIL_KERNEL_VERIFIED is True
     assert dep._HEAD_KERNEL_VERIFIED is True
 
