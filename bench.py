@@ -35,9 +35,11 @@ BENCH_NO_PALLAS2=1 / BENCH_NO_BITPLANE=1 to skip inner-product tiers,
 BENCH_EXPANSION=planes|limb|both (default planes — the measured-best
 single config; "both" restores the A/B), BENCH_NSLEAF=1 to add the
 slow-compiling ns/leaf secondary metric, BENCH_ONLY_NSLEAF=1 to run only
-it, BENCH_PLATFORM=cpu for a hermetic CPU run, BENCH_INIT_BUDGET
-(default 300 s) for the TOTAL backend-init retry budget, and
-BENCH_TIMEOUT (default 1500 s) for the stall watchdog.
+it, BENCH_PLATFORM=cpu for a hermetic CPU run, BENCH_INIT_BUDGET to pin
+the TOTAL backend-init retry budget (default: adaptive — the watchdog
+window minus BENCH_MEASURE_MARGIN [600 s], floored at 300 s, so a tunnel
+that answers late in the driver's window still yields a measurement),
+and BENCH_TIMEOUT (default 1500 s) for the stall watchdog.
 """
 
 from __future__ import annotations
@@ -121,6 +123,42 @@ class _InitTimeout(RuntimeError):
     pass
 
 
+def _init_budget_secs(timeout=None):
+    """Total backend-init retry budget in seconds.
+
+    An explicit BENCH_INIT_BUDGET wins (capture queues set 120 s and gate
+    stages on their own tunnel probe). Otherwise the budget is adaptive:
+    everything the watchdog window allows minus the margin a warm-cache
+    compile+measure+emit needs (BENCH_MEASURE_MARGIN, default 600 s) —
+    r04 lesson (BENCH_r04.json): the fixed 300 s budget gave up on a
+    tunnel that the 1500 s watchdog would have allowed to answer at
+    minute 10 of the driver's window and still produce a measurement.
+    """
+    explicit = os.environ.get("BENCH_INIT_BUDGET", "").strip()
+    if explicit:
+        try:
+            return float(explicit)
+        except ValueError:
+            _log(
+                f"WARNING: unparsable BENCH_INIT_BUDGET={explicit!r} "
+                "ignored; using the adaptive budget"
+            )
+    if timeout is None:
+        try:
+            timeout = float(os.environ.get("BENCH_TIMEOUT", 1500))
+        except ValueError:
+            timeout = 1500.0
+    try:
+        margin = float(os.environ.get("BENCH_MEASURE_MARGIN", 600))
+    except ValueError:
+        margin = 600.0
+    # Floored at 300 s for sane timeouts, but never allowed to outlive
+    # the global watchdog itself (small BENCH_TIMEOUT values cap below
+    # the floor: e.g. 350 s timeout -> 230 s init budget).
+    budget = max(300.0, timeout - margin)
+    return min(budget, max(60.0, timeout - 120))
+
+
 # Shared progress state for the global watchdog: the main thread records
 # the current stage (and the headline figure once measured); if the TPU
 # tunnel stalls mid-run — observed 2026-07-30: an execution that normally
@@ -131,15 +169,16 @@ _PROGRESS = {"stage": "startup", "qps": None, "done": False}
 
 
 def _start_watchdog():
-    # Default must exceed _ensure_backend's total budget (300s) plus one
-    # cold compile of the single headline config (~320s worst observed)
-    # with headroom, while staying well inside the driver's window.
+    # Default must exceed _ensure_backend's total budget (adaptive,
+    # timeout - BENCH_MEASURE_MARGIN) plus one cold compile of the single
+    # headline config (~320s worst observed) with headroom, while staying
+    # well inside the driver's window.
     timeout = float(os.environ.get("BENCH_TIMEOUT", 1500))
     # A hung `jax.devices()` blocks the main thread inside a C call where
     # neither SIGALRM handlers nor the retry loop can run (observed r02:
     # the 240 s alarm fired at 1502 s), so the init stage gets its own
     # thread-enforced deadline: total init budget + jax-import slack.
-    init_budget = float(os.environ.get("BENCH_INIT_BUDGET", 300))
+    init_budget = _init_budget_secs(timeout)
     init_deadline = time.monotonic() + init_budget + 120
 
     _PROGRESS["deadline"] = time.monotonic() + timeout
@@ -197,12 +236,15 @@ def _ensure_backend(jax, total_budget_secs=None, per_attempt_secs=150):
     *hang* over the tunnel, so each attempt runs under a SIGALRM watchdog.
     Round-2 failure mode (BENCH_r02.json): five 240 s attempts plus backoff
     serialized to ~28 min and blew the driver's budget — so the retry loop
-    now runs under one TOTAL wall-clock budget (BENCH_INIT_BUDGET, default
-    300 s): fail fast, emit the JSON line, point at the committed capture.
+    runs under one TOTAL wall-clock budget (_init_budget_secs: explicit
+    BENCH_INIT_BUDGET, or adaptively the watchdog window minus the
+    measure margin — r04 lesson: a fixed 300 s budget wasted tunnels that
+    answered later in the driver's window). On exhaustion: emit the JSON
+    line, point at the committed capture.
     Returns (devices, None) or (None, last_error).
     """
     if total_budget_secs is None:
-        total_budget_secs = float(os.environ.get("BENCH_INIT_BUDGET", 300))
+        total_budget_secs = _init_budget_secs()
     deadline = time.monotonic() + total_budget_secs
     last_err = None
     delay = 15
@@ -874,6 +916,13 @@ def main():
 
     _PROGRESS["stage"] = "measure"
     for name, step in candidates.items():
+        if name in timings:
+            # Already banked during the compile stage (xla-first bank);
+            # re-measuring would spend a second _slope_time run of the
+            # hardware window on a figure we already hold.
+            _log(f"expansion[{name}]: keeping banked "
+                 f"{timings[name] * 1e3:.3f} ms")
+            continue
         per, lat = _slope_time(lambda s=step: s(*staged, db_words), iters)
         if per is not None:
             timings[name] = per

@@ -29,6 +29,7 @@ tail -5 benchmarks/results/bench_quick_${stamp}.log
 echo "=== headline bench (2^20 x 256B, expansion A/B + ns/leaf) ==="
 rm -f benchmarks/results/bench_extra.json
 timeout 2700 env BENCH_EXPANSION=both BENCH_NSLEAF=1 BENCH_TIMEOUT=2600 \
+    BENCH_INIT_BUDGET=120 \
     python bench.py 2>benchmarks/results/bench_${stamp}.log \
     | tee benchmarks/results/bench_${stamp}.json || fail=1
 tail -20 benchmarks/results/bench_${stamp}.log
