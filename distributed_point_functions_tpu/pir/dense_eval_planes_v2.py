@@ -1,0 +1,199 @@
+"""Key-major plane-resident dense-PIR expansion (v2): the layout-clean
+XLA path.
+
+The v1 plane expansion (`dense_eval_planes.py`) keeps the lane axis
+node-major/key-minor (lane = node * key_groups + key_group). That forces
+two per-level layout materializations the r04 xprof blames for ~70% of
+the serving expansion step (copies 8.1 ms + reshapes 5.25 ms +
+concatenates 2.3 ms of a 23.1 ms step):
+
+* per-key correction operands must be broadcast *periodically* along the
+  minor lane axis (`_tile_keys`: a `jnp.tile` = broadcast + reshape whose
+  intermediate has the tiny key-group count in a tiled dimension — a
+  pad-heavy relayout materializing a state-sized array every level), and
+* the exit permutation back to natural block order is a state-sized
+  gather every batch.
+
+v2 removes both by construction:
+
+* **Key-group axis leading.** State is `uint32[kg, 16, 8, W]` (kg =
+  padded_keys/32, W = subtree width): the tiled physical dims are always
+  (8, W), so no shape in the level loop carries a padded tile, and every
+  per-key operand (`[kg, 16, 8, 1]` seed corrections, `[kg, 1]`
+  direction words) broadcasts along the minor W axis **natively** — zero
+  materialized operands. The plane ops (`sigma_planes`,
+  `aes_rounds_planes`, `mmo_hash_planes`) are elementwise over the
+  trailing lane axis, so `jax.vmap` over the leading kg axis reuses them
+  unchanged.
+* **No exit gather in serving mode.** Leaves exit in the doubling
+  (bit-reversed) order; `bitrev_leaves=True` hands them to the inner
+  product as-is, and the serving side bit-reversal-permutes the
+  database's record *blocks* once at staging (`bitrev_permutation` is an
+  involution), so per-batch cost is zero. `bitrev_leaves=False` applies
+  the natural-order gather for bit-identity with
+  `dense_eval.evaluate_selection_blocks` (differential tests).
+
+Reference semantics: `ExpandSeeds` breadth-first buffer reuse
+(`dpf/distributed_point_function.cc:289-372`) restricted to the covering
+subtree; the [all-left; all-right] append per level is the same
+recurrence per key pyramid, so the per-key leaf order is the classic
+bit-reversal, exactly as v1's pure-XLA path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import keys as fixed_keys
+from ..ops.aes_bitslice import (
+    aes_rounds_planes,
+    limbs_to_planes,
+    mmo_hash_planes,
+    planes_to_limbs,
+    sigma_planes,
+)
+from .dense_eval import _walk_zeros
+from .dense_eval_planes import (
+    bitrev_permutation,
+    pack_key_bits,
+    pack_key_planes,
+)
+
+U32 = jnp.uint32
+
+_sigma_v = jax.vmap(sigma_planes)
+_aes_v = jax.vmap(aes_rounds_planes, in_axes=(None, 0))
+_mmo_v = jax.vmap(mmo_hash_planes, in_axes=(None, 0))
+
+
+def pack_key_planes_kg(cw: jnp.ndarray) -> jnp.ndarray:
+    """uint32[nkp, 4] per-key 128-bit words -> uint32[kg, 16, 8, 1]
+    key-major plane masks (native broadcast operand along W)."""
+    return jnp.moveaxis(pack_key_planes(cw), -1, 0)[..., None]
+
+
+def expand_level_planes_v2(state, ctrl, cw_p, cwl_w, cwr_w):
+    """One [all-left; all-right] doubling level in key-major layout.
+
+    state: uint32[kg, 16, 8, W] planes; ctrl: uint32[kg, W] packed parent
+    control bits (word [k, n] = keys 32k..32k+31 at node n); cw_p:
+    uint32[kg, 16, 8, 1] seed-correction planes; cwl_w / cwr_w:
+    uint32[kg, 1] packed direction-correction words. Returns
+    (state [kg, 16, 8, 2W], ctrl [kg, 2W])."""
+    sig = _sigma_v(state)
+    left = _aes_v(fixed_keys.RK_LEFT, sig) ^ sig
+    right = _aes_v(fixed_keys.RK_RIGHT, sig) ^ sig
+    st = jnp.concatenate([left, right], axis=-1)
+    ctrl2 = jnp.concatenate([ctrl, ctrl], axis=-1)
+    st = st ^ (cw_p & ctrl2[:, None, None, :])
+    t_new = st[:, 0, 0]  # LSB plane = control bits
+    st = st.at[:, 0, 0].set(jnp.zeros_like(t_new))
+    w = ctrl.shape[-1]
+    kg = ctrl.shape[0]
+    cw_dir = jnp.concatenate(
+        [
+            jnp.broadcast_to(cwl_w, (kg, w)),
+            jnp.broadcast_to(cwr_w, (kg, w)),
+        ],
+        axis=-1,
+    )
+    return st, t_new ^ (ctrl2 & cw_dir)
+
+
+def evaluate_selection_blocks_planes_v2(
+    seeds0: jnp.ndarray,
+    control0: jnp.ndarray,
+    cw_seeds: jnp.ndarray,
+    cw_left: jnp.ndarray,
+    cw_right: jnp.ndarray,
+    last_vc: jnp.ndarray,
+    *,
+    walk_levels: int,
+    expand_levels: int,
+    num_blocks: int,
+    bitrev_leaves: bool = False,
+) -> jnp.ndarray:
+    """Drop-in for `dense_eval.evaluate_selection_blocks` (bit-identical
+    output with `bitrev_leaves=False`), computed with the key-major
+    plane expansion.
+
+    With `bitrev_leaves=True` the leaf axis stays in doubling order
+    (natural block g at position bitrev(g)) and is NOT truncated to
+    `num_blocks` — for serving paths that bit-reverse the database's
+    record blocks at staging instead.
+    """
+    nk = seeds0.shape[0]
+    pad_keys = (-nk) % 32
+    if pad_keys:
+        seeds0 = jnp.pad(seeds0, ((0, pad_keys), (0, 0)))
+        control0 = jnp.pad(control0, ((0, pad_keys),))
+        cw_seeds = jnp.pad(cw_seeds, ((0, 0), (0, pad_keys), (0, 0)))
+        cw_left = jnp.pad(cw_left, ((0, 0), (0, pad_keys)))
+        cw_right = jnp.pad(cw_right, ((0, 0), (0, pad_keys)))
+        last_vc = jnp.pad(last_vc, ((0, pad_keys), (0, 0)))
+    nkp = nk + pad_keys
+    kg = nkp // 32
+
+    # Phase 1 (limb space, [nk, 4] only): walk the all-zeros prefix.
+    seeds, control = _walk_zeros(
+        seeds0, control0, cw_seeds[:walk_levels], cw_left[:walk_levels]
+    )
+
+    # Enter key-major plane space once: [kg, 16, 8, 1].
+    state = jnp.moveaxis(limbs_to_planes(seeds), -1, 0)[..., None]
+    ctrl = pack_key_bits(control.astype(U32))[:, None]  # [kg, 1]
+
+    for i in range(expand_levels):
+        lvl = walk_levels + i
+        state, ctrl = expand_level_planes_v2(
+            state,
+            ctrl,
+            pack_key_planes_kg(cw_seeds[lvl]),
+            pack_key_bits(cw_left[lvl])[:, None],
+            pack_key_bits(cw_right[lvl])[:, None],
+        )
+
+    # Leaf value blocks: output PRG + XOR value correction (party
+    # negation is the identity for XOR shares).
+    values = _mmo_v(fixed_keys.RK_VALUE, state)
+    values = values ^ (pack_key_planes_kg(last_vc) & ctrl[:, None, None, :])
+
+    # Leave plane space once: [kg, 16, 8, w] -> [nkp, w, 4].
+    w = 1 << expand_levels
+    lim = jax.vmap(planes_to_limbs)(values)  # [kg, w*32, 4]
+    lim = lim.reshape(kg, w, 32, 4)
+    out = jnp.moveaxis(lim, 0, 1).reshape(w, nkp, 4)
+    out = jnp.moveaxis(out, 0, 1)  # [nkp, w, 4]
+    if not bitrev_leaves:
+        perm = jnp.asarray(bitrev_permutation(expand_levels))
+        out = out[:, perm, :][:, :num_blocks, :]
+        if out.shape[1] < num_blocks:
+            # Blocks beyond the tree's capacity (mesh-padded databases)
+            # can only select guaranteed-zero rows.
+            out = jnp.pad(
+                out, ((0, 0), (0, num_blocks - out.shape[1]), (0, 0))
+            )
+    return out[:nk]
+
+
+def bitrev_block_permute_records(db_host: np.ndarray) -> np.ndarray:
+    """Bit-reversal-permute a record-major database's 128-record blocks
+    (host-side, once at staging) so a `bitrev_leaves=True` expansion's
+    selection vector lines up with it. The permutation is an involution;
+    responses are XOR-sums over (selection, record) pairs, so applying
+    the same permutation to both sides leaves every response unchanged.
+    """
+    num_records = db_host.shape[0]
+    if num_records % 128:
+        raise ValueError("record count must be padded to a multiple of 128")
+    num_blocks = num_records // 128
+    levels = max(0, (num_blocks - 1).bit_length())
+    if num_blocks != 1 << levels:
+        raise ValueError("block count must be a power of two")
+    perm = bitrev_permutation(levels)
+    return (
+        db_host.reshape(num_blocks, 128, -1)[perm]
+        .reshape(num_records, -1)
+    )

@@ -1,0 +1,160 @@
+"""Differential tests for the key-major (v2) plane expansion
+(`pir/dense_eval_planes_v2.py`) against the limb kernel and the v1
+planes path: bit-identical natural-order output, bitrev-leaves mode
+consistency, and the staged-database involution that makes the
+gather-free serving exit correct end to end.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
+from distributed_point_functions_tpu.pir.dense_eval import (
+    evaluate_selection_blocks,
+    stage_keys,
+)
+from distributed_point_functions_tpu.pir.dense_eval_planes import (
+    bitrev_permutation,
+    evaluate_selection_blocks_planes,
+)
+from distributed_point_functions_tpu.pir.dense_eval_planes_v2 import (
+    bitrev_block_permute_records,
+    evaluate_selection_blocks_planes_v2,
+)
+
+RNG = np.random.default_rng(1234)
+
+
+def _split(client, num_blocks):
+    total = client._dpf._tree_levels_needed - 1
+    el = min(max(0, (num_blocks - 1).bit_length()), total)
+    return total - el, el
+
+
+@pytest.mark.parametrize(
+    "num_records,nq",
+    [
+        (1024, 7),    # walk > 0, keys need padding to 32
+        (512, 64),    # exact key-group multiple, kg=2
+        (300, 3),     # tiny: 3 blocks, expand < 2 levels
+        (128, 1),     # single block, expand_levels == 0
+    ],
+)
+def test_v2_matches_limb(num_records, nq):
+    num_blocks = (num_records + 127) // 128
+    client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+    indices = [int(i) for i in RNG.integers(0, num_records, nq)]
+    wl, el = _split(client, num_blocks)
+    for keys in client._generate_key_pairs(indices):
+        staged = stage_keys(keys)
+        a = np.asarray(
+            evaluate_selection_blocks(
+                *staged,
+                walk_levels=wl, expand_levels=el, num_blocks=num_blocks,
+            )
+        )
+        b = np.asarray(
+            evaluate_selection_blocks_planes_v2(
+                *staged,
+                walk_levels=wl, expand_levels=el, num_blocks=num_blocks,
+            )
+        )
+        np.testing.assert_array_equal(a, b)
+
+
+def test_v2_bitrev_matches_v1_bitrev():
+    """Both planes paths must emit the same doubling-order leaves in
+    bitrev_leaves mode (the gather-free serving contract)."""
+    num_records, nq = 512, 33  # padded key axis, kg=2
+    num_blocks = num_records // 128
+    client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+    indices = [int(i) for i in RNG.integers(0, num_records, nq)]
+    wl, el = _split(client, num_blocks)
+    keys0, _ = client._generate_key_pairs(indices)
+    staged = stage_keys(keys0)
+    a = np.asarray(
+        evaluate_selection_blocks_planes(
+            *staged,
+            walk_levels=wl, expand_levels=el, num_blocks=num_blocks,
+            bitrev_leaves=True, force_planes=True,
+        )
+    )
+    b = np.asarray(
+        evaluate_selection_blocks_planes_v2(
+            *staged,
+            walk_levels=wl, expand_levels=el, num_blocks=num_blocks,
+            bitrev_leaves=True,
+        )
+    )
+    np.testing.assert_array_equal(a, b)
+
+
+def test_v2_pads_beyond_tree_capacity():
+    num_records = 300  # tree capacity 4 blocks
+    client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+    indices = [0, 1, 150, 299]
+    wl, el = _split(client, 4)
+    keys0, _ = client._generate_key_pairs(indices)
+    staged = stage_keys(keys0)
+    a = np.asarray(
+        evaluate_selection_blocks(
+            *staged, walk_levels=wl, expand_levels=el, num_blocks=8
+        )
+    )
+    b = np.asarray(
+        evaluate_selection_blocks_planes_v2(
+            *staged, walk_levels=wl, expand_levels=el, num_blocks=8
+        )
+    )
+    np.testing.assert_array_equal(a, b)
+
+
+def test_bitrev_staging_involution_end_to_end():
+    """The gather-free serving identity: XOR inner product of
+    bitrev-order selections against a block-bitrev-permuted database
+    equals the natural-order product against the natural database, and
+    the two parties' responses reconstruct the queried records."""
+    from distributed_point_functions_tpu.ops.inner_product import (
+        xor_inner_product,
+    )
+
+    num_records, nq = 1024, 5
+    num_blocks = num_records // 128
+    words = 8
+    db = RNG.integers(0, 1 << 32, (num_records, words), dtype=np.uint32)
+    db_rev = bitrev_block_permute_records(db)
+    # Involution: applying twice restores the natural order.
+    np.testing.assert_array_equal(
+        bitrev_block_permute_records(db_rev), db
+    )
+
+    client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+    indices = [int(i) for i in RNG.integers(0, num_records, nq)]
+    wl, el = _split(client, num_blocks)
+    keys0, keys1 = client._generate_key_pairs(indices)
+    responses = []
+    for keys in (keys0, keys1):
+        staged = stage_keys(keys)
+        sel_nat = evaluate_selection_blocks_planes_v2(
+            *staged,
+            walk_levels=wl, expand_levels=el, num_blocks=num_blocks,
+        )
+        sel_rev = evaluate_selection_blocks_planes_v2(
+            *staged,
+            walk_levels=wl, expand_levels=el, num_blocks=num_blocks,
+            bitrev_leaves=True,
+        )
+        r_nat = np.asarray(xor_inner_product(db, sel_nat))
+        r_rev = np.asarray(xor_inner_product(db_rev, sel_rev))
+        np.testing.assert_array_equal(r_nat, r_rev)
+        responses.append(r_rev)
+    np.testing.assert_array_equal(
+        responses[0] ^ responses[1], db[np.asarray(indices)]
+    )
+
+
+def test_bitrev_block_permute_rejects_bad_shapes():
+    with pytest.raises(ValueError):
+        bitrev_block_permute_records(np.zeros((100, 4), np.uint32))
+    with pytest.raises(ValueError):
+        bitrev_block_permute_records(np.zeros((3 * 128, 4), np.uint32))
