@@ -101,6 +101,17 @@ def run_one(idx: int) -> dict:
         )
 
     tag = {"kernel": kind, **{k: v for k, v in p.items()}}
+    # Backend init OUTSIDE the kernel-attributable region: a tunnel-down
+    # init failure must never count as kernel evidence (the family
+    # verdict below demotes kernels cross-process on attributable
+    # errors only).
+    try:
+        jax.devices()
+        jax.device_put(np.zeros(4, np.uint32)).block_until_ready()
+    except Exception as e:  # noqa: BLE001
+        return {**tag, "ok": False, "attributable": False,
+                "error": "backend init failed: "
+                f"{str(e).splitlines()[0][:140]}"}
     t0 = time.perf_counter()
     try:
         if kind == "level":
@@ -156,7 +167,10 @@ def run_one(idx: int) -> dict:
                 "compile_s": round(t1 - t0, 1),
                 "run_ms": round((time.perf_counter() - t1) * 1e3, 2)}
     except Exception as e:  # noqa: BLE001
-        return {**tag, "ok": False, "error": str(e).splitlines()[0][:160]}
+        # The backend answered and this specific program failed: that IS
+        # kernel-attributable evidence.
+        return {**tag, "ok": False, "attributable": True,
+                "error": str(e).splitlines()[0][:160]}
 
 
 def main() -> None:
@@ -204,22 +218,34 @@ def main() -> None:
                 except ValueError:
                     pass
             else:
+                # A child that died without reporting (init hang killed
+                # by the runtime, OOM, tunnel drop) is NOT kernel
+                # evidence.
                 err = (stderr or "").strip().splitlines()
                 print(json.dumps({"kernel": kind, **p, "ok": False,
+                                  "attributable": False,
                                   "error": "child died rc="
                                   f"{proc.returncode}: "
                                   f"{err[-1][:120] if err else ''}"}),
                       flush=True)
-                results.append({"kernel": kind, "ok": False})
+                results.append({"kernel": kind, **p, "ok": False,
+                                "attributable": False})
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.communicate()
             active["proc"] = None
             consecutive_timeouts += 1
+            # A timeout is ambiguous (hung Mosaic compile OR wedged/
+            # down tunnel): never family-demote on it — r04's outage
+            # would have persisted _WALK_KERNEL_FAILED cross-process on
+            # zero kernel evidence (ADVICE r04).
             print(json.dumps({"kernel": kind, **p, "ok": False,
+                              "attributable": False,
                               "error": f"timeout {CASE_TIMEOUT:.0f}s "
-                              "(hung Mosaic compile)"}), flush=True)
-            results.append({"kernel": kind, "ok": False})
+                              "(hung Mosaic compile or wedged tunnel)"}),
+                  flush=True)
+            results.append({"kernel": kind, **p, "ok": False,
+                            "attributable": False})
             # A hung compile may leave the tunnel wedged for a while;
             # wait for it to answer again (bounded) so the NEXT case
             # gets a fair run instead of burning the 3-strikes guard
@@ -244,14 +270,24 @@ def main() -> None:
     # Persist failure verdicts so serving/bench processes skip the
     # doomed compiles this sweep just paid for. Failures only — the
     # probe checks compile/run, not bit identity, so it must never set
-    # a VERIFIED flag. Runs in a bounded child (recording needs a
-    # backend init, which hangs when the tunnel is wedged).
+    # a VERIFIED flag — and only with ATTRIBUTION: a family is demoted
+    # when no case succeeded AND at least one case produced a real
+    # compile/run error (timeouts and child/init deaths are tunnel-
+    # ambiguous and never count). Compact-entry walk cases form their
+    # own family (their serving gate has its own flag). Runs in a
+    # bounded child (recording needs a backend init, which hangs when
+    # the tunnel is wedged).
     fams = {}
     for res in results:
-        fams.setdefault(res["kernel"], []).append(res["ok"])
+        fam = res.get("kernel")
+        if fam == "walk" and res.get("compact"):
+            fam = "walk_compact"
+        fams.setdefault(fam, []).append(res)
     failed = [
-        k for k in ("walk", "tail", "head")
-        if k in fams and not any(fams[k])
+        k for k in ("walk", "walk_compact", "tail", "head")
+        if k in fams
+        and not any(r.get("ok") for r in fams[k])
+        and any(r.get("attributable") for r in fams[k])
     ]
     if failed:
         try:
@@ -267,12 +303,19 @@ def main() -> None:
 
 def record_failures(families: list) -> None:
     """Child mode: persist FAILED flags for whole kernel families whose
-    every probed case failed (see the verdict cache in
-    dense_eval_planes — serving skips known-doomed Mosaic compiles)."""
+    every probed case failed with kernel-attributable evidence (see the
+    verdict cache in dense_eval_planes — serving skips known-doomed
+    Mosaic compiles)."""
     from distributed_point_functions_tpu.pir import dense_eval_planes as dep
 
+    flag_for = {
+        "walk": "_WALK_KERNEL_FAILED",
+        "walk_compact": "_WALK_COMPACT_FAILED",
+        "tail": "_TAIL_KERNEL_FAILED",
+        "head": "_HEAD_KERNEL_FAILED",
+    }
     for fam in families:
-        setattr(dep, f"_{fam.upper()}_KERNEL_FAILED", True)
+        setattr(dep, flag_for[fam], True)
     dep.record_kernel_verdicts()
 
 

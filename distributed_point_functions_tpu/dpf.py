@@ -648,12 +648,22 @@ def _expand_levels_fn(num_levels: int, hash_leaves: bool = False):
     if not mode:
         return _expand_levels_planes_fn(num_levels,
                                         hash_leaves=hash_leaves)
+    if mode == "walk" and not _dep._walk_hier_ok():
+        # The hierarchical geometry (kg=1, node_lanes=prefix words)
+        # carries its own verdict: the base walk verdict never executed
+        # it, and Mosaic legality is shape-dependent. Unverified or
+        # failed -> serve the concat/per-level tiers here.
+        mode = (
+            "tail"
+            if _dep._TAIL_KERNEL_VERIFIED and not _dep._TAIL_KERNEL_FAILED
+            else "pallas"
+        )
     kinds = {}
     if mode == "walk":
         kinds = {
             "tail_kind": "walk",
             "head_kind": "walk",
-            "walk_compact": _dep._walk_compact_enabled(),
+            "walk_compact": _dep._walk_compact_ok(),
         }
     if mode in ("tail", "walk") and hash_leaves:
         # Knobs only enter the cache key when the tail can actually run

@@ -266,7 +266,7 @@ def evaluate_selection_blocks_planes(
                 tail_kind=tail_kind,
                 head_kind=head_kind,
                 walk_compact=(
-                    tail_kind == "walk" and _walk_compact_enabled()
+                    tail_kind == "walk" and _walk_compact_ok()
                 ),
             )
         except Exception as e:  # noqa: BLE001 - degrade, don't die
@@ -401,6 +401,8 @@ _VERDICT_FLAGS = (
     "_TAIL_KERNEL_VERIFIED", "_TAIL_KERNEL_FAILED",
     "_HEAD_KERNEL_VERIFIED", "_HEAD_KERNEL_FAILED",
     "_WALK_KERNEL_VERIFIED", "_WALK_KERNEL_FAILED",
+    "_WALK_COMPACT_VERIFIED", "_WALK_COMPACT_FAILED",
+    "_WALK_HIER_VERIFIED", "_WALK_HIER_FAILED",
 )
 
 
@@ -750,6 +752,108 @@ def _head_kernel_selfcheck() -> bool:
 
 _WALK_KERNEL_VERIFIED = False
 _WALK_KERNEL_FAILED = False
+# Mosaic legality/miscompiles are shape- and mode-dependent (the walk
+# redesign exists because of that), so the base walk verdict must NOT
+# green-light geometries it never executed: compact-entry mode and the
+# hierarchical kg=1/node_lanes=prefix-words layout carry their own
+# verdicts, each bit-verified in exactly the mode the dispatcher would
+# launch (ADVICE r04).
+_WALK_COMPACT_VERIFIED = False
+_WALK_COMPACT_FAILED = False
+_WALK_HIER_VERIFIED = False
+_WALK_HIER_FAILED = False
+
+
+def _walk_twin_instance(rng, g0, nk, r):
+    """Random walk-phase instance + its doubling-twin result: returns
+    (state, ctrl, cwp[r], cwl[r], cwr[r], vc, want_v, want_c). The twin
+    runs the sequential XLA levels and the leaf value hash — the ground
+    truth every walk-geometry self-check compares against."""
+    import numpy as _np
+
+    state = jnp.asarray(
+        rng.integers(0, 1 << 32, (16, 8, g0), dtype=_np.uint32)
+    )
+    ctrl = jnp.asarray(rng.integers(0, 1 << 32, (g0,), dtype=_np.uint32))
+    if nk is None:
+        # Hierarchical layout: one key, shared correction words.
+        from ..ops.aes_bitslice import broadcast_cw_planes
+
+        cwp = [
+            broadcast_cw_planes(jnp.asarray(
+                rng.integers(0, 1 << 32, (4,), dtype=_np.uint32)
+            ))
+            for _ in range(r)
+        ]
+        cwl = [
+            (U32(0) - jnp.asarray(rng.integers(0, 2), dtype=U32))[None]
+            for _ in range(r)
+        ]
+        cwr = [
+            (U32(0) - jnp.asarray(rng.integers(0, 2), dtype=U32))[None]
+            for _ in range(r)
+        ]
+        vc = jnp.zeros((16, 8, 1), dtype=U32)  # dpf.py's zero-vc tail
+    else:
+        cwp = [
+            pack_key_planes(jnp.asarray(
+                rng.integers(0, 1 << 32, (nk, 4), dtype=_np.uint32)
+            ))
+            for _ in range(r)
+        ]
+        cwl = [
+            pack_key_bits(jnp.asarray(
+                rng.integers(0, 2, (nk,), dtype=_np.uint32)
+            ))
+            for _ in range(r)
+        ]
+        cwr = [
+            pack_key_bits(jnp.asarray(
+                rng.integers(0, 2, (nk,), dtype=_np.uint32)
+            ))
+            for _ in range(r)
+        ]
+        vc = pack_key_planes(jnp.asarray(
+            rng.integers(0, 1 << 32, (nk, 4), dtype=_np.uint32)
+        ))
+    s, c = state, ctrl
+    for i in range(r):
+        g2 = 2 * s.shape[-1]
+        s, c = expand_level_planes(
+            s, c, _tile_keys(cwp[i], g2), _tile_keys(cwl[i], g2 // 2),
+            _tile_keys(cwr[i], g2 // 2),
+        )
+    want_v = mmo_hash_planes(fixed_keys.RK_VALUE, s) ^ (
+        _tile_keys(vc, s.shape[-1]) & c[None, None, :]
+    )
+    return state, ctrl, cwp, cwl, cwr, vc, want_v, c
+
+
+def _walk_twin_lanes(exit_order, r, n_entry, node_lanes):
+    """Lane gather mapping a walk exit order onto the doubling twin:
+    lane block p of the walk output holds leaf `exit_order[p]`, which
+    the twin placed at position argsort(doubling order)[leaf]."""
+    import numpy as _np
+
+    order = tail_node_permutation(
+        _np.arange(n_entry), r, n_entry
+    )[0]
+    pos_of_leaf = _np.argsort(order)
+    pos = pos_of_leaf[_np.asarray(exit_order)]
+    return (
+        pos[:, None] * node_lanes + _np.arange(node_lanes)[None, :]
+    ).reshape(-1)
+
+
+# Self-check instance shapes. Hardware verdicts must come from the
+# SERVING tile geometry (Mosaic legality is shape-dependent), so these
+# stay at the production widths; the CPU interpret-mode tests shrink
+# them via monkeypatch (an interpret kernel call costs ~15-30 s
+# regardless of correctness).
+_WALK_SELFCHECK_SHAPE = dict(g0=1024, nk=64, r=2, tile=2048)
+_WALK_COMPACT_SELFCHECK_SHAPE = dict(g0=1024, nk=64, r=2)
+_WALK_HIER_SELFCHECK_SHAPE = dict(nl=4, n_entry=64, r=2)
+_TAIL_SELFCHECK_SHAPE = dict(g0=256, nk=64, r=2, tile=128)
 
 
 def _walk_kernel_selfcheck() -> bool:
@@ -766,53 +870,16 @@ def _walk_kernel_selfcheck() -> bool:
     import numpy as _np
 
     rng = _np.random.default_rng(2468)
-    g0, nk, r, tile = 1024, 64, 2, 2048
+    s = _WALK_SELFCHECK_SHAPE
+    g0, nk, r, tile = s["g0"], s["nk"], s["r"], s["tile"]
     kg = nk // 32
-    state = jnp.asarray(
-        rng.integers(0, 1 << 32, (16, 8, g0), dtype=_np.uint32)
+    state, ctrl, cwp, cwl, cwr, vc, want_v, want_c = _walk_twin_instance(
+        rng, g0, nk, r
     )
-    ctrl = jnp.asarray(rng.integers(0, 1 << 32, (g0,), dtype=_np.uint32))
-    cwp = [
-        pack_key_planes(jnp.asarray(
-            rng.integers(0, 1 << 32, (nk, 4), dtype=_np.uint32)
-        ))
-        for _ in range(r)
-    ]
-    cwl = [
-        pack_key_bits(jnp.asarray(
-            rng.integers(0, 2, (nk,), dtype=_np.uint32)
-        ))
-        for _ in range(r)
-    ]
-    cwr = [
-        pack_key_bits(jnp.asarray(
-            rng.integers(0, 2, (nk,), dtype=_np.uint32)
-        ))
-        for _ in range(r)
-    ]
-    vc = pack_key_planes(jnp.asarray(
-        rng.integers(0, 1 << 32, (nk, 4), dtype=_np.uint32)
-    ))
-    s, c = state, ctrl
-    for i in range(r):
-        g2 = 2 * s.shape[-1]
-        s, c = expand_level_planes(
-            s, c, _tile_keys(cwp[i], g2), _tile_keys(cwl[i], g2 // 2),
-            _tile_keys(cwr[i], g2 // 2),
-        )
-    want_v = mmo_hash_planes(fixed_keys.RK_VALUE, s) ^ (
-        _tile_keys(vc, s.shape[-1]) & c[None, None, :]
+    # Replicated mode exits in natural leaf order.
+    lanes = _walk_twin_lanes(
+        _np.arange((g0 // kg) << r), r, g0 // kg, kg
     )
-    # Map the doubling twin's [all-left; all-right] node order to the
-    # walk kernel's natural order.
-    n_entry = g0 // kg
-    order = tail_node_permutation(
-        _np.arange(n_entry), r, n_entry
-    )[0]
-    pos_of_leaf = _np.argsort(order)
-    lanes = (
-        pos_of_leaf[:, None] * kg + _np.arange(kg)[None, :]
-    ).reshape(-1)
     got_v, got_c = walk_descend_planes_pallas(
         state, ctrl, jnp.stack(cwp), jnp.stack(cwl), jnp.stack(cwr),
         vc, r=r, tile_lanes=tile, value_hash=True,
@@ -822,12 +889,179 @@ def _walk_kernel_selfcheck() -> bool:
             _np.asarray(got_v), _np.asarray(want_v)[:, :, lanes]
         )
         and _np.array_equal(
-            _np.asarray(got_c), _np.asarray(c)[lanes]
+            _np.asarray(got_c), _np.asarray(want_c)[lanes]
         )
     ):
         raise RuntimeError("walk kernel/XLA bit mismatch on this device")
     _WALK_KERNEL_VERIFIED = True
     return True
+
+
+def _walk_compact_selfcheck() -> bool:
+    """One-time on-device bit-identity check of the walk kernel's
+    COMPACT-ENTRY mode at the dense-serving geometry (node_lanes = kg),
+    in exactly the tile/mode `walk_plan` would pick. The base walk
+    verdict never executed this mode, and a compact-mode miscompile
+    would serve wrong PIR shares under a 'verified' flag."""
+    global _WALK_COMPACT_VERIFIED, _WALK_COMPACT_FAILED
+    if _WALK_COMPACT_FAILED:
+        return False
+    if _WALK_COMPACT_VERIFIED:
+        return True
+    import numpy as _np
+
+    from ..ops.expand_planes_pallas import (
+        compose_walk_leaf_order,
+        walk_plan,
+    )
+
+    rng = _np.random.default_rng(97531)
+    s = _WALK_COMPACT_SELFCHECK_SHAPE
+    g0, nk, r = s["g0"], s["nk"], s["r"]
+    kg = nk // 32
+    state, ctrl, cwp, cwl, cwr, vc, want_v, want_c = _walk_twin_instance(
+        rng, g0, nk, r
+    )
+    n_entry = g0 // kg
+    tile, compact, npt = walk_plan(g0 << r, kg, kg, r, True)
+    if not compact:
+        # walk_plan declined compact at this geometry (tile cap): the
+        # mode cannot launch here, so there is nothing to verify.
+        raise RuntimeError(
+            "walk_plan declined compact entry at the self-check "
+            "geometry; compact mode stays unverified"
+        )
+    exit_order = compose_walk_leaf_order(
+        _np.arange(n_entry, dtype=_np.int64), r, True, npt
+    )
+    lanes = _walk_twin_lanes(exit_order, r, n_entry, kg)
+    got_v, got_c = walk_descend_planes_pallas(
+        state, ctrl, jnp.stack(cwp), jnp.stack(cwl), jnp.stack(cwr),
+        vc, r=r, tile_lanes=tile, value_hash=True, compact_entry=True,
+    )
+    if not (
+        _np.array_equal(
+            _np.asarray(got_v), _np.asarray(want_v)[:, :, lanes]
+        )
+        and _np.array_equal(
+            _np.asarray(got_c), _np.asarray(want_c)[lanes]
+        )
+    ):
+        raise RuntimeError(
+            "compact walk kernel/XLA bit mismatch on this device"
+        )
+    _WALK_COMPACT_VERIFIED = True
+    return True
+
+
+def _walk_hier_selfcheck() -> bool:
+    """One-time on-device bit-identity check of the walk kernel at the
+    HIERARCHICAL geometry (kg=1 shared corrections, node_lanes =
+    prefix words, zero value correction — `dpf._expand_levels_planes_fn`'s
+    layout), in exactly the tile/mode its `walk_order` would plan."""
+    global _WALK_HIER_VERIFIED, _WALK_HIER_FAILED
+    if _WALK_HIER_FAILED:
+        return False
+    if _WALK_HIER_VERIFIED:
+        return True
+    import numpy as _np
+
+    from ..ops.expand_planes_pallas import (
+        compose_walk_leaf_order,
+        walk_plan,
+    )
+
+    rng = _np.random.default_rng(86420)
+    s = _WALK_HIER_SELFCHECK_SHAPE
+    nl, n_entry, r = s["nl"], s["n_entry"], s["r"]
+    g0 = nl * n_entry
+    state, ctrl, cwp, cwl, cwr, vc, want_v, want_c = _walk_twin_instance(
+        rng, g0, None, r
+    )
+    # Verify every mode the hierarchical dispatch could launch —
+    # replicated AND compact, regardless of the env knob: the persisted
+    # _WALK_HIER_VERIFIED flag outlives this process, and a later
+    # process with DPF_TPU_WALK_COMPACT=1 would otherwise dispatch a
+    # tile/mode combination no self-check ever executed. (walk_plan may
+    # decline compact at this geometry, collapsing both plans into one.)
+    plans = []
+    for want_compact in (False, True):
+        plan = walk_plan(g0 << r, 1, nl, r, want_compact)
+        if plan not in plans:
+            plans.append(plan)
+    for tile, compact, npt in plans:
+        exit_order = compose_walk_leaf_order(
+            _np.arange(n_entry, dtype=_np.int64), r, compact, npt
+        )
+        lanes = _walk_twin_lanes(exit_order, r, n_entry, nl)
+        got_v, got_c = walk_descend_planes_pallas(
+            state, ctrl, jnp.stack(cwp), jnp.stack(cwl), jnp.stack(cwr),
+            vc, r=r, tile_lanes=tile, value_hash=True, node_lanes=nl,
+            compact_entry=compact,
+        )
+        if not (
+            _np.array_equal(
+                _np.asarray(got_v), _np.asarray(want_v)[:, :, lanes]
+            )
+            and _np.array_equal(
+                _np.asarray(got_c), _np.asarray(want_c)[lanes]
+            )
+        ):
+            raise RuntimeError(
+                "hierarchical walk kernel/XLA bit mismatch on this "
+                f"device (compact={compact})"
+            )
+    _WALK_HIER_VERIFIED = True
+    return True
+
+
+def _walk_compact_ok() -> bool:
+    """Gate for compact-entry walk mode at dispatch time: requested via
+    the env knob AND bit-verified in that exact mode. Under an active
+    trace the self-check cannot run; only a prior eager verification
+    counts (mirroring `_level_kernel_enabled`'s trace rule)."""
+    global _WALK_COMPACT_FAILED
+    if not _walk_compact_enabled():
+        return False
+    if _WALK_COMPACT_FAILED:
+        return False
+    if _WALK_COMPACT_VERIFIED:
+        return True
+    if not _trace_state_clean():
+        return False
+    try:
+        return _walk_compact_selfcheck()
+    except Exception as e:  # noqa: BLE001 - never break serving
+        _WALK_COMPACT_FAILED = True
+        record_kernel_verdicts()
+        warnings.warn(
+            "compact-entry walk mode failed its on-device self-check; "
+            f"serving replicated entries ({str(e).splitlines()[0][:200]})"
+        )
+        return False
+
+
+def _walk_hier_ok() -> bool:
+    """Gate for the hierarchical walk geometry at dispatch time (same
+    trace/verification rules as `_walk_compact_ok`)."""
+    global _WALK_HIER_FAILED
+    if _WALK_HIER_FAILED:
+        return False
+    if _WALK_HIER_VERIFIED:
+        return True
+    if not _trace_state_clean():
+        return False
+    try:
+        return _walk_hier_selfcheck()
+    except Exception as e:  # noqa: BLE001 - never break serving
+        _WALK_HIER_FAILED = True
+        record_kernel_verdicts()
+        warnings.warn(
+            "hierarchical walk geometry failed its on-device "
+            f"self-check; serving the concat/per-level tiers there "
+            f"({str(e).splitlines()[0][:200]})"
+        )
+        return False
 
 
 def _tail_kernel_selfcheck() -> bool:
@@ -852,7 +1086,8 @@ def _tail_kernel_selfcheck() -> bool:
     # and Mosaic's known crash regime is narrow lanes — a self-check at
     # 4-lane tiles could fail (and permanently demote the tail) at a
     # shape the tail never serves.
-    g0, nk, r, tile = 256, 64, 2, 128
+    s = _TAIL_SELFCHECK_SHAPE
+    g0, nk, r, tile = s["g0"], s["nk"], s["r"], s["tile"]
     state = jnp.asarray(
         rng.integers(0, 1 << 32, (16, 8, g0), dtype=_np.uint32)
     )
@@ -920,7 +1155,17 @@ def warm_level_kernels():
     levels. Callers that trace the expansion into a bigger program
     (bench.py's fused step, the sharded mesh step) call this once, from
     eager context, before building the traced program."""
-    return _level_kernel_enabled()
+    mode = _level_kernel_enabled()
+    if mode == "walk":
+        # The compact-entry and hierarchical geometries carry their own
+        # verdicts: warm them here so traced programs (the fused serving
+        # step, the sharded mesh step, bench's ns/leaf hierarchical
+        # stage) can dispatch them — the in-trace gates only honor a
+        # prior eager verification.
+        if _walk_compact_enabled():
+            _walk_compact_ok()
+        _walk_hier_ok()
+    return mode
 
 
 def level_kernel_status() -> dict:
@@ -936,6 +1181,10 @@ def level_kernel_status() -> dict:
         "head_failed": _HEAD_KERNEL_FAILED,
         "walk_verified": _WALK_KERNEL_VERIFIED,
         "walk_failed": _WALK_KERNEL_FAILED,
+        "walk_compact_verified": _WALK_COMPACT_VERIFIED,
+        "walk_compact_failed": _WALK_COMPACT_FAILED,
+        "walk_hier_verified": _WALK_HIER_VERIFIED,
+        "walk_hier_failed": _WALK_HIER_FAILED,
     }
 
 
