@@ -11,12 +11,13 @@ PY ?= python
 test:          ## full hermetic suite (CPU, virtual 8-device mesh)
 	$(PY) -m pytest tests/ -q
 
-test-fast:     ## ~6 min hermetic signal incl. tiny Pallas kernel cases
+test-fast:     ## ~8 min hermetic signal incl. core invariants + tiny Pallas
 	$(PY) -m pytest tests/test_aes.py tests/test_aes_sbox_tower.py \
 	    tests/test_proto_validator.py tests/test_hybrid_crypto.py \
 	    tests/test_serialization.py tests/test_farm_hash.py \
 	    tests/test_native.py tests/test_native_cuckoo.py \
 	    tests/test_testing_utils.py tests/test_demo.py \
+	    tests/test_core_fast.py \
 	    tests/test_pallas_fast.py tests/test_bench_ladder.py -q
 
 protos:        ## regenerate *_pb2.py from protos/*.proto

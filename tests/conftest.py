@@ -65,3 +65,30 @@ def _clear_jax_caches_between_modules():
     """
     yield
     jax.clear_caches()
+
+
+@pytest.fixture(autouse=True)
+def _small_selfcheck_shapes(monkeypatch):
+    """Shrink the kernel self-check instance shapes suite-wide.
+
+    The production shapes exist for Mosaic legality coverage at the
+    SERVING tile geometry — a hardware property CPU tests cannot check
+    anyway — and each interpret-mode kernel call costs ~15-30 s on this
+    box regardless of width. The shrunken shapes keep every structural
+    property the checks verify (multi-tile assembly, compact planning,
+    hier node blocks)."""
+    from distributed_point_functions_tpu.pir import dense_eval_planes as dep
+
+    monkeypatch.setattr(
+        dep, "_WALK_SELFCHECK_SHAPE", dict(g0=64, nk=64, r=2, tile=128)
+    )
+    monkeypatch.setattr(
+        dep, "_WALK_COMPACT_SELFCHECK_SHAPE", dict(g0=64, nk=64, r=2)
+    )
+    monkeypatch.setattr(
+        dep, "_WALK_HIER_SELFCHECK_SHAPE", dict(nl=2, n_entry=8, r=2)
+    )
+    monkeypatch.setattr(
+        dep, "_TAIL_SELFCHECK_SHAPE", dict(g0=32, nk=64, r=2, tile=16)
+    )
+    yield

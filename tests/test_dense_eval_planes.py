@@ -30,8 +30,8 @@ def _split(client, num_blocks):
 @pytest.mark.parametrize(
     "num_records,nq",
     [
-        (4096, 7),    # walk > 0, keys need padding to 32
-        (2048, 64),   # exact key-group multiple
+        (1024, 7),    # walk > 0, keys need padding to 32
+        (512, 64),    # exact key-group multiple
         (300, 3),     # tiny: 3 blocks, expand < 2 levels
         (128, 1),     # single block, expand_levels == 0
     ],
@@ -86,7 +86,7 @@ def test_planes_pads_beyond_tree_capacity():
 def test_bitrev_leaves_mode():
     """bitrev_leaves=True returns the plane-order leaves: natural block g
     at position bitrev(g), full 2^expand_levels width."""
-    num_records, nq = 2048, 8
+    num_records, nq = 512, 8
     num_blocks = num_records // 128
     client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
     indices = [int(i) for i in RNG.integers(0, num_records, nq)]

@@ -176,8 +176,8 @@ def test_serving_expansion_with_level_kernel(monkeypatch):
     )
     monkeypatch.setenv("DPF_TPU_LEVEL_KERNEL", "pallas")
 
-    num_records = 33 * 128  # odd block count: exercises truncation
-    nq = 64
+    num_records = 9 * 128  # odd block count: exercises truncation
+    nq = 32
     num_blocks = (num_records + 127) // 128
     total = max(0, (num_records - 1).bit_length())
     expand = min((num_blocks - 1).bit_length(), total)
@@ -215,9 +215,9 @@ def test_hierarchical_expansion_with_level_kernel(monkeypatch):
     from distributed_point_functions_tpu.value_types import IntType
 
     monkeypatch.setenv("DPF_TPU_EXPAND_LEVELS", "limb")
-    params = DpfParameters(log_domain_size=11, value_type=IntType(64))
+    params = DpfParameters(log_domain_size=9, value_type=IntType(64))
     d = DistributedPointFunction.create(params)
-    k0, k1 = d.generate_keys(777, 99)
+    k0, k1 = d.generate_keys(300, 99)
 
     def run_both():
         outs = []
@@ -252,7 +252,7 @@ def test_hierarchical_expansion_with_level_kernel(monkeypatch):
     def u64(x):
         return (int(x[1]) << 32) | int(x[0])
 
-    total = (u64(want[0][777]) + u64(want[1][777])) % (1 << 64)
+    total = (u64(want[0][300]) + u64(want[1][300])) % (1 << 64)
     assert total == 99
 
 
@@ -303,9 +303,9 @@ def test_hierarchical_fused_leaf_hash_planes_xla(monkeypatch):
     )
     from distributed_point_functions_tpu.value_types import IntType
 
-    params = DpfParameters(log_domain_size=10, value_type=IntType(32))
+    params = DpfParameters(log_domain_size=8, value_type=IntType(32))
     d = DistributedPointFunction.create(params)
-    k0, k1 = d.generate_keys(513, 7)
+    k0, k1 = d.generate_keys(213, 7)
 
     def run_both():
         outs = []
@@ -322,7 +322,7 @@ def test_hierarchical_fused_leaf_hash_planes_xla(monkeypatch):
     for w, g in zip(want, got):
         np.testing.assert_array_equal(g, w)
     total = (want[0].astype(np.uint64) + want[1].astype(np.uint64))
-    assert int(total[513]) % (1 << 32) == 7
+    assert int(total[213].item()) % (1 << 32) == 7
 
 
 def test_level_kernel_selfcheck(monkeypatch):
@@ -413,7 +413,7 @@ def test_level_kernel_selfcheck(monkeypatch):
 
 @pytest.mark.parametrize(
     "g0,nk,r,tile",
-    [(8, 32, 3, 4), (12, 96, 2, 6), (2, 64, 4, 2)],
+    [(12, 96, 2, 6), (2, 64, 3, 2)],
 )
 def test_tail_kernel_matches_xla(g0, nk, r, tile):
     """The fused multi-level tail kernel (interpret mode) is
@@ -525,14 +525,14 @@ def test_serving_expansion_with_tail_kernel(monkeypatch):
         functools.partial(dep.expand_head_planes_pallas, interpret=True),
     )
     monkeypatch.setenv("DPF_TPU_LEVEL_KERNEL", "tail")
-    monkeypatch.setenv("DPF_TPU_TAIL_LEVELS", "3")
+    monkeypatch.setenv("DPF_TPU_TAIL_LEVELS", "2")
     # Tiny tiles so several tail calls + the cross-tile order run.
     monkeypatch.setenv("DPF_TPU_TAIL_TILE_LANES", "8")
     # Fused head over the first two levels: head -> per-level -> tail in
     # one serving program.
     monkeypatch.setenv("DPF_TPU_HEAD_LEVELS", "2")
 
-    num_records = 35 * 128  # odd block count: exercises truncation
+    num_records = 19 * 128  # odd block count: exercises truncation
     nq = 96  # key padding (96 -> kg 3) alongside the tail tiling
     num_blocks = (num_records + 127) // 128
     total = max(0, (num_records - 1).bit_length())
@@ -573,9 +573,9 @@ def test_hierarchical_expansion_with_tail_kernel(monkeypatch):
     from distributed_point_functions_tpu.value_types import IntType
 
     monkeypatch.setenv("DPF_TPU_EXPAND_LEVELS", "limb")
-    params = DpfParameters(log_domain_size=11, value_type=IntType(64))
+    params = DpfParameters(log_domain_size=9, value_type=IntType(64))
     d = DistributedPointFunction.create(params)
-    k0, k1 = d.generate_keys(1234, 55)
+    k0, k1 = d.generate_keys(400, 55)
 
     def run_both():
         outs = []
@@ -588,7 +588,7 @@ def test_hierarchical_expansion_with_tail_kernel(monkeypatch):
 
     monkeypatch.setenv("DPF_TPU_EXPAND_LEVELS", "planes")
     monkeypatch.setenv("DPF_TPU_LEVEL_KERNEL", "tail")
-    monkeypatch.setenv("DPF_TPU_TAIL_LEVELS", "3")
+    monkeypatch.setenv("DPF_TPU_TAIL_LEVELS", "2")
     monkeypatch.setenv("DPF_TPU_TAIL_TILE_LANES", "16")
     # Fused head over the first two plane levels: head -> per-level ->
     # tail in the one hierarchical program.
@@ -610,7 +610,7 @@ def test_hierarchical_expansion_with_tail_kernel(monkeypatch):
     def u64(x):
         return (int(x[1]) << 32) | int(x[0])
 
-    total = (u64(want[0][1234]) + u64(want[1][1234])) % (1 << 64)
+    total = (u64(want[0][400]) + u64(want[1][400])) % (1 << 64)
     assert total == 55
 
 
@@ -645,7 +645,7 @@ def test_walk_descend_multi_tile():
     )
     tiled, tiled_c = walk_descend_planes_pallas(
         jnp.asarray(state), jnp.asarray(ctrl), cwp_all, cwl_all,
-        cwr_all, r=r, tile_lanes=kg * 2, interpret=True,
+        cwr_all, r=r, tile_lanes=kg * 4, interpret=True,
     )
     np.testing.assert_array_equal(np.asarray(full), np.asarray(tiled))
     np.testing.assert_array_equal(np.asarray(full_c), np.asarray(tiled_c))
@@ -654,13 +654,13 @@ def test_walk_descend_multi_tile():
 @pytest.mark.parametrize(
     "expand_levels,head,tail,compact",
     [
-        (5, 2, 3, False),  # walk head + walk tail, no middle
-        (6, 2, 2, False),  # walk head + PER-LEVEL middle + walk tail:
+        (4, 2, 2, False),  # walk head + walk tail, no middle
+        (5, 2, 2, False),  # walk head + PER-LEVEL middle + walk tail:
         #                    the production composition at serving
         #                    shapes, where the leaf-order bookkeeping
         #                    appends doubling between two natural-order
         #                    walk phases
-        (6, 2, 2, True),   # same, compact-entry mode (offset-major
+        (5, 2, 2, True),   # same, compact-entry mode (offset-major
         #                    tiles composed into the exit gather)
     ],
 )
@@ -778,3 +778,64 @@ def test_walk_compact_entry_matches_replicated(tiles):
     np.testing.assert_array_equal(
         np.asarray(got_c)[lanes], np.asarray(nat_c)
     )
+
+
+def test_walk_compact_and_hier_selfchecks(monkeypatch):
+    """The compact-entry and hierarchical walk geometries carry their
+    own verdicts (ADVICE r04): each is bit-verified in exactly the
+    mode/tile `walk_plan` picks, and the dispatch gates honor
+    requested/verified/failed state."""
+    import functools
+
+    from distributed_point_functions_tpu.pir import dense_eval_planes as dep
+
+    monkeypatch.setattr(
+        dep, "walk_descend_planes_pallas",
+        functools.partial(dep.walk_descend_planes_pallas, interpret=True),
+    )
+    for flag in ("_WALK_COMPACT_VERIFIED", "_WALK_COMPACT_FAILED",
+                 "_WALK_HIER_VERIFIED", "_WALK_HIER_FAILED"):
+        monkeypatch.setattr(dep, flag, False)
+    monkeypatch.setenv("DPF_TPU_WALK_COMPACT", "1")
+
+    assert dep._walk_compact_selfcheck() is True
+    assert dep._WALK_COMPACT_VERIFIED is True
+    # Hier check covers replicated AND compact modes when the knob is on.
+    assert dep._walk_hier_selfcheck() is True
+    assert dep._WALK_HIER_VERIFIED is True
+
+    # Gate logic: requested + verified + not failed.
+    assert dep._walk_compact_ok() is True
+    monkeypatch.setenv("DPF_TPU_WALK_COMPACT", "")
+    assert dep._walk_compact_ok() is False  # not requested
+    monkeypatch.setenv("DPF_TPU_WALK_COMPACT", "1")
+    monkeypatch.setattr(dep, "_WALK_COMPACT_FAILED", True)
+    assert dep._walk_compact_ok() is False  # FAILED wins over VERIFIED
+
+    # Under an active trace only a prior eager verification counts.
+    monkeypatch.setattr(dep, "_trace_state_clean", lambda: False)
+    assert dep._walk_hier_ok() is True
+    monkeypatch.setattr(dep, "_WALK_HIER_VERIFIED", False)
+    assert dep._walk_hier_ok() is False
+
+
+def test_walk_compact_selfcheck_failure_is_isolated(monkeypatch):
+    """A compact-mode miscompile demotes ONLY compact mode: the base
+    walk family keeps serving replicated entries."""
+    from distributed_point_functions_tpu.pir import dense_eval_planes as dep
+
+    for flag in ("_WALK_COMPACT_VERIFIED", "_WALK_COMPACT_FAILED"):
+        monkeypatch.setattr(dep, flag, False)
+    monkeypatch.setattr(dep, "_WALK_KERNEL_VERIFIED", True)
+    monkeypatch.setattr(dep, "_WALK_KERNEL_FAILED", False)
+    monkeypatch.setenv("DPF_TPU_WALK_COMPACT", "1")
+
+    def boom(*a, **k):
+        raise RuntimeError("mosaic compact says no")
+
+    monkeypatch.setattr(dep, "walk_descend_planes_pallas", boom)
+    with pytest.warns(UserWarning, match="compact-entry"):
+        assert dep._walk_compact_ok() is False
+    assert dep._WALK_COMPACT_FAILED is True
+    assert dep._WALK_KERNEL_VERIFIED is True
+    assert dep._WALK_KERNEL_FAILED is False

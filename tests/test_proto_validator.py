@@ -458,3 +458,53 @@ def test_ctx_rejects_partial_evaluations_level_too_large():
     bad.partial_evaluations.add()
     with pytest.raises(ValueError, match="partial_evaluations_level"):
         v.validate_evaluation_context(bad)
+
+
+# -- Reference-corpus anchors -----------------------------------------------
+
+
+def test_create_works_when_element_bitsizes_decrease():
+    """Hierarchy bitsizes may decrease (`proto_validator_test.cc:161`):
+    only log_domain_size must ascend."""
+    ps = make_params(5, 7)
+    ps[0].value_type.integer.bitsize = 64
+    ps[1].value_type.integer.bitsize = 32
+    ProtoValidator.create(ps)
+
+
+def test_create_works_when_hierarchies_are_far_apart():
+    """ld 10 -> 128 in one hierarchy step is valid
+    (`proto_validator_test.cc:169`)."""
+    ProtoValidator.create(make_params(10, 128))
+
+
+def test_reference_corpus_anchor_three_hierarchies():
+    """The reference's embedded corpus fixture shape (3 hierarchies,
+    ld 4/6/8, security 44/46/48, uint32 values —
+    `proto_validator_test.textproto`): real keys and contexts built at
+    exactly those parameters must validate for both parties, and the
+    same corpus mutations reject (the sweeps above run them on the
+    2-hierarchy fixture; this anchors the exact reference shape)."""
+    protos = []
+    for ld in (4, 6, 8):
+        p = dpf_pb2.DpfParameters()
+        p.log_domain_size = ld
+        p.value_type.integer.bitsize = 32
+        p.security_parameter = 40 + ld
+        protos.append(p)
+    params = [ser.parameters_from_proto(p) for p in protos]
+    dpf = DistributedPointFunction.create_incremental(params)
+    k0, k1 = dpf.generate_keys_incremental(11, [1, 2, 3])
+    v = ProtoValidator.create(protos)
+    for k in (k0, k1):
+        kp = ser.key_to_proto(dpf, k)
+        v.validate_dpf_key(kp)
+        ctx_proto = ser.evaluation_context_to_proto(
+            dpf, dpf.create_evaluation_context(k)
+        )
+        v.validate_evaluation_context(ctx_proto)
+        # The corpus key mutations reject on this fixture too.
+        bad = dpf_pb2.DpfKey.FromString(kp.SerializeToString())
+        bad.correction_words.add()
+        with pytest.raises(ValueError, match="correction words"):
+            v.validate_dpf_key(bad)
