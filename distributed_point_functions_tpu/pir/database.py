@@ -138,6 +138,13 @@ class DenseDpfPirDatabase:
         )
         self._db_words = None  # row-major device copy (jnp fallback path)
         self._db_perm = None  # bit-major layout, staged on first pallas use
+        # Bitrev-block staging (the v2 gather-free serving exit): same
+        # records with 128-record blocks bit-reversal-permuted, padded
+        # to a power-of-two block count. Built lazily; a process serving
+        # one expansion mode holds one staging.
+        self._host_rev = None
+        self._db_words_rev = None
+        self._db_perm_rev = None
         self._failed_tiers: set = set()
         self._failed_knobs: set = set()  # v2 knob combos that crashed
 
@@ -169,8 +176,44 @@ class DenseDpfPirDatabase:
     def record(self, i: int) -> bytes:
         return self._records[i]
 
-    def _staged_perm(self) -> jnp.ndarray:
+    def bitrev_block_count(self) -> int:
+        """Block count of the bitrev staging: the padded power of two a
+        full covering-subtree expansion emits."""
+        nb = self.num_selection_blocks
+        return 1 << max(0, (nb - 1).bit_length())
+
+    def _host_words_bitrev(self) -> np.ndarray:
+        if self._host_rev is None:
+            from .dense_eval_planes_v2 import bitrev_block_permute_records
+
+            rows = self.bitrev_block_count() * 128
+            hw = self._host_words
+            if rows > hw.shape[0]:
+                hw = np.concatenate(
+                    [hw, np.zeros((rows - hw.shape[0], hw.shape[1]),
+                                  np.uint32)]
+                )
+            self._host_rev = bitrev_block_permute_records(hw)
+        return self._host_rev
+
+    def _row_words(self, bitrev_blocks: bool = False) -> jnp.ndarray:
+        """Row-major device layout (the jnp tier's input)."""
+        if not bitrev_blocks:
+            return self.db_words
+        if self._db_words_rev is None:
+            self._db_words_rev = jnp.asarray(self._host_words_bitrev())
+        return self._db_words_rev
+
+    def _staged_perm(self, bitrev_blocks: bool = False) -> jnp.ndarray:
         """Bit-major layout (`permute_db_bitmajor`), staged once."""
+        if bitrev_blocks:
+            if self._db_perm_rev is None:
+                self._db_perm_rev = jax.block_until_ready(
+                    permute_db_bitmajor(
+                        jnp.asarray(self._host_words_bitrev())
+                    )
+                )
+            return self._db_perm_rev
         if self._db_perm is None:
             self._db_perm = jax.block_until_ready(
                 permute_db_bitmajor(jnp.asarray(self._host_words))
@@ -197,7 +240,9 @@ class DenseDpfPirDatabase:
         chain.append("jnp")
         return chain, False
 
-    def _inner_product_device(self, selections: jnp.ndarray) -> jnp.ndarray:
+    def _inner_product_device(
+        self, selections: jnp.ndarray, bitrev_blocks: bool = False
+    ) -> jnp.ndarray:
         chain, forced = self._tier_chain()
         for tier in chain:
             # Remembered failures: a failed trace/compile is not cached
@@ -212,7 +257,8 @@ class DenseDpfPirDatabase:
                         knobs, knob_key = {}, ()
                     try:
                         return xor_inner_product_pallas2_staged(
-                            self._staged_perm(), selections, **knobs
+                            self._staged_perm(bitrev_blocks), selections,
+                            **knobs
                         )
                     except Exception as e:  # noqa: BLE001
                         # The positivity pre-check above cannot know the
@@ -231,18 +277,20 @@ class DenseDpfPirDatabase:
                             f"({str(e).splitlines()[0][:200]})"
                         )
                         return xor_inner_product_pallas2_staged(
-                            self._staged_perm(), selections
+                            self._staged_perm(bitrev_blocks), selections
                         )
                 if tier == "pallas":
                     return xor_inner_product_pallas_staged(
-                        self._staged_perm(), selections
+                        self._staged_perm(bitrev_blocks), selections
                     )
                 if tier == "bitplane":
                     return xor_inner_product_bitplane(
-                        self._staged_perm(), selections
+                        self._staged_perm(bitrev_blocks), selections
                     )
                 if tier == "jnp":
-                    return xor_inner_product(self.db_words, selections)
+                    return xor_inner_product(
+                        self._row_words(bitrev_blocks), selections
+                    )
                 raise ValueError(
                     f"unknown DPF_TPU_INNER_PRODUCT tier {tier!r}"
                 )
@@ -251,33 +299,55 @@ class DenseDpfPirDatabase:
                     raise
                 self._failed_tiers.add(tier)
                 if tier == chain[-2]:
-                    self._db_perm = None  # jnp path reads row-major only
+                    # jnp path reads row-major only.
+                    self._db_perm = None
+                    self._db_perm_rev = None
                 warnings.warn(
                     f"{tier} inner product failed; falling back "
                     f"({str(e).splitlines()[0][:200]})"
                 )
         raise AssertionError("unreachable: jnp tier returns or raises")
 
-    def inner_product_with(self, selections: jnp.ndarray) -> List[bytes]:
+    def inner_product_with(
+        self, selections: jnp.ndarray, *, bitrev_blocks: bool = False
+    ) -> List[bytes]:
         """XOR of all records whose selection bit is 1, per query.
 
         `selections`: uint32[num_queries, B, 4] packed blocks with
         B * 128 >= num_selection_bits. Returns one byte-string of
         `max_value_size` per query (the reference's result convention,
         `inner_product_hwy.cc:271-272`).
+
+        With `bitrev_blocks=True` the selection blocks arrive in the
+        doubling (bit-reversed) leaf order of a `bitrev_leaves=True`
+        expansion, and the product runs against the bitrev-permuted
+        staging — same responses, no exit gather on the expansion side.
+        The block count must then equal `bitrev_block_count()` exactly.
         """
         if selections.ndim != 3 or selections.shape[-1] != 4:
             raise ValueError("selections must be uint32[nq, B, 4]")
-        if selections.shape[1] * 128 < self.size:
-            raise ValueError(
-                f"selections contain {selections.shape[1] * 128} bits, "
-                f"expected at least {self.size}"
-            )
-        needed = self.num_selection_blocks
-        if selections.shape[1] > needed:
-            selections = selections[:, :needed]
-        elif selections.shape[1] < needed:
-            pad = needed - selections.shape[1]
-            selections = jnp.pad(selections, ((0, 0), (0, pad), (0, 0)))
-        out = np.asarray(self._inner_product_device(selections))
+        if bitrev_blocks:
+            needed = self.bitrev_block_count()
+            if selections.shape[1] != needed:
+                raise ValueError(
+                    f"bitrev selections must cover exactly {needed} "
+                    f"blocks, got {selections.shape[1]}"
+                )
+        else:
+            if selections.shape[1] * 128 < self.size:
+                raise ValueError(
+                    f"selections contain {selections.shape[1] * 128} "
+                    f"bits, expected at least {self.size}"
+                )
+            needed = self.num_selection_blocks
+            if selections.shape[1] > needed:
+                selections = selections[:, :needed]
+            elif selections.shape[1] < needed:
+                pad = needed - selections.shape[1]
+                selections = jnp.pad(
+                    selections, ((0, 0), (0, pad), (0, 0))
+                )
+        out = np.asarray(
+            self._inner_product_device(selections, bitrev_blocks)
+        )
         return words_to_record_bytes(out, out.shape[0], self._max_value_size)

@@ -160,15 +160,24 @@ def expansion_impl():
     `DPF_TPU_EXPANSION`: `limb` — the per-level kernel re-entry above;
     `planes` — the plane-resident expansion
     (`dense_eval_planes.evaluate_selection_blocks_planes`, bit-identical,
-    no per-level transposes); `auto` (default) — planes on TPU, limb
-    elsewhere (the plane path's win is VPU work; CPU compile times favor
-    the limb path in the hermetic suite).
+    no per-level transposes); `v2` — the key-major layout-clean rewrite
+    (`dense_eval_planes_v2`, natural-order exit here — the gather-free
+    bitrev exit needs database coordination, see `serving_expansion`);
+    `auto` (default) — planes on TPU, limb elsewhere (the plane path's
+    win is VPU work; CPU compile times favor the limb path in the
+    hermetic suite).
     """
     import functools
     import os
 
     from ..utils.runtime import planes_selected
 
+    if os.environ.get("DPF_TPU_EXPANSION") == "v2":
+        from .dense_eval_planes_v2 import (
+            evaluate_selection_blocks_planes_v2,
+        )
+
+        return evaluate_selection_blocks_planes_v2
     if planes_selected("DPF_TPU_EXPANSION"):
         from .dense_eval_planes import evaluate_selection_blocks_planes
 
@@ -179,6 +188,21 @@ def expansion_impl():
             )
         return evaluate_selection_blocks_planes
     return evaluate_selection_blocks
+
+
+def serving_expansion():
+    """(expansion fn, wants_bitrev) for the dense server's plain path.
+
+    In `DPF_TPU_EXPANSION=v2` mode the server serves the gather-free
+    exit: the expansion keeps its doubling-order leaves
+    (`bitrev_leaves=True`) and the database runs the inner product
+    against its bitrev-block staging — the caller passes
+    `bitrev_blocks=True` through `inner_product_with`. Every other mode
+    serves natural-order selections against the natural staging."""
+    import os
+
+    fn = expansion_impl()
+    return fn, os.environ.get("DPF_TPU_EXPANSION") == "v2"
 
 
 def selection_blocks_for_keys(dpf, keys: Sequence[DpfKey], num_blocks: int):

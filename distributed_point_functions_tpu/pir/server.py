@@ -35,7 +35,12 @@ from ..prng import Aes128CtrSeededPrng, xor_bytes
 from ..value_types import XorType
 from . import messages
 from .database import DenseDpfPirDatabase, words_to_record_bytes
-from .dense_eval import expansion_impl, stage_keys, stage_keys_walked
+from .dense_eval import (
+    expansion_impl,
+    serving_expansion,
+    stage_keys,
+    stage_keys_walked,
+)
 
 # sender(helper_request: PirRequest, while_waiting: Callable[[], None])
 #   -> PirResponse
@@ -292,10 +297,20 @@ class DenseDpfPirServer(DpfPirServer):
                     f"key has {len(key.correction_words)} correction words, "
                     f"expected {expected_cw}"
                 )
+        impl, bitrev = serving_expansion()
+        if bitrev and (1 << self._expand_levels) < self._num_blocks:
+            # The tree cannot cover the padded block count (domain
+            # smaller than the database): the bitrev staging has no
+            # zero-extension story there, so serve natural order.
+            bitrev = False
+        # The bitrev exit serves an UNTRUNCATED 2^expand_levels-block
+        # tensor (up to ~2x num_blocks); the chunking budget must see
+        # that size, not the natural one.
+        eff_blocks = (1 << self._expand_levels) if bitrev else None
         if self._mesh is not None:
             staged = stage_keys(keys)
             inner_products = self._inner_products_sharded(staged, len(keys))
-        elif self._needs_chunking(len(keys)):
+        elif self._needs_chunking(len(keys), eff_blocks):
             staged = stage_keys(keys)
             inner_products = self._inner_products_chunked(staged, len(keys))
         else:
@@ -306,13 +321,16 @@ class DenseDpfPirServer(DpfPirServer):
             staged, device_walk = stage_keys_walked(
                 keys, self._walk_levels
             )
-            selections = expansion_impl()(
+            selections = impl(
                 *staged,
                 walk_levels=device_walk,
                 expand_levels=self._expand_levels,
                 num_blocks=self._num_blocks,
+                **({"bitrev_leaves": True} if bitrev else {}),
             )
-            inner_products = self._database.inner_product_with(selections)
+            inner_products = self._database.inner_product_with(
+                selections, bitrev_blocks=bitrev
+            )
         return messages.PirResponse(
             dpf_pir_response=messages.DpfPirResponse(
                 masked_response=inner_products
@@ -326,9 +344,11 @@ class DenseDpfPirServer(DpfPirServer):
             os.environ.get("DPF_TPU_SELECTION_BYTES_BUDGET", 1 << 30)
         )
 
-    def _needs_chunking(self, num_keys: int) -> bool:
+    def _needs_chunking(self, num_keys: int, blocks: int = None) -> bool:
+        if blocks is None:
+            blocks = self._num_blocks
         return (
-            num_keys * self._num_blocks * 16 > self._selection_budget_bytes()
+            num_keys * blocks * 16 > self._selection_budget_bytes()
             and self._expand_levels > 0
         )
 

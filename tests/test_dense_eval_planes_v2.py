@@ -158,3 +158,81 @@ def test_bitrev_block_permute_rejects_bad_shapes():
         bitrev_block_permute_records(np.zeros((100, 4), np.uint32))
     with pytest.raises(ValueError):
         bitrev_block_permute_records(np.zeros((3 * 128, 4), np.uint32))
+
+
+def test_dense_server_serves_via_v2(monkeypatch):
+    """DPF_TPU_EXPANSION=v2 serves the gather-free exit (doubling-order
+    selections against the bitrev-block staging) with byte-identical
+    responses, including a non-power-of-two block count, and the full
+    plain protocol still reconstructs records."""
+    from distributed_point_functions_tpu.pir import messages
+    from distributed_point_functions_tpu.pir.database import (
+        DenseDpfPirDatabase,
+    )
+    from distributed_point_functions_tpu.pir.server import DenseDpfPirServer
+    from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+    num_records = 700  # 6 blocks -> bitrev staging pads to 8
+    records = [RNG.bytes(20) for _ in range(num_records)]
+    client = DenseDpfPirClient.create(num_records, encrypt_decrypt.encrypt)
+    indices = [5, 42, 699]
+    keys0, _ = client._generate_key_pairs(indices)
+    req = messages.PirRequest(
+        plain_request=messages.PlainRequest(dpf_keys=list(keys0))
+    )
+    server = DenseDpfPirServer.create_plain(DenseDpfPirDatabase(records))
+
+    monkeypatch.setenv("DPF_TPU_EXPANSION", "limb")
+    a = server.handle_request(req).dpf_pir_response.masked_response
+    monkeypatch.setenv("DPF_TPU_EXPANSION", "v2")
+    b = server.handle_request(req).dpf_pir_response.masked_response
+    assert a == b
+
+    # End-to-end under v2: both parties' responses reconstruct records.
+    req0, req1 = client.create_plain_requests(indices)
+    r0 = server.handle_request(req0)
+    r1 = server.handle_request(req1)
+    for i, idx in enumerate(indices):
+        combined = bytes(
+            x ^ y
+            for x, y in zip(
+                r0.dpf_pir_response.masked_response[i],
+                r1.dpf_pir_response.masked_response[i],
+            )
+        )
+        assert combined[: len(records[idx])] == records[idx]
+
+
+def test_database_bitrev_inner_product_matches_natural():
+    """inner_product_with(bitrev_blocks=True) against bitrev-order
+    selections equals the natural product, and shape mismatches are
+    rejected."""
+    import jax.numpy as jnp
+
+    from distributed_point_functions_tpu.pir.database import (
+        DenseDpfPirDatabase,
+    )
+    from distributed_point_functions_tpu.pir.dense_eval_planes import (
+        bitrev_permutation,
+    )
+
+    num_records = 700  # 6 blocks, bitrev staging 8 blocks
+    records = [RNG.bytes(24) for _ in range(num_records)]
+    db = DenseDpfPirDatabase(records)
+    nb, nb_rev = db.num_selection_blocks, db.bitrev_block_count()
+    assert (nb, nb_rev) == (6, 8)
+    sel_nat = RNG.integers(0, 1 << 32, (3, nb, 4), dtype=np.uint32)
+    # Natural block g sits at position bitrev(g) in the bitrev layout.
+    perm = bitrev_permutation(3)
+    sel_full = np.zeros((3, nb_rev, 4), np.uint32)
+    sel_full[:, :nb] = sel_nat
+    sel_rev = sel_full[:, perm]
+    want = db.inner_product_with(jnp.asarray(sel_nat))
+    got = db.inner_product_with(
+        jnp.asarray(sel_rev), bitrev_blocks=True
+    )
+    assert want == got
+    with pytest.raises(ValueError, match="exactly"):
+        db.inner_product_with(
+            jnp.asarray(sel_nat), bitrev_blocks=True
+        )
