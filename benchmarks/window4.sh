@@ -96,10 +96,13 @@ commit_stage headline $?
 tail -5 benchmarks/results/bench_q128_${stamp}.log
 
 echo "=== 3. batch sweep (q64 / q256 / q512, auto) ==="
+# BENCH_NO_VET: the headline stage already vetted the kernel mode and
+# persisted verdicts; re-vetting per sweep shape would burn a child
+# compile per q against the same single-client tunnel.
 for q in 64 256 512; do
     { wait_tunnel && stage_fits 1300; } || finish
     rm -f benchmarks/results/bench_extra.json
-    timeout 1300 env BENCH_QUERIES=$q BENCH_ITERS=8 \
+    timeout 1300 env BENCH_QUERIES=$q BENCH_ITERS=8 BENCH_NO_VET=1 \
         BENCH_INIT_BUDGET=120 BENCH_TIMEOUT=1200 python bench.py \
         2>benchmarks/results/bench_q${q}_${stamp}.log \
         | tee benchmarks/results/bench_q${q}_${stamp}.json
