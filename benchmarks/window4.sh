@@ -137,6 +137,13 @@ timeout 3600 python benchmarks/baseline_suite.py --scale full \
 commit_stage sparse_big $?
 
 { wait_tunnel && stage_fits 2700; } || finish
+echo "=== 6b. dense_big via the v2 gather-free serving path ==="
+timeout 2700 env DPF_TPU_EXPANSION=v2 python benchmarks/baseline_suite.py \
+    --scale full --suite dense_big \
+    2>&1 | tee benchmarks/results/dense_big_v2_${stamp}.json
+commit_stage dense_big_v2 $?
+
+{ wait_tunnel && stage_fits 2700; } || finish
 echo "=== 7. synthetic hierarchical (reference experiments configs) ==="
 timeout 2700 python benchmarks/synthetic_data_benchmarks.py \
     --log_domain_size 32 --log_num_nonzeros 20 --num_iterations 3 \

@@ -307,7 +307,7 @@ def test_generate_keys_batch_falls_back_for_other_types():
     out0 = np.asarray(dpf.evaluate_next([], dpf.create_evaluation_context(keys0[0])))
     out1 = np.asarray(dpf.evaluate_next([], dpf.create_evaluation_context(keys1[0])))
     combined = (out0.astype(np.uint64) + out1.astype(np.uint64)) % (1 << 32)
-    assert int(combined[3]) == 7 and int(combined.sum()) == 7
+    assert combined[3].item() == 7 and combined.sum().item() == 7
 
 
 def test_generate_keys_batch_validates_alphas():

@@ -533,7 +533,8 @@ def test_serving_expansion_with_tail_kernel(monkeypatch):
     monkeypatch.setenv("DPF_TPU_HEAD_LEVELS", "2")
 
     num_records = 19 * 128  # odd block count: exercises truncation
-    nq = 96  # key padding (96 -> kg 3) alongside the tail tiling
+    nq = 64  # exact key-group multiple (kg 3 coverage lives in
+    #          test_tail_kernel_matches_xla[12-96-2-6])
     num_blocks = (num_records + 127) // 128
     total = max(0, (num_records - 1).bit_length())
     expand = min((num_blocks - 1).bit_length(), total)
@@ -839,3 +840,49 @@ def test_walk_compact_selfcheck_failure_is_isolated(monkeypatch):
     assert dep._WALK_COMPACT_FAILED is True
     assert dep._WALK_KERNEL_VERIFIED is True
     assert dep._WALK_KERNEL_FAILED is False
+
+
+def test_tail_dispatch_odd_kg_matches_xla(monkeypatch):
+    """Serving-side concat-tail dispatch at kg=3 with a non-power-of-two
+    tile (tile % 8 != 0): the cross-tile exit-order composition and
+    truncation at odd-kg geometry — the coverage the shrunken
+    test_serving_expansion_with_tail_kernel (nq 64) no longer carries."""
+    import functools as ft
+
+    from distributed_point_functions_tpu.ops import (
+        expand_planes_pallas as epp,
+    )
+    from distributed_point_functions_tpu.pir import dense_eval_planes as dep
+
+    for name in ("expand_level_planes_pallas", "expand_tail_planes_pallas"):
+        monkeypatch.setattr(
+            dep, name, ft.partial(getattr(dep, name), interpret=True)
+        )
+    nk, expand_levels = 96, 4  # kg=3
+    num_blocks = 13  # odd, < 2^4: exercises truncation
+    rng = np.random.default_rng(77)
+    args = tuple(
+        jnp.asarray(a)
+        for a in (
+            rng.integers(0, 1 << 32, (nk, 4), dtype=np.uint32),
+            rng.integers(0, 2, (nk,), dtype=np.uint32),
+            rng.integers(0, 1 << 32, (expand_levels, nk, 4),
+                         dtype=np.uint32),
+            rng.integers(0, 2, (expand_levels, nk), dtype=np.uint32),
+            rng.integers(0, 2, (expand_levels, nk), dtype=np.uint32),
+            rng.integers(0, 1 << 32, (nk, 4), dtype=np.uint32),
+        )
+    )
+    kwargs = dict(
+        walk_levels=0, expand_levels=expand_levels, num_blocks=num_blocks
+    )
+    want = np.asarray(
+        dep._evaluate_selection_blocks_planes_jit(*args, **kwargs)
+    )
+    got = np.asarray(
+        dep._evaluate_selection_blocks_planes_jit(
+            *args, **kwargs,
+            level_kernel=True, tail_levels=2, tail_tile_nodes=2,
+        )
+    )
+    np.testing.assert_array_equal(got, want)
