@@ -305,6 +305,112 @@ def _ensure_backend(jax, total_budget_secs=None, per_attempt_secs=150):
     return None, last_err
 
 
+_WARM_CHILD_CODE = """
+import os, signal, time
+import jax
+p = os.environ.get("BENCH_PLATFORM")
+if p:
+    jax.config.update("jax_platforms", p)
+cache = os.environ.get("BENCH_CACHE_DIR")
+if cache:
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+# A second-client init hang on the single-client tunnel must kill the
+# child FAST (SIGALRM's default action terminates even inside a C
+# call), so the parent reads a quick 'env' instead of burning the
+# whole warm timeout before warming in-process anyway.
+try:
+    signal.alarm(int(float(os.environ.get("BENCH_WARM_INIT_BUDGET", "120"))))
+except Exception:
+    pass
+import numpy as np
+jax.device_put(np.zeros(4, np.uint32)).block_until_ready()
+signal.alarm(0)
+m = os.environ.get("BENCH_WARM_MARKER")
+if m:
+    open(m, "w").write("warm")
+if os.environ.get("DPF_TPU_FAULT_WARM_HANG", "") == "1":
+    time.sleep(3600)  # test-only: simulate a hung self-check compile
+from distributed_point_functions_tpu.pir import dense_eval_planes as dep
+dep.warm_level_kernels()
+"""
+
+
+def _run_bounded_child(argv, extra_env, marker_env, timeout_var):
+    """Run a child process under a hard timeout with the marker
+    discipline shared by the kernel-warm and serving-vet stages: the
+    child writes the marker file when it reaches its dangerous stage, so
+    the parent can tell compile-stage evidence from environment
+    ambiguity. Returns (status, returncode, marker_seen, seconds) with
+    status in {"done", "timeout", "error"}."""
+    import subprocess
+    import tempfile
+
+    try:
+        timeout = float(os.environ.get(timeout_var, 900))
+    except ValueError:
+        timeout = 900.0
+    remaining = _PROGRESS.get("deadline", 0) - time.monotonic()
+    timeout = max(10.0, min(timeout, remaining - 300))
+    marker = os.path.join(
+        tempfile.gettempdir(), f"{marker_env.lower()}_{os.getpid()}.marker"
+    )
+    try:
+        os.unlink(marker)
+    except OSError:
+        pass
+    env = dict(os.environ, **extra_env)
+    env[marker_env] = marker
+    t0 = time.perf_counter()
+    status, rc = "done", None
+    try:
+        proc = subprocess.run(
+            argv, env=env, timeout=timeout, capture_output=True
+        )
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        status = "timeout"
+    except Exception as e:  # noqa: BLE001 - child vetting is best-effort
+        first = (str(e).splitlines() or ["<no message>"])[0]
+        _log(f"bounded child unavailable ({first})")
+        status = "error"
+    marker_seen = os.path.exists(marker)
+    try:
+        os.unlink(marker)
+    except OSError:
+        pass
+    return status, rc, marker_seen, time.perf_counter() - t0
+
+
+def _warm_kernels_subprocess():
+    """Run the first kernel warmup (self-check Mosaic compiles) in a
+    killable child. Verdicts persist via the shared cache file, so a
+    successful child makes the parent's in-process warm free. Returns
+    "ok", "env" (the child never reached the self-check stage — tunnel
+    ambiguity, parent warms in-process as before), or "hang" (the child
+    reached it and went silent OR died abnormally there — the parent
+    must NOT repeat those compiles in-process)."""
+    status, rc, marker_seen, secs = _run_bounded_child(
+        [sys.executable, "-c", _WARM_CHILD_CODE], {},
+        "BENCH_WARM_MARKER", "BENCH_WARM_TIMEOUT",
+    )
+    if status == "error":
+        verdict = "env"
+    elif status == "timeout" or rc != 0:
+        # A timeout or an abnormal death (segfaulting Mosaic compile)
+        # after the marker is compile-stage evidence: re-running the
+        # same compiles in-process could kill the parent before the
+        # banked JSON ever prints.
+        verdict = "hang" if marker_seen else "env"
+    else:
+        verdict = "ok"
+    _log(f"kernel warmup child: {verdict} ({secs:.0f}s, rc={rc})")
+    return verdict
+
+
 def _slope_time(fn, iters, reps=3):
     """Min-of-reps slope timing: time(1 call) vs time(1+N calls) with one
     host readback each; the slope isolates device time per call under the
@@ -819,20 +925,36 @@ def main():
     # Run the level-kernel self-checks EAGERLY before anything traces the
     # expansion: inside jax.jit the check cannot run, and a fresh process
     # would silently serve the XLA levels (this is why the r02 headline
-    # never engaged the fused kernels despite auto mode).
+    # never engaged the fused kernels despite auto mode). On TPU the
+    # FIRST warmup runs in a killable child (same marker discipline as
+    # the serving vet): the self-checks are Mosaic compiles under a
+    # rotated verdict-cache key, and a silent hang there would otherwise
+    # eat the window in-process. A successful child persists its
+    # verdicts, so the in-process warm below is pure cache loads.
     eager_kernel_mode = None
-    try:
-        from distributed_point_functions_tpu.pir import (
-            dense_eval_planes as _dep,
-        )
+    skip_warm = False
+    if (
+        not vet_mode
+        and jax.default_backend() == "tpu"
+        and os.environ.get("BENCH_NO_VET", "") != "1"
+    ):
+        skip_warm = _warm_kernels_subprocess() == "hang"
+        if skip_warm:
+            _log("kernel warmup hung in the bounded child; serving "
+                 "without kernel tiers this run")
+    if not skip_warm:
+        try:
+            from distributed_point_functions_tpu.pir import (
+                dense_eval_planes as _dep,
+            )
 
-        eager_kernel_mode = _dep.warm_level_kernels()
-        _log(f"level kernels: eager mode={eager_kernel_mode!r}")
-    except Exception as e:  # noqa: BLE001 - observability only
-        _log(
-            "level-kernel warmup failed: "
-            f"{(str(e).splitlines() or ['<no message>'])[0]}"
-        )
+            eager_kernel_mode = _dep.warm_level_kernels()
+            _log(f"level kernels: eager mode={eager_kernel_mode!r}")
+        except Exception as e:  # noqa: BLE001 - observability only
+            _log(
+                "level-kernel warmup failed: "
+                f"{(str(e).splitlines() or ['<no message>'])[0]}"
+            )
     if (
         auto_mode
         and "planes_xla" in candidates
@@ -887,55 +1009,37 @@ def main():
         # persist the engaged tier's failure ONLY if the backend still
         # answers (a dead tunnel must not burn kernel verdicts).
         _PROGRESS["stage"] = "vet"
-        import subprocess
-        import tempfile
-
-        try:
-            vet_timeout = float(os.environ.get("BENCH_VET_TIMEOUT", 900))
-        except ValueError:
-            vet_timeout = 900.0
-        remaining = _PROGRESS.get("deadline", 0) - time.monotonic()
-        vet_timeout = max(60.0, min(vet_timeout, remaining - 300))
-        marker = os.path.join(
-            tempfile.gettempdir(), f"bench_vet_{os.getpid()}.marker"
-        )
-        try:
-            os.unlink(marker)
-        except OSError:
-            pass
         # The child dials the same single-client tunnel the parent
         # holds; if the backend refuses a second client it must fail
         # FAST as rc=2, so pin a small init budget unless the caller
         # already did.
-        env = dict(os.environ, BENCH_VET_ONLY="1", BENCH_VET_MARKER=marker)
-        env.setdefault("BENCH_INIT_BUDGET", "120")
-        t_v = time.perf_counter()
-        verdict = "ok"
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env, timeout=vet_timeout, capture_output=True,
-            )
-            if proc.returncode == 2:
-                verdict = "env-fail"
-            elif proc.returncode != 0:
-                verdict = "fail"
-        except subprocess.TimeoutExpired:
+        vet_env = {"BENCH_VET_ONLY": "1"}
+        if not os.environ.get("BENCH_INIT_BUDGET"):
+            vet_env["BENCH_INIT_BUDGET"] = "120"
+        status, rc, marker_seen, secs = _run_bounded_child(
+            [sys.executable, os.path.abspath(__file__)], vet_env,
+            "BENCH_VET_MARKER", "BENCH_VET_TIMEOUT",
+        )
+        if status == "error":
+            verdict = "ok"  # vet unavailable: compile in-process
+        elif status == "timeout":
             # Only a hang AFTER the child reached its compile stage is
             # kernel evidence; an init/staging hang (wedged tunnel, or
             # the backend serializing the second client) is ambiguous
             # and must neither demote a tier nor skip the candidate.
-            verdict = "hang" if os.path.exists(marker) else "env-hang"
-        except Exception as e:  # noqa: BLE001 - vet is best-effort
-            _log(f"serving vet unavailable ({str(e).splitlines()[0]}); "
-                 "compiling in-process")
+            verdict = "hang" if marker_seen else "env-hang"
+        elif rc == 0:
             verdict = "ok"
-        try:
-            os.unlink(marker)
-        except OSError:
-            pass
-        _log(f"serving vet: {verdict} "
-             f"({time.perf_counter() - t_v:.0f}s, mode="
+        elif rc == 2:
+            verdict = "env-fail"
+        elif rc < 0 and marker_seen:
+            # Killed by a signal mid-compile (segfaulting Mosaic):
+            # repeating it in-process could kill the parent before the
+            # banked JSON prints — treat like a hang.
+            verdict = "hang"
+        else:
+            verdict = "fail"
+        _log(f"serving vet: {verdict} ({secs:.0f}s, rc={rc}, mode="
              f"{eager_kernel_mode!r})")
         if verdict in ("env-fail", "env-hang"):
             # The vet could not run in this environment (most likely
@@ -947,6 +1051,8 @@ def main():
         if verdict == "hang":
             del candidate_defs["planes"]
             try:
+                import subprocess
+
                 from distributed_point_functions_tpu.pir import (
                     dense_eval_planes as _dep,
                 )

@@ -150,3 +150,45 @@ def test_vet_survives_hung_compile(bench_env, monkeypatch):
         stored = json.load(f)
     (entry,) = stored.values()
     assert entry.get("_WALK_KERNEL_FAILED") is True
+
+
+def test_warm_child_hang_skips_kernel_tiers(bench_env, monkeypatch):
+    """A hung self-check compile in the bounded warm child must skip the
+    in-process warmup (no kernel tiers this run), demote nothing, and
+    still emit a valid headline from the banked XLA candidate."""
+    import time as _time
+
+    import jax
+
+    from distributed_point_functions_tpu.pir import dense_eval_planes as dep
+
+    import bench
+
+    # Route the TPU-only warm-child path on CPU; the child itself runs
+    # on BENCH_PLATFORM=cpu, writes its marker, then hangs on the
+    # injected fault.
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setenv("DPF_TPU_FAULT_WARM_HANG", "1")
+    monkeypatch.setenv("BENCH_WARM_TIMEOUT", "30")
+    monkeypatch.setattr(dep, "_VERDICTS_LOADED", True)
+    monkeypatch.setattr(dep, "_LAST_RECORDED", None)
+
+    out = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    t0 = _time.monotonic()
+    try:
+        bench.main()
+    finally:
+        bench._PROGRESS["done"] = True
+        jax.config.update("jax_compilation_cache_dir", None)
+    elapsed = _time.monotonic() - t0
+
+    line = out.getvalue().strip().splitlines()[-1]
+    result = json.loads(line)
+    assert result["value"] > 0, result
+    assert "error" not in result, result
+    assert elapsed < 420, elapsed
+    # An ambiguous warm hang must never demote kernel tiers.
+    assert dep._WALK_KERNEL_FAILED is False
+    assert dep._TAIL_KERNEL_FAILED is False
+    assert dep._LEVEL_KERNEL_FAILED is False
