@@ -652,12 +652,10 @@ def _expand_levels_fn(num_levels: int, hash_leaves: bool = False):
         # The hierarchical geometry (kg=1, node_lanes=prefix words)
         # carries its own verdict: the base walk verdict never executed
         # it, and Mosaic legality is shape-dependent. Unverified or
-        # failed -> serve the concat/per-level tiers here.
-        mode = (
-            "tail"
-            if _dep._TAIL_KERNEL_VERIFIED and not _dep._TAIL_KERNEL_FAILED
-            else "pallas"
-        )
+        # failed -> serve the concat/per-level tiers here. The tail
+        # fallback likewise needs the tail kernel proven at *this*
+        # geometry, not the dense-tile verdict.
+        mode = "tail" if _dep._tail_hier_ok() else "pallas"
     kinds = {}
     if mode == "walk":
         kinds = {

@@ -102,6 +102,25 @@ def xor_inner_product(
     return acc
 
 
+def xor_inner_product_accumulate(
+    acc: jnp.ndarray,
+    db_span: jnp.ndarray,
+    selections: jnp.ndarray,
+    chunk: int = 256,
+) -> jnp.ndarray:
+    """Partial-accumulate entry for the streaming serving scan: XOR the
+    inner product of one database block span into per-query accumulators.
+
+    acc: uint32[nq, W] running XOR accumulators; db_span: uint32[R, W]
+    one span of (permuted) record rows, R a multiple of 128; selections:
+    uint32[nq, B, 4] the selection blocks covering exactly that span.
+    Returns the updated uint32[nq, W] accumulators; XOR-accumulating
+    every span of a partition of the database equals one full
+    `xor_inner_product`.
+    """
+    return acc ^ xor_inner_product(db_span, selections, chunk=chunk)
+
+
 @jax.jit
 def xor_inner_product_bitplane(
     db_perm: jnp.ndarray, selections: jnp.ndarray

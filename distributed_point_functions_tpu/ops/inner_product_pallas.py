@@ -49,6 +49,52 @@ from jax.experimental.pallas import tpu as pltpu
 
 U32 = jnp.uint32
 I32 = jnp.int32
+
+# jax < 0.6 has no varying-manual-axes metadata on ShapeDtypeStruct; its
+# shard_map fallback runs with the replication checker off instead
+# (parallel/sharded.py), so dropping the annotation there is consistent.
+try:
+    jax.ShapeDtypeStruct((1,), U32, vma=frozenset())
+    _HAS_VMA = True
+except TypeError:
+    _HAS_VMA = False
+
+_INTERPRET_REPEAT_TILES: bool | None = None
+
+
+def _interpret_repeat_tiles() -> bool:
+    """Whether interpret-mode `pltpu.repeat` tiles the source like Mosaic
+    (`[a b] -> [a b a b]`).
+
+    Old jax interpreted it as element-wise `np.repeat` (`[a a b b]`),
+    silently corrupting every kernel below under interpret=True; those
+    kernels swap in a concat-based tile when this probe says so. The
+    compiled path always has Mosaic semantics and is never rerouted.
+    """
+    global _INTERPRET_REPEAT_TILES
+    if _INTERPRET_REPEAT_TILES is None:
+        def probe(x_ref, o_ref):
+            o_ref[:] = pltpu.repeat(x_ref[:], 2, axis=1)
+
+        # The probe must run eagerly even when first reached while
+        # tracing the jitted caller.
+        with jax.ensure_compile_time_eval():
+            got = pl.pallas_call(
+                probe,
+                out_shape=jax.ShapeDtypeStruct((1, 4), U32),
+                interpret=True,
+            )(jnp.arange(2, dtype=U32)[None, :])
+            _INTERPRET_REPEAT_TILES = bool(
+                (got[0] == jnp.array([0, 1, 0, 1], dtype=U32)).all()
+            )
+    return _INTERPRET_REPEAT_TILES
+
+
+def _tile_repeat(x, factor: int, axis: int):
+    """Mosaic-semantics repeat (whole-source tiling along `axis`)."""
+    if factor == 1:
+        return x
+    return jnp.concatenate([x] * factor, axis=axis)
 I8 = jnp.int8
 BF16 = jnp.bfloat16
 F32 = jnp.float32
@@ -236,7 +282,10 @@ def xor_inner_product_pallas_staged(
     return out[:nq] if nq_pad != nq else out
 
 
-def _ip_kernel_v2(sel_ref, db_ref, out_ref, *, j_chunk: int, int8: bool):
+def _ip_kernel_v2(
+    sel_ref, db_ref, out_ref, *, j_chunk: int, int8: bool,
+    repeat=pltpu.repeat,
+):
     """One large MXU dot per (grid step, value-bit chunk).
 
     v1 (`_ip_kernel`) issues 32x32 = 1024 tiny [TQ, TG] x [TG, W] dots per
@@ -274,7 +323,7 @@ def _ip_kernel_v2(sel_ref, db_ref, out_ref, *, j_chunk: int, int8: bool):
         as_i32 = bits_u32.astype(I32)
         return as_i32.astype(I8) if int8 else as_i32.astype(F32).astype(BF16)
 
-    sel_rep = pltpu.repeat(sel_ref[:], 32, axis=1)  # [TQ, 32*TG] tiled
+    sel_rep = repeat(sel_ref[:], 32, axis=1)  # [TQ, 32*TG] tiled
     b_iota = lax.broadcasted_iota(U32, (tq, tr), 1) // U32(tg)
     lhs = to_mm((sel_rep >> b_iota) & U32(1))
 
@@ -290,7 +339,7 @@ def _ip_kernel_v2(sel_ref, db_ref, out_ref, *, j_chunk: int, int8: bool):
     # mismatch the unvarying iotas and constants throughout the kernel
     # (the VMA checker runs at trace time on any backend; the declared
     # out_shape vma covers the result).
-    db_rep = pltpu.repeat(dbw, j_chunk, axis=1)
+    db_rep = repeat(dbw, j_chunk, axis=1)
     acc_t = I32 if int8 else F32
     for jc in range(0, 32, j_chunk):
         if j_chunk == 1:
@@ -346,7 +395,14 @@ def _ip_pallas_staged_v2(
 
     acc_t = I32 if int8 else F32
     counts = pl.pallas_call(
-        functools.partial(_ip_kernel_v2, j_chunk=j_chunk, int8=int8),
+        functools.partial(
+            _ip_kernel_v2, j_chunk=j_chunk, int8=int8,
+            repeat=(
+                _tile_repeat
+                if interpret and not _interpret_repeat_tiles()
+                else pltpu.repeat
+            ),
+        ),
         grid=(nq // tq, num_groups // tg),
         in_specs=[
             pl.BlockSpec((tq, tg), lambda q, r: (q, r)),
@@ -359,7 +415,7 @@ def _ip_pallas_staged_v2(
         # checker on (the multi-chip MXU step, `parallel/sharded.py`).
         out_shape=jax.ShapeDtypeStruct(
             (nq, 32 * num_words), acc_t,
-            **({"vma": frozenset(vma)} if vma else {}),
+            **({"vma": frozenset(vma)} if (vma and _HAS_VMA) else {}),
         ),
         interpret=interpret,
     )(packed, db_perm)
@@ -441,6 +497,47 @@ def xor_inner_product_pallas2_staged(
         vma=vma,
     )
     return out[:nq] if nq_pad != nq else out
+
+
+def xor_inner_product_pallas2_accumulate(
+    acc: jnp.ndarray,
+    db_perm_span: jnp.ndarray,
+    selections: jnp.ndarray,
+    **kwargs,
+) -> jnp.ndarray:
+    """Partial-accumulate entry for the streaming serving scan: XOR one
+    staged block span's MXU inner product into per-query accumulators.
+
+    acc: uint32[nq, W]; db_perm_span: uint32[32, Gc, W] one bit-major
+    staged span (`stage_db_chunks_bitmajor`); selections: uint32[nq, B, 4]
+    covering exactly that span (`_stage_selections` pads the group axis to
+    Gc). Extra kwargs pass through to `xor_inner_product_pallas2_staged`.
+    """
+    return acc ^ xor_inner_product_pallas2_staged(
+        db_perm_span, selections, **kwargs
+    )
+
+
+def stage_db_chunks_bitmajor(
+    db_words: jnp.ndarray, num_chunks: int
+) -> jnp.ndarray:
+    """Split a (permuted) row-major database into equal record spans and
+    bit-major stage each: uint32[R, W] -> uint32[num_chunks, 32, Gc, W].
+
+    Each chunk is independently padded to a 4096-record multiple by
+    `permute_db_bitmajor`, so Gc >= 128 always satisfies the compiled v2
+    kernel's 16-group floor regardless of chunk size.
+    """
+    num_records, num_words = db_words.shape
+    if num_chunks <= 0 or num_records % num_chunks:
+        raise ValueError(
+            f"record count {num_records} is not divisible into "
+            f"{num_chunks} chunks"
+        )
+    chunk_records = num_records // num_chunks
+    return jax.vmap(permute_db_bitmajor)(
+        db_words.reshape(num_chunks, chunk_records, num_words)
+    )
 
 
 def xor_inner_product_pallas(

@@ -33,6 +33,21 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax < 0.6 only exports shard_map from jax.experimental, and its
+# replication checker predates the varying-manual-axes metadata the
+# pallas inner-product declares on new jax — run it with the checker
+# off there (the new-jax default-on check_vma path still covers these
+# programs wherever jax.shard_map exists).
+shard_map = getattr(jax, "shard_map", None)
+if shard_map is None:
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs):
+        return _experimental_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
 from ..ops.inner_product import unpack_selection_bits
 from ..pir.dense_eval import expansion_impl
 
@@ -109,7 +124,7 @@ def sharded_inner_product(mesh: Mesh, axis_name: str = "x"):
         partial = _local_partial_ip(db_shard, selections, idx)
         return partial[None]  # [1, nq, W], sharded over the mesh axis
 
-    shard_mapped = jax.shard_map(
+    shard_mapped = shard_map(
         step,
         mesh=mesh,
         in_specs=(P(axis_name, None), P()),
@@ -226,7 +241,7 @@ def sharded_dense_pir_step_multi(
             for db_shard in db_shards
         )
 
-    shard_mapped = jax.shard_map(
+    shard_mapped = shard_map(
         step,
         mesh=mesh,
         in_specs=(
@@ -319,7 +334,7 @@ def stage_sharded_bitmajor(mesh: Mesh, db_words, axis_name: str = "x"):
     ndev = mesh.devices.size
     _check_divisible("num_records", db_words.shape[0], 4096 * ndev)
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             permute_db_bitmajor,
             mesh=mesh,
             in_specs=P(axis_name, None),
@@ -410,7 +425,7 @@ def sharded_dense_pir_step_mxu(
             for db_shard in db_shards
         )
 
-    shard_mapped = jax.shard_map(
+    shard_mapped = shard_map(
         step,
         mesh=mesh,
         in_specs=(
@@ -449,5 +464,126 @@ def sharded_dense_pir_step_mxu(
             *db_perms,
         )
         return tuple(_xor_combine(p, mesh) for p in partials)
+
+    return run
+
+
+def stage_streaming_chunks(mesh: Mesh, db_chunks, axis_name: str = "x"):
+    """Place a streaming chunk staging (`database.streaming_chunks`
+    layout: uint32[nc, ...] row- or bit-major per chunk) sharded over the
+    chunk axis: each device holds a contiguous span of scan steps."""
+    spec = P(*((axis_name,) + (None,) * (db_chunks.ndim - 1)))
+    return jax.device_put(db_chunks, NamedSharding(mesh, spec))
+
+
+def sharded_dense_pir_step_streaming(
+    mesh: Mesh,
+    *,
+    walk_levels: int,
+    cut_levels: int,
+    chunk_levels: int,
+    axis_name: str = "x",
+    ip: str = "jnp",
+    interpret: bool = False,
+):
+    """Streaming variant of `sharded_dense_pir_step_mxu`: the fused
+    expand->inner-product scan with the *chunk* axis sharded over the
+    mesh instead of the record axis.
+
+    Keys arrive replicated; every device expands the cheap covering
+    subtree down to the cut, slices out its own span of
+    `2^cut_levels / ndev` cut-state lanes by `axis_index`, and scans its
+    local database chunks, accumulating per-shard XOR partials that are
+    combined once at the end. No selection tensor is ever materialized
+    or all-gathered — per-device peak selection bytes drop by the mesh
+    factor on top of the streaming chunk bound.
+
+    Returns fn(seeds0[nq,4], control0[nq], cw_seeds[L,nq,4],
+    cw_left[L,nq], cw_right[L,nq], last_vc[nq,4],
+    db_chunks uint32[2^cut_levels, ...] sharded on axis 0
+    (`stage_streaming_chunks`; bit-major per chunk for ip="pallas2"))
+    -> uint32[nq, W]. `2^cut_levels` must be divisible by the mesh size.
+    """
+    from ..pir.dense_eval_planes_v2 import (
+        _packed_levels,
+        _pad_keys32,
+        pack_key_planes_kg,
+        streaming_cut_state,
+        streaming_scan_accumulate,
+    )
+
+    ndev = mesh.devices.size
+    num_chunks = 1 << cut_levels
+    _check_divisible("num_chunks", num_chunks, ndev)
+    nc_local = num_chunks // ndev
+    levels = walk_levels + cut_levels + chunk_levels
+
+    def step(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
+             db_chunks_shard):
+        nk = seeds0.shape[0]
+        seeds0, control0, cw_seeds, cw_left, cw_right, last_vc = (
+            _pad_keys32(
+                seeds0, control0, cw_seeds, cw_left, cw_right, last_vc
+            )
+        )
+        state, ctrl = streaming_cut_state(
+            seeds0,
+            control0,
+            cw_seeds,
+            cw_left,
+            cw_right,
+            walk_levels=walk_levels,
+            cut_levels=cut_levels,
+        )
+        idx = lax.axis_index(axis_name)
+        state = lax.dynamic_slice_in_dim(
+            state, idx * nc_local, nc_local, axis=-1
+        )
+        ctrl = lax.dynamic_slice_in_dim(
+            ctrl, idx * nc_local, nc_local, axis=-1
+        )
+        tail_cwp, tail_cwl, tail_cwr = _packed_levels(
+            cw_seeds, cw_left, cw_right, walk_levels + cut_levels, levels
+        )
+        acc = streaming_scan_accumulate(
+            state,
+            ctrl,
+            db_chunks_shard,
+            tail_cwp,
+            tail_cwl,
+            tail_cwr,
+            pack_key_planes_kg(last_vc),
+            ip=ip,
+            interpret=interpret,
+            vma=(axis_name,),
+        )
+        return acc[None, :nk]
+
+    shard_mapped = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(), P(), P(axis_name)),
+        out_specs=P(axis_name),
+    )
+
+    @jax.jit
+    def run(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
+            db_chunks):
+        if cw_seeds.shape[0] != levels:
+            raise ValueError(
+                f"key has {cw_seeds.shape[0]} correction levels; step "
+                f"was built for walk {walk_levels} + cut {cut_levels} + "
+                f"chunk {chunk_levels}"
+            )
+        if db_chunks.shape[0] != num_chunks:
+            raise ValueError(
+                f"expected {num_chunks} database chunks, got "
+                f"{db_chunks.shape[0]}"
+            )
+        partials = shard_mapped(
+            seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
+            db_chunks,
+        )
+        return _xor_combine(partials, mesh)
 
     return run

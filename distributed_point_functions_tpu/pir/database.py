@@ -22,6 +22,7 @@ XOR-reduction. Set
 from __future__ import annotations
 
 import os
+import threading
 import warnings
 from typing import List, Sequence
 
@@ -145,6 +146,14 @@ class DenseDpfPirDatabase:
         self._host_rev = None
         self._db_words_rev = None
         self._db_perm_rev = None
+        # Streaming staging (blocked-bitrev chunk spans), one plan at a
+        # time: ((cut_levels, bitmajor), uint32[nc, ...] device array).
+        self._streaming_stage = None
+        # All lazy stagings build under this lock: concurrent first
+        # requests must not stage the database twice (each staging is a
+        # full HBM copy). Reentrant because _staged_perm -> _row_words
+        # -> _host_words_bitrev nest.
+        self._stage_lock = threading.RLock()
         self._failed_tiers: set = set()
         self._failed_knobs: set = set()  # v2 knob combos that crashed
 
@@ -169,9 +178,10 @@ class DenseDpfPirDatabase:
     @property
     def db_words(self) -> jnp.ndarray:
         """uint32[num_records_padded, record_words] device buffer."""
-        if self._db_words is None:
-            self._db_words = jnp.asarray(self._host_words)
-        return self._db_words
+        with self._stage_lock:
+            if self._db_words is None:
+                self._db_words = jnp.asarray(self._host_words)
+            return self._db_words
 
     def record(self, i: int) -> bytes:
         return self._records[i]
@@ -182,43 +192,98 @@ class DenseDpfPirDatabase:
         nb = self.num_selection_blocks
         return 1 << max(0, (nb - 1).bit_length())
 
-    def _host_words_bitrev(self) -> np.ndarray:
-        if self._host_rev is None:
-            from .dense_eval_planes_v2 import bitrev_block_permute_records
+    def _host_words_padded(self) -> np.ndarray:
+        """Host rows zero-padded to the bitrev staging's block count."""
+        rows = self.bitrev_block_count() * 128
+        hw = self._host_words
+        if rows > hw.shape[0]:
+            hw = np.concatenate(
+                [hw, np.zeros((rows - hw.shape[0], hw.shape[1]),
+                              np.uint32)]
+            )
+        return hw
 
-            rows = self.bitrev_block_count() * 128
-            hw = self._host_words
-            if rows > hw.shape[0]:
-                hw = np.concatenate(
-                    [hw, np.zeros((rows - hw.shape[0], hw.shape[1]),
-                                  np.uint32)]
+    def _host_words_bitrev(self) -> np.ndarray:
+        with self._stage_lock:
+            if self._host_rev is None:
+                from .dense_eval_planes_v2 import bitrev_block_permute_records
+
+                self._host_rev = bitrev_block_permute_records(
+                    self._host_words_padded()
                 )
-            self._host_rev = bitrev_block_permute_records(hw)
-        return self._host_rev
+            return self._host_rev
 
     def _row_words(self, bitrev_blocks: bool = False) -> jnp.ndarray:
         """Row-major device layout (the jnp tier's input)."""
         if not bitrev_blocks:
             return self.db_words
-        if self._db_words_rev is None:
-            self._db_words_rev = jnp.asarray(self._host_words_bitrev())
-        return self._db_words_rev
+        with self._stage_lock:
+            if self._db_words_rev is None:
+                self._db_words_rev = jnp.asarray(self._host_words_bitrev())
+                # The host-side permuted copy only exists to feed device
+                # stagings; keeping it would hold a second full database
+                # in host RSS for the process lifetime. (Rebuilt from
+                # `_host_words` if another staging needs it.)
+                self._host_rev = None
+            return self._db_words_rev
 
     def _staged_perm(self, bitrev_blocks: bool = False) -> jnp.ndarray:
         """Bit-major layout (`permute_db_bitmajor`), staged once."""
-        if bitrev_blocks:
-            if self._db_perm_rev is None:
-                self._db_perm_rev = jax.block_until_ready(
-                    permute_db_bitmajor(
-                        jnp.asarray(self._host_words_bitrev())
+        with self._stage_lock:
+            if bitrev_blocks:
+                if self._db_perm_rev is None:
+                    self._db_perm_rev = jax.block_until_ready(
+                        permute_db_bitmajor(
+                            jnp.asarray(self._host_words_bitrev())
+                        )
                     )
+                    self._host_rev = None  # see _row_words
+                return self._db_perm_rev
+            if self._db_perm is None:
+                self._db_perm = jax.block_until_ready(
+                    permute_db_bitmajor(jnp.asarray(self._host_words))
                 )
-            return self._db_perm_rev
-        if self._db_perm is None:
-            self._db_perm = jax.block_until_ready(
-                permute_db_bitmajor(jnp.asarray(self._host_words))
+            return self._db_perm
+
+    def streaming_chunks(
+        self, *, cut_levels: int, bitmajor: bool
+    ) -> jnp.ndarray:
+        """Device staging for the streaming serving plan: records in
+        streaming (blocked bit-reversed) block order, split into
+        `2**cut_levels` chunk spans along the leading axis.
+
+        Returns uint32[nc, chunk_records, W] row-major, or
+        uint32[nc, 32, Gc, W] bit-major per chunk when `bitmajor` (the
+        pallas2 scan tier). One staging is cached at a time, keyed by
+        the plan split — a batch-size change that moves the planner's
+        cut restages (the covering padded row count is plan-invariant,
+        only the chunk boundaries move).
+        """
+        from .dense_eval_planes_v2 import streaming_block_permute_records
+
+        key = (int(cut_levels), bool(bitmajor))
+        with self._stage_lock:
+            if (
+                self._streaming_stage is not None
+                and self._streaming_stage[0] == key
+            ):
+                return self._streaming_stage[1]
+            host = streaming_block_permute_records(
+                self._host_words_padded(), cut_levels
             )
-        return self._db_perm
+            nc = 1 << cut_levels
+            if bitmajor:
+                from ..ops.inner_product_pallas import stage_db_chunks_bitmajor
+
+                arr = jax.block_until_ready(
+                    stage_db_chunks_bitmajor(jnp.asarray(host), nc)
+                )
+            else:
+                arr = jax.block_until_ready(
+                    jnp.asarray(host.reshape(nc, -1, host.shape[1]))
+                )
+            self._streaming_stage = (key, arr)
+            return arr
 
     def _tier_chain(self):
         """(tiers-to-try, forced): the inner-product fallback chain.

@@ -396,6 +396,13 @@ def chunked_pir_inner_products(
     chunk's selections are ever live (the TPU analog of SURVEY.md §5's
     chunked/blockwise expansion sized to HBM).
 
+    This is the legacy limb-layout fallback: `pir.planner` now routes
+    over-budget serving to the streaming plane-layout pipeline
+    (`dense_eval_planes_v2.streaming_pir_inner_products_v2`) when the
+    expansion tree covers the padded block count, and only falls back
+    here otherwise. The materialized path doubles as the differential
+    oracle for both.
+
     db_words: uint32[num_chunks * 2^chunk_expand_levels * 128, W] (zero
     rows beyond the real record count). Tree depth must satisfy
     walk_levels + chunk_bits + chunk_expand_levels == total levels.

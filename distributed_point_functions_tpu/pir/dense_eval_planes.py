@@ -403,6 +403,7 @@ _VERDICT_FLAGS = (
     "_WALK_KERNEL_VERIFIED", "_WALK_KERNEL_FAILED",
     "_WALK_COMPACT_VERIFIED", "_WALK_COMPACT_FAILED",
     "_WALK_HIER_VERIFIED", "_WALK_HIER_FAILED",
+    "_TAIL_HIER_VERIFIED", "_TAIL_HIER_FAILED",
 )
 
 
@@ -762,6 +763,12 @@ _WALK_COMPACT_VERIFIED = False
 _WALK_COMPACT_FAILED = False
 _WALK_HIER_VERIFIED = False
 _WALK_HIER_FAILED = False
+# Tail kernel at the HIERARCHICAL operand geometry (kg=1 shared
+# corrections, zero value correction — dpf.py's fused program): its own
+# verdict pair, because the dense-tile _TAIL_KERNEL_VERIFIED never
+# executed those operand shapes and Mosaic legality is shape-dependent.
+_TAIL_HIER_VERIFIED = False
+_TAIL_HIER_FAILED = False
 
 
 def _walk_twin_instance(rng, g0, nk, r):
@@ -854,6 +861,7 @@ _WALK_SELFCHECK_SHAPE = dict(g0=1024, nk=64, r=2, tile=2048)
 _WALK_COMPACT_SELFCHECK_SHAPE = dict(g0=1024, nk=64, r=2)
 _WALK_HIER_SELFCHECK_SHAPE = dict(nl=4, n_entry=64, r=2)
 _TAIL_SELFCHECK_SHAPE = dict(g0=256, nk=64, r=2, tile=128)
+_TAIL_HIER_SELFCHECK_SHAPE = dict(g0=256, r=2, tile=128)
 
 
 def _walk_kernel_selfcheck() -> bool:
@@ -919,18 +927,19 @@ def _walk_compact_selfcheck() -> bool:
     s = _WALK_COMPACT_SELFCHECK_SHAPE
     g0, nk, r = s["g0"], s["nk"], s["r"]
     kg = nk // 32
+    tile, compact, npt = walk_plan(g0 << r, kg, kg, r, True)
+    if not compact:
+        # walk_plan declined compact at this geometry (tile cap): the
+        # mode cannot launch here, so there is nothing to verify — and
+        # nothing FAILED. Returning False (instead of raising into the
+        # caller's except clause) keeps the cross-process FAILED verdict
+        # reserved for genuine kernel evidence; a decline is a planner
+        # decision that can change with tile knobs or jax versions.
+        return False
     state, ctrl, cwp, cwl, cwr, vc, want_v, want_c = _walk_twin_instance(
         rng, g0, nk, r
     )
     n_entry = g0 // kg
-    tile, compact, npt = walk_plan(g0 << r, kg, kg, r, True)
-    if not compact:
-        # walk_plan declined compact at this geometry (tile cap): the
-        # mode cannot launch here, so there is nothing to verify.
-        raise RuntimeError(
-            "walk_plan declined compact entry at the self-check "
-            "geometry; compact mode stays unverified"
-        )
     exit_order = compose_walk_leaf_order(
         _np.arange(n_entry, dtype=_np.int64), r, True, npt
     )
@@ -1146,6 +1155,105 @@ def _tail_kernel_selfcheck() -> bool:
     return True
 
 
+def _tail_hier_selfcheck() -> bool:
+    """One-time on-device bit-identity check of the fused tail kernel at
+    the HIERARCHICAL operand geometry (`dpf._expand_levels_planes_fn`'s
+    tail: kg=1 broadcast correction planes, [1]-shaped direction words,
+    zero value correction) against the XLA twin. `_TAIL_KERNEL_VERIFIED`
+    comes from per-key dense-tile operands and does not cover these
+    shapes."""
+    global _TAIL_HIER_VERIFIED, _TAIL_HIER_FAILED
+    if _TAIL_HIER_FAILED:
+        return False
+    if _TAIL_HIER_VERIFIED:
+        return True
+    import numpy as _np
+
+    from ..ops.aes_bitslice import broadcast_cw_planes
+
+    rng = _np.random.default_rng(8642)
+    s = _TAIL_HIER_SELFCHECK_SHAPE
+    g0, r, tile = s["g0"], s["r"], s["tile"]
+    state = jnp.asarray(
+        rng.integers(0, 1 << 32, (16, 8, g0), dtype=_np.uint32)
+    )
+    ctrl = jnp.asarray(rng.integers(0, 1 << 32, (g0,), dtype=_np.uint32))
+    cwp = [
+        broadcast_cw_planes(jnp.asarray(
+            rng.integers(0, 1 << 32, (4,), dtype=_np.uint32)
+        ))
+        for _ in range(r)
+    ]
+    cwl = [
+        (U32(0) - jnp.asarray(rng.integers(0, 2), dtype=U32))[None]
+        for _ in range(r)
+    ]
+    cwr = [
+        (U32(0) - jnp.asarray(rng.integers(0, 2), dtype=U32))[None]
+        for _ in range(r)
+    ]
+    vc = jnp.zeros((16, 8, 1), dtype=U32)
+    want_vs, want_cs = [], []
+    for lo in range(0, g0, tile):
+        st = state[:, :, lo:lo + tile]
+        c = ctrl[lo:lo + tile]
+        for i in range(r):
+            g2 = 2 * st.shape[-1]
+            st, c = expand_level_planes(
+                st, c, _tile_keys(cwp[i], g2), _tile_keys(cwl[i], g2 // 2),
+                _tile_keys(cwr[i], g2 // 2),
+            )
+        want_vs.append(
+            mmo_hash_planes(fixed_keys.RK_VALUE, st)
+            ^ (_tile_keys(vc, st.shape[-1]) & c[None, None, :])
+        )
+        want_cs.append(c)
+    got_v, got_c = expand_tail_planes_pallas(
+        state, ctrl, jnp.stack(cwp), jnp.stack(cwl), jnp.stack(cwr), vc,
+        tile_lanes=tile,
+    )
+    if not (
+        _np.array_equal(
+            _np.asarray(got_v),
+            _np.asarray(jnp.concatenate(want_vs, axis=-1)),
+        )
+        and _np.array_equal(
+            _np.asarray(got_c), _np.asarray(jnp.concatenate(want_cs))
+        )
+    ):
+        raise RuntimeError(
+            "hierarchical-geometry tail kernel/XLA bit mismatch on this "
+            "device"
+        )
+    _TAIL_HIER_VERIFIED = True
+    return True
+
+
+def _tail_hier_ok() -> bool:
+    """Gate for the tail kernel at the hierarchical operand geometry
+    (same trace/verification rules as `_walk_hier_ok`): dpf.py's
+    walk-mode fallback must not trust the dense-tile tail verdict across
+    geometries."""
+    global _TAIL_HIER_FAILED
+    if _TAIL_HIER_FAILED:
+        return False
+    if _TAIL_HIER_VERIFIED:
+        return True
+    if not _trace_state_clean():
+        return False
+    try:
+        return _tail_hier_selfcheck()
+    except Exception as e:  # noqa: BLE001 - never break serving
+        _TAIL_HIER_FAILED = True
+        record_kernel_verdicts()
+        warnings.warn(
+            "hierarchical-geometry tail kernel failed its on-device "
+            f"self-check; serving the per-level tiers there "
+            f"({str(e).splitlines()[0][:200]})"
+        )
+        return False
+
+
 def warm_level_kernels():
     """Eagerly run the kernel self-checks (and return the serving mode).
 
@@ -1164,7 +1272,14 @@ def warm_level_kernels():
         # prior eager verification.
         if _walk_compact_enabled():
             _walk_compact_ok()
-        _walk_hier_ok()
+        if not _walk_hier_ok():
+            # dpf.py's walk fallback re-dispatches the hierarchical tail
+            # through the fused tail kernel when ITS geometry verdict
+            # holds; warm that verdict too so the traced program can
+            # still take the tail tier.
+            _tail_hier_ok()
+    elif mode == "tail":
+        _tail_hier_ok()
     return mode
 
 
@@ -1185,6 +1300,8 @@ def level_kernel_status() -> dict:
         "walk_compact_failed": _WALK_COMPACT_FAILED,
         "walk_hier_verified": _WALK_HIER_VERIFIED,
         "walk_hier_failed": _WALK_HIER_FAILED,
+        "tail_hier_verified": _TAIL_HIER_VERIFIED,
+        "tail_hier_failed": _TAIL_HIER_FAILED,
     }
 
 

@@ -42,6 +42,8 @@ bit-reversal, exactly as v1's pure-XLA path.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -54,6 +56,8 @@ from ..ops.aes_bitslice import (
     planes_to_limbs,
     sigma_planes,
 )
+from ..ops.inner_product import xor_inner_product_accumulate
+from ..ops.inner_product_pallas import xor_inner_product_pallas2_accumulate
 from .dense_eval import _walk_zeros
 from .dense_eval_planes import (
     bitrev_permutation,
@@ -102,6 +106,33 @@ def expand_level_planes_v2(state, ctrl, cw_p, cwl_w, cwr_w):
     return st, t_new ^ (ctrl2 & cw_dir)
 
 
+def _pad_keys32(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc):
+    """Pad the per-key operand set to a 32-multiple of keys (the plane
+    packing granule). Padded keys expand to garbage-but-deterministic
+    leaves; callers slice results back to the real key count."""
+    nk = seeds0.shape[0]
+    pad_keys = (-nk) % 32
+    if pad_keys:
+        seeds0 = jnp.pad(seeds0, ((0, pad_keys), (0, 0)))
+        control0 = jnp.pad(control0, ((0, pad_keys),))
+        cw_seeds = jnp.pad(cw_seeds, ((0, 0), (0, pad_keys), (0, 0)))
+        cw_left = jnp.pad(cw_left, ((0, 0), (0, pad_keys)))
+        cw_right = jnp.pad(cw_right, ((0, 0), (0, pad_keys)))
+        last_vc = jnp.pad(last_vc, ((0, pad_keys), (0, 0)))
+    return seeds0, control0, cw_seeds, cw_left, cw_right, last_vc
+
+
+def _planes_leaves_to_blocks(values: jnp.ndarray) -> jnp.ndarray:
+    """Leave plane space once: value planes [kg, 16, 8, w] ->
+    packed selection blocks [kg*32, w, 4] (leaf axis order preserved)."""
+    kg = values.shape[0]
+    w = values.shape[-1]
+    lim = jax.vmap(planes_to_limbs)(values)  # [kg, w*32, 4]
+    lim = lim.reshape(kg, w, 32, 4)
+    out = jnp.moveaxis(lim, 0, 1).reshape(w, kg * 32, 4)
+    return jnp.moveaxis(out, 0, 1)  # [kg*32, w, 4]
+
+
 def evaluate_selection_blocks_planes_v2(
     seeds0: jnp.ndarray,
     control0: jnp.ndarray,
@@ -125,16 +156,9 @@ def evaluate_selection_blocks_planes_v2(
     record blocks at staging instead.
     """
     nk = seeds0.shape[0]
-    pad_keys = (-nk) % 32
-    if pad_keys:
-        seeds0 = jnp.pad(seeds0, ((0, pad_keys), (0, 0)))
-        control0 = jnp.pad(control0, ((0, pad_keys),))
-        cw_seeds = jnp.pad(cw_seeds, ((0, 0), (0, pad_keys), (0, 0)))
-        cw_left = jnp.pad(cw_left, ((0, 0), (0, pad_keys)))
-        cw_right = jnp.pad(cw_right, ((0, 0), (0, pad_keys)))
-        last_vc = jnp.pad(last_vc, ((0, pad_keys), (0, 0)))
-    nkp = nk + pad_keys
-    kg = nkp // 32
+    seeds0, control0, cw_seeds, cw_left, cw_right, last_vc = _pad_keys32(
+        seeds0, control0, cw_seeds, cw_left, cw_right, last_vc
+    )
 
     # Phase 1 (limb space, [nk, 4] only): walk the all-zeros prefix.
     seeds, control = _walk_zeros(
@@ -160,12 +184,7 @@ def evaluate_selection_blocks_planes_v2(
     values = _mmo_v(fixed_keys.RK_VALUE, state)
     values = values ^ (pack_key_planes_kg(last_vc) & ctrl[:, None, None, :])
 
-    # Leave plane space once: [kg, 16, 8, w] -> [nkp, w, 4].
-    w = 1 << expand_levels
-    lim = jax.vmap(planes_to_limbs)(values)  # [kg, w*32, 4]
-    lim = lim.reshape(kg, w, 32, 4)
-    out = jnp.moveaxis(lim, 0, 1).reshape(w, nkp, 4)
-    out = jnp.moveaxis(out, 0, 1)  # [nkp, w, 4]
+    out = _planes_leaves_to_blocks(values)  # [nkp, w, 4]
     if not bitrev_leaves:
         perm = jnp.asarray(bitrev_permutation(expand_levels))
         out = out[:, perm, :][:, :num_blocks, :]
@@ -197,3 +216,232 @@ def bitrev_block_permute_records(db_host: np.ndarray) -> np.ndarray:
         db_host.reshape(num_blocks, 128, -1)[perm]
         .reshape(num_records, -1)
     )
+
+
+# ---------------------------------------------------------------------------
+# Streaming fused expand -> inner-product serving pipeline.
+#
+# The covering subtree is expanded down to `cut_levels` once; the last
+# `chunk_levels` doubling levels then run inside a jitted `lax.scan`, one
+# tail subtree (= one cut-state lane) per step, and each step's selection
+# blocks are XOR/MXU-accumulated against the matching database block span
+# immediately.  The full `uint32[num_queries, num_blocks, 4]` selection
+# matrix never exists in HBM, and XLA double-buffers the next database
+# chunk read against the current tail expansion.
+#
+# Block order.  After `cut` doubling levels, cut-state lane c holds the
+# node whose natural cut-bit prefix is bitrev_cut(c); expanding that lane
+# alone `r` more levels emits sub-leaf position q holding natural
+# sub-index bitrev_r(q).  Scan step c therefore covers natural blocks
+#     (bitrev_cut(c) << r) | bitrev_r(q),  q = 0..2^r-1,
+# which is NOT a contiguous span of the full-bitrev staging (a contiguous
+# full-bitrev span is a set of leaves sharing a path *suffix*, scattered
+# across all tail subtrees).  The database is instead staged once in this
+# *blocked* bit-reversed block order (`streaming_block_order`, an
+# involution that degenerates to the plain bit-reversal when cut == 0 or
+# r == 0), so every scan step reads one contiguous chunk.
+# ---------------------------------------------------------------------------
+
+
+def streaming_block_order(expand_levels: int, cut_levels: int) -> np.ndarray:
+    """Natural block index held at each staged position of the streaming
+    database layout: position c * 2^r + q (scan step c, row-block q)
+    holds natural block (bitrev_cut(c) << r) | bitrev_r(q), with
+    r = expand_levels - cut_levels."""
+    if not 0 <= cut_levels <= expand_levels:
+        raise ValueError("cut_levels must be in [0, expand_levels]")
+    r = expand_levels - cut_levels
+    pre = np.asarray(bitrev_permutation(cut_levels), dtype=np.int64)
+    sub = np.asarray(bitrev_permutation(r), dtype=np.int64)
+    return ((pre[:, None] << r) | sub[None, :]).reshape(-1)
+
+
+def streaming_block_permute_records(
+    db_host: np.ndarray, cut_levels: int
+) -> np.ndarray:
+    """Permute a record-major database's 128-record blocks into streaming
+    block order (host-side, once at staging). Row count must already be
+    padded to a power-of-two block count covering the tree."""
+    num_records = db_host.shape[0]
+    if num_records % 128:
+        raise ValueError("record count must be padded to a multiple of 128")
+    num_blocks = num_records // 128
+    levels = max(0, (num_blocks - 1).bit_length())
+    if num_blocks != 1 << levels:
+        raise ValueError("block count must be a power of two")
+    order = streaming_block_order(levels, cut_levels)
+    return (
+        db_host.reshape(num_blocks, 128, -1)[order]
+        .reshape(num_records, -1)
+    )
+
+
+def _packed_levels(cw_seeds, cw_left, cw_right, lo: int, hi: int):
+    """Pre-pack per-level correction operands for doubling levels
+    [lo, hi) into key-major plane form (kept outside scan bodies so the
+    packing is not re-traced per step)."""
+    cwp = [pack_key_planes_kg(cw_seeds[lvl]) for lvl in range(lo, hi)]
+    cwl = [pack_key_bits(cw_left[lvl])[:, None] for lvl in range(lo, hi)]
+    cwr = [pack_key_bits(cw_right[lvl])[:, None] for lvl in range(lo, hi)]
+    return cwp, cwl, cwr
+
+
+def streaming_cut_state(
+    seeds0,
+    control0,
+    cw_seeds,
+    cw_left,
+    cw_right,
+    *,
+    walk_levels: int,
+    cut_levels: int,
+):
+    """Walk the all-zeros prefix and expand the covering subtree down to
+    the cut: the resumable state the streaming scan slices per step.
+
+    Operands must already be 32-multiple padded (`_pad_keys32`). Returns
+    (state [kg, 16, 8, 2^cut], ctrl [kg, 2^cut])."""
+    seeds, control = _walk_zeros(
+        seeds0, control0, cw_seeds[:walk_levels], cw_left[:walk_levels]
+    )
+    state = jnp.moveaxis(limbs_to_planes(seeds), -1, 0)[..., None]
+    ctrl = pack_key_bits(control.astype(U32))[:, None]
+    cwp, cwl, cwr = _packed_levels(
+        cw_seeds, cw_left, cw_right, walk_levels, walk_levels + cut_levels
+    )
+    for level in range(cut_levels):
+        state, ctrl = expand_level_planes_v2(
+            state, ctrl, cwp[level], cwl[level], cwr[level]
+        )
+    return state, ctrl
+
+
+def streaming_tail_selections(state, ctrl, tail_cwp, tail_cwl, tail_cwr, vc_p):
+    """Resumable tail expansion: finish one tail subtree from its
+    cut-level state slice and emit its packed selection blocks.
+
+    state [kg, 16, 8, n] / ctrl [kg, n] (n = 1 inside the scan),
+    tail_* are `_packed_levels` lists, vc_p = `pack_key_planes_kg` of
+    the value correction. Returns uint32[kg*32, n << len(tail_cwp), 4]
+    in single-subtree doubling (bit-reversed) leaf order."""
+    for cwp, cwl, cwr in zip(tail_cwp, tail_cwl, tail_cwr):
+        state, ctrl = expand_level_planes_v2(state, ctrl, cwp, cwl, cwr)
+    values = _mmo_v(fixed_keys.RK_VALUE, state)
+    values = values ^ (vc_p & ctrl[:, None, None, :])
+    return _planes_leaves_to_blocks(values)
+
+
+def streaming_scan_accumulate(
+    state,
+    ctrl,
+    db_chunks,
+    tail_cwp,
+    tail_cwl,
+    tail_cwr,
+    vc_p,
+    *,
+    ip: str = "jnp",
+    interpret: bool = False,
+    vma=(),
+):
+    """Scan the cut-state lanes against the streaming-staged database
+    chunks, fusing tail expansion with the XOR inner product.
+
+    db_chunks: uint32[n, chunk_records, W] row-major (ip="jnp") or
+    uint32[n, 32, Gc, W] bit-major (ip="pallas2"), where n matches the
+    lane count of `state`. Returns uint32[kg*32, W] accumulators."""
+    num_lanes = state.shape[-1]
+    if db_chunks.shape[0] != num_lanes:
+        raise ValueError(
+            f"db_chunks leading axis {db_chunks.shape[0]} != cut-state "
+            f"lane count {num_lanes}"
+        )
+    st_x = jnp.moveaxis(state, -1, 0)[..., None]  # [n, kg, 16, 8, 1]
+    ct_x = jnp.moveaxis(ctrl, -1, 0)[..., None]  # [n, kg, 1]
+
+    def body(acc, xs):
+        db_c, st, ct = xs
+        sel = streaming_tail_selections(
+            st, ct, tail_cwp, tail_cwl, tail_cwr, vc_p
+        )
+        if ip == "pallas2":
+            acc = xor_inner_product_pallas2_accumulate(
+                acc, db_c, sel, interpret=interpret, vma=vma
+            )
+        else:
+            acc = xor_inner_product_accumulate(acc, db_c, sel)
+        return acc, None
+
+    nkp = state.shape[0] * 32
+    acc0 = jnp.zeros((nkp, db_chunks.shape[-1]), U32)
+    acc, _ = jax.lax.scan(body, acc0, (db_chunks, st_x, ct_x))
+    return acc
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("walk_levels", "cut_levels", "chunk_levels", "ip", "interpret"),
+)
+def streaming_pir_inner_products_v2(
+    seeds0,
+    control0,
+    cw_seeds,
+    cw_left,
+    cw_right,
+    last_vc,
+    db_chunks,
+    *,
+    walk_levels: int,
+    cut_levels: int,
+    chunk_levels: int,
+    ip: str = "jnp",
+    interpret: bool = False,
+):
+    """One jitted streaming serving step: expansion fused with the XOR
+    inner product, never materializing the selection matrix.
+
+    The database must be staged in streaming block order
+    (`streaming_block_permute_records` with the same `cut_levels`) and
+    split into `2^cut_levels` chunks along the leading axis — bit-major
+    per chunk for ip="pallas2". Returns uint32[num_keys, W] XOR-share
+    inner products, bit-identical to the materialized path."""
+    levels = walk_levels + cut_levels + chunk_levels
+    if cw_seeds.shape[0] != levels:
+        raise ValueError(
+            f"key has {cw_seeds.shape[0]} correction levels; plan needs "
+            f"walk {walk_levels} + cut {cut_levels} + chunk {chunk_levels}"
+        )
+    if db_chunks.shape[0] != 1 << cut_levels:
+        raise ValueError(
+            f"expected {1 << cut_levels} database chunks, got "
+            f"{db_chunks.shape[0]}"
+        )
+    nk = seeds0.shape[0]
+    seeds0, control0, cw_seeds, cw_left, cw_right, last_vc = _pad_keys32(
+        seeds0, control0, cw_seeds, cw_left, cw_right, last_vc
+    )
+    state, ctrl = streaming_cut_state(
+        seeds0,
+        control0,
+        cw_seeds,
+        cw_left,
+        cw_right,
+        walk_levels=walk_levels,
+        cut_levels=cut_levels,
+    )
+    tail_cwp, tail_cwl, tail_cwr = _packed_levels(
+        cw_seeds, cw_left, cw_right, walk_levels + cut_levels, levels
+    )
+    vc_p = pack_key_planes_kg(last_vc)
+    acc = streaming_scan_accumulate(
+        state,
+        ctrl,
+        db_chunks,
+        tail_cwp,
+        tail_cwl,
+        tail_cwr,
+        vc_p,
+        ip=ip,
+        interpret=interpret,
+    )
+    return acc[:nk]

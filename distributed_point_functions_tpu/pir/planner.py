@@ -1,0 +1,212 @@
+"""Serving-path planner for dense DPF PIR.
+
+One place that decides how a dense-PIR batch is served, replacing the
+scattered ``_needs_chunking`` heuristics.  Three modes:
+
+``materialized``
+    Full-domain expansion writes the packed selection matrix
+    ``uint32[num_queries, num_blocks, 4]`` to HBM and the inner product
+    re-reads it.  Cheapest to trace, and the differential-test oracle
+    for the other two modes.  Chosen whenever that matrix fits the HBM
+    selection budget.
+
+``streaming``
+    The fused expand->inner-product pipeline
+    (:func:`..pir.dense_eval_planes_v2.streaming_pir_inner_products_v2`).
+    The covering subtree is expanded down to ``cut_levels``; a jitted
+    ``lax.scan`` then expands each of the ``2**cut_levels`` tail
+    subtrees the remaining ``chunk_levels`` levels and immediately
+    XOR/MXU-accumulates the matching database block span, so the full
+    selection matrix never exists in HBM.  Requires the tree to cover
+    the padded block count (``2**expand_levels >= num_blocks``) because
+    the database is staged in streaming (blocked bit-reversed) block
+    order.
+
+``chunked``
+    The legacy limb-space chunked loop
+    (:func:`..pir.dense_eval.chunked_pir_inner_products`), kept for
+    geometries streaming cannot serve (trees that do not cover the
+    padded block count) and as a fallback when streaming is disabled
+    via ``DPF_TPU_STREAMING=0``.
+
+HBM budget model (all byte counts are *selection-attributable*, i.e.
+tensors whose size is proportional to the number of selection bits):
+
+- materialized: ``num_keys * eff_blocks * 16`` bytes live at once
+  (16 bytes = one 128-bit selection block).
+- streaming: the cut-level state holds one 16-byte seed per query per
+  subtree for the whole scan (``num_keys * 2**cut_levels * 16``), and
+  each scan step materializes one chunk's selections
+  (``num_keys * 2**chunk_levels * 16``), double-buffered by XLA while
+  the next database span is prefetched, hence the factor 2:
+
+      peak = num_keys * 16 * (2**cut_levels + 2 * 2**chunk_levels)
+
+  The planner picks the largest ``chunk_levels`` whose peak fits the
+  budget (bigger chunks amortize per-step overhead); if no split fits
+  it minimizes the peak, which lands near ``chunk_levels ~
+  (expand_levels - 1) / 2``.
+- chunked: one chunk's selections at a time,
+  ``num_keys * 2**chunk_expand_levels * 16``.
+
+The budget defaults to 1 GiB and is overridden with
+``DPF_TPU_SELECTION_BYTES_BUDGET``.  ``DPF_TPU_STREAMING`` gates the
+streaming mode (``auto`` = use when over budget, ``1`` = use whenever
+applicable even under budget, ``0`` = never).  ``DPF_TPU_STREAMING_IP``
+picks the inner-product tier inside the scan (``auto`` = pallas2 on
+TPU, jnp elsewhere).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+_DEFAULT_BUDGET_BYTES = 1 << 30
+_SELECTION_BLOCK_BYTES = 16
+
+# Legacy chunked path: pad the block count so chunks stay at least this
+# many doubling levels (keeps per-chunk tensors MXU-friendly).
+CHUNK_GRANULE_LEVELS = 10
+
+
+def selection_budget_bytes() -> int:
+    """HBM budget for selection-attributable tensors, from the env."""
+    raw = os.environ.get("DPF_TPU_SELECTION_BYTES_BUDGET", "").strip()
+    if raw:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            pass
+    return _DEFAULT_BUDGET_BYTES
+
+
+def streaming_mode() -> str:
+    mode = os.environ.get("DPF_TPU_STREAMING", "auto").strip().lower()
+    return mode if mode in ("auto", "0", "1") else "auto"
+
+
+def streaming_ip(backend: str | None) -> str:
+    env = os.environ.get("DPF_TPU_STREAMING_IP", "auto").strip().lower()
+    if env in ("jnp", "pallas2"):
+        return env
+    return "pallas2" if backend == "tpu" else "jnp"
+
+
+def materialized_selection_bytes(num_keys: int, eff_blocks: int) -> int:
+    return num_keys * eff_blocks * _SELECTION_BLOCK_BYTES
+
+
+def streaming_selection_bytes(num_keys: int, cut_levels: int, chunk_levels: int) -> int:
+    return num_keys * _SELECTION_BLOCK_BYTES * (
+        (1 << cut_levels) + 2 * (1 << chunk_levels)
+    )
+
+
+def chunked_selection_bytes(num_keys: int, chunk_expand_levels: int) -> int:
+    return num_keys * (1 << chunk_expand_levels) * _SELECTION_BLOCK_BYTES
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingPlan:
+    """Resolved serving decision for one dense-PIR batch."""
+
+    mode: str  # "materialized" | "streaming" | "chunked"
+    num_keys: int
+    num_blocks: int
+    expand_levels: int
+    budget_bytes: int
+    # Model of the peak live selection-attributable bytes for `mode`.
+    selection_bytes_peak: int
+    # Streaming split: expand_levels == cut_levels + chunk_levels and
+    # num_chunks == 2**cut_levels.  For the legacy chunked mode,
+    # chunk_levels carries chunk_expand_levels and cut_levels the path
+    # bits walked per chunk root; num_chunks is a lower bound (the
+    # server re-pads block count to the chunk granule).
+    cut_levels: int = 0
+    chunk_levels: int = 0
+    num_chunks: int = 1
+    # Inner-product tier used inside the streaming scan.
+    ip: str = "jnp"
+
+
+def _pick_streaming_split(num_keys: int, expand_levels: int, budget: int) -> int:
+    """Largest chunk_levels whose modeled peak fits `budget`, else the
+    peak-minimizing split."""
+    feasible = [
+        r
+        for r in range(expand_levels + 1)
+        if streaming_selection_bytes(num_keys, expand_levels - r, r) <= budget
+    ]
+    if feasible:
+        return max(feasible)
+    return min(
+        range(expand_levels + 1),
+        key=lambda r: streaming_selection_bytes(num_keys, expand_levels - r, r),
+    )
+
+
+def plan_dense_serving(
+    *,
+    num_keys: int,
+    num_blocks: int,
+    expand_levels: int,
+    serving_bitrev: bool = False,
+    backend: str | None = None,
+    budget_bytes: int | None = None,
+    force_ip: str | None = None,
+) -> ServingPlan:
+    """Choose the serving mode and its parameters for one batch.
+
+    ``serving_bitrev`` says whether the materialized path would expand
+    the full padded domain (bitrev staging: ``2**expand_levels``
+    blocks) or truncate to ``num_blocks``; it sets the materialized
+    byte cost, not streaming applicability.
+    """
+    budget = selection_budget_bytes() if budget_bytes is None else budget_bytes
+    mode = streaming_mode()
+    streaming_ok = (
+        mode != "0" and expand_levels > 0 and (1 << expand_levels) >= num_blocks
+    )
+    eff_blocks = (1 << expand_levels) if serving_bitrev else num_blocks
+    mat_bytes = materialized_selection_bytes(num_keys, eff_blocks)
+    over_budget = mat_bytes > budget and expand_levels > 0
+
+    common = dict(
+        num_keys=num_keys,
+        num_blocks=num_blocks,
+        expand_levels=expand_levels,
+        budget_bytes=budget,
+    )
+    if streaming_ok and (over_budget or mode == "1"):
+        chunk_levels = _pick_streaming_split(num_keys, expand_levels, budget)
+        cut_levels = expand_levels - chunk_levels
+        ip = force_ip or streaming_ip(backend)
+        return ServingPlan(
+            mode="streaming",
+            selection_bytes_peak=streaming_selection_bytes(
+                num_keys, cut_levels, chunk_levels
+            ),
+            cut_levels=cut_levels,
+            chunk_levels=chunk_levels,
+            num_chunks=1 << cut_levels,
+            ip=ip,
+            **common,
+        )
+    if over_budget:
+        cel = min(expand_levels, CHUNK_GRANULE_LEVELS)
+        while cel > 0 and chunked_selection_bytes(num_keys, cel) > budget:
+            cel -= 1
+        return ServingPlan(
+            mode="chunked",
+            selection_bytes_peak=chunked_selection_bytes(num_keys, cel),
+            cut_levels=expand_levels - cel,
+            chunk_levels=cel,
+            num_chunks=1 << (expand_levels - cel),
+            **common,
+        )
+    return ServingPlan(
+        mode="materialized",
+        selection_bytes_peak=mat_bytes,
+        **common,
+    )

@@ -26,7 +26,6 @@ inner product.
 from __future__ import annotations
 
 import math
-import os
 import threading
 from typing import Callable, Optional
 
@@ -41,6 +40,7 @@ from .dense_eval import (
     stage_keys,
     stage_keys_walked,
 )
+from .planner import ServingPlan, plan_dense_serving, selection_budget_bytes
 
 # sender(helper_request: PirRequest, while_waiting: Callable[[], None])
 #   -> PirResponse
@@ -210,6 +210,7 @@ class DenseDpfPirServer(DpfPirServer):
         self._sharded_db = None
         self._chunked_db = None
         self._chunked_db_lock = threading.Lock()
+        self._streaming_ip_failed = False
         self._log_domain_size = max(
             0, math.ceil(math.log2(database.size))
         )
@@ -303,53 +304,123 @@ class DenseDpfPirServer(DpfPirServer):
             # smaller than the database): the bitrev staging has no
             # zero-extension story there, so serve natural order.
             bitrev = False
-        # The bitrev exit serves an UNTRUNCATED 2^expand_levels-block
-        # tensor (up to ~2x num_blocks); the chunking budget must see
-        # that size, not the natural one.
-        eff_blocks = (1 << self._expand_levels) if bitrev else None
         if self._mesh is not None:
             staged = stage_keys(keys)
             inner_products = self._inner_products_sharded(staged, len(keys))
-        elif self._needs_chunking(len(keys), eff_blocks):
-            staged = stage_keys(keys)
-            inner_products = self._inner_products_chunked(staged, len(keys))
         else:
-            # Walk the shared all-zeros prefix on the host during staging
-            # (sub-ms there vs ~1.4 ms of dispatch-bound device AES per
-            # batch); the device step starts at the expansion root.
-            # DPF_TPU_HOST_WALK=0 restores the on-device walk.
-            staged, device_walk = stage_keys_walked(
-                keys, self._walk_levels
-            )
-            selections = impl(
-                *staged,
-                walk_levels=device_walk,
-                expand_levels=self._expand_levels,
-                num_blocks=self._num_blocks,
-                **({"bitrev_leaves": True} if bitrev else {}),
-            )
-            inner_products = self._database.inner_product_with(
-                selections, bitrev_blocks=bitrev
-            )
+            plan = self._plan_serving(len(keys), bitrev)
+            if plan.mode == "streaming":
+                inner_products = self._inner_products_streaming(
+                    plan, keys
+                )
+            elif plan.mode == "chunked":
+                staged = stage_keys(keys)
+                inner_products = self._inner_products_chunked(
+                    staged, len(keys), plan
+                )
+            else:
+                # Walk the shared all-zeros prefix on the host during
+                # staging (sub-ms there vs ~1.4 ms of dispatch-bound
+                # device AES per batch); the device step starts at the
+                # expansion root. DPF_TPU_HOST_WALK=0 restores the
+                # on-device walk.
+                staged, device_walk = stage_keys_walked(
+                    keys, self._walk_levels
+                )
+                selections = impl(
+                    *staged,
+                    walk_levels=device_walk,
+                    expand_levels=self._expand_levels,
+                    num_blocks=self._num_blocks,
+                    **({"bitrev_leaves": True} if bitrev else {}),
+                )
+                inner_products = self._database.inner_product_with(
+                    selections, bitrev_blocks=bitrev
+                )
         return messages.PirResponse(
             dpf_pir_response=messages.DpfPirResponse(
                 masked_response=inner_products
             )
         )
 
-    # -- chunked serving (selection tensor larger than the HBM budget) -------
+    # -- over-budget serving (selection tensor larger than the HBM budget) ---
+
+    def _plan_serving(self, num_keys: int, bitrev: bool) -> ServingPlan:
+        """One planner call decides materialized / streaming / chunked
+        and the streaming cut/chunk split (see `planner.py` for the HBM
+        budget model). A remembered streaming inner-product failure
+        (e.g. a Mosaic compile crash) demotes the scan tier to jnp for
+        the rest of the process."""
+        import jax
+
+        plan = plan_dense_serving(
+            num_keys=num_keys,
+            num_blocks=self._num_blocks,
+            expand_levels=self._expand_levels,
+            serving_bitrev=bitrev,
+            backend=jax.default_backend(),
+        )
+        if plan.mode == "streaming" and self._streaming_ip_failed:
+            import dataclasses
+
+            plan = dataclasses.replace(plan, ip="jnp")
+        return plan
 
     def _selection_budget_bytes(self) -> int:
-        return int(
-            os.environ.get("DPF_TPU_SELECTION_BYTES_BUDGET", 1 << 30)
-        )
+        return selection_budget_bytes()
 
     def _needs_chunking(self, num_keys: int, blocks: int = None) -> bool:
+        """Whether a batch of `num_keys` exceeds the materialized HBM
+        budget (planner-backed shim; the planner also picks WHICH
+        over-budget mode serves it)."""
         if blocks is None:
             blocks = self._num_blocks
         return (
             num_keys * blocks * 16 > self._selection_budget_bytes()
             and self._expand_levels > 0
+        )
+
+    def _inner_products_streaming(self, plan: ServingPlan, keys):
+        """Serve via the fused streaming scan: tail expansion and XOR
+        inner product per chunk, no materialized selection matrix
+        (`dense_eval_planes_v2.streaming_pir_inner_products_v2`)."""
+        import numpy as np
+
+        from .dense_eval_planes_v2 import streaming_pir_inner_products_v2
+
+        num_keys = len(keys)
+        staged, device_walk = stage_keys_walked(keys, self._walk_levels)
+
+        def run(ip: str):
+            db_chunks = self._database.streaming_chunks(
+                cut_levels=plan.cut_levels, bitmajor=(ip == "pallas2")
+            )
+            return np.asarray(
+                streaming_pir_inner_products_v2(
+                    *staged,
+                    db_chunks,
+                    walk_levels=device_walk,
+                    cut_levels=plan.cut_levels,
+                    chunk_levels=plan.chunk_levels,
+                    ip=ip,
+                )
+            )
+
+        try:
+            out = run(plan.ip)
+        except Exception as e:  # noqa: BLE001 - demote the scan tier once
+            if plan.ip == "jnp":
+                raise
+            self._streaming_ip_failed = True
+            import warnings
+
+            warnings.warn(
+                f"streaming {plan.ip} inner product failed; falling back "
+                f"to the jnp scan tier ({str(e).splitlines()[0][:200]})"
+            )
+            out = run("jnp")
+        return words_to_record_bytes(
+            out, num_keys, self._database.max_value_size
         )
 
     # Chunk-granule cap: the chunked database is padded to a multiple of
@@ -378,9 +449,13 @@ class DenseDpfPirServer(DpfPirServer):
                 self._chunked_db = (padded_blocks, db)
         return self._chunked_db
 
-    def _inner_products_chunked(self, staged, num_keys: int):
-        """Serve via `chunked_pir_inner_products`: only one chunk's
-        selection blocks are ever live (SURVEY.md §5 long-context mode).
+    def _inner_products_chunked(
+        self, staged, num_keys: int, plan: ServingPlan
+    ):
+        """Serve via the legacy `chunked_pir_inner_products` loop: only
+        one chunk's selection blocks are ever live. Kept for geometries
+        the streaming scan cannot serve (trees that do not cover the
+        padded block count) and for `DPF_TPU_STREAMING=0`.
 
         The budget bounds the live *packed* leaf tensor
         (nq * chunk_blocks * 16 bytes); the inner product itself runs
@@ -392,10 +467,10 @@ class DenseDpfPirServer(DpfPirServer):
         from .dense_eval import chunked_pir_inner_products
 
         padded_blocks, db = self._chunked_database()
-        budget = self._selection_budget_bytes()
-        cel = min(self._expand_levels, self._CHUNK_GRANULE_LEVELS)
-        while cel > 0 and num_keys * (1 << cel) * 16 > budget:
-            cel -= 1
+        # The planner caps chunk_expand_levels by budget and granule;
+        # the chunk count re-derives from the granule-padded block
+        # count (plan.num_chunks is the unpadded lower bound).
+        cel = min(plan.chunk_levels, self._CHUNK_GRANULE_LEVELS)
         chunk_bits = self._expand_levels - cel
         num_chunks = padded_blocks >> cel
 

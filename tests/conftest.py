@@ -91,4 +91,7 @@ def _small_selfcheck_shapes(monkeypatch):
     monkeypatch.setattr(
         dep, "_TAIL_SELFCHECK_SHAPE", dict(g0=32, nk=64, r=2, tile=16)
     )
+    monkeypatch.setattr(
+        dep, "_TAIL_HIER_SELFCHECK_SHAPE", dict(g0=32, r=2, tile=16)
+    )
     yield
