@@ -18,6 +18,8 @@ test-fast:     ## ~8 min hermetic signal incl. core invariants + tiny Pallas
 	    tests/test_native.py tests/test_native_cuckoo.py \
 	    tests/test_testing_utils.py tests/test_demo.py \
 	    tests/test_core_fast.py \
+	    tests/test_serving_batcher.py tests/test_serving_transport.py \
+	    tests/test_serving_service.py \
 	    tests/test_pallas_fast.py tests/test_bench_ladder.py -q
 
 protos:        ## regenerate *_pb2.py from protos/*.proto

@@ -98,9 +98,11 @@ def _metric_name():
 
 
 def _default_metric_unit():
-    # BENCH_ONLY_NSLEAF runs report the secondary metric's shape from
-    # every emitter — including the watchdog thread — so the tee'd file
-    # never mixes metric shapes.
+    # BENCH_ONLY_NSLEAF / BENCH_SERVING runs report their own metric
+    # shape from every emitter — including the watchdog thread — so the
+    # tee'd file never mixes metric shapes.
+    if os.environ.get("BENCH_SERVING", "") == "1":
+        return "serving_closed_loop_queries_per_sec", "queries/s"
     if os.environ.get("BENCH_ONLY_NSLEAF", "") == "1":
         ld = _nsleaf_ld()
         return f"dpf_full_domain_eval_ns_per_leaf_ld{ld}_u64", "ns/leaf"
@@ -528,6 +530,36 @@ def main():
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
+
+    if os.environ.get("BENCH_SERVING", "") == "1":
+        # Closed-loop serving benchmark (BENCH_SERVING=1): drive the
+        # serving/ runtime's dynamic batcher against the serialized
+        # one-request-at-a-time baseline and emit ONE JSON line in the
+        # headline format; vs_baseline is the batched/unbatched speedup.
+        # Runs before _ensure_backend: it is a CPU-scale sweep
+        # (BENCH_PLATFORM=cpu is the intended setting) and must not
+        # depend on the TPU tunnel.
+        _PROGRESS["stage"] = "serving-bench"
+        try:
+            from benchmarks.serving_bench import run_serving_bench
+
+            report = run_serving_bench()
+            best = report["best_batched_qps"]
+            base = report["best_unbatched_qps"]
+            _emit(
+                best,
+                (best / base) if base else 0.0,
+                error=None
+                if report["correctness_ok"]
+                else "batched responses diverged from the unbatched oracle",
+            )
+        except Exception as e:  # noqa: BLE001 - the JSON line must print
+            _emit(
+                0.0, 0.0,
+                error=f"serving bench failed: "
+                f"{str(e).splitlines()[0][:200]}",
+            )
+        return
 
     # Pre-warm the backend BEFORE building the 256MB host database, with
     # retries; on failure emit the JSON line instead of crashing. The

@@ -1,0 +1,263 @@
+"""Closed-loop serving benchmark: dynamic batcher vs serialized requests.
+
+Drives a `serving.PlainSession` with a closed-loop offered-load sweep —
+`c` client threads, each issuing its next request the moment the previous
+one returns — at several concurrency levels, in two modes:
+
+* **batched**: the session's `DynamicBatcher` coalesces concurrent
+  requests into padded power-of-two key batches (the serving/ tentpole);
+* **unbatched**: the same session class with `batching=False`, so every
+  request pays its own `handle_plain_request` device step — the
+  one-request-at-a-time baseline.
+
+Every response is compared bit-for-bit against an oracle computed
+upfront by a direct (no serving runtime) `DenseDpfPirServer`, so the
+throughput claim carries an equal-correctness proof in the same run.
+The report includes the batched session's full metrics export — batch
+size histogram, padding waste, and the jit bucket compile/hit counters
+that demonstrate the bounded-compilation property.
+
+Run directly (one JSON report on stdout, also written to
+``benchmarks/results/serving_bench.json``)::
+
+    JAX_PLATFORMS=cpu python -m benchmarks.serving_bench
+
+or through the headline harness (one bench-style JSON line)::
+
+    BENCH_SERVING=1 BENCH_PLATFORM=cpu python bench.py
+
+Environment knobs: SERVING_BENCH_RECORDS (default 2048),
+SERVING_BENCH_RECORD_BYTES (32), SERVING_BENCH_CONCURRENCY ("1,4,16"),
+SERVING_BENCH_REQUESTS (total closed-loop requests per sweep point,
+default 64), SERVING_BENCH_MAX_BATCH (16), SERVING_BENCH_OUT (report
+path; empty string disables the file).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+
+def _log(msg: str) -> None:
+    print(f"[serving-bench {time.strftime('%H:%M:%S')}] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def _closed_loop(handle, requests, concurrency):
+    """Run `requests` through `handle` from `concurrency` closed-loop
+    client threads; returns (wall_seconds, latencies_ms, responses)."""
+    next_idx = [0]
+    lock = threading.Lock()
+    latencies = [0.0] * len(requests)
+    responses = [None] * len(requests)
+    errors = []
+
+    def client():
+        while True:
+            with lock:
+                i = next_idx[0]
+                if i >= len(requests):
+                    return
+                next_idx[0] = i + 1
+            t0 = time.perf_counter()
+            try:
+                responses[i] = handle(requests[i])
+            except Exception as e:  # noqa: BLE001 - collected, not raised
+                with lock:
+                    errors.append(f"request {i}: {e}")
+                return
+            latencies[i] = (time.perf_counter() - t0) * 1e3
+    threads = [
+        threading.Thread(target=client, name=f"closed-loop-{t}")
+        for t in range(concurrency)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise RuntimeError("; ".join(errors[:3]))
+    return wall, latencies, responses
+
+
+def run_serving_bench():
+    """Build the database, sweep (mode x concurrency), return the report
+    dict (also written to SERVING_BENCH_OUT unless empty)."""
+    from distributed_point_functions_tpu.pir import messages
+    from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
+    from distributed_point_functions_tpu.pir.database import (
+        DenseDpfPirDatabase,
+    )
+    from distributed_point_functions_tpu.pir.server import DenseDpfPirServer
+    from distributed_point_functions_tpu.serving import (
+        PlainSession,
+        ServingConfig,
+        bucket_size,
+    )
+
+    num_records = int(os.environ.get("SERVING_BENCH_RECORDS", 2048))
+    record_bytes = int(os.environ.get("SERVING_BENCH_RECORD_BYTES", 32))
+    num_requests = int(os.environ.get("SERVING_BENCH_REQUESTS", 64))
+    max_batch = int(os.environ.get("SERVING_BENCH_MAX_BATCH", 16))
+    concurrency_levels = [
+        int(c)
+        for c in os.environ.get("SERVING_BENCH_CONCURRENCY", "1,4,16")
+        .split(",")
+        if c.strip()
+    ]
+
+    _log(
+        f"database: {num_records} x {record_bytes}B, "
+        f"{num_requests} requests/point, max_batch={max_batch}, "
+        f"concurrency sweep {concurrency_levels}"
+    )
+    builder = DenseDpfPirDatabase.Builder()
+    for i in range(num_records):
+        builder.insert(
+            (b"serve-%06d:" % i).ljust(record_bytes, b".")[:record_bytes]
+        )
+    database = builder.build()
+
+    # Request pool: one single-key plain request per closed-loop request,
+    # generated up front so key generation never sits inside the timed
+    # loop. The oracle answers each request alone on a bare server — the
+    # ground truth both modes must match bit-for-bit.
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+    requests = [
+        client.create_plain_requests([int(i)])[0]
+        for i in rng.integers(0, num_records, num_requests)
+    ]
+    oracle_server = DenseDpfPirServer.create_plain(database)
+    _log("computing oracle responses (and warming per-shape jit entries)")
+    t0 = time.perf_counter()
+    oracle = [
+        oracle_server.handle_plain_request(r).dpf_pir_response.masked_response
+        for r in requests
+    ]
+    # Warm every power-of-two bucket the batcher can form, so the sweep
+    # measures steady-state serving rather than first-shape compiles (the
+    # module-level jit cache is shared across server instances).
+    b = 1
+    while b <= max_batch:
+        oracle_server.handle_plain_request(
+            messages.PirRequest(
+                plain_request=messages.PlainRequest(
+                    dpf_keys=list(requests[0].plain_request.dpf_keys) * b
+                )
+            )
+        )
+        b *= 2
+    _log(f"oracle + warmup done in {time.perf_counter() - t0:.1f}s")
+
+    def sweep_mode(batching):
+        config = ServingConfig(
+            max_batch_size=max_batch,
+            max_wait_ms=2.0,
+            max_queue=max(256, 4 * num_requests),
+            batching=batching,
+        )
+        points = []
+        with PlainSession(database, config) as session:
+            for concurrency in concurrency_levels:
+                wall, lats, resps = _closed_loop(
+                    session.handle_request, requests, concurrency
+                )
+                mismatches = sum(
+                    1
+                    for got, want in zip(resps, oracle)
+                    if got.dpf_pir_response.masked_response != want
+                )
+                lats.sort()
+                qps = len(requests) / wall
+                points.append({
+                    "mode": "batched" if batching else "unbatched",
+                    "concurrency": concurrency,
+                    "qps": round(qps, 2),
+                    "wall_s": round(wall, 3),
+                    "p50_ms": round(_percentile(lats, 0.50), 3),
+                    "p95_ms": round(_percentile(lats, 0.95), 3),
+                    "p99_ms": round(_percentile(lats, 0.99), 3),
+                    "mismatches": mismatches,
+                })
+                _log(
+                    f"{points[-1]['mode']:>9} c={concurrency:<3} "
+                    f"{qps:8.1f} q/s  p50 {points[-1]['p50_ms']:.1f} ms  "
+                    f"p95 {points[-1]['p95_ms']:.1f} ms  "
+                    f"mismatches={mismatches}"
+                )
+            metrics = session.metrics.export()
+        return points, metrics
+
+    unbatched_points, _ = sweep_mode(batching=False)
+    batched_points, batched_metrics = sweep_mode(batching=True)
+
+    best_batched = max(p["qps"] for p in batched_points)
+    best_unbatched = max(p["qps"] for p in unbatched_points)
+    correctness_ok = all(
+        p["mismatches"] == 0 for p in batched_points + unbatched_points
+    )
+    compiles = batched_metrics["counters"].get(
+        "plain.batcher.jit_bucket_compiles", 0
+    )
+    report = {
+        "config": {
+            "num_records": num_records,
+            "record_bytes": record_bytes,
+            "num_requests": num_requests,
+            "max_batch_size": max_batch,
+            "concurrency_levels": concurrency_levels,
+            "jit_bucket_bound": bucket_size(max_batch).bit_length(),
+        },
+        "sweep": unbatched_points + batched_points,
+        "best_batched_qps": best_batched,
+        "best_unbatched_qps": best_unbatched,
+        "batched_speedup": round(best_batched / best_unbatched, 2)
+        if best_unbatched
+        else None,
+        "correctness_ok": correctness_ok,
+        "jit_bucket_compiles": compiles,
+        "batched_metrics": batched_metrics,
+    }
+    _log(
+        f"best batched {best_batched:.1f} q/s vs unbatched "
+        f"{best_unbatched:.1f} q/s ({report['batched_speedup']}x), "
+        f"{compiles} jit buckets, correctness "
+        f"{'ok' if correctness_ok else 'FAILED'}"
+    )
+
+    out = os.environ.get(
+        "SERVING_BENCH_OUT", "benchmarks/results/serving_bench.json"
+    )
+    if out:
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2)
+        _log(f"report written to {out}")
+    return report
+
+
+def main():
+    report = run_serving_bench()
+    print(json.dumps(report, indent=2))
+    if not report["correctness_ok"]:
+        raise SystemExit("serving bench FAILED correctness")
+
+
+if __name__ == "__main__":
+    main()

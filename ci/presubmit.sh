@@ -3,11 +3,13 @@
 # .bazelci/presubmit.yml:15-34 (two-compiler matrix, benchmark-tagged
 # targets excluded). Stages:
 #   1. lint        — stdlib AST lint (tools/lint.py)
-#   2. protos      — generated *_pb2.py match protos/*.proto
-#   3. native      — C++ oracle kernels build (g++)
-#   4. test-fast   — <5 min hermetic signal tier (incl. tiny-shape
+#   2. layers      — serving -> pir -> ops layer DAG + import-cycle
+#                    check (tools/check_layers.py)
+#   3. protos      — generated *_pb2.py match protos/*.proto
+#   4. native      — C++ oracle kernels build (g++)
+#   5. test-fast   — <5 min hermetic signal tier (incl. tiny-shape
 #                    interpret cases of every serving Pallas kernel)
-#   5. dryrun      — 8-virtual-device multichip compile+step
+#   6. dryrun      — 8-virtual-device multichip compile+step
 # Benchmarks are excluded exactly as the reference excludes
 # `--test_tag_filters=-benchmark`. `FULL=1` appends the whole suite.
 set -u -o pipefail
@@ -21,6 +23,8 @@ stage() {
 }
 
 stage lint python tools/lint.py
+
+stage layers python tools/check_layers.py
 
 stage protoc-check bash -c '
     tmp=$(mktemp -d) &&

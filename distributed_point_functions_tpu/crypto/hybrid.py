@@ -6,8 +6,7 @@ The reference encrypts the helper request with Tink's hybrid encryption
 run real asymmetric encryption from fixed checked-in keysets
 (`pir/testing/encrypt_decrypt.h:29-36`).
 
-This module is the framework's equivalent: an HPKE-style KEM/DEM scheme
-built from the `cryptography` package's primitives —
+This module is the framework's equivalent: an HPKE-style KEM/DEM scheme —
 
   KEM:  X25519 ephemeral-static Diffie-Hellman
   KDF:  HKDF-SHA256, salt = enc || pk_receiver, info = suite id || context
@@ -18,6 +17,11 @@ The scheme is IND-CCA2 in the same sense as Tink's ECIES-AEAD-HKDF: the
 GCM tag authenticates both the payload and the context info, and the
 ephemeral public key is bound into the KDF salt so ciphertexts cannot be
 re-targeted between keys or contexts.
+
+Primitives come from the `cryptography` package when importable;
+otherwise the byte-identical pure-Python/numpy backend in
+`crypto/_fallback.py` takes over (same wire format, same keysets), so
+images without the dependency still run the encrypted protocol.
 
 `HybridEncrypt.__call__` / `HybridDecrypt.__call__` match the seam
 signature ``(data: bytes, context_info: bytes) -> bytes`` used by
@@ -30,13 +34,20 @@ from __future__ import annotations
 import os
 from typing import Tuple
 
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+try:  # pragma: no cover - exercised implicitly by whichever image runs
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+    BACKEND = "cryptography"
+except ImportError:  # pragma: no cover
+    from . import _fallback
+
+    BACKEND = "fallback"
 
 _SUITE_ID = b"dpf-tpu-hybrid-v1:X25519+HKDF-SHA256+AES-128-GCM"
 _ENC_LEN = 32  # X25519 public key
@@ -44,40 +55,68 @@ _NONCE_LEN = 12
 _KEY_LEN = 16  # AES-128
 
 
+# -- backend seam: raw-bytes X25519 / HKDF / AES-GCM ------------------------
+
+if BACKEND == "cryptography":
+
+    def _public_key(private_bytes: bytes) -> bytes:
+        pk = X25519PrivateKey.from_private_bytes(private_bytes).public_key()
+        return pk.public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+
+    def _exchange(private_bytes: bytes, public_bytes: bytes) -> bytes:
+        return X25519PrivateKey.from_private_bytes(private_bytes).exchange(
+            X25519PublicKey.from_public_bytes(public_bytes)
+        )
+
+    def _hkdf(secret: bytes, salt: bytes, info: bytes) -> bytes:
+        return HKDF(
+            algorithm=hashes.SHA256(), length=_KEY_LEN, salt=salt, info=info
+        ).derive(secret)
+
+    def _gcm_encrypt(key, nonce, plaintext, aad) -> bytes:
+        return AESGCM(key).encrypt(nonce, plaintext, aad)
+
+    def _gcm_decrypt(key, nonce, data, aad) -> bytes:
+        return AESGCM(key).decrypt(nonce, data, aad)
+
+else:
+
+    def _public_key(private_bytes: bytes) -> bytes:
+        return _fallback.x25519_public(private_bytes)
+
+    def _exchange(private_bytes: bytes, public_bytes: bytes) -> bytes:
+        return _fallback.x25519(private_bytes, public_bytes)
+
+    def _hkdf(secret: bytes, salt: bytes, info: bytes) -> bytes:
+        return _fallback.hkdf_sha256(secret, salt, info, _KEY_LEN)
+
+    def _gcm_encrypt(key, nonce, plaintext, aad) -> bytes:
+        return _fallback.AesGcm(key).encrypt(nonce, plaintext, aad)
+
+    def _gcm_decrypt(key, nonce, data, aad) -> bytes:
+        return _fallback.AesGcm(key).decrypt(nonce, data, aad)
+
+
 def generate_keypair() -> Tuple[bytes, bytes]:
     """Returns ``(private_bytes, public_bytes)``, each 32 raw bytes."""
-    sk = X25519PrivateKey.generate()
-    return _private_bytes(sk), _public_bytes(sk.public_key())
+    sk = os.urandom(_ENC_LEN)
+    return sk, _public_key(sk)
 
 
 def keypair_from_private_bytes(private_bytes: bytes) -> Tuple[bytes, bytes]:
-    sk = X25519PrivateKey.from_private_bytes(private_bytes)
-    return private_bytes, _public_bytes(sk.public_key())
-
-
-def _private_bytes(sk: X25519PrivateKey) -> bytes:
-    return sk.private_bytes(
-        serialization.Encoding.Raw,
-        serialization.PrivateFormat.Raw,
-        serialization.NoEncryption(),
-    )
-
-
-def _public_bytes(pk: X25519PublicKey) -> bytes:
-    return pk.public_bytes(
-        serialization.Encoding.Raw, serialization.PublicFormat.Raw
-    )
+    return private_bytes, _public_key(private_bytes)
 
 
 def _derive_key(
     shared_secret: bytes, enc: bytes, receiver_pk: bytes, context_info: bytes
 ) -> bytes:
-    return HKDF(
-        algorithm=hashes.SHA256(),
-        length=_KEY_LEN,
+    return _hkdf(
+        shared_secret,
         salt=enc + receiver_pk,
         info=_SUITE_ID + b"|" + context_info,
-    ).derive(shared_secret)
+    )
 
 
 class HybridEncrypt:
@@ -89,16 +128,18 @@ class HybridEncrypt:
                 f"receiver public key must be {_ENC_LEN} raw bytes"
             )
         self._pk_bytes = bytes(receiver_public_bytes)
-        self._pk = X25519PublicKey.from_public_bytes(self._pk_bytes)
 
     def __call__(self, plaintext: bytes, context_info: bytes = b"") -> bytes:
-        eph = X25519PrivateKey.generate()
-        enc = _public_bytes(eph.public_key())
+        eph_sk = os.urandom(_ENC_LEN)
+        enc = _public_key(eph_sk)
         key = _derive_key(
-            eph.exchange(self._pk), enc, self._pk_bytes, context_info
+            _exchange(eph_sk, self._pk_bytes),
+            enc,
+            self._pk_bytes,
+            context_info,
         )
         nonce = os.urandom(_NONCE_LEN)
-        ct = AESGCM(key).encrypt(nonce, plaintext, context_info)
+        ct = _gcm_encrypt(key, nonce, plaintext, context_info)
         return enc + nonce + ct
 
 
@@ -110,8 +151,8 @@ class HybridDecrypt:
             raise ValueError(
                 f"receiver private key must be {_ENC_LEN} raw bytes"
             )
-        self._sk = X25519PrivateKey.from_private_bytes(receiver_private_bytes)
-        self._pk_bytes = _public_bytes(self._sk.public_key())
+        self._sk_bytes = bytes(receiver_private_bytes)
+        self._pk_bytes = _public_key(self._sk_bytes)
 
     @property
     def public_bytes(self) -> bytes:
@@ -123,6 +164,6 @@ class HybridDecrypt:
         enc = ciphertext[:_ENC_LEN]
         nonce = ciphertext[_ENC_LEN : _ENC_LEN + _NONCE_LEN]
         body = ciphertext[_ENC_LEN + _NONCE_LEN :]
-        shared = self._sk.exchange(X25519PublicKey.from_public_bytes(enc))
+        shared = _exchange(self._sk_bytes, enc)
         key = _derive_key(shared, enc, self._pk_bytes, context_info)
-        return AESGCM(key).decrypt(nonce, body, context_info)
+        return _gcm_decrypt(key, nonce, body, context_info)

@@ -35,7 +35,6 @@ from ..value_types import XorType
 from . import messages
 from .database import DenseDpfPirDatabase, words_to_record_bytes
 from .dense_eval import (
-    expansion_impl,
     serving_expansion,
     stage_keys,
     stage_keys_walked,
@@ -59,6 +58,7 @@ class DpfPirServer:
         self._sender: Optional[ForwardHelperRequestFn] = None
         self._decrypter: Optional[DecryptHelperRequestFn] = None
         self._encryption_context_info = ENCRYPTION_CONTEXT_INFO
+        self._plain_handler: Optional[Callable] = None
 
     # -- role setup ---------------------------------------------------------
 
@@ -83,6 +83,22 @@ class DpfPirServer:
     def role(self) -> str:
         return self._role
 
+    def set_plain_handler(self, handler: Optional[Callable]) -> None:
+        """Batch-entry hook: route every internal plain evaluation (the
+        plain role's requests, the Leader's own share inside
+        `while_waiting`, the Helper's decrypted request) through
+        `handler(request) -> PirResponse` instead of calling
+        `handle_plain_request` directly. `serving/` installs its dynamic
+        batcher here; `None` restores the direct path. The handler may
+        itself call `handle_plain_request` (the real evaluator) — the
+        hook only intercepts the role-dispatch call sites."""
+        self._plain_handler = handler
+
+    def _dispatch_plain(self, request):
+        if self._plain_handler is not None:
+            return self._plain_handler(request)
+        return self.handle_plain_request(request)
+
     def get_public_params(self):
         """`PirServerPublicParams` proto to send to a client before any
         queries (`pir/pir_server.h:31`, `dense_dpf_pir_server.cc:87-89`).
@@ -98,7 +114,7 @@ class DpfPirServer:
         self, request: "messages.PirRequest"
     ) -> "messages.PirResponse":
         if self._role == "plain":
-            return self.handle_plain_request(request)
+            return self._dispatch_plain(request)
         if self._role == "leader":
             return self._handle_leader_request(request)
         return self._handle_helper_request(request)
@@ -133,7 +149,7 @@ class DpfPirServer:
 
         def while_waiting():
             try:
-                state["response"] = self.handle_plain_request(plain_request)
+                state["response"] = self._dispatch_plain(plain_request)
             except Exception as e:  # surfaced after the sender returns
                 state["error"] = e
             state["has_run"] = True
@@ -175,7 +191,7 @@ class DpfPirServer:
             self._encryption_context_info,
         )
         inner = self._parse_helper_request(decrypted)
-        response = self.handle_plain_request(
+        response = self._dispatch_plain(
             messages.PirRequest(plain_request=inner.plain_request)
         )
         prng = Aes128CtrSeededPrng(inner.one_time_pad_seed)

@@ -1,0 +1,60 @@
+"""Production Leader/Helper serving runtime.
+
+Promotes the two-server deployment model from a demo script into a
+subsystem: dynamic shape-bucketed batching (`batcher`), session objects
+with deadlines, Helper retry, and degradation (`service`), reusable
+framed transports (`transport`), and a dependency-free metrics registry
+(`metrics`). Layering: serving -> pir -> ops, never the reverse
+(enforced by `tools/check_layers.py` in presubmit).
+"""
+
+from .batcher import (
+    DeadlineExceeded,
+    DynamicBatcher,
+    Overloaded,
+    bucket_size,
+)
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .service import (
+    HelperSession,
+    HelperUnavailable,
+    LeaderSession,
+    PlainSession,
+    ServingConfig,
+)
+from .transport import (
+    FramedTcpServer,
+    InProcessTransport,
+    TcpTransport,
+    Transport,
+    TransportError,
+    TransportTimeout,
+    parse_hostport,
+    recv_msg,
+    send_msg,
+)
+
+__all__ = [
+    "Counter",
+    "DeadlineExceeded",
+    "DynamicBatcher",
+    "FramedTcpServer",
+    "Gauge",
+    "HelperSession",
+    "HelperUnavailable",
+    "Histogram",
+    "InProcessTransport",
+    "LeaderSession",
+    "MetricsRegistry",
+    "Overloaded",
+    "PlainSession",
+    "ServingConfig",
+    "TcpTransport",
+    "Transport",
+    "TransportError",
+    "TransportTimeout",
+    "bucket_size",
+    "parse_hostport",
+    "recv_msg",
+    "send_msg",
+]

@@ -1,0 +1,259 @@
+"""Thread-safe dynamic batcher: coalesce concurrent PIR requests.
+
+The TPU serving cost model (PR 1's planner/streaming pipeline, and
+BBCGGI arXiv:2012.14884 before it) is dominated by batched DPF
+evaluation: throughput scales with the number of keys a single device
+step evaluates, while a one-key step pays the whole dispatch cost.
+Nothing in the library formed those batches — every caller of
+`handle_plain_request` paid its own device step. This batcher is the
+missing piece:
+
+* Concurrent `submit(keys)` calls coalesce into one evaluation of their
+  concatenated keys. A batch closes when it holds `max_batch_size` keys
+  or `max_wait_ms` after its first request arrived, whichever is first.
+* The batch's key count pads up to a **power-of-two bucket** (duplicate
+  of the first key; its result is discarded). Every jitted program in
+  the serving path specializes on `num_keys`, so bucketing bounds the
+  number of distinct compilations at `log2(max_batch_size)+1` instead
+  of one per observed arrival pattern — each bucket hits an existing
+  jit/planner cache entry.
+* Admission is a **bounded queue**: when `max_queue` requests are
+  already waiting, `submit` sheds load with `Overloaded` instead of
+  growing an unbounded backlog.
+* Requests carry an optional absolute **deadline** (`time.monotonic()`
+  seconds). The worker drops expired requests while forming a batch —
+  they fail with `DeadlineExceeded` *without evaluating* — and the
+  submitting thread enforces the same deadline on its wait.
+
+The batcher is generic over the evaluation function
+(`evaluate(keys) -> list of per-key results`), so it serves any of the
+server roles (and unit tests run it on stubs with no JAX at all).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional, Sequence
+
+from .metrics import MetricsRegistry
+
+
+class Overloaded(RuntimeError):
+    """Admission queue full: the request was shed, not enqueued."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline passed before its batch was evaluated."""
+
+
+def bucket_size(num_keys: int) -> int:
+    """Smallest power of two >= num_keys (the jit-shape bucket)."""
+    if num_keys <= 0:
+        raise ValueError("num_keys must be positive")
+    return 1 << (num_keys - 1).bit_length()
+
+
+class _Pending:
+    __slots__ = (
+        "keys", "deadline", "event", "result", "error", "t0", "abandoned"
+    )
+
+    def __init__(self, keys, deadline):
+        self.keys = keys
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.t0 = time.monotonic()
+        self.abandoned = False
+
+
+class DynamicBatcher:
+    """See module docstring. One background worker forms and evaluates
+    batches; `submit` blocks the calling thread until its slice of the
+    batch result is ready (or raises `Overloaded` / `DeadlineExceeded` /
+    the evaluation error)."""
+
+    def __init__(
+        self,
+        evaluate: Callable[[List], List],
+        *,
+        max_batch_size: int = 64,
+        max_wait_ms: float = 2.0,
+        max_queue: int = 256,
+        metrics: Optional[MetricsRegistry] = None,
+        name: str = "batcher",
+    ):
+        if max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        self._evaluate = evaluate
+        self._max_batch_size = max_batch_size
+        self._max_wait_s = max(0.0, max_wait_ms) / 1e3
+        self._max_queue = max_queue
+        self._name = name
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        m, n = self.metrics, name
+        self._c_submitted = m.counter(f"{n}.requests_submitted")
+        self._c_shed = m.counter(f"{n}.requests_shed")
+        self._c_deadline = m.counter(f"{n}.requests_deadline_exceeded")
+        self._c_batches = m.counter(f"{n}.batches")
+        self._c_pad = m.counter(f"{n}.padded_keys")
+        self._c_compiles = m.counter(f"{n}.jit_bucket_compiles")
+        self._c_hits = m.counter(f"{n}.jit_bucket_hits")
+        self._g_depth = m.gauge(f"{n}.queue_depth")
+        self._h_batch = m.histogram(
+            f"{n}.batch_keys", buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256)
+        )
+        self._h_latency = m.histogram(f"{n}.request_latency_ms")
+        self._cond = threading.Condition()
+        self._queue: deque = deque()
+        self._seen_buckets: set = set()
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._run, daemon=True, name=f"{name}-worker"
+        )
+        self._worker.start()
+
+    # -- client side --------------------------------------------------------
+
+    def submit(
+        self, keys: Sequence, deadline: Optional[float] = None
+    ) -> List:
+        """Evaluate `keys` as part of a coalesced batch; returns one
+        result per key, in order. `deadline` is absolute
+        `time.monotonic()` seconds."""
+        keys = list(keys)
+        if not keys:
+            raise ValueError("keys must not be empty")
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if len(self._queue) >= self._max_queue:
+                self._c_shed.inc()
+                raise Overloaded(
+                    f"{self._name}: admission queue full "
+                    f"({self._max_queue} requests waiting)"
+                )
+            pending = _Pending(keys, deadline)
+            self._queue.append(pending)
+            self._g_depth.set(len(self._queue))
+            self._c_submitted.inc()
+            self._cond.notify()
+        timeout = (
+            None if deadline is None
+            else max(0.0, deadline - time.monotonic())
+        )
+        if not pending.event.wait(timeout):
+            with self._cond:
+                pending.abandoned = True
+            # The worker may complete it concurrently; deadline still wins.
+            if not pending.event.is_set() or pending.error is not None:
+                self._c_deadline.inc()
+                raise DeadlineExceeded(
+                    f"{self._name}: deadline passed after "
+                    f"{(time.monotonic() - pending.t0) * 1e3:.1f} ms in queue"
+                )
+        if pending.error is not None:
+            raise pending.error
+        return pending.result
+
+    # -- worker -------------------------------------------------------------
+
+    def _collect(self) -> Optional[List[_Pending]]:
+        """Block for the first request, then fill the batch until
+        `max_batch_size` keys or `max_wait_ms` elapse. Returns None only
+        at shutdown with an empty queue."""
+        with self._cond:
+            while not self._queue:
+                if self._closed:
+                    return None
+                self._cond.wait()
+            batch = [self._queue.popleft()]
+            num_keys = len(batch[0].keys)
+            close_at = time.monotonic() + self._max_wait_s
+            while num_keys < self._max_batch_size:
+                if self._queue:
+                    nxt = self._queue[0]
+                    if num_keys + len(nxt.keys) > self._max_batch_size:
+                        break
+                    self._queue.popleft()
+                    batch.append(nxt)
+                    num_keys += len(nxt.keys)
+                    continue
+                remaining = close_at - time.monotonic()
+                if remaining <= 0 or self._closed:
+                    break
+                self._cond.wait(remaining)
+            self._g_depth.set(len(self._queue))
+        return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            now = time.monotonic()
+            live = []
+            for p in batch:
+                if p.abandoned or (
+                    p.deadline is not None and now > p.deadline
+                ):
+                    # Dropped unevaluated; the submitter raises
+                    # DeadlineExceeded (and counts it) on its side.
+                    p.error = DeadlineExceeded("expired in queue")
+                    p.event.set()
+                    continue
+                live.append(p)
+            if not live:
+                continue
+            flat = [k for p in live for k in p.keys]
+            bucket = bucket_size(len(flat))
+            padded = flat + [flat[0]] * (bucket - len(flat))
+            if bucket in self._seen_buckets:
+                self._c_hits.inc()
+            else:
+                self._seen_buckets.add(bucket)
+                self._c_compiles.inc()
+            self._c_batches.inc()
+            self._c_pad.inc(bucket - len(flat))
+            self._h_batch.observe(len(flat))
+            try:
+                with self.metrics.timed(f"{self._name}.evaluate_ms"):
+                    results = list(self._evaluate(padded))
+                if len(results) < len(flat):
+                    raise RuntimeError(
+                        f"evaluate returned {len(results)} results for "
+                        f"{len(flat)} keys"
+                    )
+            except Exception as e:  # noqa: BLE001 - fan the error out
+                for p in live:
+                    p.error = e
+                    p.event.set()
+                continue
+            offset = 0
+            done = time.monotonic()
+            for p in live:
+                p.result = results[offset:offset + len(p.keys)]
+                offset += len(p.keys)
+                self._h_latency.observe((done - p.t0) * 1e3)
+                p.event.set()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Drain the queue, then stop the worker. Subsequent submits
+        raise."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._worker.join(timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,190 @@
+"""Dependency-free serving metrics: counters, gauges, histograms.
+
+The serving runtime needs observability (queue depth, batch-size
+distribution, padding waste, jit-cache hits vs. recompiles, Helper
+retry/timeout counts, latency percentiles) without pulling a metrics
+client into the image. This registry is the stdlib answer: thread-safe
+instruments keyed by name, exported as one plain dict so any caller —
+the closed-loop bench, a debug endpoint, a log line — can serialize it.
+
+Timed regions double as profiler annotations: `registry.timed(name)`
+wraps the block in `utils.profiling.annotate(name)` (a named TraceAnnotation
+inside an active xprof trace) *and* records the wall-clock milliseconds
+into the `name` histogram, so the same instrumentation feeds both the
+metrics dict and a device trace.
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import contextlib
+import threading
+import time
+from typing import Dict, Optional, Sequence
+
+from ..utils.profiling import annotate
+
+# Default latency bucket upper bounds, in milliseconds.
+DEFAULT_BUCKETS_MS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+    250.0, 500.0, 1000.0, 2500.0, 10000.0,
+)
+
+# Bounded reservoir per histogram: enough samples for stable p99 at
+# serving rates without unbounded growth on long-lived processes.
+_RESERVOIR = 8192
+
+
+class Counter:
+    """Monotonic counter."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Point-in-time value (queue depth, in-flight requests)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Cumulative bucket counts plus a bounded sample reservoir.
+
+    Buckets give the exported dict a stable, mergeable shape; the
+    reservoir (most recent `_RESERVOIR` observations) gives exact
+    percentiles at serving horizons.
+    """
+
+    def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS_MS):
+        self._lock = threading.Lock()
+        self._bounds = tuple(sorted(buckets))
+        self._counts = [0] * (len(self._bounds) + 1)  # +inf overflow
+        self._samples = collections.deque(maxlen=_RESERVOIR)
+        self._count = 0
+        self._sum = 0.0
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._counts[bisect.bisect_left(self._bounds, v)] += 1
+            self._samples.append(v)
+            self._count += 1
+            self._sum += v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Exact percentile over the reservoir; None with no samples."""
+        with self._lock:
+            if not self._samples:
+                return None
+            ordered = sorted(self._samples)
+        rank = min(len(ordered) - 1, max(0, round(p / 100 * (len(ordered) - 1))))
+        return ordered[rank]
+
+    def export(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            count, total = self._count, self._sum
+            samples = sorted(self._samples)
+
+        def pct(p):
+            if not samples:
+                return None
+            i = min(len(samples) - 1, max(0, round(p / 100 * (len(samples) - 1))))
+            return round(samples[i], 4)
+
+        return {
+            "count": count,
+            "sum": round(total, 4),
+            "mean": round(total / count, 4) if count else None,
+            "p50": pct(50),
+            "p95": pct(95),
+            "p99": pct(99),
+            "max": round(samples[-1], 4) if samples else None,
+            "buckets": {
+                **{str(b): c for b, c in zip(self._bounds, counts)},
+                "+inf": counts[-1],
+            },
+        }
+
+
+class MetricsRegistry:
+    """Named instruments, created on first use, exported as one dict."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            return self._gauges.setdefault(name, Gauge())
+
+    def histogram(
+        self, name: str, buckets: Sequence[float] = DEFAULT_BUCKETS_MS
+    ) -> Histogram:
+        with self._lock:
+            return self._histograms.setdefault(name, Histogram(buckets))
+
+    @contextlib.contextmanager
+    def timed(self, name: str):
+        """Time the block into histogram `name` (ms) inside a profiler
+        annotation of the same name."""
+        hist = self.histogram(name)
+        t0 = time.perf_counter()
+        with annotate(name):
+            try:
+                yield
+            finally:
+                hist.observe((time.perf_counter() - t0) * 1e3)
+
+    def export(self) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {
+            "counters": {k: c.value for k, c in sorted(counters.items())},
+            "gauges": {k: g.value for k, g in sorted(gauges.items())},
+            "histograms": {
+                k: h.export() for k, h in sorted(histograms.items())
+            },
+        }

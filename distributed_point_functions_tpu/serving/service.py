@@ -1,0 +1,344 @@
+"""Leader/Helper/Plain serving sessions: the production runtime roles.
+
+`pir/server.py` implements the protocol roles with injected transport
+and crypto callbacks; these sessions wrap them into deployable objects:
+
+* every plain evaluation (a plain-role request, the Leader's own share,
+  the Helper's decrypted request) routes through one `DynamicBatcher`
+  per session via the server's `set_plain_handler` hook, so concurrent
+  requests share device steps and jit cache entries;
+* requests carry per-request **deadlines** (default
+  `ServingConfig.request_timeout_ms`) enforced both in the batcher
+  queue and on the submitting thread;
+* the Leader's Helper leg gets a per-attempt **timeout** and bounded
+  **exponential-backoff retry**; exhausted retries raise
+  `HelperUnavailable`, or — when the operator opts in with
+  `allow_degraded` — degrade to the Leader's own
+  `handle_plain_request` share so the session keeps answering (the
+  response is flagged in metrics; a client that sees degraded service
+  must fall back to plain single-server queries to read real records);
+* a `MetricsRegistry` per session (injectable, so co-located sessions
+  can share one) records queue/batch/retry/latency counters, exported
+  with `session.metrics.export()`.
+
+Sessions speak either library `messages.PirRequest` objects
+(`handle_request`) or the framed proto wire format (`handle_wire`,
+pluggable straight into `transport.FramedTcpServer`).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import dataclasses
+import time
+from typing import Optional
+
+from .. import serialization
+from ..pir import messages
+from ..pir.database import DenseDpfPirDatabase
+from ..pir.server import DenseDpfPirServer
+from .batcher import DeadlineExceeded, DynamicBatcher, Overloaded
+from .metrics import MetricsRegistry
+from .transport import Transport, TransportError, TransportTimeout
+
+__all__ = [
+    "ServingConfig",
+    "HelperUnavailable",
+    "PlainSession",
+    "LeaderSession",
+    "HelperSession",
+    "DeadlineExceeded",
+    "Overloaded",
+]
+
+
+class HelperUnavailable(RuntimeError):
+    """The Helper leg failed every attempt (timeouts and/or refusals)."""
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Operator knobs for a serving session.
+
+    `request_timeout_ms=None` disables deadlines (a cold first request
+    compiles jit programs and may legitimately take minutes on CPU).
+    `helper_retries` counts retries *after* the first attempt; backoff
+    doubles from `helper_backoff_ms` up to `helper_backoff_max_ms`.
+    `allow_degraded=True` opts into Leader-share-only responses when the
+    Helper is permanently down (see module docstring for the privacy
+    and correctness contract).
+    """
+
+    max_batch_size: int = 64
+    max_wait_ms: float = 2.0
+    max_queue: int = 256
+    request_timeout_ms: Optional[float] = None
+    helper_timeout_ms: Optional[float] = 30_000.0
+    helper_retries: int = 2
+    helper_backoff_ms: float = 10.0
+    helper_backoff_max_ms: float = 250.0
+    allow_degraded: bool = False
+    batching: bool = True
+
+
+# The deadline travels from handle_request into the server's plain
+# handler (called synchronously, possibly from inside the Leader's
+# while_waiting callback on the same thread) without threading it
+# through the reference-mirroring server signatures.
+_DEADLINE: contextvars.ContextVar = contextvars.ContextVar(
+    "serving_deadline", default=None
+)
+
+
+class _Session:
+    """Shared session mechanics: batcher wiring, deadlines, wire codec."""
+
+    def __init__(
+        self,
+        server: DenseDpfPirServer,
+        config: Optional[ServingConfig],
+        metrics: Optional[MetricsRegistry],
+        name: str,
+    ):
+        self._server = server
+        self._config = config if config is not None else ServingConfig()
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._name = name
+        self._batcher: Optional[DynamicBatcher] = None
+        if self._config.batching:
+            self._batcher = DynamicBatcher(
+                self._evaluate_keys,
+                max_batch_size=self._config.max_batch_size,
+                max_wait_ms=self._config.max_wait_ms,
+                max_queue=self._config.max_queue,
+                metrics=self.metrics,
+                name=f"{name}.batcher",
+            )
+            server.set_plain_handler(self._batched_plain_handler)
+
+    @property
+    def server(self) -> DenseDpfPirServer:
+        return self._server
+
+    @property
+    def config(self) -> ServingConfig:
+        return self._config
+
+    # -- batching -----------------------------------------------------------
+
+    def _evaluate_keys(self, keys):
+        """The batcher's evaluation function: one real device step for
+        the whole coalesced key batch."""
+        response = self._server.handle_plain_request(
+            messages.PirRequest(
+                plain_request=messages.PlainRequest(dpf_keys=keys)
+            )
+        )
+        return response.dpf_pir_response.masked_response
+
+    def _batched_plain_handler(self, request):
+        out = self._batcher.submit(
+            request.plain_request.dpf_keys, deadline=_DEADLINE.get()
+        )
+        return messages.PirResponse(
+            dpf_pir_response=messages.DpfPirResponse(
+                masked_response=list(out)
+            )
+        )
+
+    # -- request entry points -----------------------------------------------
+
+    def _default_deadline(self) -> Optional[float]:
+        if self._config.request_timeout_ms is None:
+            return None
+        return time.monotonic() + self._config.request_timeout_ms / 1e3
+
+    def handle_request(
+        self,
+        request: "messages.PirRequest",
+        deadline: Optional[float] = None,
+    ) -> "messages.PirResponse":
+        """Serve one request; `deadline` is absolute `time.monotonic()`
+        seconds (defaults from `request_timeout_ms`)."""
+        if deadline is None:
+            deadline = self._default_deadline()
+        token = _DEADLINE.set(deadline)
+        try:
+            with self.metrics.timed(f"{self._name}.request_ms"):
+                return self._server.handle_request(request)
+        finally:
+            _DEADLINE.reset(token)
+
+    def handle_wire(self, data: bytes) -> bytes:
+        """Framed proto entry point (plugs into `FramedTcpServer`)."""
+        from ..protos import private_information_retrieval_pb2 as pir_pb2
+
+        proto = pir_pb2.PirRequest.FromString(data)
+        request = serialization.pir_request_from_proto(
+            self._server.dpf, proto
+        )
+        response = self.handle_request(request)
+        return serialization.pir_response_to_proto(
+            response
+        ).SerializeToString()
+
+    def close(self) -> None:
+        if self._batcher is not None:
+            self._server.set_plain_handler(None)
+            self._batcher.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class PlainSession(_Session):
+    """Single-server (trusted) serving: plain requests, batched."""
+
+    def __init__(
+        self,
+        database: DenseDpfPirDatabase,
+        config: Optional[ServingConfig] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        mesh=None,
+    ):
+        super().__init__(
+            DenseDpfPirServer.create_plain(database, mesh=mesh),
+            config,
+            metrics,
+            "plain",
+        )
+
+
+class HelperSession(_Session):
+    """The Helper role: decrypts its leg, evaluates (batched), masks."""
+
+    def __init__(
+        self,
+        database: DenseDpfPirDatabase,
+        decrypter,
+        config: Optional[ServingConfig] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        mesh=None,
+    ):
+        super().__init__(
+            DenseDpfPirServer.create_helper(database, decrypter, mesh=mesh),
+            config,
+            metrics,
+            "helper",
+        )
+
+
+class LeaderSession(_Session):
+    """The Leader role: forwards the encrypted Helper leg over an
+    injected `Transport` with timeout/retry/backoff, computes its own
+    share while waiting, and XOR-combines the masked responses."""
+
+    def __init__(
+        self,
+        database: DenseDpfPirDatabase,
+        helper_transport: Transport,
+        config: Optional[ServingConfig] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        mesh=None,
+    ):
+        self._transport = helper_transport
+        server = DenseDpfPirServer.create_leader(
+            database, self._send_to_helper, mesh=mesh
+        )
+        super().__init__(server, config, metrics, "leader")
+        m = self.metrics
+        self._c_retries = m.counter("leader.helper_retries")
+        self._c_timeouts = m.counter("leader.helper_timeouts")
+        self._c_failures = m.counter("leader.helper_failures")
+        self._c_degraded = m.counter("leader.degraded_responses")
+
+    # -- helper leg ---------------------------------------------------------
+
+    def _send_to_helper(self, helper_request, while_waiting):
+        """`ForwardHelperRequestFn` with retry: serialize, round-trip
+        with a per-attempt timeout, back off and retry on transport
+        faults. `while_waiting` (the Leader's own share) runs exactly
+        once, overlapped with the first successful send."""
+        wire = serialization.pir_request_to_proto(
+            self._server.dpf, helper_request
+        ).SerializeToString()
+        cfg = self._config
+        called = [False]
+
+        def leader_share_once():
+            if not called[0]:
+                called[0] = True
+                while_waiting()
+
+        timeout = (
+            None if cfg.helper_timeout_ms is None
+            else cfg.helper_timeout_ms / 1e3
+        )
+        backoff = cfg.helper_backoff_ms / 1e3
+        last: Optional[Exception] = None
+        for attempt in range(cfg.helper_retries + 1):
+            try:
+                with self.metrics.timed("leader.helper_leg_ms"):
+                    data = self._transport.roundtrip(
+                        wire, timeout=timeout, on_sent=leader_share_once
+                    )
+                break
+            except TransportError as e:
+                last = e
+                if isinstance(e, TransportTimeout):
+                    self._c_timeouts.inc()
+                if attempt >= cfg.helper_retries:
+                    self._c_failures.inc()
+                    raise HelperUnavailable(
+                        f"helper leg failed after {attempt + 1} "
+                        f"attempt(s): {e}"
+                    ) from e
+                self._c_retries.inc()
+                time.sleep(min(backoff, cfg.helper_backoff_max_ms / 1e3))
+                backoff *= 2
+        else:  # pragma: no cover - loop always breaks or raises
+            raise HelperUnavailable(str(last))
+        # A misbehaving-but-fast helper could answer before the share ran.
+        leader_share_once()
+        from ..protos import private_information_retrieval_pb2 as pir_pb2
+
+        return serialization.pir_response_from_proto(
+            pir_pb2.PirResponse.FromString(data)
+        )
+
+    # -- degradation --------------------------------------------------------
+
+    def handle_request(self, request, deadline=None):
+        if deadline is None:
+            deadline = self._default_deadline()
+        try:
+            return super().handle_request(request, deadline)
+        except HelperUnavailable:
+            if not (
+                self._config.allow_degraded
+                and request.leader_request is not None
+            ):
+                raise
+            # Operator-sanctioned degraded mode: answer with the
+            # Leader's own share only. The client cannot unmask a real
+            # record from this (the Helper's share is missing) — it is a
+            # liveness signal telling clients to fall back to plain
+            # queries — but the session stays up and keeps its batcher,
+            # metrics, and deadlines exercised.
+            self._c_degraded.inc()
+            token = _DEADLINE.set(deadline)
+            try:
+                return self._server._dispatch_plain(
+                    messages.PirRequest(
+                        plain_request=request.leader_request.plain_request
+                    )
+                )
+            finally:
+                _DEADLINE.reset(token)
+
+    def close(self):
+        super().close()
+        self._transport.close()

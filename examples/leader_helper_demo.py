@@ -1,10 +1,11 @@
 """Two-server dense PIR over real TCP sockets — the deployment model.
 
-The reference keeps the Leader->Helper transport abstract behind an
-injected callback (`pir/dpf_pir_server.h:92-109`: "transport-agnostic; no
-RPC stack in-repo"); its tests play the network with in-process lambdas.
-This demo runs the same protocol across OS processes, with the proto wire
-format (`protos/private_information_retrieval.proto`) framed over TCP:
+Thin CLI over the `serving/` runtime. The protocol, framing, batching,
+deadline/retry policy, and metrics all live in
+`distributed_point_functions_tpu/serving/` (`transport.py` frames proto
+messages over TCP, `service.py` wraps the Leader/Helper roles from
+`pir/server.py`); this script only parses flags, builds the shared demo
+database, and wires the roles together:
 
     client ──LeaderRequest──> leader ──EncryptedHelperRequest──> helper
            <─masked response─        <──masked helper response──
@@ -34,8 +35,6 @@ from __future__ import annotations
 import argparse
 import os
 import socket
-import socketserver
-import struct
 import subprocess
 import sys
 import time
@@ -44,38 +43,6 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 NUM_RECORDS = 512
 RECORD_BYTES = 32
-
-
-# ---------------------------------------------------------------------------
-# Message framing: 4-byte big-endian length prefix per proto message.
-# ---------------------------------------------------------------------------
-
-
-def send_msg(sock: socket.socket, data: bytes) -> None:
-    sock.sendall(struct.pack(">I", len(data)) + data)
-
-
-def recv_msg(sock: socket.socket) -> bytes:
-    header = _recv_exact(sock, 4)
-    (length,) = struct.unpack(">I", header)
-    if length > (1 << 30):
-        raise ValueError(f"unreasonable message length {length}")
-    return _recv_exact(sock, length)
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("peer closed the connection")
-        buf += chunk
-    return buf
-
-
-def _parse_hostport(s: str) -> tuple[str, int]:
-    host, _, port = s.rpartition(":")
-    return host or "localhost", int(port)
 
 
 # ---------------------------------------------------------------------------
@@ -99,25 +66,18 @@ def build_database():
     return builder.build(), records
 
 
-def serve(port: int, handle, name: str):
-    """Framed request->response loop; one message per connection round."""
+def _serving_config():
+    """Demo-friendly knobs: no deadlines (the first request compiles jit
+    programs, legitimately slow on CPU), generous helper leg."""
+    from distributed_point_functions_tpu.serving import ServingConfig
 
-    class Handler(socketserver.BaseRequestHandler):
-        def handle(self):
-            while True:
-                try:
-                    data = recv_msg(self.request)
-                except (ConnectionError, struct.error):
-                    return
-                send_msg(self.request, handle(data))
-
-    class Server(socketserver.ThreadingTCPServer):
-        allow_reuse_address = True
-        daemon_threads = True
-
-    with Server(("", port), Handler) as server:
-        print(f"[{name}] listening on :{port}", flush=True)
-        server.serve_forever()
+    return ServingConfig(
+        max_batch_size=64,
+        max_wait_ms=2.0,
+        request_timeout_ms=None,
+        helper_timeout_ms=600_000.0,
+        helper_retries=2,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -126,70 +86,35 @@ def serve(port: int, handle, name: str):
 
 
 def run_helper(port: int) -> None:
-    from distributed_point_functions_tpu import serialization
-    from distributed_point_functions_tpu.pir.server import DenseDpfPirServer
+    from distributed_point_functions_tpu.serving import (
+        FramedTcpServer,
+        HelperSession,
+    )
     from distributed_point_functions_tpu.testing import encrypt_decrypt
 
     db, _ = build_database()
-    server = DenseDpfPirServer.create_helper(db, encrypt_decrypt.decrypt)
-
-    def handle(data: bytes) -> bytes:
-        from distributed_point_functions_tpu.protos import (
-            private_information_retrieval_pb2 as pir_pb2,
-        )
-
-        req_proto = pir_pb2.PirRequest.FromString(data)
-        request = serialization.pir_request_from_proto(server.dpf, req_proto)
-        response = server.handle_request(request)
-        return serialization.pir_response_to_proto(
-            response
-        ).SerializeToString()
-
-    serve(port, handle, "helper")
+    session = HelperSession(db, encrypt_decrypt.decrypt, _serving_config())
+    server = FramedTcpServer(session.handle_wire, port=port, name="helper")
+    print(f"[helper] listening on :{server.port}", flush=True)
+    server.serve_forever()
 
 
 def run_leader(port: int, helper_addr: str) -> None:
-    from distributed_point_functions_tpu import serialization
-    from distributed_point_functions_tpu.pir import messages
-    from distributed_point_functions_tpu.pir.server import DenseDpfPirServer
+    from distributed_point_functions_tpu.serving import (
+        FramedTcpServer,
+        LeaderSession,
+        TcpTransport,
+        parse_hostport,
+    )
 
     db, _ = build_database()
-    helper_host, helper_port = _parse_hostport(helper_addr)
-
-    def sender(helper_request, while_waiting):
-        """Forward the encrypted request over TCP; compute the leader's own
-        share while the helper works (`dpf_pir_server.cc:108-110`)."""
-        wire = serialization.pir_request_to_proto(
-            server.dpf, helper_request
-        ).SerializeToString()
-        with socket.create_connection((helper_host, helper_port)) as s:
-            send_msg(s, wire)
-            while_waiting()
-            data = recv_msg(s)
-        from distributed_point_functions_tpu.protos import (
-            private_information_retrieval_pb2 as pir_pb2,
-        )
-
-        return serialization.pir_response_from_proto(
-            pir_pb2.PirResponse.FromString(data)
-        )
-
-    server = DenseDpfPirServer.create_leader(db, sender)
-
-    def handle(data: bytes) -> bytes:
-        from distributed_point_functions_tpu.protos import (
-            private_information_retrieval_pb2 as pir_pb2,
-        )
-
-        req_proto = pir_pb2.PirRequest.FromString(data)
-        request = serialization.pir_request_from_proto(server.dpf, req_proto)
-        response = server.handle_request(request)
-        return serialization.pir_response_to_proto(
-            response
-        ).SerializeToString()
-
-    _ = messages  # imported for side-effect-free type reference
-    serve(port, handle, "leader")
+    helper_host, helper_port = parse_hostport(helper_addr)
+    session = LeaderSession(
+        db, TcpTransport(helper_host, helper_port), _serving_config()
+    )
+    server = FramedTcpServer(session.handle_wire, port=port, name="leader")
+    print(f"[leader] listening on :{server.port}", flush=True)
+    server.serve_forever()
 
 
 def run_client(leader_addr: str, indices: list[int]) -> list[bytes]:
@@ -197,6 +122,10 @@ def run_client(leader_addr: str, indices: list[int]) -> list[bytes]:
     from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
     from distributed_point_functions_tpu.protos import (
         private_information_retrieval_pb2 as pir_pb2,
+    )
+    from distributed_point_functions_tpu.serving import (
+        TcpTransport,
+        parse_hostport,
     )
     from distributed_point_functions_tpu.testing import encrypt_decrypt
 
@@ -206,10 +135,9 @@ def run_client(leader_addr: str, indices: list[int]) -> list[bytes]:
         client.dpf, request
     ).SerializeToString()
 
-    host, port = _parse_hostport(leader_addr)
-    with socket.create_connection((host, port)) as s:
-        send_msg(s, wire)
-        data = recv_msg(s)
+    host, port = parse_hostport(leader_addr)
+    with TcpTransport(host, port) as transport:
+        data = transport.roundtrip(wire)
     response = serialization.pir_response_from_proto(
         pir_pb2.PirResponse.FromString(data)
     )

@@ -1,0 +1,253 @@
+"""DynamicBatcher unit tests: pure-Python stubs, no JAX involved.
+
+The batcher is generic over `evaluate(keys) -> results`, so these tests
+drive it with counting stubs to pin down the coalescing, bucketing,
+shedding, deadline, and error-fanout contracts in isolation; the
+integration against real servers lives in test_serving_service.py.
+"""
+
+import threading
+import time
+
+import pytest
+
+from distributed_point_functions_tpu.serving import (
+    DeadlineExceeded,
+    DynamicBatcher,
+    MetricsRegistry,
+    Overloaded,
+    bucket_size,
+)
+
+
+class RecordingEvaluator:
+    """Identity evaluation that records every batch it is handed."""
+
+    def __init__(self, delay_s=0.0):
+        self.calls = []
+        self.delay_s = delay_s
+        self.lock = threading.Lock()
+
+    def __call__(self, keys):
+        with self.lock:
+            self.calls.append(list(keys))
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return list(keys)
+
+
+def test_bucket_size_powers_of_two():
+    assert [bucket_size(n) for n in (1, 2, 3, 4, 5, 8, 9, 63, 64)] == [
+        1, 2, 4, 4, 8, 8, 16, 64, 64,
+    ]
+    with pytest.raises(ValueError):
+        bucket_size(0)
+
+
+def test_single_submit_identity():
+    ev = RecordingEvaluator()
+    with DynamicBatcher(ev, max_batch_size=8, max_wait_ms=1.0) as b:
+        assert b.submit(["k0", "k1"]) == ["k0", "k1"]
+    # One batch, padded from 2 keys to the 2-bucket (no padding needed).
+    assert len(ev.calls) == 1
+    assert ev.calls[0] == ["k0", "k1"]
+
+
+def test_concurrent_submits_coalesce_and_slice_in_order():
+    ev = RecordingEvaluator(delay_s=0.02)
+    metrics = MetricsRegistry()
+    with DynamicBatcher(
+        ev, max_batch_size=16, max_wait_ms=20.0, metrics=metrics, name="b"
+    ) as b:
+        results = {}
+
+        def client(i):
+            results[i] = b.submit([f"r{i}a", f"r{i}b"])
+
+        # Park one submission so the worker is busy, then pile up
+        # concurrent clients that must coalesce into ONE batch.
+        first = threading.Thread(target=client, args=(99,))
+        first.start()
+        time.sleep(0.005)  # let the worker pick up the first batch
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(5)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        first.join()
+    # Every request got exactly its own keys back, in its own order.
+    for i in list(range(5)) + [99]:
+        assert results[i] == [f"r{i}a", f"r{i}b"]
+    # The five concurrent clients shared batches (fewer batches than
+    # clients); with the worker parked they typically form one batch.
+    assert len(ev.calls) <= 3
+    counters = metrics.export()["counters"]
+    assert counters["b.requests_submitted"] == 6
+    assert counters["b.batches"] == len(ev.calls)
+
+
+def test_batches_padded_to_power_of_two_buckets():
+    ev = RecordingEvaluator(delay_s=0.02)
+    metrics = MetricsRegistry()
+    with DynamicBatcher(
+        ev, max_batch_size=16, max_wait_ms=20.0, metrics=metrics, name="b"
+    ) as b:
+        hold = threading.Thread(target=b.submit, args=(["x"],))
+        hold.start()
+        time.sleep(0.005)
+        threads = [
+            threading.Thread(target=b.submit, args=([f"k{i}"],))
+            for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        hold.join()
+    # Every evaluated batch is a power-of-two size; padding duplicates
+    # the first key.
+    for call in ev.calls:
+        assert len(call) == bucket_size(len(call))
+    padded = metrics.export()["counters"]["b.padded_keys"]
+    total_keys = sum(len(c) for c in ev.calls)
+    assert total_keys - 4 == padded
+
+
+def test_overload_shedding():
+    release = threading.Event()
+
+    def slow(keys):
+        release.wait(5.0)
+        return list(keys)
+
+    metrics = MetricsRegistry()
+    b = DynamicBatcher(
+        slow, max_batch_size=1, max_queue=2, metrics=metrics, name="b"
+    )
+    try:
+        threads = [
+            threading.Thread(target=lambda: b.submit(["k"]))
+            for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)  # worker holds one batch; 2 more fill the queue
+        with pytest.raises(Overloaded):
+            b.submit(["shed-me"])
+        assert metrics.export()["counters"]["b.requests_shed"] == 1
+    finally:
+        release.set()
+        for t in threads:
+            t.join()
+        b.close()
+
+
+def test_deadline_expired_in_queue_never_evaluated():
+    started = threading.Event()
+    release = threading.Event()
+    ev = RecordingEvaluator()
+
+    def gated(keys):
+        started.set()
+        release.wait(5.0)
+        return ev(keys)
+
+    metrics = MetricsRegistry()
+    b = DynamicBatcher(gated, max_batch_size=1, metrics=metrics, name="b")
+    try:
+        hold = threading.Thread(target=lambda: b.submit(["hold"]))
+        hold.start()
+        assert started.wait(2.0)
+        # This request's deadline passes while the worker is busy; it
+        # must fail without its keys ever reaching the evaluator.
+        with pytest.raises(DeadlineExceeded):
+            b.submit(["late"], deadline=time.monotonic() + 0.01)
+        release.set()
+        hold.join()
+        time.sleep(0.05)
+        assert all("late" not in call for call in ev.calls)
+        counters = metrics.export()["counters"]
+        assert counters["b.requests_deadline_exceeded"] == 1
+    finally:
+        release.set()
+        b.close()
+
+
+def test_evaluation_error_fans_out_to_all_batch_members():
+    def boom(keys):
+        raise RuntimeError("device on fire")
+
+    b = DynamicBatcher(boom, max_batch_size=8, max_wait_ms=5.0)
+    try:
+        errors = []
+
+        def client():
+            try:
+                b.submit(["k"])
+            except RuntimeError as e:
+                errors.append(str(e))
+
+        threads = [threading.Thread(target=client) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == ["device on fire"] * 3
+    finally:
+        b.close()
+
+
+def test_mixed_sizes_bounded_compile_count():
+    """1..N mixed-size request streams touch at most log2(max_batch)+1
+    distinct jit buckets — counted via the metrics registry."""
+    ev = RecordingEvaluator()
+    metrics = MetricsRegistry()
+    max_batch = 16
+    with DynamicBatcher(
+        ev, max_batch_size=max_batch, max_wait_ms=2.0,
+        metrics=metrics, name="b",
+    ) as b:
+        for round_sizes in [(1,), (3,), (2, 2), (5,), (7, 1), (16,), (11,)]:
+            threads = [
+                threading.Thread(
+                    target=b.submit, args=([f"s{s}k{j}" for j in range(s)],)
+                )
+                for s in round_sizes
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+    bound = max_batch.bit_length()  # log2(16)+1 = 5
+    counters = metrics.export()["counters"]
+    assert counters["b.jit_bucket_compiles"] <= bound
+    distinct = {len(c) for c in ev.calls}
+    assert len(distinct) == counters["b.jit_bucket_compiles"]
+    assert counters["b.jit_bucket_hits"] == counters["b.batches"] - len(
+        distinct
+    )
+
+
+def test_submit_after_close_raises():
+    b = DynamicBatcher(lambda keys: list(keys))
+    b.close()
+    with pytest.raises(RuntimeError):
+        b.submit(["k"])
+
+
+def test_close_drains_pending_work():
+    ev = RecordingEvaluator(delay_s=0.01)
+    b = DynamicBatcher(ev, max_batch_size=4, max_wait_ms=1.0)
+    results = []
+    threads = [
+        threading.Thread(target=lambda: results.append(b.submit(["k"])))
+        for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    b.close()
+    assert len(results) == 4

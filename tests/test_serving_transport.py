@@ -1,0 +1,172 @@
+"""Framed transport tests: framing, pooling, reconnect, timeouts."""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from distributed_point_functions_tpu.serving import (
+    FramedTcpServer,
+    InProcessTransport,
+    TcpTransport,
+    TransportError,
+    TransportTimeout,
+    parse_hostport,
+    recv_msg,
+    send_msg,
+)
+
+
+def test_parse_hostport():
+    assert parse_hostport("localhost:9001") == ("localhost", 9001)
+    assert parse_hostport("10.0.0.2:80") == ("10.0.0.2", 80)
+    with pytest.raises(ValueError):
+        parse_hostport("no-port")
+
+
+def test_send_recv_roundtrip_over_socketpair():
+    a, b = socket.socketpair()
+    try:
+        send_msg(a, b"hello \x00 world")
+        assert recv_msg(b) == b"hello \x00 world"
+        send_msg(b, b"")
+        assert recv_msg(a) == b""
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_rejects_oversized_length_prefix():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack(">I", (1 << 30) + 1))
+        with pytest.raises(TransportError):
+            recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_in_process_transport_on_sent_ordering():
+    events = []
+
+    def handler(payload):
+        events.append(("handled", payload))
+        return payload.upper()
+
+    t = InProcessTransport(handler)
+    out = t.roundtrip(b"abc", on_sent=lambda: events.append(("sent", None)))
+    assert out == b"ABC"
+    # on_sent fires after the send, before the reply is consumed.
+    assert events[0][0] == "sent"
+
+
+def test_framed_tcp_server_echo_and_connection_reuse():
+    with FramedTcpServer(lambda data: b"echo:" + data) as server:
+        t = TcpTransport("localhost", server.port)
+        try:
+            assert t.roundtrip(b"one") == b"echo:one"
+            assert t.roundtrip(b"two") == b"echo:two"
+            # Both round-trips reused one pooled connection.
+            assert t.reconnects == 0
+        finally:
+            t.close()
+
+
+def test_tcp_transport_reconnects_after_peer_restart():
+    handler = lambda data: b"ok:" + data  # noqa: E731
+    server = FramedTcpServer(handler)
+    server.start()
+    port = server.port
+    t = TcpTransport("localhost", port)
+    try:
+        assert t.roundtrip(b"a") == b"ok:a"
+        server.stop()
+        # Same port, fresh server: the pooled connection is stale and the
+        # transport must transparently reconnect and resend once.
+        server = FramedTcpServer(handler, port=port)
+        server.start()
+        assert t.roundtrip(b"b") == b"ok:b"
+        assert t.reconnects >= 1
+    finally:
+        t.close()
+        server.stop()
+
+
+def test_tcp_transport_timeout_on_slow_handler():
+    def slow(data):
+        time.sleep(2.0)
+        return data
+
+    with FramedTcpServer(slow) as server:
+        t = TcpTransport("localhost", server.port)
+        try:
+            with pytest.raises(TransportTimeout):
+                t.roundtrip(b"x", timeout=0.1)
+        finally:
+            t.close()
+
+
+def test_tcp_transport_connection_refused_raises_immediately():
+    # Grab a port that is definitely closed.
+    probe = socket.socket()
+    probe.bind(("localhost", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    t = TcpTransport("localhost", port, connect_timeout=0.5)
+    try:
+        with pytest.raises(TransportError):
+            t.roundtrip(b"x")
+    finally:
+        t.close()
+
+
+def test_framed_server_survives_handler_exception():
+    calls = []
+
+    def flaky(data):
+        calls.append(data)
+        if data == b"bad":
+            raise ValueError("handler bug")
+        return b"ok"
+
+    with FramedTcpServer(flaky) as server:
+        t1 = TcpTransport("localhost", server.port)
+        try:
+            # The failing request drops its connection...
+            with pytest.raises(TransportError):
+                t1.roundtrip(b"bad", timeout=2.0)
+        finally:
+            t1.close()
+        # ...but the server keeps accepting new ones.
+        t2 = TcpTransport("localhost", server.port)
+        try:
+            assert t2.roundtrip(b"good") == b"ok"
+        finally:
+            t2.close()
+
+
+def test_concurrent_clients_one_server():
+    with FramedTcpServer(lambda d: d[::-1]) as server:
+        results = {}
+
+        def client(i):
+            t = TcpTransport("localhost", server.port)
+            try:
+                payload = b"payload-%d" % i
+                for _ in range(3):
+                    results[i] = t.roundtrip(payload)
+            finally:
+                t.close()
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(8)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        for i in range(8):
+            assert results[i] == (b"payload-%d" % i)[::-1]

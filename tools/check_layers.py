@@ -1,0 +1,189 @@
+#!/usr/bin/env python3
+"""Layer-DAG and import-cycle presubmit check (stdlib AST, no deps).
+
+Two rules over `distributed_point_functions_tpu/`:
+
+1. **Layer DAG** — `serving -> pir -> ops`, never the reverse, and the
+   serving runtime is a leaf layer: no library module outside
+   `serving/` may import `serving` (applications — examples/, bench.py,
+   benchmarks/ — may). Checked over ALL imports, including
+   function-level ones, because a reversed dependency is wrong wherever
+   the import statement sits.
+
+2. **No module-level import cycles** — the repo's sanctioned idiom for
+   breaking genuine cycles is the function-level import, so only
+   imports that execute at module import time participate in the cycle
+   graph.
+
+Exit 0 on success; prints each violation and exits 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+PACKAGE = "distributed_point_functions_tpu"
+ROOT = Path(__file__).resolve().parent.parent
+
+# Layer order, outermost first: a module may import same-or-lower
+# layers only. Subpackages not listed are unconstrained by rule 1
+# (but still cycle-checked by rule 2).
+LAYERS = {"serving": 3, "pir": 2, "ops": 1}
+
+
+def module_name(path: Path) -> str:
+    rel = path.relative_to(ROOT).with_suffix("")
+    parts = list(rel.parts)
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def resolve_relative(module: str, node: ast.ImportFrom, is_pkg: bool) -> str:
+    """Absolute dotted name for a (possibly relative) import-from."""
+    if node.level == 0:
+        return node.module or ""
+    base = module.split(".")
+    # A package's __init__ resolves level-1 against itself.
+    up = node.level - (1 if is_pkg else 0)
+    if up:
+        base = base[:-up]
+    return ".".join(base + ([node.module] if node.module else []))
+
+
+def collect(path: Path):
+    """Returns (all_imports, module_level_imports) as absolute names."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    module = module_name(path)
+    is_pkg = path.name == "__init__.py"
+    all_imports, top_imports = [], []
+
+    def visit(node, top):
+        for child in ast.iter_child_nodes(node):
+            inner_top = top and not isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+            )
+            if isinstance(child, ast.Import):
+                names = [a.name for a in child.names]
+            elif isinstance(child, ast.ImportFrom):
+                base = resolve_relative(module, child, is_pkg)
+                names = [
+                    f"{base}.{a.name}" if base else a.name
+                    for a in child.names
+                ]
+            else:
+                visit(child, inner_top)
+                continue
+            all_imports.extend(names)
+            if top:
+                top_imports.extend(names)
+
+    visit(tree, top=True)
+    return all_imports, top_imports
+
+
+def layer_of(module: str):
+    parts = module.split(".")
+    if len(parts) >= 2 and parts[0] == PACKAGE and parts[1] in LAYERS:
+        return parts[1]
+    return None
+
+
+def find_cycle(graph):
+    """First module-level import cycle found via iterative DFS, or None."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {m: WHITE for m in graph}
+    for start in sorted(graph):
+        if color[start] != WHITE:
+            continue
+        stack = [(start, iter(sorted(graph[start])))]
+        color[start] = GRAY
+        path = [start]
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in graph:
+                    continue
+                if color[nxt] == GRAY:
+                    return path[path.index(nxt):] + [nxt]
+                if color[nxt] == WHITE:
+                    color[nxt] = GRAY
+                    path.append(nxt)
+                    stack.append((nxt, iter(sorted(graph[nxt]))))
+                    advanced = True
+                    break
+            if not advanced:
+                color[node] = BLACK
+                path.pop()
+                stack.pop()
+    return None
+
+
+def main() -> int:
+    pkg_root = ROOT / PACKAGE
+    violations = []
+    graph = {}
+    for path in sorted(pkg_root.rglob("*.py")):
+        module = module_name(path)
+        try:
+            all_imports, top_imports = collect(path)
+        except SyntaxError as e:
+            violations.append(f"{path}: unparsable ({e})")
+            continue
+        src_layer = layer_of(module)
+        for name in all_imports:
+            tgt_layer = layer_of(name)
+            if tgt_layer is None or src_layer == tgt_layer:
+                continue
+            if tgt_layer == "serving":
+                violations.append(
+                    f"{module}: imports {name} — only serving/ (and "
+                    "applications) may depend on the serving runtime"
+                )
+            elif (
+                src_layer is not None
+                and LAYERS[tgt_layer] > LAYERS[src_layer]
+            ):
+                # Unlayered support modules (dpf, crypto, prng, ...) may
+                # import ops freely; only the ranked layers constrain
+                # their upward edges.
+                violations.append(
+                    f"{module}: imports {name} — reverses the "
+                    f"serving -> pir -> ops layer DAG"
+                )
+        graph[module] = {
+            n for imp in top_imports
+            if (n := _owning_module(imp)) and n.startswith(PACKAGE)
+        }
+
+    cycle = find_cycle(graph)
+    if cycle:
+        violations.append(
+            "module-level import cycle: " + " -> ".join(cycle)
+        )
+    for v in violations:
+        print(f"check_layers: {v}")
+    if not violations:
+        print(f"check_layers: OK ({len(graph)} modules, no cycles, "
+              "layer DAG holds)")
+    return 1 if violations else 0
+
+
+def _owning_module(imported: str):
+    """Trim `pkg.mod.symbol` to the module part we know about."""
+    parts = imported.split(".")
+    for cut in range(len(parts), 0, -1):
+        candidate = ".".join(parts[:cut])
+        if (ROOT / Path(*parts[:cut])).with_suffix(".py").exists() or (
+            ROOT / Path(*parts[:cut]) / "__init__.py"
+        ).exists():
+            return candidate
+    return None
+
+
+if __name__ == "__main__":
+    sys.exit(main())
