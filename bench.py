@@ -98,9 +98,11 @@ def _metric_name():
 
 
 def _default_metric_unit():
-    # BENCH_ONLY_NSLEAF / BENCH_SERVING runs report their own metric
-    # shape from every emitter — including the watchdog thread — so the
-    # tee'd file never mixes metric shapes.
+    # BENCH_ONLY_NSLEAF / BENCH_SERVING / BENCH_HEAVY_HITTERS runs
+    # report their own metric shape from every emitter — including the
+    # watchdog thread — so the tee'd file never mixes metric shapes.
+    if os.environ.get("BENCH_HEAVY_HITTERS", "") == "1":
+        return "heavy_hitters_sweep_lanes_per_sec", "lanes/s"
     if os.environ.get("BENCH_SERVING", "") == "1":
         return "serving_closed_loop_queries_per_sec", "queries/s"
     if os.environ.get("BENCH_ONLY_NSLEAF", "") == "1":
@@ -530,6 +532,36 @@ def main():
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
+
+    if os.environ.get("BENCH_HEAVY_HITTERS", "") == "1":
+        # Heavy-hitters sweep benchmark (BENCH_HEAVY_HITTERS=1): full
+        # two-server sweeps across a clients x domain x threshold grid,
+        # each point checked against the plaintext oracle; the headline
+        # value is fused (key, prefix) evaluation lanes per second and
+        # vs_baseline is the cut-state-resume speedup over re-expanding
+        # every level from the root. CPU-scale like BENCH_SERVING, so it
+        # runs before _ensure_backend.
+        _PROGRESS["stage"] = "heavy-hitters-bench"
+        try:
+            from benchmarks.heavy_hitters_bench import (
+                run_heavy_hitters_bench,
+            )
+
+            report = run_heavy_hitters_bench()
+            _emit(
+                report["best_lanes_per_sec"],
+                report.get("resume_speedup") or 0.0,
+                error=None
+                if report["correctness_ok"]
+                else "private sweep diverged from the plaintext oracle",
+            )
+        except Exception as e:  # noqa: BLE001 - the JSON line must print
+            _emit(
+                0.0, 0.0,
+                error=f"heavy-hitters bench failed: "
+                f"{str(e).splitlines()[0][:200]}",
+            )
+        return
 
     if os.environ.get("BENCH_SERVING", "") == "1":
         # Closed-loop serving benchmark (BENCH_SERVING=1): drive the

@@ -9,7 +9,9 @@
 #   4. native      — C++ oracle kernels build (g++)
 #   5. test-fast   — <5 min hermetic signal tier (incl. tiny-shape
 #                    interpret cases of every serving Pallas kernel)
-#   6. dryrun      — 8-virtual-device multichip compile+step
+#   6. hh-smoke    — heavy-hitters sweep end to end (tiny domain,
+#                    2 levels, in-process transport, plaintext check)
+#   7. dryrun      — 8-virtual-device multichip compile+step
 # Benchmarks are excluded exactly as the reference excludes
 # `--test_tag_filters=-benchmark`. `FULL=1` appends the whole suite.
 set -u -o pipefail
@@ -44,6 +46,9 @@ stage protoc-check bash -c '
 stage native bash -c 'cd native && bash build.sh'
 
 stage test-fast make -s test-fast
+
+stage hh-smoke env JAX_PLATFORMS=cpu \
+    python examples/heavy_hitters_demo.py --smoke
 
 stage dryrun make -s dryrun
 

@@ -178,6 +178,52 @@ class EvaluationContext:
     partial_evaluations_level: int = 0
 
 
+@dataclasses.dataclass
+class BatchCutState:
+    """Device-resident walk states of MANY keys at one hierarchy level.
+
+    The multi-key analog of `EvaluationContext.partial_evaluations`: for
+    every (key, prefix) pair the pre-value-hash seed and control bit at
+    the hierarchy level's tree level. `evaluate_prefixes_batch` returns
+    one of these after every level so the next level's evaluation walks
+    only the new tree levels instead of re-expanding from the root —
+    the state reuse the heavy-hitters level-synchronized sweep is built
+    on. `prefixes` are the *domain indices* the state was evaluated at,
+    strictly ascending; a later call may resume from any subset's
+    children (survivors of threshold pruning).
+    """
+
+    hierarchy_level: int
+    prefixes: np.ndarray  # sorted domain indices; uint64 or object
+    seeds: jnp.ndarray  # uint32[num_keys, num_prefixes, 4]
+    control: jnp.ndarray  # uint32[num_keys, num_prefixes]
+
+    @property
+    def num_keys(self) -> int:
+        return self.seeds.shape[0]
+
+    def __len__(self) -> int:
+        return len(self.prefixes)
+
+    def positions(self, wanted_list) -> np.ndarray:
+        """Positions of `wanted_list` prefixes in this state; raises
+        ValueError naming the first missing one."""
+        wide = self.prefixes.dtype == object
+        wanted = np.array(wanted_list, dtype=object if wide else np.uint64)
+        pos = np.searchsorted(self.prefixes, wanted)
+        pos_clipped = np.minimum(pos, len(self.prefixes) - 1)
+        ok = (pos < len(self.prefixes)) & (
+            self.prefixes[pos_clipped] == wanted
+        )
+        if not np.all(ok):
+            missing = wanted[np.argmin(ok)]
+            raise ValueError(
+                f"prefix {int(missing)} not present in cut state at "
+                f"hierarchy level {self.hierarchy_level}"
+            )
+        return pos_clipped.astype(np.int64)
+
+
 def _build_fused_accumulate(plan, vt, blocks_needed):
     """One jitted program: multi-level walk + per-level value extraction
     + masked accumulation (the fused engine behind
@@ -1849,6 +1895,158 @@ class DistributedPointFunction:
         if n_pad == n:
             return out
         return jax.tree_util.tree_map(lambda x: x[:n], out)
+
+    def evaluate_prefixes_batch(
+        self,
+        staged: StagedKeyBatch,
+        hierarchy_level: int,
+        prefixes: Sequence[int],
+        cuts: Optional[BatchCutState] = None,
+    ):
+        """Evaluate EVERY staged key at EVERY prefix of one hierarchy
+        level, resuming from cached cut states — the batched per-level
+        engine of the heavy-hitters sweep (usable by any workload that
+        needs a fused `[num_keys, num_prefixes]` evaluation).
+
+        `prefixes` are strictly ascending domain indices at
+        `hierarchy_level`. With `cuts` (a `BatchCutState` from an
+        earlier level) each lane's walk starts from the cached seed of
+        the prefix's ancestor at `cuts.hierarchy_level` — every
+        ancestor must be present in `cuts.prefixes` — so only
+        `tree(level) - tree(cuts.level)` tree levels are hashed instead
+        of the full root-to-level path. Without `cuts` the walk starts
+        at the root.
+
+        Lanes are laid out key-major (`lane = key * P_pad + prefix`)
+        and run through the per-seed correction-word mode of
+        `_eval_paths` in ONE fused device program; the prefix axis is
+        padded to a power of two so frontier widths recur in the jit
+        cache. Returns `(values, new_cuts)`: `values` is the hierarchy
+        level's value pytree with batch shape
+        `[num_keys, len(prefixes)]` (party negation applied per key),
+        and `new_cuts` the `BatchCutState` at `hierarchy_level` for the
+        next level's resume.
+        """
+        num_keys = staged.n
+        num_prefixes = len(prefixes)
+        if num_prefixes == 0:
+            raise ValueError("prefixes must not be empty")
+        if not (0 <= hierarchy_level < len(self.parameters)):
+            raise ValueError("hierarchy_level out of range")
+        lds = self.parameters[hierarchy_level].log_domain_size
+        last = -1
+        for p in prefixes:
+            if not (0 <= p < (1 << lds)):
+                raise ValueError(f"prefix {p} out of range")
+            if p <= last:
+                raise ValueError(
+                    "prefixes must be strictly ascending"
+                )
+            last = p
+
+        stop_level = self._hierarchy_to_tree[hierarchy_level]
+        tree_indices = [
+            self._domain_to_tree_index(p, hierarchy_level)
+            for p in prefixes
+        ]
+        block_indices = [
+            self._domain_to_block_index(p, hierarchy_level)
+            for p in prefixes
+        ]
+
+        p_pad = _next_pow2(num_prefixes)
+        paths_np = np.zeros((p_pad, 4), dtype=np.uint32)
+        paths_np[:num_prefixes] = np.stack(
+            [aes.u128_to_limbs(t) for t in tree_indices]
+        ).astype(np.uint32)
+
+        if cuts is None:
+            start_level = 0
+            seeds = jnp.broadcast_to(
+                staged.seeds[:, None, :], (num_keys, p_pad, 4)
+            )
+            control = jnp.broadcast_to(
+                staged.parties[:, None], (num_keys, p_pad)
+            )
+        else:
+            if cuts.hierarchy_level >= hierarchy_level:
+                raise ValueError(
+                    "cuts.hierarchy_level must precede hierarchy_level"
+                )
+            if cuts.num_keys != num_keys:
+                raise ValueError("cuts/staged key-count mismatch")
+            start_level = self._hierarchy_to_tree[cuts.hierarchy_level]
+            prev_lds = self.parameters[cuts.hierarchy_level].log_domain_size
+            shift = lds - prev_lds
+            parents = [p >> shift for p in prefixes]
+            pos_np = np.zeros((p_pad,), dtype=np.int64)
+            pos_np[:num_prefixes] = cuts.positions(parents)
+            pos = jnp.asarray(pos_np)
+            seeds = jnp.take(cuts.seeds, pos, axis=1)
+            control = jnp.take(cuts.control, pos, axis=1)
+
+        n_lanes = num_keys * p_pad
+        seeds = seeds.reshape(n_lanes, 4)
+        control = control.reshape(n_lanes)
+        paths = jnp.asarray(np.tile(paths_np, (num_keys, 1)))
+
+        num_levels = stop_level - start_level
+        if num_levels > 0:
+            # Per-seed correction-word mode: lane (k, p) uses key k's
+            # correction words, repeated across the prefix axis.
+            cw_seeds = jnp.repeat(
+                staged.cw_seeds[start_level:stop_level], p_pad, axis=1
+            )
+            cw_left = jnp.repeat(
+                staged.cw_left[start_level:stop_level], p_pad, axis=1
+            )
+            cw_right = jnp.repeat(
+                staged.cw_right[start_level:stop_level], p_pad, axis=1
+            )
+            bit_indices = np.array(
+                [num_levels - 1 - j for j in range(num_levels)],
+                dtype=np.int32,
+            )
+            seeds, control = _eval_paths(
+                seeds, control, paths, cw_seeds, cw_left, cw_right,
+                jnp.asarray(bit_indices),
+            )
+
+        vt = self.parameters[hierarchy_level].value_type
+        vc = jax.tree_util.tree_map(
+            lambda x: jnp.repeat(x, p_pad, axis=0),
+            staged.value_corrections[hierarchy_level],
+        )
+        block_np = np.zeros((p_pad,), dtype=np.int32)
+        block_np[:num_prefixes] = block_indices
+        values = _leaf_stage_at(
+            seeds,
+            control,
+            vc,
+            jnp.asarray(np.tile(block_np, num_keys)),
+            vt,
+            self._blocks_needed[hierarchy_level],
+            -1,  # party negation below, per key
+        )
+        parties = jnp.repeat(staged.parties, p_pad, axis=0)
+        values = vt.dev_where(parties != 0, vt.dev_neg(values), values)
+        values = jax.tree_util.tree_map(
+            lambda x: x.reshape((num_keys, p_pad) + x.shape[1:])[
+                :, :num_prefixes
+            ],
+            values,
+        )
+
+        wide = any(p > 0x7FFFFFFFFFFFFFFF for p in prefixes)
+        new_cuts = BatchCutState(
+            hierarchy_level=hierarchy_level,
+            prefixes=np.array(
+                list(prefixes), dtype=object if wide else np.uint64
+            ),
+            seeds=seeds.reshape(num_keys, p_pad, 4)[:, :num_prefixes],
+            control=control.reshape(num_keys, p_pad)[:, :num_prefixes],
+        )
+        return values, new_cuts
 
     @property
     def _fused_accumulate_cache(self):

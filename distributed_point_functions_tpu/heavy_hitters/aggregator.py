@@ -1,0 +1,242 @@
+"""Batched per-level evaluation over the incremental DPF, budgeted.
+
+One server's compute engine for the level-synchronized sweep: evaluate
+ALL live keys at ALL candidate prefixes of hierarchy level ℓ as fused
+`[num_keys, num_prefixes]` device programs
+(`dpf.evaluate_prefixes_batch`), resuming from the `BatchCutState`
+cached by level ℓ−1 so each level only hashes the newly revealed tree
+levels — never re-expanding from the root.
+
+Like `pir/planner.py` for dense PIR, an explicit byte-budget model
+decides how the `keys x frontier` product is served. Per lane
+(key, prefix) the fused program holds the walk state, the repeated
+correction words for the levels walked, the path, and the leaf value
+blocks:
+
+    lane_bytes = 16 * (walk_levels + value_blocks + 3)
+
+(16 bytes per 128-bit block; +3 covers seeds in/out and the path). The
+planner picks the largest power-of-two prefix-chunk width whose
+`num_keys * chunk * lane_bytes` fits the budget
+(`DPF_TPU_HH_BYTES_BUDGET`, default 256 MiB) and the aggregator runs
+the frontier through it chunk by chunk — chunked evaluation is
+bit-identical to the unchunked program because lanes are independent.
+
+The per-key-per-prefix share sums reduce over the key axis on device;
+with a `jax.sharding.Mesh` the reduction (and, under GSPMD, the AES
+walk feeding it) shards over keys via
+`parallel.sharded.sum_shares_over_keys`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dpf import BatchCutState, DistributedPointFunction
+from ..value_types import IntType
+
+_DEFAULT_BUDGET_BYTES = 1 << 28  # 256 MiB
+_BLOCK_BYTES = 16
+
+
+def frontier_budget_bytes() -> int:
+    """Byte budget for one fused level evaluation, from the env."""
+    raw = os.environ.get("DPF_TPU_HH_BYTES_BUDGET", "").strip()
+    if raw:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            pass
+    return _DEFAULT_BUDGET_BYTES
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def lane_bytes(walk_levels: int, value_blocks: int) -> int:
+    """Modeled live bytes per (key, prefix) lane of one fused level."""
+    return _BLOCK_BYTES * (walk_levels + value_blocks + 3)
+
+
+@dataclasses.dataclass(frozen=True)
+class LevelPlan:
+    """Resolved chunking decision for one level's frontier evaluation."""
+
+    num_keys: int
+    num_prefixes: int
+    walk_levels: int
+    chunk_prefixes: int  # power of two
+    num_chunks: int
+    lane_bytes: int
+    bytes_peak: int  # modeled peak for one chunk
+    budget_bytes: int
+
+
+def plan_level(
+    num_keys: int,
+    num_prefixes: int,
+    walk_levels: int,
+    value_blocks: int,
+    budget_bytes: Optional[int] = None,
+) -> LevelPlan:
+    """Largest power-of-two prefix chunk whose modeled bytes fit the
+    budget (bigger chunks amortize dispatch); floor of one prefix."""
+    budget = frontier_budget_bytes() if budget_bytes is None else budget_bytes
+    lb = lane_bytes(walk_levels, value_blocks)
+    chunk = _next_pow2(max(1, num_prefixes))
+    while chunk > 1 and num_keys * chunk * lb > budget:
+        chunk //= 2
+    num_chunks = -(-num_prefixes // chunk)
+    return LevelPlan(
+        num_keys=num_keys,
+        num_prefixes=num_prefixes,
+        walk_levels=walk_levels,
+        chunk_prefixes=chunk,
+        num_chunks=num_chunks,
+        lane_bytes=lb,
+        bytes_peak=num_keys * chunk * lb,
+        budget_bytes=budget,
+    )
+
+
+class LevelAggregator:
+    """Per-level batched share aggregation with cut-state caching.
+
+    `evaluate_level(ℓ, frontier)` returns this server's additive share
+    of the per-prefix count histogram (`uint` mod `2^count_bits`,
+    one entry per frontier prefix) and caches the level's
+    `BatchCutState` so the next level resumes from it. Levels must be
+    evaluated in ascending order within one sweep; `reset()` starts a
+    fresh sweep over the same staged keys.
+
+    Counts use the additive integer value types whose device value is a
+    single limb (`IntType` up to 32 bits) so the key-axis reduction is
+    one `jnp.sum` in native uint32 (wrap-around IS the group law).
+    """
+
+    def __init__(
+        self,
+        dpf: DistributedPointFunction,
+        keys: Sequence,
+        budget_bytes: Optional[int] = None,
+        mesh=None,
+        metrics=None,
+    ):
+        vts = {p.value_type for p in dpf.parameters}
+        if len(vts) != 1:
+            raise ValueError(
+                "heavy-hitters hierarchies use one value type at every "
+                "level"
+            )
+        vt = next(iter(vts))
+        if not isinstance(vt, IntType) or vt.nlimbs != 1:
+            raise ValueError(
+                "count aggregation needs an additive IntType of <= 32 "
+                f"bits, got {vt}"
+            )
+        self._dpf = dpf
+        self._vt = vt
+        self._mask = np.uint64((1 << vt.bits) - 1)
+        self._staged = dpf.stage_key_batch(list(keys))
+        self._budget = budget_bytes
+        self._mesh = mesh
+        self._metrics = metrics
+        self._cuts: Optional[BatchCutState] = None
+        self._prev_level = -1
+
+    @property
+    def num_keys(self) -> int:
+        return self._staged.n
+
+    @property
+    def cuts(self) -> Optional[BatchCutState]:
+        return self._cuts
+
+    def reset(self) -> None:
+        """Drop cached cut states; the next call may start at any level."""
+        self._cuts = None
+        self._prev_level = -1
+
+    def _sum_over_keys(self, values) -> jnp.ndarray:
+        """[num_keys, P] share sum, optionally key-axis sharded."""
+        leaves = jax.tree_util.tree_leaves(values)
+        arr = leaves[0][..., 0]  # single-limb IntType: [K, P]
+        if self._mesh is not None:
+            n_dev = int(np.prod([s for s in self._mesh.devices.shape]))
+            if arr.shape[0] % n_dev == 0:
+                from ..parallel.sharded import sum_shares_over_keys
+
+                return sum_shares_over_keys(arr, self._mesh)
+        return jnp.sum(arr, axis=0, dtype=jnp.uint32)
+
+    def evaluate_level(
+        self, hierarchy_level: int, prefixes: Sequence[int]
+    ) -> np.ndarray:
+        """This server's share of the count histogram over `prefixes`
+        (strictly ascending domain indices at `hierarchy_level`)."""
+        if hierarchy_level <= self._prev_level:
+            raise ValueError(
+                f"levels must ascend within a sweep (got {hierarchy_level} "
+                f"after {self._prev_level}; reset() starts a new sweep)"
+            )
+        prefixes = [int(p) for p in prefixes]
+        cuts = self._cuts
+        resume = cuts is not None and cuts.hierarchy_level < hierarchy_level
+        stop = self._dpf._hierarchy_to_tree[hierarchy_level]
+        start = (
+            self._dpf._hierarchy_to_tree[cuts.hierarchy_level]
+            if resume
+            else 0
+        )
+        plan = plan_level(
+            self._staged.n,
+            len(prefixes),
+            stop - start,
+            self._dpf._blocks_needed[hierarchy_level],
+            self._budget,
+        )
+        if self._metrics is not None:
+            self._metrics.counter(
+                "hh.cut_resume_prefixes" if resume else
+                "hh.root_eval_prefixes"
+            ).inc(len(prefixes))
+            self._metrics.counter("hh.level_chunks").inc(plan.num_chunks)
+
+        shares: List[np.ndarray] = []
+        cut_parts: List[BatchCutState] = []
+        for c in range(plan.num_chunks):
+            chunk = prefixes[
+                c * plan.chunk_prefixes : (c + 1) * plan.chunk_prefixes
+            ]
+            values, cut = self._dpf.evaluate_prefixes_batch(
+                self._staged,
+                hierarchy_level,
+                chunk,
+                cuts=cuts if resume else None,
+            )
+            shares.append(np.asarray(self._sum_over_keys(values)))
+            cut_parts.append(cut)
+        if len(cut_parts) == 1:
+            merged = cut_parts[0]
+        else:
+            merged = BatchCutState(
+                hierarchy_level=hierarchy_level,
+                prefixes=np.concatenate([c.prefixes for c in cut_parts]),
+                seeds=jnp.concatenate(
+                    [c.seeds for c in cut_parts], axis=1
+                ),
+                control=jnp.concatenate(
+                    [c.control for c in cut_parts], axis=1
+                ),
+            )
+        self._cuts = merged
+        self._prev_level = hierarchy_level
+        out = np.concatenate(shares).astype(np.uint64) & self._mask
+        return out.astype(np.uint32)

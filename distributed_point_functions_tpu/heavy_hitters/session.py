@@ -1,0 +1,238 @@
+"""Leader/Helper deployment of the heavy-hitters sweep.
+
+Same topology as `serving/service.py`: the Leader owns the sweep state
+machine (`protocol.FrontierSweep`), sends each round's frontier to the
+Helper over any `serving.transport.Transport`, and — in the transport's
+`on_sent` window — computes its OWN share while the Helper computes
+theirs, so the two halves of every round overlap. Reconstructed counts
+(the only values either side learns) drive threshold pruning; the next
+frontier ships with the next round.
+
+Wire format (versioned, fixed-width little-endian; rides inside the
+4-byte framed messages of `serving.transport`):
+
+    request  = MAGIC "DPHH" | u8 version | u8 kind=1 | u32 round
+             | u32 num_prefixes | num_prefixes * u64 frontier
+    response = MAGIC "DPHH" | u8 version | u8 kind=2 | u32 round
+             | u32 num_prefixes | num_prefixes * u32 shares
+    reset    = MAGIC "DPHH" | u8 version | u8 kind=3   (reply: kind=4)
+
+Prefixes are u64 on the wire, which is why `HeavyHittersConfig` caps
+`domain_bits` at 64; shares are u32 (`count_bits <= 32`).
+
+Per-round metrics land in a `serving.metrics.MetricsRegistry`:
+`hh.keys_live` / `hh.frontier_width` / `hh.prune_ratio` gauges,
+`hh.bytes_sent` / `hh.bytes_received` counters, and an `hh.round_ms`
+histogram — the counters the bench and the demo report.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..serving.metrics import MetricsRegistry
+from ..serving.transport import Transport
+from .protocol import (
+    FrontierSweep,
+    HeavyHittersResult,
+    HeavyHittersServer,
+    ProtocolError,
+    reconstruct_counts,
+)
+
+_MAGIC = b"DPHH"
+_VERSION = 1
+_KIND_EVAL_REQUEST = 1
+_KIND_EVAL_RESPONSE = 2
+_KIND_RESET_REQUEST = 3
+_KIND_RESET_RESPONSE = 4
+
+_HEADER = struct.Struct("<4sBB")
+_EVAL_HEADER = struct.Struct("<4sBBII")
+
+
+def encode_eval_request(
+    round_index: int, frontier: np.ndarray
+) -> bytes:
+    frontier = np.ascontiguousarray(frontier, dtype="<u8")
+    return (
+        _EVAL_HEADER.pack(
+            _MAGIC, _VERSION, _KIND_EVAL_REQUEST,
+            round_index, frontier.shape[0],
+        )
+        + frontier.tobytes()
+    )
+
+
+def encode_eval_response(
+    round_index: int, shares: np.ndarray
+) -> bytes:
+    shares = np.ascontiguousarray(shares, dtype="<u4")
+    return (
+        _EVAL_HEADER.pack(
+            _MAGIC, _VERSION, _KIND_EVAL_RESPONSE,
+            round_index, shares.shape[0],
+        )
+        + shares.tobytes()
+    )
+
+
+def _check_header(payload: bytes, expected_kind: int) -> None:
+    if len(payload) < _HEADER.size:
+        raise ProtocolError(f"short message ({len(payload)} bytes)")
+    magic, version, kind = _HEADER.unpack_from(payload)
+    if magic != _MAGIC:
+        raise ProtocolError(f"bad magic {magic!r}")
+    if version != _VERSION:
+        raise ProtocolError(f"unsupported wire version {version}")
+    if kind != expected_kind:
+        raise ProtocolError(
+            f"unexpected message kind {kind} (wanted {expected_kind})"
+        )
+
+
+def _decode_eval(payload: bytes, kind: int, itemsize: int, dtype):
+    _check_header(payload, kind)
+    if len(payload) < _EVAL_HEADER.size:
+        raise ProtocolError("truncated eval header")
+    _, _, _, round_index, count = _EVAL_HEADER.unpack_from(payload)
+    body = payload[_EVAL_HEADER.size :]
+    if len(body) != count * itemsize:
+        raise ProtocolError(
+            f"eval body is {len(body)} bytes, expected {count * itemsize}"
+        )
+    return round_index, np.frombuffer(body, dtype=dtype)
+
+
+def decode_eval_request(payload: bytes):
+    """-> (round_index, frontier uint64[num_prefixes])."""
+    return _decode_eval(payload, _KIND_EVAL_REQUEST, 8, "<u8")
+
+
+def decode_eval_response(payload: bytes):
+    """-> (round_index, shares uint32[num_prefixes])."""
+    return _decode_eval(payload, _KIND_EVAL_RESPONSE, 4, "<u4")
+
+
+class HeavyHittersHelper:
+    """The Helper role: a `bytes -> bytes` handler around one server.
+
+    Plug it into `serving.transport.FramedTcpServer` (the TCP
+    deployment) or `InProcessTransport` (tests) unchanged. Stateful
+    across rounds — the cut-state cache lives in the wrapped
+    `HeavyHittersServer` — and accepts a reset message so one process
+    can serve successive sweeps.
+    """
+
+    def __init__(self, server: HeavyHittersServer):
+        self._server = server
+
+    @property
+    def server(self) -> HeavyHittersServer:
+        return self._server
+
+    def handle_wire(self, payload: bytes) -> bytes:
+        if len(payload) >= _HEADER.size:
+            _, _, kind = _HEADER.unpack_from(payload)
+            if kind == _KIND_RESET_REQUEST:
+                _check_header(payload, _KIND_RESET_REQUEST)
+                self._server.reset()
+                return _HEADER.pack(
+                    _MAGIC, _VERSION, _KIND_RESET_RESPONSE
+                )
+        round_index, frontier = decode_eval_request(payload)
+        shares = self._server.evaluate_round(
+            round_index, frontier.tolist()
+        )
+        return encode_eval_response(round_index, shares)
+
+
+class HeavyHittersLeader:
+    """The Leader role: drives the sweep over a transport.
+
+    Each round the frontier goes out, the Leader's own share computes in
+    the `on_sent` overlap window, the Helper's share comes back, and the
+    reconstructed counts prune the frontier. `round_timeout_ms` bounds
+    each round trip (`TransportTimeout` surfaces to the caller — a slow
+    Helper must not silently stall the sweep).
+    """
+
+    def __init__(
+        self,
+        server: HeavyHittersServer,
+        transport: Transport,
+        metrics: Optional[MetricsRegistry] = None,
+        round_timeout_ms: Optional[float] = None,
+    ):
+        self._server = server
+        self._transport = transport
+        self._metrics = metrics if metrics is not None else MetricsRegistry()
+        self._timeout = (
+            round_timeout_ms / 1e3 if round_timeout_ms else None
+        )
+
+    @property
+    def metrics(self) -> MetricsRegistry:
+        return self._metrics
+
+    def reset_helper(self) -> None:
+        """Tell the Helper to start a fresh sweep (and reset locally)."""
+        reply = self._transport.roundtrip(
+            _HEADER.pack(_MAGIC, _VERSION, _KIND_RESET_REQUEST),
+            timeout=self._timeout,
+        )
+        _check_header(reply, _KIND_RESET_RESPONSE)
+        self._server.reset()
+
+    def run(self) -> HeavyHittersResult:
+        m = self._metrics
+        m.gauge("hh.keys_live").set(self._server.num_keys)
+        config = self._server.config
+        sweep = FrontierSweep(config)
+        while not sweep.done:
+            r = sweep.round_index
+            frontier = sweep.frontier
+            payload = encode_eval_request(r, frontier)
+            own_share: list = []
+
+            def compute_own_share():
+                # on_sent may fire twice on a transparent reconnect;
+                # the share must only be computed once.
+                if not own_share:
+                    own_share.append(
+                        self._server.evaluate_round(r, frontier)
+                    )
+
+            t0 = time.perf_counter()
+            reply = self._transport.roundtrip(
+                payload,
+                timeout=self._timeout,
+                on_sent=compute_own_share,
+            )
+            round_ms = (time.perf_counter() - t0) * 1e3
+            helper_round, helper_share = decode_eval_response(reply)
+            if helper_round != r:
+                raise ProtocolError(
+                    f"helper answered round {helper_round} during "
+                    f"round {r}"
+                )
+            counts = reconstruct_counts(
+                own_share[0], helper_share, config.count_bits
+            )
+            stats = sweep.observe_counts(counts)
+            stats.wall_ms = round_ms
+            stats.bytes_sent = len(payload)
+            stats.bytes_received = len(reply)
+            m.gauge("hh.frontier_width").set(stats.frontier_width)
+            m.gauge("hh.prune_ratio").set(stats.prune_ratio)
+            m.counter("hh.bytes_sent").inc(stats.bytes_sent)
+            m.counter("hh.bytes_received").inc(stats.bytes_received)
+            m.histogram("hh.round_ms").observe(round_ms)
+            m.counter("hh.rounds").inc()
+        return HeavyHittersResult(
+            heavy_hitters=sweep.result, rounds=sweep.rounds
+        )

@@ -110,6 +110,35 @@ def _check_divisible(name: str, value: int, divisor: int) -> None:
         )
 
 
+def sum_shares_over_keys(
+    values: jnp.ndarray, mesh: Mesh, axis_name: str = "x"
+) -> jnp.ndarray:
+    """Additive key-axis reduction for heavy-hitters share histograms:
+    uint32[num_keys, P] sharded over keys -> replicated uint32[P].
+
+    Unlike the PIR combine, the group law here is plain mod-2^32
+    addition, so the reduction IS a `psum`: each device sums its key
+    slice locally and the collective adds the per-device partials.
+    `num_keys` must be divisible by the mesh size (callers fall back to
+    a single-device `jnp.sum` otherwise).
+    """
+    ndev = mesh.devices.size
+    _check_divisible("num_keys", values.shape[0], ndev)
+
+    def step(local):
+        return lax.psum(
+            jnp.sum(local, axis=0, dtype=jnp.uint32), axis_name
+        )
+
+    fn = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=P(axis_name, None),
+        out_specs=P(),
+    )
+    return jax.jit(fn)(values)
+
+
 def sharded_inner_product(mesh: Mesh, axis_name: str = "x"):
     """Jitted XOR inner product with the database sharded over records.
 

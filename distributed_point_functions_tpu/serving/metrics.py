@@ -188,3 +188,20 @@ class MetricsRegistry:
                 k: h.export() for k, h in sorted(histograms.items())
             },
         }
+
+    def snapshot(self) -> dict:
+        """`export()` under a name that pairs with `reset()`: tests and
+        benchmarks take a snapshot of exactly the activity since the
+        last reset, instead of a since-process-start aggregate."""
+        return self.export()
+
+    def reset(self) -> None:
+        """Drop every instrument so metric state cannot leak across test
+        cases or bench repetitions sharing one registry. Instruments are
+        recreated on next use; holders of old `Counter`/`Gauge`/
+        `Histogram` references keep writing to orphaned objects, so
+        long-lived callers should re-fetch by name after a reset."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()

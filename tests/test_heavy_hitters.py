@@ -1,0 +1,225 @@
+"""End-to-end and unit coverage for the heavy-hitters subsystem.
+
+The acceptance bar: with keys drawn from a known value multiset, the
+reconstructed heavy-hitter set at threshold t exactly equals the
+plaintext answer — on the in-process transport AND over real TCP — and
+budget-chunked evaluation is bit-identical to unchunked (lanes are
+independent, so chunking must be invisible).
+
+One module-scoped fixture generates the client key pairs once; every
+test builds its own (cheap) servers over them so sweep state never
+leaks between cases.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu import heavy_hitters as hh
+from distributed_point_functions_tpu.serving.metrics import MetricsRegistry
+from distributed_point_functions_tpu.serving.transport import (
+    FramedTcpServer,
+    InProcessTransport,
+    TcpTransport,
+)
+
+# 8-bit domain, two 4-bit levels: frontier 16 wide at round 0, tiny jit
+# shapes, and non-trivial pruning. 3 appears 3x, 77 and 9 twice, the
+# rest once — heavy hitters at t=2 are {3: 3, 77: 2, 9: 2}.
+VALUES = [3, 3, 3, 77, 77, 9, 9, 200]
+CONFIG = hh.HeavyHittersConfig(domain_bits=8, level_bits=4, threshold=2)
+
+
+@pytest.fixture(scope="module")
+def key_pairs():
+    client = hh.HeavyHittersClient(CONFIG)
+    pairs = [client.generate_report(v) for v in VALUES]
+    return [p[0] for p in pairs], [p[1] for p in pairs]
+
+
+def _servers(key_pairs, config=CONFIG, **kwargs):
+    keys0, keys1 = key_pairs
+    return (
+        hh.HeavyHittersServer(config, keys0, **kwargs),
+        hh.HeavyHittersServer(config, keys1, **kwargs),
+    )
+
+
+def test_run_protocol_matches_plaintext_oracle(key_pairs):
+    s0, s1 = _servers(key_pairs)
+    result = hh.run_protocol(s0, s1)
+    want = hh.plaintext_heavy_hitters(VALUES, CONFIG)
+    assert result.as_dict() == want == {3: 3, 77: 2, 9: 2}
+    # Round 0 counted 16 candidate prefixes; only prefixes of surviving
+    # values descend.
+    assert result.rounds[0].frontier_width == 16
+    assert result.rounds[0].survivors == len(
+        {v >> 4 for v in (3, 77, 9)}
+    )
+
+
+def test_leader_helper_in_process_matches_oracle(key_pairs):
+    s0, s1 = _servers(key_pairs)
+    metrics = MetricsRegistry()
+    leader = hh.HeavyHittersLeader(
+        s0,
+        InProcessTransport(hh.HeavyHittersHelper(s1).handle_wire),
+        metrics=metrics,
+    )
+    result = leader.run()
+    assert result.as_dict() == hh.plaintext_heavy_hitters(VALUES, CONFIG)
+    snap = metrics.snapshot()
+    assert snap["counters"]["hh.rounds"] == len(result.rounds) == 2
+    assert snap["counters"]["hh.bytes_sent"] == sum(
+        st.bytes_sent for st in result.rounds
+    )
+    assert snap["gauges"]["hh.keys_live"] == len(VALUES)
+
+
+def test_leader_helper_over_tcp_matches_oracle(key_pairs):
+    s0, s1 = _servers(key_pairs)
+    helper = hh.HeavyHittersHelper(s1)
+    with FramedTcpServer(helper.handle_wire, port=0, name="hh-test") as srv:
+        with TcpTransport("localhost", srv.port) as transport:
+            leader = hh.HeavyHittersLeader(
+                s0, transport, round_timeout_ms=120_000.0
+            )
+            result = leader.run()
+    assert result.as_dict() == hh.plaintext_heavy_hitters(VALUES, CONFIG)
+
+
+def test_chunked_evaluation_bit_identical_to_unchunked(key_pairs):
+    keys0, _ = key_pairs
+    dpf = CONFIG.make_dpf()
+    whole = hh.LevelAggregator(dpf, keys0)
+    # Budget that fits only 2 prefix lanes per chunk: the 16-wide round-0
+    # frontier runs as 8 fused programs instead of 1.
+    tiny = hh.LevelAggregator(
+        dpf,
+        keys0,
+        budget_bytes=len(keys0) * 2 * hh.lane_bytes(16, 1),
+    )
+    frontier0 = list(range(16))
+    a = whole.evaluate_level(0, frontier0)
+    b = tiny.evaluate_level(0, frontier0)
+    np.testing.assert_array_equal(a, b)
+
+    # The merged chunked cut-state must serve the next level identically
+    # to the unchunked cache (non-power-of-two 48-wide frontier).
+    frontier1 = sorted((p << 4) | c for p in (0, 4, 12) for c in range(16))
+    np.testing.assert_array_equal(
+        whole.evaluate_level(1, frontier1),
+        tiny.evaluate_level(1, frontier1),
+    )
+
+
+def test_level_plan_respects_budget():
+    plan = hh.plan_level(
+        num_keys=100, num_prefixes=1000, walk_levels=10, value_blocks=1,
+        budget_bytes=1 << 20,
+    )
+    assert plan.chunk_prefixes & (plan.chunk_prefixes - 1) == 0
+    assert plan.bytes_peak <= plan.budget_bytes
+    assert plan.num_chunks * plan.chunk_prefixes >= plan.num_prefixes
+    # A budget too small for even one lane still makes progress.
+    floor = hh.plan_level(100, 1000, 10, 1, budget_bytes=1)
+    assert floor.chunk_prefixes == 1
+
+
+def test_sharded_key_sum_matches_single_device(key_pairs):
+    from distributed_point_functions_tpu.parallel.sharded import (
+        make_mesh,
+        sum_shares_over_keys,
+    )
+
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(3)
+    values = rng.integers(0, 1 << 32, size=(8, 6), dtype=np.uint32)
+    got = np.asarray(sum_shares_over_keys(values, mesh))
+    np.testing.assert_array_equal(
+        got, values.astype(np.uint64).sum(axis=0) & 0xFFFFFFFF
+    )
+
+    # Through the aggregator: mesh-sharded shares equal the plain path
+    # (8 keys over 8 virtual devices).
+    keys0, _ = key_pairs
+    dpf = CONFIG.make_dpf()
+    plain = hh.LevelAggregator(dpf, keys0[:8])
+    sharded = hh.LevelAggregator(dpf, keys0[:8], mesh=mesh)
+    frontier = list(range(16))
+    np.testing.assert_array_equal(
+        plain.evaluate_level(0, frontier),
+        sharded.evaluate_level(0, frontier),
+    )
+
+
+def test_frontier_sweep_early_exit_when_nothing_survives(key_pairs):
+    config = hh.HeavyHittersConfig(
+        domain_bits=8, level_bits=4, threshold=100
+    )
+    s0, s1 = _servers(key_pairs, config=config)
+    result = hh.run_protocol(s0, s1)
+    assert result.as_dict() == {}
+    assert len(result.rounds) == 1  # pruned to nothing, never descended
+    assert result.rounds[0].survivors == 0
+
+
+def test_round_order_is_enforced(key_pairs):
+    s0, _ = _servers(key_pairs)
+    with pytest.raises(hh.ProtocolError, match="out of order"):
+        s0.evaluate_round(1, [0])
+    s0.evaluate_round(0, list(range(16)))
+    with pytest.raises(hh.ProtocolError, match="out of order"):
+        s0.evaluate_round(0, list(range(16)))
+    s0.reset()
+    s0.evaluate_round(0, list(range(16)))
+
+
+def test_wire_codec_roundtrip_and_rejection():
+    frontier = np.array([0, 5, 1 << 40], dtype=np.uint64)
+    req = hh.encode_eval_request(3, frontier)
+    r, decoded = hh.decode_eval_request(req)
+    assert r == 3
+    np.testing.assert_array_equal(decoded, frontier)
+
+    shares = np.array([7, 0, 0xFFFFFFFF], dtype=np.uint32)
+    resp = hh.encode_eval_response(3, shares)
+    r, decoded = hh.decode_eval_response(resp)
+    assert r == 3
+    np.testing.assert_array_equal(decoded, shares)
+
+    with pytest.raises(hh.ProtocolError, match="magic"):
+        hh.decode_eval_request(b"XXXX" + req[4:])
+    with pytest.raises(hh.ProtocolError, match="kind"):
+        hh.decode_eval_request(resp)
+    with pytest.raises(hh.ProtocolError, match="body"):
+        hh.decode_eval_request(req[:-3])
+
+
+def test_value_encoding():
+    assert hh.encode_value(b"ab", 16) == 0x6162
+    assert hh.encode_value("ab", 16) == 0x6162
+    assert hh.decode_value(0x6162, 16) == b"ab"
+    assert hh.encode_value(200, 8) == 200
+    with pytest.raises(ValueError, match="bytes"):
+        hh.encode_value(b"abc", 16)
+    with pytest.raises(ValueError, match="domain"):
+        hh.encode_value(256, 8)
+    with pytest.raises(ValueError):
+        hh.HeavyHittersConfig(domain_bits=128)
+
+
+def test_metrics_snapshot_reset_isolation():
+    registry = MetricsRegistry()
+    registry.counter("hh.rounds").inc(5)
+    registry.gauge("hh.keys_live").set(8)
+    registry.histogram("hh.round_ms").observe(1.5)
+    snap = registry.snapshot()
+    assert snap["counters"]["hh.rounds"] == 5
+    registry.reset()
+    clean = registry.snapshot()
+    assert clean["counters"] == {}
+    assert clean["gauges"] == {}
+    assert clean["histograms"] == {}
+    # Instruments recreate on next use after a reset.
+    registry.counter("hh.rounds").inc(1)
+    assert registry.snapshot()["counters"]["hh.rounds"] == 1

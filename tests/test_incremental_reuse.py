@@ -1,0 +1,149 @@
+"""Cut-state reuse bit-identity for batched incremental evaluation.
+
+The tentpole invariant of the heavy-hitters aggregator: evaluating
+hierarchy level ℓ from the `BatchCutState` cached at level ℓ−1 must be
+*bit-identical* to a fresh root-to-ℓ evaluation — resuming only skips
+re-walking tree levels whose output is already determined, it never
+changes a single seed, control bit, or value share. Checked across two
+hierarchy geometries (even 4-bit steps and uneven non-byte-aligned
+steps) including a non-power-of-two prefix frontier, against both the
+from-root batch and the per-key `evaluate_at` oracle.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.dpf import (
+    DistributedPointFunction,
+    DpfParameters,
+)
+from distributed_point_functions_tpu.value_types import IntType
+
+# Two geometries: even steps, and uneven steps with a non-byte-aligned
+# total — distinct tree shortenings exercise distinct start/stop walks.
+GEOMETRIES = {
+    "even-4bit-steps": [4, 8, 12],
+    "uneven-steps": [3, 7, 13],
+}
+
+
+def _make(widths, alphas):
+    params = [DpfParameters(w, IntType(32)) for w in widths]
+    dpf = DistributedPointFunction.create_incremental(params)
+    betas = [1] * len(widths)
+    pairs = [dpf.generate_keys_incremental(a, betas) for a in alphas]
+    staged0 = dpf.stage_key_batch([p[0] for p in pairs])
+    staged1 = dpf.stage_key_batch([p[1] for p in pairs])
+    return dpf, pairs, staged0, staged1
+
+
+def _values_array(values) -> np.ndarray:
+    import jax
+
+    return np.asarray(jax.tree_util.tree_leaves(values)[0][..., 0])
+
+
+@pytest.mark.parametrize("geometry", sorted(GEOMETRIES))
+def test_resume_bit_identical_to_root(geometry):
+    widths = GEOMETRIES[geometry]
+    alphas = [0, 3, (1 << widths[-1]) - 1, 1 << (widths[-1] - 1)]
+    dpf, pairs, staged0, staged1 = _make(widths, alphas)
+
+    # Non-power-of-two frontier at level 0 (5 of the 8+ prefixes),
+    # including every alpha's true prefix and some misses.
+    shift0 = widths[-1] - widths[0]
+    level0 = sorted({a >> shift0 for a in alphas} | {1, 2})[:5]
+    assert len(level0) not in (1, 2, 4, 8)
+
+    for staged in (staged0, staged1):
+        _, cuts0 = dpf.evaluate_prefixes_batch(staged, 0, level0)
+
+        # Level-1 frontier: all children of the level-0 prefixes (also
+        # non-power-of-two), evaluated two ways.
+        step = widths[1] - widths[0]
+        level1 = sorted(
+            (p << step) | c for p in level0 for c in range(1 << step)
+        )
+        v_resume, cuts_resume = dpf.evaluate_prefixes_batch(
+            staged, 1, level1, cuts=cuts0
+        )
+        v_root, cuts_root = dpf.evaluate_prefixes_batch(staged, 1, level1)
+
+        np.testing.assert_array_equal(
+            _values_array(v_resume), _values_array(v_root)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(cuts_resume.seeds), np.asarray(cuts_root.seeds)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(cuts_resume.control), np.asarray(cuts_root.control)
+        )
+
+        # And a second descent: level 2 resumed from the level-1 cuts
+        # (themselves produced by a resume) still matches from-root.
+        step2 = widths[2] - widths[1]
+        level2 = sorted(
+            (p << step2) | c for p in level1[:3] for c in range(1 << step2)
+        )
+        v2_resume, _ = dpf.evaluate_prefixes_batch(
+            staged, 2, level2, cuts=cuts_resume
+        )
+        v2_root, _ = dpf.evaluate_prefixes_batch(staged, 2, level2)
+        np.testing.assert_array_equal(
+            _values_array(v2_resume), _values_array(v2_root)
+        )
+
+
+def test_resume_matches_evaluate_at_oracle():
+    widths = GEOMETRIES["even-4bit-steps"]
+    alphas = [5, 100, 2048]
+    dpf, pairs, staged0, _ = _make(widths, alphas)
+
+    shift0 = widths[-1] - widths[0]
+    level0 = sorted({a >> shift0 for a in alphas} | {0})
+    _, cuts0 = dpf.evaluate_prefixes_batch(staged0, 0, level0)
+    step = widths[1] - widths[0]
+    level1 = sorted(
+        (p << step) | c for p in level0 for c in range(1 << step)
+    )
+    v_resume, _ = dpf.evaluate_prefixes_batch(
+        staged0, 1, level1, cuts=cuts0
+    )
+    got = _values_array(v_resume)
+
+    for i, (k0, _) in enumerate(pairs):
+        want = _values_array(dpf.evaluate_at(k0, 1, level1))
+        np.testing.assert_array_equal(got[i], want)
+
+
+def test_shares_reconstruct_to_point_function():
+    """Both parties' batched shares sum to the indicator histogram."""
+    widths = GEOMETRIES["uneven-steps"]
+    alphas = [9, 9, 4000]
+    dpf, pairs, staged0, staged1 = _make(widths, alphas)
+
+    shift0 = widths[-1] - widths[0]
+    level0 = sorted({a >> shift0 for a in alphas} | {3, 5})
+    v0, c0 = dpf.evaluate_prefixes_batch(staged0, 0, level0)
+    v1, c1 = dpf.evaluate_prefixes_batch(staged1, 0, level0)
+    total = (
+        _values_array(v0).astype(np.uint64).sum(axis=0)
+        + _values_array(v1).astype(np.uint64).sum(axis=0)
+    ) & np.uint64(0xFFFFFFFF)
+    from collections import Counter
+
+    truth = Counter(a >> shift0 for a in alphas)
+    np.testing.assert_array_equal(
+        total, [truth.get(p, 0) for p in level0]
+    )
+
+
+def test_stale_and_missing_cuts_are_rejected():
+    widths = GEOMETRIES["even-4bit-steps"]
+    dpf, pairs, staged0, _ = _make(widths, [7])
+    _, cuts0 = dpf.evaluate_prefixes_batch(staged0, 0, [0, 1])
+    # A level-1 prefix whose parent was never evaluated at level 0.
+    step = widths[1] - widths[0]
+    orphan = 3 << step
+    with pytest.raises(ValueError):
+        dpf.evaluate_prefixes_batch(staged0, 1, [orphan], cuts=cuts0)

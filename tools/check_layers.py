@@ -3,12 +3,14 @@
 
 Two rules over `distributed_point_functions_tpu/`:
 
-1. **Layer DAG** — `serving -> pir -> ops`, never the reverse, and the
-   serving runtime is a leaf layer: no library module outside
-   `serving/` may import `serving` (applications — examples/, bench.py,
-   benchmarks/ — may). Checked over ALL imports, including
-   function-level ones, because a reversed dependency is wrong wherever
-   the import statement sits.
+1. **Layer DAG** — `heavy_hitters -> serving -> pir -> ops`, never the
+   reverse, with restricted layers: the serving runtime may only be
+   imported by `heavy_hitters/` (the one in-library session kind built
+   on it), and `heavy_hitters` itself is application-facing — no
+   library layer imports it (applications — examples/, bench.py,
+   benchmarks/ — may import anything). Checked over ALL imports,
+   including function-level ones, because a reversed dependency is
+   wrong wherever the import statement sits.
 
 2. **No module-level import cycles** — the repo's sanctioned idiom for
    breaking genuine cycles is the function-level import, so only
@@ -30,7 +32,13 @@ ROOT = Path(__file__).resolve().parent.parent
 # Layer order, outermost first: a module may import same-or-lower
 # layers only. Subpackages not listed are unconstrained by rule 1
 # (but still cycle-checked by rule 2).
-LAYERS = {"serving": 3, "pir": 2, "ops": 1}
+LAYERS = {"heavy_hitters": 4, "serving": 3, "pir": 2, "ops": 1}
+
+# Restricted layers: importable only from the listed source layers
+# (plus themselves). serving stays a near-leaf — its one in-library
+# consumer is the heavy_hitters session; heavy_hitters is a true leaf
+# only applications may import.
+RESTRICTED = {"serving": {"heavy_hitters"}, "heavy_hitters": set()}
 
 
 def module_name(path: Path) -> str:
@@ -139,10 +147,16 @@ def main() -> int:
             tgt_layer = layer_of(name)
             if tgt_layer is None or src_layer == tgt_layer:
                 continue
-            if tgt_layer == "serving":
+            if (
+                tgt_layer in RESTRICTED
+                and src_layer not in RESTRICTED[tgt_layer]
+            ):
+                allowed = ", ".join(sorted(RESTRICTED[tgt_layer])) or (
+                    "applications"
+                )
                 violations.append(
-                    f"{module}: imports {name} — only serving/ (and "
-                    "applications) may depend on the serving runtime"
+                    f"{module}: imports {name} — only {allowed} (and "
+                    f"applications) may depend on the {tgt_layer} layer"
                 )
             elif (
                 src_layer is not None
@@ -153,7 +167,7 @@ def main() -> int:
                 # their upward edges.
                 violations.append(
                     f"{module}: imports {name} — reverses the "
-                    f"serving -> pir -> ops layer DAG"
+                    f"heavy_hitters -> serving -> pir -> ops layer DAG"
                 )
         graph[module] = {
             n for imp in top_imports
