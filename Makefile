@@ -19,7 +19,7 @@ test-fast:     ## ~8 min hermetic signal incl. core invariants + tiny Pallas
 	    tests/test_testing_utils.py tests/test_demo.py \
 	    tests/test_core_fast.py \
 	    tests/test_serving_batcher.py tests/test_serving_transport.py \
-	    tests/test_serving_service.py \
+	    tests/test_serving_service.py tests/test_observability.py \
 	    tests/test_heavy_hitters.py tests/test_incremental_reuse.py \
 	    tests/test_pallas_fast.py tests/test_bench_ladder.py -q
 

@@ -1556,6 +1556,18 @@ def main():
 
     def _dump_extra():
         try:
+            # Refresh the observability aggregates at each dump: the
+            # per-stage span summary and the planner-tier counters
+            # accumulated over everything the bench ran so far.
+            from distributed_point_functions_tpu.observability import (
+                tracing,
+            )
+
+            extra["stage_spans"] = tracing.stage_summary()
+            extra["runtime_counters"] = tracing.runtime_counters.export()
+        except Exception:  # noqa: BLE001 - observability only
+            pass
+        try:
             os.makedirs("benchmarks/results", exist_ok=True)
             with open("benchmarks/results/bench_extra.json", "w") as f:
                 json.dump(extra, f, indent=2)

@@ -115,9 +115,12 @@ def run_heavy_hitters_bench():
     """Sweep the grid, check each point against the oracle, return the
     report dict (also written to HH_BENCH_OUT unless empty)."""
     from distributed_point_functions_tpu import heavy_hitters as hh
+    from distributed_point_functions_tpu.observability import tracing
     from distributed_point_functions_tpu.serving.metrics import (
         MetricsRegistry,
     )
+
+    tracing.reset_stages()
 
     num_clients = int(os.environ.get("HH_BENCH_CLIENTS", 48))
     level_bits = int(os.environ.get("HH_BENCH_LEVEL_BITS", 4))
@@ -232,6 +235,11 @@ def run_heavy_hitters_bench():
         if speedups
         else None,
         "correctness_ok": correctness_ok,
+        # Sweep-wide span summary (helper_evaluate / leader_own_share /
+        # reconstruct / round percentiles) and the final measured
+        # point's metrics snapshot.
+        "stage_spans": tracing.stage_summary(),
+        "metrics_snapshot": snap,
     }
 
     out = os.environ.get(

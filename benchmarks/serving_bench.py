@@ -101,12 +101,17 @@ def run_serving_bench():
     from distributed_point_functions_tpu.pir.database import (
         DenseDpfPirDatabase,
     )
+    from distributed_point_functions_tpu.observability import tracing
     from distributed_point_functions_tpu.pir.server import DenseDpfPirServer
     from distributed_point_functions_tpu.serving import (
         PlainSession,
         ServingConfig,
         bucket_size,
     )
+
+    # Stage spans accumulate process-wide; reset so the report's span
+    # summary covers exactly this sweep.
+    tracing.reset_stages()
 
     num_records = int(os.environ.get("SERVING_BENCH_RECORDS", 2048))
     record_bytes = int(os.environ.get("SERVING_BENCH_RECORD_BYTES", 32))
@@ -233,6 +238,11 @@ def run_serving_bench():
         "correctness_ok": correctness_ok,
         "jit_bucket_compiles": compiles,
         "batched_metrics": batched_metrics,
+        # Per-stage span summary (queue wait / batch assembly / device
+        # compute / evaluate_* percentiles) and the planner-tier
+        # counters, so the report decomposes where the q/s went.
+        "stage_spans": tracing.stage_summary(),
+        "runtime_counters": tracing.runtime_counters.export(),
     }
     _log(
         f"best batched {best_batched:.1f} q/s vs unbatched "

@@ -11,7 +11,10 @@
 #                    interpret cases of every serving Pallas kernel)
 #   6. hh-smoke    — heavy-hitters sweep end to end (tiny domain,
 #                    2 levels, in-process transport, plaintext check)
-#   7. dryrun      — 8-virtual-device multichip compile+step
+#   7. admin-smoke — operator telemetry endpoint: serve one traced
+#                    request, then scrape /healthz, /metrics (Prometheus
+#                    text), and /tracez off a live AdminServer
+#   8. dryrun      — 8-virtual-device multichip compile+step
 # Benchmarks are excluded exactly as the reference excludes
 # `--test_tag_filters=-benchmark`. `FULL=1` appends the whole suite.
 set -u -o pipefail
@@ -49,6 +52,30 @@ stage test-fast make -s test-fast
 
 stage hh-smoke env JAX_PLATFORMS=cpu \
     python examples/heavy_hitters_demo.py --smoke
+
+stage admin-smoke env JAX_PLATFORMS=cpu python -c '
+import json, urllib.request
+from distributed_point_functions_tpu import observability as obs
+from distributed_point_functions_tpu.serving.metrics import MetricsRegistry
+
+reg = MetricsRegistry()
+rec = obs.tracing.FlightRecorder()
+with obs.tracing.trace_request("smoke.request", recorder=rec):
+    with reg.timed("smoke.request_ms"):
+        with obs.tracing.span("device_compute"):
+            pass
+with obs.AdminServer(registry=reg, recorder=rec) as admin:
+    base = f"http://127.0.0.1:{admin.port}"
+    assert urllib.request.urlopen(base + "/healthz").read() == b"ok\n"
+    text = urllib.request.urlopen(base + "/metrics").read().decode()
+    assert "# TYPE dpf_smoke_request_ms histogram" in text, text
+    assert "dpf_smoke_request_ms_bucket" in text, text
+    tracez = json.load(urllib.request.urlopen(base + "/tracez"))
+    assert tracez["recorded"] == 1 and tracez["slowest"], tracez
+    spans = [s["name"] for s in tracez["slowest"][0]["spans"]]
+    assert "device_compute" in spans, spans
+print("admin-smoke: OK (/healthz, /metrics, /tracez)")
+'
 
 stage dryrun make -s dryrun
 

@@ -31,7 +31,9 @@ from .session import (
     HeavyHittersHelper,
     HeavyHittersLeader,
     decode_eval_request,
+    decode_eval_request_full,
     decode_eval_response,
+    decode_eval_response_full,
     encode_eval_request,
     encode_eval_response,
 )
@@ -49,7 +51,9 @@ __all__ = [
     "ProtocolError",
     "RoundStats",
     "decode_eval_request",
+    "decode_eval_request_full",
     "decode_eval_response",
+    "decode_eval_response_full",
     "decode_value",
     "encode_eval_request",
     "encode_eval_response",

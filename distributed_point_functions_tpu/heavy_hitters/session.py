@@ -12,18 +12,34 @@ Wire format (versioned, fixed-width little-endian; rides inside the
 4-byte framed messages of `serving.transport`):
 
     request  = MAGIC "DPHH" | u8 version | u8 kind=1 | u32 round
-             | u32 num_prefixes | num_prefixes * u64 frontier
+             | u32 num_prefixes | [v2: 8-byte trace id]
+             | num_prefixes * u64 frontier
     response = MAGIC "DPHH" | u8 version | u8 kind=2 | u32 round
-             | u32 num_prefixes | num_prefixes * u32 shares
+             | u32 num_prefixes | [v2: f64 helper_ms]
+             | num_prefixes * u32 shares
     reset    = MAGIC "DPHH" | u8 version | u8 kind=3   (reply: kind=4)
+
+Version 2 adds observability: the Leader's trace id rides in the
+request (so one id names both halves of a round in either party's
+flight recorder) and the Helper reports its server-side evaluation
+milliseconds in the response (so the Leader splits the helper leg into
+network vs. remote compute). The Helper always answers in the
+*request's* version; a Leader talking to a v1-only Helper sees a
+`ProtocolError` (in-process) or a closed connection (`TransportError`
+over TCP) on its first v2 round, downgrades its wire version once, and
+re-sends the round — the own-share overlap hook is idempotent, so the
+resend costs only the wire leg.
 
 Prefixes are u64 on the wire, which is why `HeavyHittersConfig` caps
 `domain_bits` at 64; shares are u32 (`count_bits <= 32`).
 
 Per-round metrics land in a `serving.metrics.MetricsRegistry`:
 `hh.keys_live` / `hh.frontier_width` / `hh.prune_ratio` gauges,
-`hh.bytes_sent` / `hh.bytes_received` counters, and an `hh.round_ms`
-histogram — the counters the bench and the demo report.
+`hh.bytes_sent` / `hh.bytes_received` / `hh.wire_downgrades` counters,
+and `hh.round_ms` / `hh.helper_remote_ms` / `hh.helper_network_ms`
+histograms — the counters the bench and the demo report. Each sweep
+roots one observability trace (`hh.sweep`) whose per-round spans carry
+frontier width and prune ratio.
 """
 
 from __future__ import annotations
@@ -34,8 +50,9 @@ from typing import Optional
 
 import numpy as np
 
+from ..observability import tracing
 from ..serving.metrics import MetricsRegistry
-from ..serving.transport import Transport
+from ..serving.transport import Transport, TransportError, TransportTimeout
 from .protocol import (
     FrontierSweep,
     HeavyHittersResult,
@@ -45,7 +62,8 @@ from .protocol import (
 )
 
 _MAGIC = b"DPHH"
-_VERSION = 1
+_VERSION = 2
+_SUPPORTED_VERSIONS = (1, 2)
 _KIND_EVAL_REQUEST = 1
 _KIND_EVAL_RESPONSE = 2
 _KIND_RESET_REQUEST = 3
@@ -53,69 +71,118 @@ _KIND_RESET_RESPONSE = 4
 
 _HEADER = struct.Struct("<4sBB")
 _EVAL_HEADER = struct.Struct("<4sBBII")
+# v2 extensions, immediately after the eval header.
+_REQ_TRACE = struct.Struct("<8s")   # request: raw trace id (zeros = none)
+_RESP_TIMING = struct.Struct("<d")  # response: helper-side eval ms
 
 
 def encode_eval_request(
-    round_index: int, frontier: np.ndarray
+    round_index: int,
+    frontier: np.ndarray,
+    version: int = _VERSION,
+    trace_id: Optional[str] = None,
 ) -> bytes:
+    if version not in _SUPPORTED_VERSIONS:
+        raise ValueError(f"unsupported wire version {version}")
     frontier = np.ascontiguousarray(frontier, dtype="<u8")
+    ext = b""
+    if version >= 2:
+        raw = bytes.fromhex(trace_id) if trace_id else b"\x00" * 8
+        if len(raw) != 8:
+            raise ValueError(f"trace id must be 16 hex chars: {trace_id!r}")
+        ext = _REQ_TRACE.pack(raw)
     return (
         _EVAL_HEADER.pack(
-            _MAGIC, _VERSION, _KIND_EVAL_REQUEST,
+            _MAGIC, version, _KIND_EVAL_REQUEST,
             round_index, frontier.shape[0],
         )
+        + ext
         + frontier.tobytes()
     )
 
 
 def encode_eval_response(
-    round_index: int, shares: np.ndarray
+    round_index: int,
+    shares: np.ndarray,
+    version: int = _VERSION,
+    helper_ms: float = 0.0,
 ) -> bytes:
+    if version not in _SUPPORTED_VERSIONS:
+        raise ValueError(f"unsupported wire version {version}")
     shares = np.ascontiguousarray(shares, dtype="<u4")
+    ext = _RESP_TIMING.pack(float(helper_ms)) if version >= 2 else b""
     return (
         _EVAL_HEADER.pack(
-            _MAGIC, _VERSION, _KIND_EVAL_RESPONSE,
+            _MAGIC, version, _KIND_EVAL_RESPONSE,
             round_index, shares.shape[0],
         )
+        + ext
         + shares.tobytes()
     )
 
 
-def _check_header(payload: bytes, expected_kind: int) -> None:
+def _check_header(payload: bytes, expected_kind: int) -> int:
+    """Validate magic/version/kind; returns the message's version."""
     if len(payload) < _HEADER.size:
         raise ProtocolError(f"short message ({len(payload)} bytes)")
     magic, version, kind = _HEADER.unpack_from(payload)
     if magic != _MAGIC:
         raise ProtocolError(f"bad magic {magic!r}")
-    if version != _VERSION:
+    if version not in _SUPPORTED_VERSIONS:
         raise ProtocolError(f"unsupported wire version {version}")
     if kind != expected_kind:
         raise ProtocolError(
             f"unexpected message kind {kind} (wanted {expected_kind})"
         )
+    return version
 
 
-def _decode_eval(payload: bytes, kind: int, itemsize: int, dtype):
-    _check_header(payload, kind)
+def _decode_eval(payload: bytes, kind: int, itemsize: int, dtype, ext_struct):
+    version = _check_header(payload, kind)
     if len(payload) < _EVAL_HEADER.size:
         raise ProtocolError("truncated eval header")
     _, _, _, round_index, count = _EVAL_HEADER.unpack_from(payload)
-    body = payload[_EVAL_HEADER.size :]
+    offset = _EVAL_HEADER.size
+    ext = None
+    if version >= 2:
+        if len(payload) < offset + ext_struct.size:
+            raise ProtocolError("truncated v2 extension")
+        (ext,) = ext_struct.unpack_from(payload, offset)
+        offset += ext_struct.size
+    body = payload[offset:]
     if len(body) != count * itemsize:
         raise ProtocolError(
             f"eval body is {len(body)} bytes, expected {count * itemsize}"
         )
-    return round_index, np.frombuffer(body, dtype=dtype)
+    return round_index, np.frombuffer(body, dtype=dtype), version, ext
+
+
+def decode_eval_request_full(payload: bytes):
+    """-> (round_index, frontier uint64[num_prefixes], version,
+    trace_id hex str or None)."""
+    round_index, frontier, version, raw = _decode_eval(
+        payload, _KIND_EVAL_REQUEST, 8, "<u8", _REQ_TRACE
+    )
+    trace_id = raw.hex() if raw and raw != b"\x00" * 8 else None
+    return round_index, frontier, version, trace_id
+
+
+def decode_eval_response_full(payload: bytes):
+    """-> (round_index, shares uint32[num_prefixes], version,
+    helper_ms float or None)."""
+    return _decode_eval(
+        payload, _KIND_EVAL_RESPONSE, 4, "<u4", _RESP_TIMING
+    )
 
 
 def decode_eval_request(payload: bytes):
     """-> (round_index, frontier uint64[num_prefixes])."""
-    return _decode_eval(payload, _KIND_EVAL_REQUEST, 8, "<u8")
+    return decode_eval_request_full(payload)[:2]
 
 
 def decode_eval_response(payload: bytes):
     """-> (round_index, shares uint32[num_prefixes])."""
-    return _decode_eval(payload, _KIND_EVAL_RESPONSE, 4, "<u4")
+    return decode_eval_response_full(payload)[:2]
 
 
 class HeavyHittersHelper:
@@ -139,16 +206,36 @@ class HeavyHittersHelper:
         if len(payload) >= _HEADER.size:
             _, _, kind = _HEADER.unpack_from(payload)
             if kind == _KIND_RESET_REQUEST:
-                _check_header(payload, _KIND_RESET_REQUEST)
+                version = _check_header(payload, _KIND_RESET_REQUEST)
                 self._server.reset()
+                # Reply in the request's version (v1 Leaders reject v2).
                 return _HEADER.pack(
-                    _MAGIC, _VERSION, _KIND_RESET_RESPONSE
+                    _MAGIC, min(version, _VERSION), _KIND_RESET_RESPONSE
                 )
-        round_index, frontier = decode_eval_request(payload)
-        shares = self._server.evaluate_round(
-            round_index, frontier.tolist()
+        round_index, frontier, version, trace_id = (
+            decode_eval_request_full(payload)
         )
-        return encode_eval_response(round_index, shares)
+        # A propagated trace id means this round is the server half of a
+        # peer's request: root a fresh server-side trace under that id
+        # (`fresh` matters in-process, where both roles share a thread).
+        t0 = time.perf_counter()
+        with tracing.trace_request(
+            "hh.helper.round",
+            trace_id=trace_id,
+            fresh=trace_id is not None,
+            role="hh-helper",
+            round=round_index,
+        ):
+            with tracing.span(
+                "helper_evaluate", frontier_width=int(frontier.shape[0])
+            ):
+                shares = self._server.evaluate_round(
+                    round_index, frontier.tolist()
+                )
+        helper_ms = (time.perf_counter() - t0) * 1e3
+        return encode_eval_response(
+            round_index, shares, version=version, helper_ms=helper_ms
+        )
 
 
 class HeavyHittersLeader:
@@ -174,65 +261,138 @@ class HeavyHittersLeader:
         self._timeout = (
             round_timeout_ms / 1e3 if round_timeout_ms else None
         )
+        self._wire_version = _VERSION
+        self._c_downgrades = self._metrics.counter("hh.wire_downgrades")
 
     @property
     def metrics(self) -> MetricsRegistry:
         return self._metrics
 
+    @property
+    def wire_version(self) -> int:
+        """The version this Leader currently speaks (sticky-downgraded
+        to 1 after the first fault from a v1-only Helper)."""
+        return self._wire_version
+
+    def _maybe_downgrade(self, exc: Exception) -> bool:
+        """Whether `exc` looks like a v1-only peer rejecting v2 (an
+        in-process ProtocolError, or a closed connection over TCP) and a
+        downgrade is still available. Timeouts never downgrade — a slow
+        Helper is not an old Helper."""
+        if self._wire_version <= min(_SUPPORTED_VERSIONS):
+            return False
+        if isinstance(exc, TransportTimeout):
+            return False
+        self._wire_version = 1
+        self._c_downgrades.inc()
+        return True
+
     def reset_helper(self) -> None:
         """Tell the Helper to start a fresh sweep (and reset locally)."""
-        reply = self._transport.roundtrip(
-            _HEADER.pack(_MAGIC, _VERSION, _KIND_RESET_REQUEST),
-            timeout=self._timeout,
-        )
-        _check_header(reply, _KIND_RESET_RESPONSE)
+        while True:
+            try:
+                reply = self._transport.roundtrip(
+                    _HEADER.pack(
+                        _MAGIC, self._wire_version, _KIND_RESET_REQUEST
+                    ),
+                    timeout=self._timeout,
+                )
+                _check_header(reply, _KIND_RESET_RESPONSE)
+                break
+            except (ProtocolError, TransportError) as e:
+                if not self._maybe_downgrade(e):
+                    raise
         self._server.reset()
+
+    def _round_trip(self, r, frontier, on_sent, trace):
+        """One wire exchange at the current version. Returns
+        (payload, reply, helper_round, helper_share, helper_ms)."""
+        version = self._wire_version
+        trace_id = trace.trace_id if version >= 2 else None
+        payload = encode_eval_request(
+            r, frontier, version=version, trace_id=trace_id
+        )
+        reply = self._transport.roundtrip(
+            payload, timeout=self._timeout, on_sent=on_sent
+        )
+        helper_round, helper_share, _, helper_ms = (
+            decode_eval_response_full(reply)
+        )
+        return payload, reply, helper_round, helper_share, helper_ms
 
     def run(self) -> HeavyHittersResult:
         m = self._metrics
         m.gauge("hh.keys_live").set(self._server.num_keys)
         config = self._server.config
         sweep = FrontierSweep(config)
-        while not sweep.done:
-            r = sweep.round_index
-            frontier = sweep.frontier
-            payload = encode_eval_request(r, frontier)
-            own_share: list = []
+        with tracing.trace_request(
+            "hh.sweep", role="hh-leader", domain_bits=config.domain_bits
+        ) as trace:
+            while not sweep.done:
+                r = sweep.round_index
+                frontier = sweep.frontier
+                own_share: list = []
 
-            def compute_own_share():
-                # on_sent may fire twice on a transparent reconnect;
-                # the share must only be computed once.
-                if not own_share:
-                    own_share.append(
-                        self._server.evaluate_round(r, frontier)
+                def compute_own_share():
+                    # on_sent may fire twice on a transparent reconnect
+                    # (and again on a wire-version downgrade resend);
+                    # the share must only be computed once.
+                    if not own_share:
+                        with tracing.span("leader_own_share", round=r):
+                            own_share.append(
+                                self._server.evaluate_round(r, frontier)
+                            )
+
+                t0 = time.perf_counter()
+                try:
+                    payload, reply, helper_round, helper_share, helper_ms = (
+                        self._round_trip(r, frontier, compute_own_share, trace)
                     )
-
-            t0 = time.perf_counter()
-            reply = self._transport.roundtrip(
-                payload,
-                timeout=self._timeout,
-                on_sent=compute_own_share,
-            )
-            round_ms = (time.perf_counter() - t0) * 1e3
-            helper_round, helper_share = decode_eval_response(reply)
-            if helper_round != r:
-                raise ProtocolError(
-                    f"helper answered round {helper_round} during "
-                    f"round {r}"
+                except (ProtocolError, TransportError) as e:
+                    if not self._maybe_downgrade(e):
+                        raise
+                    # v1-only Helper: re-send this round at v1. The own-
+                    # share guard above makes the overlap hook idempotent,
+                    # so the resend pays only the wire leg.
+                    payload, reply, helper_round, helper_share, helper_ms = (
+                        self._round_trip(r, frontier, compute_own_share, trace)
+                    )
+                round_ms = (time.perf_counter() - t0) * 1e3
+                if helper_round != r:
+                    raise ProtocolError(
+                        f"helper answered round {helper_round} during "
+                        f"round {r}"
+                    )
+                with tracing.span("reconstruct", round=r):
+                    counts = reconstruct_counts(
+                        own_share[0], helper_share, config.count_bits
+                    )
+                stats = sweep.observe_counts(counts)
+                stats.wall_ms = round_ms
+                stats.bytes_sent = len(payload)
+                stats.bytes_received = len(reply)
+                if helper_ms is not None:
+                    network_ms = max(0.0, round_ms - helper_ms)
+                    m.histogram("hh.helper_remote_ms").observe(helper_ms)
+                    m.histogram("hh.helper_network_ms").observe(network_ms)
+                    trace.add_span(
+                        "helper_leg", round_ms, round=r,
+                        remote_ms=round(helper_ms, 3),
+                        network_ms=round(network_ms, 3),
+                    )
+                else:
+                    trace.add_span("helper_leg", round_ms, round=r)
+                trace.add_span(
+                    "round", round_ms, round=r,
+                    frontier_width=stats.frontier_width,
+                    prune_ratio=round(stats.prune_ratio, 4),
                 )
-            counts = reconstruct_counts(
-                own_share[0], helper_share, config.count_bits
-            )
-            stats = sweep.observe_counts(counts)
-            stats.wall_ms = round_ms
-            stats.bytes_sent = len(payload)
-            stats.bytes_received = len(reply)
-            m.gauge("hh.frontier_width").set(stats.frontier_width)
-            m.gauge("hh.prune_ratio").set(stats.prune_ratio)
-            m.counter("hh.bytes_sent").inc(stats.bytes_sent)
-            m.counter("hh.bytes_received").inc(stats.bytes_received)
-            m.histogram("hh.round_ms").observe(round_ms)
-            m.counter("hh.rounds").inc()
+                m.gauge("hh.frontier_width").set(stats.frontier_width)
+                m.gauge("hh.prune_ratio").set(stats.prune_ratio)
+                m.counter("hh.bytes_sent").inc(stats.bytes_sent)
+                m.counter("hh.bytes_received").inc(stats.bytes_received)
+                m.histogram("hh.round_ms").observe(round_ms)
+                m.counter("hh.rounds").inc()
         return HeavyHittersResult(
             heavy_hitters=sweep.result, rounds=sweep.rounds
         )

@@ -1,0 +1,65 @@
+"""End-to-end request observability: tracing, exposition, admin surface.
+
+Four pieces, all stdlib-only and importable from any layer above
+`utils/` (the layer DAG is serving -> observability -> utils; this
+package never imports pir/, ops/, or serving/):
+
+* `tracing` — per-request spans with trace ids, a bounded flight
+  recorder retaining the slowest/errored traces, process-wide stage
+  aggregates, and runtime counters for layers below serving.
+* `propagation` — the versioned envelope that carries a trace id on
+  the Leader->Helper wire and the Helper's stage timings back
+  (old-version peers interop by detection).
+* `exposition` — Prometheus text rendering of the metrics registry.
+* `admin` — the `/metrics` `/varz` `/healthz` `/tracez` `/profilez`
+  operator HTTP endpoint.
+"""
+
+from .admin import AdminServer
+from .exposition import parse_labeled_name, render_prometheus
+from .propagation import (
+    EnvelopeError,
+    encode_request,
+    encode_response,
+    try_decode_request,
+    try_decode_response,
+)
+from .tracing import (
+    CounterGroup,
+    FlightRecorder,
+    Trace,
+    add_span,
+    current_trace,
+    default_recorder,
+    new_trace_id,
+    reset_stages,
+    runtime_counters,
+    set_default_recorder,
+    span,
+    stage_summary,
+    trace_request,
+)
+
+__all__ = [
+    "AdminServer",
+    "CounterGroup",
+    "EnvelopeError",
+    "FlightRecorder",
+    "Trace",
+    "add_span",
+    "current_trace",
+    "default_recorder",
+    "encode_request",
+    "encode_response",
+    "new_trace_id",
+    "parse_labeled_name",
+    "render_prometheus",
+    "reset_stages",
+    "runtime_counters",
+    "set_default_recorder",
+    "span",
+    "stage_summary",
+    "trace_request",
+    "try_decode_request",
+    "try_decode_response",
+]

@@ -1,0 +1,241 @@
+"""Operator telemetry endpoint: /metrics, /varz, /healthz, /tracez,
+/profilez — a stdlib `http.server` surface any session can hang off a
+port.
+
+The serving runtime's observability state (metrics registry, flight
+recorder, stage aggregates, runtime counters) is in-process; this
+server is the scrape surface:
+
+    /healthz                 liveness ("ok", 200)
+    /metrics                 Prometheus text exposition of the registry
+                             plus the observability runtime counters
+    /varz                    the same state as one JSON document
+                             (registry export, stage summary, uptime)
+    /tracez                  flight-recorder dump (slowest / errored /
+                             recent traces, JSON)
+    /profilez?duration_ms=N  on-demand xprof capture via
+                             `utils/profiling.trace` into a fresh
+                             directory; returns the trace dir (bounded
+                             at 60 s; one capture at a time)
+
+The registry is duck-typed (`.export() -> dict`) so this layer never
+imports `serving/` (check_layers: serving -> observability -> utils).
+Bind is loopback by default — the surface is for operators, not the
+internet.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import tempfile
+import threading
+import time
+import urllib.parse
+from typing import Optional
+
+from ..utils.profiling import trace as xprof_trace
+from . import tracing
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["AdminServer", "MAX_PROFILE_MS"]
+
+MAX_PROFILE_MS = 60_000.0
+
+
+class AdminServer:
+    """Threaded HTTP admin server over the observability state.
+
+    `registry` is anything with `export() -> dict` (a
+    `serving.metrics.MetricsRegistry`); `recorder` defaults to the
+    process-wide flight recorder. `port=0` picks a free port
+    (`server.port` after start).
+    """
+
+    def __init__(
+        self,
+        registry=None,
+        recorder: Optional[tracing.FlightRecorder] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        name: str = "admin",
+        profile_dir: Optional[str] = None,
+    ):
+        self._registry = registry
+        self._recorder = (
+            recorder if recorder is not None else tracing.default_recorder()
+        )
+        self._name = name
+        self._profile_dir = profile_dir
+        self._profile_lock = threading.Lock()
+        self._started_unix = time.time()
+        outer = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            # The default handler logs every request to stderr.
+            def log_message(self, fmt, *args):  # noqa: D102
+                logger.debug("[%s] %s", outer._name, fmt % args)
+
+            def do_GET(self):  # noqa: N802 - http.server API
+                try:
+                    outer._route(self)
+                except BrokenPipeError:  # scraper went away mid-reply
+                    pass
+                except Exception as e:  # noqa: BLE001 - keep serving
+                    logger.exception("[%s] %s failed", outer._name,
+                                     self.path)
+                    try:
+                        outer._reply(
+                            self, 500, "text/plain; charset=utf-8",
+                            f"internal error: {e}\n".encode(),
+                        )
+                    except OSError:
+                        pass
+
+        class _Server(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = _Server((host, port), _Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- routing ------------------------------------------------------------
+
+    @staticmethod
+    def _reply(handler, status: int, ctype: str, body: bytes) -> None:
+        handler.send_response(status)
+        handler.send_header("Content-Type", ctype)
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def _merged_export(self) -> dict:
+        export = (
+            self._registry.export()
+            if self._registry is not None
+            else {"counters": {}, "gauges": {}, "histograms": {}}
+        )
+        export = {
+            "counters": dict(export.get("counters", {})),
+            "gauges": dict(export.get("gauges", {})),
+            "histograms": dict(export.get("histograms", {})),
+        }
+        for name, value in tracing.runtime_counters.export().items():
+            export["counters"].setdefault(name, value)
+        return export
+
+    def _route(self, handler) -> None:
+        parsed = urllib.parse.urlsplit(handler.path)
+        path = parsed.path.rstrip("/") or "/"
+        if path == "/healthz":
+            self._reply(
+                handler, 200, "text/plain; charset=utf-8", b"ok\n"
+            )
+        elif path == "/metrics":
+            from .exposition import render_prometheus
+
+            body = render_prometheus(self._merged_export()).encode()
+            self._reply(
+                handler, 200,
+                "text/plain; version=0.0.4; charset=utf-8", body,
+            )
+        elif path == "/varz":
+            body = json.dumps(
+                {
+                    "name": self._name,
+                    "uptime_s": round(
+                        time.time() - self._started_unix, 1
+                    ),
+                    "metrics": self._merged_export(),
+                    "stages": tracing.stage_summary(),
+                },
+                indent=2, default=str,
+            ).encode()
+            self._reply(handler, 200, "application/json", body)
+        elif path == "/tracez":
+            body = json.dumps(
+                self._recorder.dump(), indent=2, default=str
+            ).encode()
+            self._reply(handler, 200, "application/json", body)
+        elif path == "/profilez":
+            self._profilez(handler, parsed.query)
+        else:
+            self._reply(
+                handler, 404, "text/plain; charset=utf-8",
+                b"unknown endpoint; try /healthz /metrics /varz "
+                b"/tracez /profilez\n",
+            )
+
+    def _profilez(self, handler, query: str) -> None:
+        params = urllib.parse.parse_qs(query)
+        try:
+            duration_ms = float(params.get("duration_ms", ["1000"])[0])
+        except ValueError:
+            self._reply(
+                handler, 400, "text/plain; charset=utf-8",
+                b"duration_ms must be a number\n",
+            )
+            return
+        duration_ms = min(max(duration_ms, 1.0), MAX_PROFILE_MS)
+        if not self._profile_lock.acquire(blocking=False):
+            self._reply(
+                handler, 409, "text/plain; charset=utf-8",
+                b"a profile capture is already running\n",
+            )
+            return
+        try:
+            log_dir = tempfile.mkdtemp(
+                prefix=f"dpf-xprof-{self._name}-",
+                dir=self._profile_dir,
+            )
+            t0 = time.perf_counter()
+            with xprof_trace(log_dir):
+                # The capture window: serving threads keep running; the
+                # profiler samples them while this handler sleeps.
+                time.sleep(duration_ms / 1e3)
+            body = json.dumps(
+                {
+                    "log_dir": log_dir,
+                    "duration_ms": round(
+                        (time.perf_counter() - t0) * 1e3, 1
+                    ),
+                },
+                indent=2,
+            ).encode()
+        finally:
+            self._profile_lock.release()
+        self._reply(handler, 200, "application/json", body)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def registry(self):
+        return self._registry
+
+    def start(self) -> "AdminServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            daemon=True,
+            name=f"{self._name}-http",
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,145 @@
+"""Cross-party trace-context propagation for the serving wire format.
+
+The Leader->Helper leg of `serving/service.py` carries a serialized
+`PirRequest` proto inside the 4-byte framed transport. This module
+wraps that payload in a versioned envelope so the trace id travels with
+the request and the Helper's server-side stage timings travel back —
+the Leader can then decompose helper-leg RTT into network time vs.
+Helper-reported compute.
+
+Wire layout (all integers big-endian):
+
+    request  = MAGIC(4) | u8 version | u8 kind=1 | 8-byte trace id
+             | u32 inner_len | inner proto bytes
+    response = MAGIC(4) | u8 version | u8 kind=2 | u32 meta_len
+             | meta JSON (trace_id, server_ms, spans)
+             | u32 inner_len | inner proto bytes
+
+**Old-peer interop is by construction + detection, not negotiation.**
+MAGIC starts with byte 0xFF: as a protobuf tag that is field 31 with
+wire type 7, which does not exist, so an old Helper fed an enveloped
+request fails proto parsing immediately (its connection closes, the
+Leader sees a transport fault, downgrades to bare proto, and retries
+inside its existing retry budget). Conversely `try_decode_request`
+returns the payload untouched when the magic is absent, so a new
+Helper serves old bare-proto Leaders unchanged — and replies bare, so
+old Leaders never see an envelope.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Optional, Tuple
+
+__all__ = [
+    "EnvelopeError",
+    "PROPAGATION_VERSION",
+    "encode_request",
+    "try_decode_request",
+    "encode_response",
+    "try_decode_response",
+]
+
+# 0xFF first => guaranteed-invalid protobuf, so old peers fail fast.
+_MAGIC = b"\xffDPT"
+PROPAGATION_VERSION = 1
+_KIND_REQUEST = 1
+_KIND_RESPONSE = 2
+
+_HEAD = struct.Struct(">4sBB")
+_LEN = struct.Struct(">I")
+
+
+class EnvelopeError(ValueError):
+    """Magic matched but the envelope is malformed or unsupported."""
+
+
+def encode_request(trace_id: str, inner: bytes) -> bytes:
+    tid = bytes.fromhex(trace_id)[:8].ljust(8, b"\0")
+    return (
+        _HEAD.pack(_MAGIC, PROPAGATION_VERSION, _KIND_REQUEST)
+        + tid
+        + _LEN.pack(len(inner))
+        + inner
+    )
+
+
+def try_decode_request(payload: bytes) -> Tuple[Optional[str], bytes]:
+    """-> (trace_id | None, inner bytes). No magic: the payload is a
+    bare old-version proto and comes back untouched."""
+    if not payload.startswith(_MAGIC):
+        return None, payload
+    if len(payload) < _HEAD.size + 8 + _LEN.size:
+        raise EnvelopeError("truncated envelope header")
+    _, version, kind = _HEAD.unpack_from(payload)
+    if version != PROPAGATION_VERSION:
+        raise EnvelopeError(f"unsupported envelope version {version}")
+    if kind != _KIND_REQUEST:
+        raise EnvelopeError(f"unexpected envelope kind {kind}")
+    tid = payload[_HEAD.size:_HEAD.size + 8]
+    (inner_len,) = _LEN.unpack_from(payload, _HEAD.size + 8)
+    inner = payload[_HEAD.size + 8 + _LEN.size:]
+    if len(inner) != inner_len:
+        raise EnvelopeError(
+            f"envelope body is {len(inner)} bytes, expected {inner_len}"
+        )
+    return tid.hex(), inner
+
+
+def encode_response(
+    inner: bytes,
+    trace_id: str,
+    server_ms: float,
+    spans: Optional[list] = None,
+) -> bytes:
+    meta = json.dumps(
+        {
+            "trace_id": trace_id,
+            "server_ms": round(float(server_ms), 3),
+            "spans": [
+                {
+                    "name": str(s.get("name", "?")),
+                    "duration_ms": float(s.get("duration_ms", 0.0)),
+                }
+                for s in (spans or [])
+            ],
+        },
+        separators=(",", ":"),
+    ).encode()
+    return (
+        _HEAD.pack(_MAGIC, PROPAGATION_VERSION, _KIND_RESPONSE)
+        + _LEN.pack(len(meta))
+        + meta
+        + _LEN.pack(len(inner))
+        + inner
+    )
+
+
+def try_decode_response(payload: bytes) -> Tuple[Optional[dict], bytes]:
+    """-> (meta | None, inner bytes). No magic: a bare proto reply from
+    an old-version Helper, returned untouched."""
+    if not payload.startswith(_MAGIC):
+        return None, payload
+    if len(payload) < _HEAD.size + _LEN.size:
+        raise EnvelopeError("truncated envelope header")
+    _, version, kind = _HEAD.unpack_from(payload)
+    if version != PROPAGATION_VERSION:
+        raise EnvelopeError(f"unsupported envelope version {version}")
+    if kind != _KIND_RESPONSE:
+        raise EnvelopeError(f"unexpected envelope kind {kind}")
+    (meta_len,) = _LEN.unpack_from(payload, _HEAD.size)
+    meta_end = _HEAD.size + _LEN.size + meta_len
+    if len(payload) < meta_end + _LEN.size:
+        raise EnvelopeError("truncated envelope meta")
+    try:
+        meta = json.loads(payload[_HEAD.size + _LEN.size:meta_end])
+    except ValueError as e:
+        raise EnvelopeError(f"bad envelope meta: {e}") from e
+    (inner_len,) = _LEN.unpack_from(payload, meta_end)
+    inner = payload[meta_end + _LEN.size:]
+    if len(inner) != inner_len:
+        raise EnvelopeError(
+            f"envelope body is {len(inner)} bytes, expected {inner_len}"
+        )
+    return meta, inner

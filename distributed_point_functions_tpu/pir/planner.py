@@ -62,6 +62,8 @@ from __future__ import annotations
 import dataclasses
 import os
 
+from ..observability.tracing import runtime_counters
+
 _DEFAULT_BUDGET_BYTES = 1 << 30
 _SELECTION_BLOCK_BYTES = 16
 
@@ -182,6 +184,8 @@ def plan_dense_serving(
         chunk_levels = _pick_streaming_split(num_keys, expand_levels, budget)
         cut_levels = expand_levels - chunk_levels
         ip = force_ip or streaming_ip(backend)
+        runtime_counters.inc("pir.plan.streaming")
+        runtime_counters.inc(f"pir.plan.streaming_ip.{ip}")
         return ServingPlan(
             mode="streaming",
             selection_bytes_peak=streaming_selection_bytes(
@@ -197,6 +201,7 @@ def plan_dense_serving(
         cel = min(expand_levels, CHUNK_GRANULE_LEVELS)
         while cel > 0 and chunked_selection_bytes(num_keys, cel) > budget:
             cel -= 1
+        runtime_counters.inc("pir.plan.chunked")
         return ServingPlan(
             mode="chunked",
             selection_bytes_peak=chunked_selection_bytes(num_keys, cel),
@@ -205,6 +210,7 @@ def plan_dense_serving(
             num_chunks=1 << (expand_levels - cel),
             **common,
         )
+    runtime_counters.inc("pir.plan.materialized")
     return ServingPlan(
         mode="materialized",
         selection_bytes_peak=mat_bytes,

@@ -30,6 +30,7 @@ import threading
 from typing import Callable, Optional
 
 from ..dpf import DistributedPointFunction, DpfParameters
+from ..observability import tracing
 from ..prng import Aes128CtrSeededPrng, xor_bytes
 from ..value_types import XorType
 from . import messages
@@ -171,14 +172,15 @@ class DpfPirServer:
                 f"number of responses from Helper (={len(hr)}) does not "
                 f"match the number of responses from Leader (={len(lr)})"
             )
-        combined = []
-        for i, (h, l) in enumerate(zip(hr, lr)):
-            if len(h) != len(l):
-                raise RuntimeError(
-                    f"response size mismatch at index {i}: got {len(h)} "
-                    f"(Helper) vs. {len(l)} (Leader)"
-                )
-            combined.append(xor_bytes(h, l))
+        with tracing.span("combine"):
+            combined = []
+            for i, (h, l) in enumerate(zip(hr, lr)):
+                if len(h) != len(l):
+                    raise RuntimeError(
+                        f"response size mismatch at index {i}: got {len(h)} "
+                        f"(Helper) vs. {len(l)} (Leader)"
+                    )
+                combined.append(xor_bytes(h, l))
         return messages.PirResponse(
             dpf_pir_response=messages.DpfPirResponse(masked_response=combined)
         )
@@ -186,19 +188,21 @@ class DpfPirServer:
     def _handle_helper_request(self, request):
         if request.encrypted_helper_request is None:
             raise ValueError("request must be a valid EncryptedHelperRequest")
-        decrypted = self._decrypter(
-            request.encrypted_helper_request.encrypted_request,
-            self._encryption_context_info,
-        )
-        inner = self._parse_helper_request(decrypted)
+        with tracing.span("helper_decrypt"):
+            decrypted = self._decrypter(
+                request.encrypted_helper_request.encrypted_request,
+                self._encryption_context_info,
+            )
+            inner = self._parse_helper_request(decrypted)
         response = self._dispatch_plain(
             messages.PirRequest(plain_request=inner.plain_request)
         )
-        prng = Aes128CtrSeededPrng(inner.one_time_pad_seed)
-        masked = [
-            xor_bytes(r, prng.get_random_bytes(len(r)))
-            for r in response.dpf_pir_response.masked_response
-        ]
+        with tracing.span("mask"):
+            prng = Aes128CtrSeededPrng(inner.one_time_pad_seed)
+            masked = [
+                xor_bytes(r, prng.get_random_bytes(len(r)))
+                for r in response.dpf_pir_response.masked_response
+            ]
         return messages.PirResponse(
             dpf_pir_response=messages.DpfPirResponse(masked_response=masked)
         )
@@ -322,37 +326,47 @@ class DenseDpfPirServer(DpfPirServer):
             bitrev = False
         if self._mesh is not None:
             staged = stage_keys(keys)
-            inner_products = self._inner_products_sharded(staged, len(keys))
+            with tracing.span("evaluate_sharded", num_keys=len(keys)):
+                inner_products = self._inner_products_sharded(
+                    staged, len(keys)
+                )
         else:
             plan = self._plan_serving(len(keys), bitrev)
             if plan.mode == "streaming":
-                inner_products = self._inner_products_streaming(
-                    plan, keys
-                )
+                with tracing.span(
+                    "evaluate_streaming", num_keys=len(keys), ip=plan.ip
+                ):
+                    inner_products = self._inner_products_streaming(
+                        plan, keys
+                    )
             elif plan.mode == "chunked":
                 staged = stage_keys(keys)
-                inner_products = self._inner_products_chunked(
-                    staged, len(keys), plan
-                )
+                with tracing.span("evaluate_chunked", num_keys=len(keys)):
+                    inner_products = self._inner_products_chunked(
+                        staged, len(keys), plan
+                    )
             else:
                 # Walk the shared all-zeros prefix on the host during
                 # staging (sub-ms there vs ~1.4 ms of dispatch-bound
                 # device AES per batch); the device step starts at the
                 # expansion root. DPF_TPU_HOST_WALK=0 restores the
                 # on-device walk.
-                staged, device_walk = stage_keys_walked(
-                    keys, self._walk_levels
-                )
-                selections = impl(
-                    *staged,
-                    walk_levels=device_walk,
-                    expand_levels=self._expand_levels,
-                    num_blocks=self._num_blocks,
-                    **({"bitrev_leaves": True} if bitrev else {}),
-                )
-                inner_products = self._database.inner_product_with(
-                    selections, bitrev_blocks=bitrev
-                )
+                with tracing.span(
+                    "evaluate_materialized", num_keys=len(keys)
+                ):
+                    staged, device_walk = stage_keys_walked(
+                        keys, self._walk_levels
+                    )
+                    selections = impl(
+                        *staged,
+                        walk_levels=device_walk,
+                        expand_levels=self._expand_levels,
+                        num_blocks=self._num_blocks,
+                        **({"bitrev_leaves": True} if bitrev else {}),
+                    )
+                    inner_products = self._database.inner_product_with(
+                        selections, bitrev_blocks=bitrev
+                    )
         return messages.PirResponse(
             dpf_pir_response=messages.DpfPirResponse(
                 masked_response=inner_products
@@ -428,6 +442,7 @@ class DenseDpfPirServer(DpfPirServer):
             if plan.ip == "jnp":
                 raise
             self._streaming_ip_failed = True
+            tracing.runtime_counters.inc("pir.streaming_ip_demotions")
             import warnings
 
             warnings.warn(

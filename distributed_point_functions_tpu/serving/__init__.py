@@ -4,8 +4,13 @@ Promotes the two-server deployment model from a demo script into a
 subsystem: dynamic shape-bucketed batching (`batcher`), session objects
 with deadlines, Helper retry, and degradation (`service`), reusable
 framed transports (`transport`), and a dependency-free metrics registry
-(`metrics`). Layering: serving -> pir -> ops, never the reverse
-(enforced by `tools/check_layers.py` in presubmit).
+(`metrics`). Layering: serving -> pir -> ops -> observability, never
+the reverse (enforced by `tools/check_layers.py` in presubmit).
+
+Observability rides along everywhere: sessions root a trace per
+request, the batcher and the role handlers mark stage spans, and the
+`observability.AdminServer` serves the registry + flight recorder over
+HTTP (`/metrics`, `/varz`, `/tracez`, `/healthz`, `/profilez`).
 """
 
 from .batcher import (
@@ -14,7 +19,7 @@ from .batcher import (
     Overloaded,
     bucket_size,
 )
-from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry, labeled_name
 from .service import (
     HelperSession,
     HelperUnavailable,
@@ -54,6 +59,7 @@ __all__ = [
     "TransportError",
     "TransportTimeout",
     "bucket_size",
+    "labeled_name",
     "parse_hostport",
     "recv_msg",
     "send_msg",

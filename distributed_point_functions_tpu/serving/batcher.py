@@ -37,6 +37,7 @@ import time
 from collections import deque
 from typing import Callable, List, Optional, Sequence
 
+from ..observability import tracing
 from .metrics import MetricsRegistry
 
 
@@ -57,7 +58,8 @@ def bucket_size(num_keys: int) -> int:
 
 class _Pending:
     __slots__ = (
-        "keys", "deadline", "event", "result", "error", "t0", "abandoned"
+        "keys", "deadline", "event", "result", "error", "t0", "abandoned",
+        "trace",
     )
 
     def __init__(self, keys, deadline):
@@ -68,6 +70,9 @@ class _Pending:
         self.error: Optional[BaseException] = None
         self.t0 = time.monotonic()
         self.abandoned = False
+        # The submitting request's trace: the worker thread appends the
+        # queue-wait / device-compute spans onto it by reference.
+        self.trace = tracing.current_trace()
 
 
 class DynamicBatcher:
@@ -109,6 +114,11 @@ class DynamicBatcher:
             f"{n}.batch_keys", buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256)
         )
         self._h_latency = m.histogram(f"{n}.request_latency_ms")
+        self._h_queue_wait = m.histogram(f"{n}.queue_wait_ms")
+        self._h_pad_waste = m.histogram(
+            f"{n}.pad_waste_ratio",
+            buckets=(0.0, 0.05, 0.1, 0.25, 0.5, 0.75, 0.875, 1.0),
+        )
         self._cond = threading.Condition()
         self._queue: deque = deque()
         self._seen_buckets: set = set()
@@ -163,15 +173,18 @@ class DynamicBatcher:
 
     # -- worker -------------------------------------------------------------
 
-    def _collect(self) -> Optional[List[_Pending]]:
+    def _collect(self):
         """Block for the first request, then fill the batch until
-        `max_batch_size` keys or `max_wait_ms` elapse. Returns None only
-        at shutdown with an empty queue."""
+        `max_batch_size` keys or `max_wait_ms` elapse. Returns
+        (batch, assembly_seconds) — assembly measured from the first
+        pop, i.e. the window spent waiting for co-batchable arrivals —
+        or None only at shutdown with an empty queue."""
         with self._cond:
             while not self._queue:
                 if self._closed:
                     return None
                 self._cond.wait()
+            t_first = time.monotonic()
             batch = [self._queue.popleft()]
             num_keys = len(batch[0].keys)
             close_at = time.monotonic() + self._max_wait_s
@@ -189,13 +202,14 @@ class DynamicBatcher:
                     break
                 self._cond.wait(remaining)
             self._g_depth.set(len(self._queue))
-        return batch
+        return batch, time.monotonic() - t_first
 
     def _run(self) -> None:
         while True:
-            batch = self._collect()
-            if batch is None:
+            collected = self._collect()
+            if collected is None:
                 return
+            batch, assembly_s = collected
             now = time.monotonic()
             live = []
             for p in batch:
@@ -213,6 +227,7 @@ class DynamicBatcher:
             flat = [k for p in live for k in p.keys]
             bucket = bucket_size(len(flat))
             padded = flat + [flat[0]] * (bucket - len(flat))
+            pad_waste = (bucket - len(flat)) / bucket
             if bucket in self._seen_buckets:
                 self._c_hits.inc()
             else:
@@ -221,9 +236,12 @@ class DynamicBatcher:
             self._c_batches.inc()
             self._c_pad.inc(bucket - len(flat))
             self._h_batch.observe(len(flat))
+            self._h_pad_waste.observe(pad_waste)
             try:
+                t_eval = time.perf_counter()
                 with self.metrics.timed(f"{self._name}.evaluate_ms"):
                     results = list(self._evaluate(padded))
+                eval_ms = (time.perf_counter() - t_eval) * 1e3
                 if len(results) < len(flat):
                     raise RuntimeError(
                         f"evaluate returned {len(results)} results for "
@@ -234,12 +252,34 @@ class DynamicBatcher:
                     p.error = e
                     p.event.set()
                 continue
+            # Batch-level stage aggregates (once per batch) ...
+            tracing.add_span(
+                "batch_assembly", assembly_s * 1e3,
+                bucket=bucket, batch_keys=len(flat),
+            )
+            tracing.add_span(
+                "device_compute", eval_ms, pad_waste_ratio=round(pad_waste, 4)
+            )
             offset = 0
             done = time.monotonic()
             for p in live:
                 p.result = results[offset:offset + len(p.keys)]
                 offset += len(p.keys)
+                queue_wait_ms = (now - p.t0) * 1e3
+                self._h_queue_wait.observe(queue_wait_ms)
                 self._h_latency.observe((done - p.t0) * 1e3)
+                # ... and per-request spans grafted onto the submitting
+                # thread's trace so /tracez decomposes each request.
+                if p.trace is not None:
+                    p.trace.add_span("queue_wait", queue_wait_ms)
+                    p.trace.add_span(
+                        "batch_assembly", assembly_s * 1e3,
+                        bucket=bucket, batch_keys=len(flat),
+                    )
+                    p.trace.add_span(
+                        "device_compute", eval_ms,
+                        pad_waste_ratio=round(pad_waste, 4),
+                    )
                 p.event.set()
 
     # -- lifecycle ----------------------------------------------------------

@@ -12,6 +12,16 @@ wraps the block in `utils.profiling.annotate(name)` (a named TraceAnnotation
 inside an active xprof trace) *and* records the wall-clock milliseconds
 into the `name` histogram, so the same instrumentation feeds both the
 metrics dict and a device trace.
+
+**Labels.** The registry is a flat namespace; instruments that vary by
+role, level, or tier use the labeling convention `base{k=v,k2=v2}` —
+built with `labeled_name()` or by passing `labels=` to
+`counter`/`gauge`/`histogram`/`timed`. Keys are sorted, so the same
+label set always maps to the same instrument. The Prometheus renderer
+(`observability/exposition.py`) splits the suffix back into label
+pairs, turning e.g. `request_ms{role=leader}` and
+`request_ms{role=helper}` into one labeled metric family instead of
+two colliding flat names.
 """
 
 from __future__ import annotations
@@ -34,6 +44,21 @@ DEFAULT_BUCKETS_MS = (
 # Bounded reservoir per histogram: enough samples for stable p99 at
 # serving rates without unbounded growth on long-lived processes.
 _RESERVOIR = 8192
+
+
+def labeled_name(base: str, labels: Optional[Dict[str, object]] = None) -> str:
+    """Canonical `base{k=v,k2=v2}` instrument name (keys sorted). Label
+    values must not contain `,` `=` `{` `}` — they would corrupt the
+    parse on exposition."""
+    if not labels:
+        return base
+    for k, v in labels.items():
+        if any(c in f"{k}{v}" for c in ",={}"):
+            raise ValueError(
+                f"label {k}={v!r} contains a reserved character"
+            )
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{base}{{{inner}}}"
 
 
 class Counter:
@@ -105,26 +130,31 @@ class Histogram:
         with self._lock:
             return self._count
 
+    @staticmethod
+    def _rank(ordered, p: float) -> Optional[float]:
+        """Percentile `p` from an already-sorted sample list — the one
+        shared implementation, so callers that need several percentiles
+        (export) sort the reservoir exactly once."""
+        if not ordered:
+            return None
+        i = min(len(ordered) - 1, max(0, round(p / 100 * (len(ordered) - 1))))
+        return ordered[i]
+
     def percentile(self, p: float) -> Optional[float]:
         """Exact percentile over the reservoir; None with no samples."""
         with self._lock:
-            if not self._samples:
-                return None
             ordered = sorted(self._samples)
-        rank = min(len(ordered) - 1, max(0, round(p / 100 * (len(ordered) - 1))))
-        return ordered[rank]
+        return self._rank(ordered, p)
 
     def export(self) -> dict:
         with self._lock:
             counts = list(self._counts)
             count, total = self._count, self._sum
-            samples = sorted(self._samples)
+            ordered = sorted(self._samples)
 
         def pct(p):
-            if not samples:
-                return None
-            i = min(len(samples) - 1, max(0, round(p / 100 * (len(samples) - 1))))
-            return round(samples[i], 4)
+            v = self._rank(ordered, p)
+            return None if v is None else round(v, 4)
 
         return {
             "count": count,
@@ -133,7 +163,7 @@ class Histogram:
             "p50": pct(50),
             "p95": pct(95),
             "p99": pct(99),
-            "max": round(samples[-1], 4) if samples else None,
+            "max": round(ordered[-1], 4) if ordered else None,
             "buckets": {
                 **{str(b): c for b, c in zip(self._bounds, counts)},
                 "+inf": counts[-1],
@@ -150,25 +180,31 @@ class MetricsRegistry:
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
 
-    def counter(self, name: str) -> Counter:
+    def counter(self, name: str, labels: Optional[Dict] = None) -> Counter:
+        name = labeled_name(name, labels)
         with self._lock:
             return self._counters.setdefault(name, Counter())
 
-    def gauge(self, name: str) -> Gauge:
+    def gauge(self, name: str, labels: Optional[Dict] = None) -> Gauge:
+        name = labeled_name(name, labels)
         with self._lock:
             return self._gauges.setdefault(name, Gauge())
 
     def histogram(
-        self, name: str, buckets: Sequence[float] = DEFAULT_BUCKETS_MS
+        self,
+        name: str,
+        buckets: Sequence[float] = DEFAULT_BUCKETS_MS,
+        labels: Optional[Dict] = None,
     ) -> Histogram:
+        name = labeled_name(name, labels)
         with self._lock:
             return self._histograms.setdefault(name, Histogram(buckets))
 
     @contextlib.contextmanager
-    def timed(self, name: str):
+    def timed(self, name: str, labels: Optional[Dict] = None):
         """Time the block into histogram `name` (ms) inside a profiler
         annotation of the same name."""
-        hist = self.histogram(name)
+        hist = self.histogram(name, labels=labels)
         t0 = time.perf_counter()
         with annotate(name):
             try:

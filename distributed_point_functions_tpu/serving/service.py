@@ -19,7 +19,19 @@ and crypto callbacks; these sessions wrap them into deployable objects:
   must fall back to plain single-server queries to read real records);
 * a `MetricsRegistry` per session (injectable, so co-located sessions
   can share one) records queue/batch/retry/latency counters, exported
-  with `session.metrics.export()`.
+  with `session.metrics.export()`;
+* every request roots an observability **trace** (`observability/
+  tracing.py`): wire decode/encode, queue wait, batch assembly, and
+  device compute land as spans, the finished trace lands in the flight
+  recorder (`/tracez` on an `AdminServer`). On the Leader, the trace id
+  rides to the Helper inside a versioned envelope
+  (`observability/propagation.py`) and the Helper's server-side spans
+  come back in the reply, so helper-leg RTT decomposes into network
+  vs. Helper-reported compute. Old-version peers interop: a Helper fed
+  a bare proto answers a bare proto, and a Leader whose enveloped
+  request faults a v0 Helper downgrades that transport to bare proto
+  (counted in `leader.wire_downgrades`) and retries within its
+  existing retry budget.
 
 Sessions speak either library `messages.PirRequest` objects
 (`handle_request`) or the framed proto wire format (`handle_wire`,
@@ -34,6 +46,7 @@ import time
 from typing import Optional
 
 from .. import serialization
+from ..observability import propagation, tracing
 from ..pir import messages
 from ..pir.database import DenseDpfPirDatabase
 from ..pir.server import DenseDpfPirServer
@@ -164,23 +177,51 @@ class _Session:
             deadline = self._default_deadline()
         token = _DEADLINE.set(deadline)
         try:
-            with self.metrics.timed(f"{self._name}.request_ms"):
-                return self._server.handle_request(request)
+            with tracing.trace_request(
+                f"{self._name}.request", role=self._name
+            ):
+                with self.metrics.timed(f"{self._name}.request_ms"):
+                    return self._server.handle_request(request)
         finally:
             _DEADLINE.reset(token)
 
     def handle_wire(self, data: bytes) -> bytes:
-        """Framed proto entry point (plugs into `FramedTcpServer`)."""
+        """Framed proto entry point (plugs into `FramedTcpServer`).
+
+        An incoming trace-context envelope (a new-version Leader's
+        helper leg) is unwrapped here: the inner proto serves under the
+        propagated trace id and the reply wraps back with this side's
+        stage spans. A bare proto (old-version peer, or a client) is
+        served and answered bare, unchanged.
+        """
         from ..protos import private_information_retrieval_pb2 as pir_pb2
 
-        proto = pir_pb2.PirRequest.FromString(data)
-        request = serialization.pir_request_from_proto(
-            self._server.dpf, proto
-        )
-        response = self.handle_request(request)
-        return serialization.pir_response_to_proto(
-            response
-        ).SerializeToString()
+        trace_id, inner = propagation.try_decode_request(data)
+        t0 = time.perf_counter()
+        with tracing.trace_request(
+            f"{self._name}.request",
+            trace_id=trace_id,
+            fresh=trace_id is not None,
+            role=self._name,
+        ) as trace:
+            with tracing.span("decode"):
+                proto = pir_pb2.PirRequest.FromString(inner)
+                request = serialization.pir_request_from_proto(
+                    self._server.dpf, proto
+                )
+            response = self.handle_request(request)
+            with tracing.span("encode"):
+                out = serialization.pir_response_to_proto(
+                    response
+                ).SerializeToString()
+            if trace_id is None:
+                return out
+            return propagation.encode_response(
+                out,
+                trace.trace_id,
+                server_ms=(time.perf_counter() - t0) * 1e3,
+                spans=trace.span_list(),
+            )
 
     def close(self) -> None:
         if self._batcher is not None:
@@ -254,6 +295,11 @@ class LeaderSession(_Session):
         self._c_timeouts = m.counter("leader.helper_timeouts")
         self._c_failures = m.counter("leader.helper_failures")
         self._c_degraded = m.counter("leader.degraded_responses")
+        self._c_downgrades = m.counter("leader.wire_downgrades")
+        # None = envelope support unknown (probe with an envelope);
+        # False = peer rejected it once (bare proto from then on);
+        # True = peer answered an envelope.
+        self._peer_envelope: Optional[bool] = None
 
     # -- helper leg ---------------------------------------------------------
 
@@ -261,7 +307,15 @@ class LeaderSession(_Session):
         """`ForwardHelperRequestFn` with retry: serialize, round-trip
         with a per-attempt timeout, back off and retry on transport
         faults. `while_waiting` (the Leader's own share) runs exactly
-        once, overlapped with the first successful send."""
+        once, overlapped with the first successful send.
+
+        The request goes out wrapped in a trace-context envelope until
+        the peer proves it is old-version: a non-timeout fault on an
+        envelope probe (an old Helper fails proto-parsing the envelope
+        and drops the connection) downgrades this transport to bare
+        proto before the normal retry policy resumes. Timeouts do NOT
+        downgrade — a slow Helper is not an old one.
+        """
         wire = serialization.pir_request_to_proto(
             self._server.dpf, helper_request
         ).SerializeToString()
@@ -271,22 +325,55 @@ class LeaderSession(_Session):
         def leader_share_once():
             if not called[0]:
                 called[0] = True
-                while_waiting()
+                with tracing.span("leader_own_share"):
+                    while_waiting()
 
         timeout = (
             None if cfg.helper_timeout_ms is None
             else cfg.helper_timeout_ms / 1e3
         )
         backoff = cfg.helper_backoff_ms / 1e3
+        trace = tracing.current_trace()
         last: Optional[Exception] = None
-        for attempt in range(cfg.helper_retries + 1):
+        attempt = 0
+        while attempt <= cfg.helper_retries:
+            enveloped = self._peer_envelope is not False
+            payload = (
+                propagation.encode_request(
+                    trace.trace_id if trace is not None
+                    else tracing.new_trace_id(),
+                    wire,
+                )
+                if enveloped
+                else wire
+            )
             try:
+                t0 = time.perf_counter()
                 with self.metrics.timed("leader.helper_leg_ms"):
                     data = self._transport.roundtrip(
-                        wire, timeout=timeout, on_sent=leader_share_once
+                        payload, timeout=timeout,
+                        on_sent=leader_share_once,
                     )
+                rtt_ms = (time.perf_counter() - t0) * 1e3
                 break
-            except TransportError as e:
+            except Exception as e:  # noqa: BLE001 - triaged below
+                is_transport = isinstance(e, TransportError)
+                if (
+                    enveloped
+                    and self._peer_envelope is None
+                    and not isinstance(e, TransportTimeout)
+                ):
+                    # Probe fault: plausibly an old peer choking on the
+                    # envelope. Downgrade this transport to bare proto
+                    # and re-send immediately — the probe does not
+                    # consume a retry attempt (downgrading is sticky,
+                    # so this branch runs at most once per transport).
+                    self._peer_envelope = False
+                    self._c_downgrades.inc()
+                    last = e
+                    continue
+                if not is_transport:
+                    raise
                 last = e
                 if isinstance(e, TransportTimeout):
                     self._c_timeouts.inc()
@@ -299,15 +386,51 @@ class LeaderSession(_Session):
                 self._c_retries.inc()
                 time.sleep(min(backoff, cfg.helper_backoff_max_ms / 1e3))
                 backoff *= 2
-        else:  # pragma: no cover - loop always breaks or raises
-            raise HelperUnavailable(str(last))
+                attempt += 1
+        else:
+            self._c_failures.inc()
+            raise HelperUnavailable(
+                f"helper leg failed after {attempt} attempt(s): {last}"
+            ) from last
         # A misbehaving-but-fast helper could answer before the share ran.
         leader_share_once()
+        meta, inner = (
+            propagation.try_decode_response(data)
+            if enveloped
+            else (None, data)
+        )
+        if enveloped:
+            self._peer_envelope = meta is not None
+        if meta is not None:
+            # Decompose the helper leg: the Helper reports its own
+            # server time, the rest of the RTT is the network (plus
+            # framing) — and the Helper's stage spans graft on under a
+            # `helper.` prefix.
+            remote_ms = float(meta.get("server_ms", 0.0))
+            network_ms = max(0.0, rtt_ms - remote_ms)
+            self.metrics.histogram("leader.helper_remote_ms").observe(
+                remote_ms
+            )
+            self.metrics.histogram("leader.helper_network_ms").observe(
+                network_ms
+            )
+            if trace is not None:
+                trace.add_span(
+                    "helper_leg", rtt_ms, remote_ms=round(remote_ms, 3),
+                    network_ms=round(network_ms, 3),
+                )
+                trace.add_remote_spans(
+                    meta.get("spans", []), prefix="helper."
+                )
+                trace.add_span("helper_network", network_ms)
+        elif trace is not None:
+            trace.add_span("helper_leg", rtt_ms)
         from ..protos import private_information_retrieval_pb2 as pir_pb2
 
-        return serialization.pir_response_from_proto(
-            pir_pb2.PirResponse.FromString(data)
-        )
+        with tracing.span("decode"):
+            return serialization.pir_response_from_proto(
+                pir_pb2.PirResponse.FromString(inner)
+            )
 
     # -- degradation --------------------------------------------------------
 

@@ -25,18 +25,28 @@ import logging
 logger = logging.getLogger(__name__)
 _warned = False
 
+# `annotate()` sits on the per-request serving hot path (every span is
+# one), so the profiler module resolves once and is cached — including
+# the unavailable case, so a broken install doesn't retry the import
+# per request. `_UNRESOLVED` (not None) is the sentinel because None is
+# the cached "unavailable" answer.
+_UNRESOLVED = object()
+_prof_module = _UNRESOLVED
+
 
 def _profiler():
-    global _warned
-    try:
-        import jax.profiler as prof
+    global _warned, _prof_module
+    if _prof_module is _UNRESOLVED:
+        try:
+            import jax.profiler as prof
 
-        return prof
-    except Exception:  # pragma: no cover - profiler always ships with jax
-        if not _warned:
-            _warned = True
-            logger.info("jax.profiler unavailable; tracing disabled")
-        return None
+            _prof_module = prof
+        except Exception:  # pragma: no cover - profiler ships with jax
+            _prof_module = None
+            if not _warned:
+                _warned = True
+                logger.info("jax.profiler unavailable; tracing disabled")
+    return _prof_module
 
 
 @contextlib.contextmanager

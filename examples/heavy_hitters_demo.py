@@ -59,7 +59,13 @@ def demo_values(num_clients: int, seed: int):
     return values[:num_clients]
 
 
-def run_sweep(config, values, transport_kind: str, verbose: bool = True):
+def run_sweep(
+    config,
+    values,
+    transport_kind: str,
+    verbose: bool = True,
+    admin_port=None,
+):
     from distributed_point_functions_tpu import heavy_hitters as hh
     from distributed_point_functions_tpu.serving.transport import (
         FramedTcpServer,
@@ -87,6 +93,23 @@ def run_sweep(config, values, transport_kind: str, verbose: bool = True):
         transport = InProcessTransport(helper.handle_wire)
 
     leader = hh.HeavyHittersLeader(leader_server, transport)
+    admin = None
+    if admin_port is not None:
+        from distributed_point_functions_tpu.observability import (
+            AdminServer,
+            tracing,
+        )
+
+        admin = AdminServer(
+            registry=leader.metrics,
+            recorder=tracing.default_recorder(),
+            port=admin_port,
+            name="hh-leader",
+        ).start()
+        print(
+            f"[leader] admin endpoint on :{admin.port} "
+            "(/metrics /varz /tracez /healthz /profilez)"
+        )
     try:
         t0 = time.perf_counter()
         result = leader.run()
@@ -95,6 +118,8 @@ def run_sweep(config, values, transport_kind: str, verbose: bool = True):
         transport.close()
         if tcp_server is not None:
             tcp_server.stop()
+        if admin is not None:
+            admin.stop()
 
     if verbose:
         for st in result.rounds:
@@ -152,6 +177,10 @@ def main():
     ap.add_argument("--level-bits", type=int, default=8,
                     help="bits revealed per sweep round")
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--admin-port", type=int, default=None,
+                    help="serve the operator telemetry endpoint "
+                    "(/metrics /varz /tracez /healthz /profilez) on "
+                    "this port during the sweep (0 = auto-pick)")
     ap.add_argument("--platform", default="cpu",
                     help="JAX platform (default cpu)")
     args = ap.parse_args()
@@ -182,7 +211,7 @@ def main():
     )
     values = demo_values(args.clients, args.seed)
     kind = "tcp" if args.tcp else "in-process"
-    result = run_sweep(config, values, kind)
+    result = run_sweep(config, values, kind, admin_port=args.admin_port)
     check_result(result, values, config)
 
 

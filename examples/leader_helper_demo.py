@@ -85,7 +85,33 @@ def _serving_config():
 # ---------------------------------------------------------------------------
 
 
-def run_helper(port: int) -> None:
+def _maybe_admin(admin_port, registry, name: str):
+    """Start the operator telemetry endpoint when --admin-port is given
+    (0 = auto-pick). Serves /metrics, /varz, /tracez, /healthz, and
+    /profilez off the role's live registry and flight recorder."""
+    if admin_port is None:
+        return None
+    from distributed_point_functions_tpu.observability import (
+        AdminServer,
+        tracing,
+    )
+
+    admin = AdminServer(
+        registry=registry,
+        recorder=tracing.default_recorder(),
+        port=admin_port,
+        name=name,
+    )
+    admin.start()
+    print(
+        f"[{name}] admin endpoint on :{admin.port} "
+        "(/metrics /varz /tracez /healthz /profilez)",
+        flush=True,
+    )
+    return admin
+
+
+def run_helper(port: int, admin_port=None) -> None:
     from distributed_point_functions_tpu.serving import (
         FramedTcpServer,
         HelperSession,
@@ -94,12 +120,13 @@ def run_helper(port: int) -> None:
 
     db, _ = build_database()
     session = HelperSession(db, encrypt_decrypt.decrypt, _serving_config())
+    _maybe_admin(admin_port, session.metrics, "helper")
     server = FramedTcpServer(session.handle_wire, port=port, name="helper")
     print(f"[helper] listening on :{server.port}", flush=True)
     server.serve_forever()
 
 
-def run_leader(port: int, helper_addr: str) -> None:
+def run_leader(port: int, helper_addr: str, admin_port=None) -> None:
     from distributed_point_functions_tpu.serving import (
         FramedTcpServer,
         LeaderSession,
@@ -112,6 +139,7 @@ def run_leader(port: int, helper_addr: str) -> None:
     session = LeaderSession(
         db, TcpTransport(helper_host, helper_port), _serving_config()
     )
+    _maybe_admin(admin_port, session.metrics, "leader")
     server = FramedTcpServer(session.handle_wire, port=port, name="leader")
     print(f"[leader] listening on :{server.port}", flush=True)
     server.serve_forever()
@@ -216,6 +244,10 @@ def main():
     ap.add_argument("--leader", default="localhost:9000",
                     help="leader host:port (client role)")
     ap.add_argument("--indices", default="3,42,99")
+    ap.add_argument("--admin-port", type=int, default=None,
+                    help="serve the operator telemetry endpoint "
+                    "(/metrics /varz /tracez /healthz /profilez) on this "
+                    "port (0 = auto-pick; helper and leader roles)")
     ap.add_argument("--demo", action="store_true",
                     help="spawn helper+leader and run a client against them")
     ap.add_argument("--platform", default="",
@@ -234,9 +266,9 @@ def main():
     if args.demo:
         run_demo(args.port, platform)
     elif args.role == "helper":
-        run_helper(args.port)
+        run_helper(args.port, admin_port=args.admin_port)
     elif args.role == "leader":
-        run_leader(args.port, args.helper)
+        run_leader(args.port, args.helper, admin_port=args.admin_port)
     elif args.role == "client":
         indices = [int(x) for x in args.indices.split(",")]
         for i, rec in enumerate(

@@ -3,14 +3,18 @@
 
 Two rules over `distributed_point_functions_tpu/`:
 
-1. **Layer DAG** — `heavy_hitters -> serving -> pir -> ops`, never the
-   reverse, with restricted layers: the serving runtime may only be
-   imported by `heavy_hitters/` (the one in-library session kind built
-   on it), and `heavy_hitters` itself is application-facing — no
-   library layer imports it (applications — examples/, bench.py,
-   benchmarks/ — may import anything). Checked over ALL imports,
-   including function-level ones, because a reversed dependency is
-   wrong wherever the import statement sits.
+1. **Layer DAG** — `heavy_hitters -> serving -> pir -> ops ->
+   observability`, never the reverse, with restricted layers: the
+   serving runtime may only be imported by `heavy_hitters/` (the one
+   in-library session kind built on it), and `heavy_hitters` itself is
+   application-facing — no library layer imports it (applications —
+   examples/, bench.py, benchmarks/ — may import anything).
+   `observability` sits at the bottom on purpose: every layer may
+   instrument itself (spans, runtime counters), but observability
+   imports only `utils/` — never pir/ops/serving — so tracing can
+   never create an upward edge. Checked over ALL imports, including
+   function-level ones, because a reversed dependency is wrong
+   wherever the import statement sits.
 
 2. **No module-level import cycles** — the repo's sanctioned idiom for
    breaking genuine cycles is the function-level import, so only
@@ -32,7 +36,13 @@ ROOT = Path(__file__).resolve().parent.parent
 # Layer order, outermost first: a module may import same-or-lower
 # layers only. Subpackages not listed are unconstrained by rule 1
 # (but still cycle-checked by rule 2).
-LAYERS = {"heavy_hitters": 4, "serving": 3, "pir": 2, "ops": 1}
+LAYERS = {
+    "heavy_hitters": 5,
+    "serving": 4,
+    "pir": 3,
+    "ops": 2,
+    "observability": 1,
+}
 
 # Restricted layers: importable only from the listed source layers
 # (plus themselves). serving stays a near-leaf — its one in-library
@@ -167,7 +177,8 @@ def main() -> int:
                 # their upward edges.
                 violations.append(
                     f"{module}: imports {name} — reverses the "
-                    f"heavy_hitters -> serving -> pir -> ops layer DAG"
+                    f"heavy_hitters -> serving -> pir -> ops -> "
+                    f"observability layer DAG"
                 )
         graph[module] = {
             n for imp in top_imports
