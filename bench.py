@@ -60,6 +60,12 @@ BASELINE_QPS = 16.0
 # Best driver-reproducible capture committed this round, referenced by
 # failure-path error messages so a tunnel outage at bench time cannot
 # erase the round's measured result. Update alongside new captures.
+# The numeric value travels separately as the machine-readable
+# "last_good" field on infra-error emissions (BENCH_r05 lesson: a hung
+# init emitted value 0.0 with the real number buried in prose, so the
+# regression gate and dashboards conflated a tunnel outage with a
+# catastrophic regression).
+LAST_CAPTURE_QPS = 7203.53
 LAST_CAPTURE_NOTE = (
     "last captured rc=0 run (2026-08-01): 7203.53 q/s at q128 "
     "(benchmarks/results/bench_cold_20260801_082955.json)"
@@ -111,16 +117,33 @@ def _default_metric_unit():
     return _metric_name(), "queries/s"
 
 
-def _emit(value, vs_baseline, error=None):
+def _emit(value, vs_baseline, error=None, status=None, last_good=None):
+    """Print the single JSON result line and append it to the
+    trajectory store (`benchmarks/results/history.jsonl`) that
+    `benchmarks/regression_gate.py` enforces.
+
+    `status` partitions failures for the gate: "ok" (a real
+    measurement; the default without an error), "infra_error" (the
+    harness/tunnel failed — hung init, watchdog stall with nothing
+    banked; never enters the gate's rolling median), and "error"
+    (the bench itself failed; also excluded from the median). On
+    non-ok emissions `last_good` carries the previous capture's value
+    machine-readably instead of stuffing it into the error prose.
+    """
     metric, unit = _default_metric_unit()
+    if status is None:
+        status = "ok" if not error else "error"
     line = {
         "metric": metric,
         "value": round(float(value), 2),
         "unit": unit,
         "vs_baseline": round(float(vs_baseline), 2),
+        "status": status,
     }
     if error:
         line["error"] = str(error)[:400]
+    if last_good is not None:
+        line["last_good"] = round(float(last_good), 2)
     # Single-shot under a lock: the watchdog thread and the main thread
     # both funnel through here, and exactly one JSON line may print.
     with _EMIT_LOCK:
@@ -128,6 +151,34 @@ def _emit(value, vs_baseline, error=None):
             return
         _PROGRESS["done"] = True
         print(json.dumps(line), flush=True)
+    _append_history(line)
+
+
+def _append_history(line):
+    """Best-effort history append — runs on the watchdog thread too
+    (before its os._exit), so it must never raise and never block on
+    device state. BENCH_HISTORY=0 disables; BENCH_HISTORY_PATH
+    overrides the store location."""
+    if os.environ.get("BENCH_HISTORY", "1") == "0":
+        return
+    try:
+        from benchmarks.regression_gate import append_record, git_rev
+
+        record = dict(line)
+        record["git_rev"] = git_rev()
+        record["device"] = _PROGRESS.get("device", "unknown")
+        record["topology"] = _PROGRESS.get("topology", "unknown")
+        append_record(
+            record,
+            path=os.environ.get(
+                "BENCH_HISTORY_PATH", "benchmarks/results/history.jsonl"
+            ),
+        )
+    except Exception as e:  # noqa: BLE001 - history must not break a bench
+        try:
+            _log(f"history append failed (non-fatal): {e}")
+        except Exception:
+            pass
 
 
 class _InitTimeout(RuntimeError):
@@ -216,6 +267,8 @@ def _start_watchdog():
                         f"TPU backend init hung past {init_budget:.0f}s "
                         "budget (tunnel down?); " + LAST_CAPTURE_NOTE
                     ),
+                    status="infra_error",
+                    last_good=LAST_CAPTURE_QPS,
                 )
                 os._exit(1)
         if _PROGRESS["done"]:
@@ -225,12 +278,17 @@ def _start_watchdog():
             f"WATCHDOG: no completion after {timeout:.0f}s "
             f"(stage: {_PROGRESS['stage']}); emitting and exiting"
         )
+        # A banked qps is a real (if early) measurement: emit it as ok
+        # so the gate judges it. Nothing banked means the harness never
+        # got far enough to measure — an infra error, not a zero.
         _emit(
             qps or 0.0,
             (qps or 0.0) / BASELINE_QPS,
             error=f"watchdog timeout after {timeout:.0f}s during "
             f"stage '{_PROGRESS['stage']}' (TPU tunnel stall?); "
             + LAST_CAPTURE_NOTE,
+            status="ok" if qps else "infra_error",
+            last_good=None if qps else LAST_CAPTURE_QPS,
         )
         os._exit(1 if qps is None else 0)
 
@@ -283,6 +341,10 @@ def _ensure_backend(jax, total_budget_secs=None, per_attempt_secs=150):
                 f"backend ok in {time.perf_counter() - t0:.1f}s: "
                 f"{[str(d) for d in devs]}"
             )
+            # Topology for the history record (read by _append_history
+            # on every later emit, including the watchdog's).
+            _PROGRESS["device"] = getattr(devs[0], "platform", "unknown")
+            _PROGRESS["topology"] = f"{len(devs)}x{jax.process_count()}"
             return devs, None
         except Exception as e:  # noqa: BLE001 - must never crash the bench
             last_err = e
@@ -501,7 +563,11 @@ def main():
     # Reset shared progress state: main() runs once per process in
     # production, but in-process callers (the ladder tests) invoke it
     # repeatedly and a stale done=True would suppress _emit entirely.
-    _PROGRESS.update(stage="startup", qps=None, done=False)
+    _PROGRESS.update(
+        stage="startup", qps=None, done=False,
+        device=os.environ.get("BENCH_PLATFORM", "") or "unknown",
+        topology="unknown",
+    )
     # BENCH_VET_ONLY=1: child mode for the wedge-proof serving vet —
     # compile ONLY the auto planes candidate and exit. Exit codes: 0
     # compile landed, 1 compile errored, 2 environment failure (backend
@@ -612,6 +678,8 @@ def main():
                 f"TPU backend unreachable "
                 f"({str(err).splitlines()[0][:160]}); " + LAST_CAPTURE_NOTE
             ),
+            status="infra_error",
+            last_good=LAST_CAPTURE_QPS,
         )
         return
 
@@ -803,7 +871,7 @@ def main():
     expand_mode = os.environ.get("BENCH_EXPANSION", "planes")
     if expand_mode not in ("both", "limb", "planes", "v2"):
         _emit(0.0, 0.0, error=f"invalid BENCH_EXPANSION={expand_mode!r} "
-              "(expected both|limb|planes|v2)")
+              "(expected both|limb|planes|v2)", status="infra_error")
         return
     import functools
 

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..dpf import BatchCutState, DistributedPointFunction
+from ..observability.device import default_telemetry, shape_key
 from ..value_types import IntType
 
 _DEFAULT_BUDGET_BYTES = 1 << 28  # 256 MiB
@@ -209,34 +210,48 @@ class LevelAggregator:
             ).inc(len(prefixes))
             self._metrics.counter("hh.level_chunks").inc(plan.num_chunks)
 
+        telemetry = default_telemetry()
         shares: List[np.ndarray] = []
         cut_parts: List[BatchCutState] = []
         for c in range(plan.num_chunks):
             chunk = prefixes[
                 c * plan.chunk_prefixes : (c + 1) * plan.chunk_prefixes
             ]
-            values, cut = self._dpf.evaluate_prefixes_batch(
-                self._staged,
-                hierarchy_level,
-                chunk,
-                cuts=cuts if resume else None,
+            # The fused program specializes on (levels walked, chunk
+            # width, value blocks, resume-vs-root); the trailing short
+            # chunk is its own shape.
+            chunk_key = shape_key(
+                ("l", stop - start),
+                ("p", len(chunk)),
+                ("b", self._dpf._blocks_needed[hierarchy_level]),
+                ("r", int(resume)),
             )
+            with telemetry.compile_tracker.dispatch("hh.level", chunk_key):
+                values, cut = self._dpf.evaluate_prefixes_batch(
+                    self._staged,
+                    hierarchy_level,
+                    chunk,
+                    cuts=cuts if resume else None,
+                )
             shares.append(np.asarray(self._sum_over_keys(values)))
             cut_parts.append(cut)
-        if len(cut_parts) == 1:
-            merged = cut_parts[0]
-        else:
-            merged = BatchCutState(
-                hierarchy_level=hierarchy_level,
-                prefixes=np.concatenate([c.prefixes for c in cut_parts]),
-                seeds=jnp.concatenate(
-                    [c.seeds for c in cut_parts], axis=1
-                ),
-                control=jnp.concatenate(
-                    [c.control for c in cut_parts], axis=1
-                ),
-            )
-        self._cuts = merged
+        with telemetry.hbm.phase("cut_state_cache"):
+            if len(cut_parts) == 1:
+                merged = cut_parts[0]
+            else:
+                merged = BatchCutState(
+                    hierarchy_level=hierarchy_level,
+                    prefixes=np.concatenate(
+                        [c.prefixes for c in cut_parts]
+                    ),
+                    seeds=jnp.concatenate(
+                        [c.seeds for c in cut_parts], axis=1
+                    ),
+                    control=jnp.concatenate(
+                        [c.control for c in cut_parts], axis=1
+                    ),
+                )
+            self._cuts = merged
         self._prev_level = hierarchy_level
         out = np.concatenate(shares).astype(np.uint64) & self._mask
         return out.astype(np.uint32)

@@ -1,22 +1,42 @@
-"""End-to-end request observability: tracing, exposition, admin surface.
+"""End-to-end request observability: tracing, device telemetry, SLOs,
+exposition, admin surface.
 
-Four pieces, all stdlib-only and importable from any layer above
-`utils/` (the layer DAG is serving -> observability -> utils; this
-package never imports pir/, ops/, or serving/):
+Six pieces, importable from any layer above `utils/` (the layer DAG is
+serving -> observability -> utils; this package never imports pir/,
+ops/, or serving/ — `device`/`slo` reach JAX lazily and only for
+device facts):
 
 * `tracing` — per-request spans with trace ids, a bounded flight
   recorder retaining the slowest/errored traces, process-wide stage
   aggregates, and runtime counters for layers below serving.
+* `device` — compile-event tracker (one compile per new dispatch
+  shape, cache hits, compile-latency histograms, a `jax.monitoring`
+  bridge) and the HBM accountant (live-bytes watermarks with
+  per-phase attribution).
+* `slo` — declarative latency/throughput/compile-budget objectives
+  graded continuously against the metrics registry; hard breaches
+  degrade `/healthz` to 503.
 * `propagation` — the versioned envelope that carries a trace id on
   the Leader->Helper wire and the Helper's stage timings back
   (old-version peers interop by detection).
-* `exposition` — Prometheus text rendering of the metrics registry.
-* `admin` — the `/metrics` `/varz` `/healthz` `/tracez` `/profilez`
-  operator HTTP endpoint.
+* `exposition` — Prometheus text rendering of the metrics registry,
+  including OpenMetrics-style exemplars linking buckets to traces.
+* `admin` — the `/metrics` `/varz` `/healthz` `/statusz` `/tracez`
+  `/profilez` operator HTTP endpoint.
 """
 
 from .admin import AdminServer
+from .device import (
+    CompileTracker,
+    DeviceTelemetry,
+    HbmAccountant,
+    default_telemetry,
+    install_jax_monitoring_listener,
+    set_default_telemetry,
+    shape_key,
+)
 from .exposition import parse_labeled_name, render_prometheus
+from .slo import SloObjective, SloTracker
 from .propagation import (
     EnvelopeError,
     encode_request,
@@ -42,21 +62,30 @@ from .tracing import (
 
 __all__ = [
     "AdminServer",
+    "CompileTracker",
     "CounterGroup",
+    "DeviceTelemetry",
     "EnvelopeError",
     "FlightRecorder",
+    "HbmAccountant",
+    "SloObjective",
+    "SloTracker",
     "Trace",
     "add_span",
     "current_trace",
     "default_recorder",
+    "default_telemetry",
     "encode_request",
     "encode_response",
+    "install_jax_monitoring_listener",
     "new_trace_id",
     "parse_labeled_name",
     "render_prometheus",
     "reset_stages",
     "runtime_counters",
     "set_default_recorder",
+    "set_default_telemetry",
+    "shape_key",
     "span",
     "stage_summary",
     "trace_request",

@@ -1,16 +1,24 @@
-"""Operator telemetry endpoint: /metrics, /varz, /healthz, /tracez,
-/profilez — a stdlib `http.server` surface any session can hang off a
-port.
+"""Operator telemetry endpoint: /metrics, /varz, /healthz, /statusz,
+/tracez, /profilez — a stdlib `http.server` surface any session can
+hang off a port.
 
 The serving runtime's observability state (metrics registry, flight
-recorder, stage aggregates, runtime counters) is in-process; this
-server is the scrape surface:
+recorder, stage aggregates, runtime counters, device telemetry, SLO
+tracker) is in-process; this server is the scrape surface:
 
-    /healthz                 liveness ("ok", 200)
+    /healthz                 liveness ("ok", 200); with an SLO tracker
+                             attached, degrades to 503 while any hard
+                             objective is in breach and recovers on
+                             the next probe after the breach clears
     /metrics                 Prometheus text exposition of the registry
                              plus the observability runtime counters
     /varz                    the same state as one JSON document
                              (registry export, stage summary, uptime)
+    /statusz                 operator incident page (HTML): compile
+                             counts and cache-hit ratios per dispatch
+                             site, HBM watermarks per phase, SLO burn
+                             table; `?format=json` for the same data
+                             machine-readable
     /tracez                  flight-recorder dump (slowest / errored /
                              recent traces, JSON)
     /profilez?duration_ms=N  on-demand xprof capture via
@@ -26,6 +34,7 @@ internet.
 
 from __future__ import annotations
 
+import html
 import http.server
 import json
 import logging
@@ -37,6 +46,7 @@ from typing import Optional
 
 from ..utils.profiling import trace as xprof_trace
 from . import tracing
+from .device import DeviceTelemetry, default_telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -62,11 +72,19 @@ class AdminServer:
         port: int = 0,
         name: str = "admin",
         profile_dir: Optional[str] = None,
+        device: Optional[DeviceTelemetry] = None,
+        slo=None,
     ):
         self._registry = registry
         self._recorder = (
             recorder if recorder is not None else tracing.default_recorder()
         )
+        # device defaults to the process-wide telemetry every dispatch
+        # site reports into; slo (an `slo.SloTracker` or anything with
+        # `breaches()`/`export()`) is opt-in — without one, /healthz
+        # stays a bare liveness probe.
+        self._device = device if device is not None else default_telemetry()
+        self._slo = slo
         self._name = name
         self._profile_dir = profile_dir
         self._profile_lock = threading.Lock()
@@ -130,9 +148,9 @@ class AdminServer:
         parsed = urllib.parse.urlsplit(handler.path)
         path = parsed.path.rstrip("/") or "/"
         if path == "/healthz":
-            self._reply(
-                handler, 200, "text/plain; charset=utf-8", b"ok\n"
-            )
+            self._healthz(handler)
+        elif path == "/statusz":
+            self._statusz(handler, parsed.query)
         elif path == "/metrics":
             from .exposition import render_prometheus
 
@@ -165,8 +183,54 @@ class AdminServer:
             self._reply(
                 handler, 404, "text/plain; charset=utf-8",
                 b"unknown endpoint; try /healthz /metrics /varz "
-                b"/tracez /profilez\n",
+                b"/statusz /tracez /profilez\n",
             )
+
+    def _healthz(self, handler) -> None:
+        if self._slo is None:
+            self._reply(
+                handler, 200, "text/plain; charset=utf-8", b"ok\n"
+            )
+            return
+        breaches = self._slo.breaches(evaluate=True)
+        if not breaches:
+            self._reply(
+                handler, 200, "text/plain; charset=utf-8", b"ok\n"
+            )
+            return
+        lines = "".join(
+            f"slo breach: {b['name']} ({b['metric']} observed "
+            f"{b['observed']} vs {b['threshold']}, "
+            f"burning {b['burn_s']}s)\n"
+            for b in breaches
+        )
+        self._reply(
+            handler, 503, "text/plain; charset=utf-8",
+            ("unhealthy\n" + lines).encode(),
+        )
+
+    # -- /statusz -----------------------------------------------------------
+
+    def _status_state(self) -> dict:
+        state = {
+            "name": self._name,
+            "uptime_s": round(time.time() - self._started_unix, 1),
+            "device": self._device.export(),
+            "slo": self._slo.export() if self._slo is not None else None,
+        }
+        return state
+
+    def _statusz(self, handler, query: str) -> None:
+        params = urllib.parse.parse_qs(query)
+        state = self._status_state()
+        if params.get("format", [""])[0] == "json":
+            body = json.dumps(state, indent=2, default=str).encode()
+            self._reply(handler, 200, "application/json", body)
+            return
+        self._reply(
+            handler, 200, "text/html; charset=utf-8",
+            _render_statusz(state).encode(),
+        )
 
     def _profilez(self, handler, query: str) -> None:
         params = urllib.parse.parse_qs(query)
@@ -239,3 +303,117 @@ class AdminServer:
 
     def __exit__(self, *exc):
         self.stop()
+
+
+# ---------------------------------------------------------------------------
+# /statusz rendering (server-side HTML, no JS, survives a pager-duty curl)
+# ---------------------------------------------------------------------------
+
+_STATUSZ_STYLE = (
+    "<style>body{font-family:monospace;margin:1.5em}"
+    "table{border-collapse:collapse;margin:0.5em 0}"
+    "td,th{border:1px solid #999;padding:2px 8px;text-align:left}"
+    "th{background:#eee}.breach{background:#fdd}.ok{background:#dfd}"
+    ".nodata{color:#777}</style>"
+)
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} GiB"  # pragma: no cover - loop always returns
+
+
+def _render_statusz(state: dict) -> str:
+    esc = html.escape
+    out = [
+        "<!doctype html><html><head><title>statusz</title>",
+        _STATUSZ_STYLE,
+        "</head><body>",
+        f"<h1>{esc(str(state['name']))} /statusz</h1>",
+        f"<p>uptime: {state['uptime_s']} s</p>",
+    ]
+
+    slo = state.get("slo")
+    out.append("<h2>SLO burn</h2>")
+    if slo is None:
+        out.append("<p class=nodata>no SLO tracker attached</p>")
+    else:
+        health = "healthy" if slo["healthy"] else "UNHEALTHY (hard breach)"
+        cls = "ok" if slo["healthy"] else "breach"
+        out.append(f"<p class={cls}>{health}</p>")
+        out.append(
+            "<table><tr><th>objective</th><th>kind</th><th>metric</th>"
+            "<th>observed</th><th>threshold</th><th>severity</th>"
+            "<th>state</th><th>burn</th></tr>"
+        )
+        for r in slo["objectives"]:
+            cls = {"breach": "breach", "ok": "ok"}.get(r["state"], "nodata")
+            observed = "-" if r["observed"] is None else r["observed"]
+            out.append(
+                f"<tr class={cls}><td>{esc(r['name'])}</td>"
+                f"<td>{esc(r['kind'])}</td><td>{esc(r['metric'])}</td>"
+                f"<td>{observed}</td><td>{r['threshold']}</td>"
+                f"<td>{esc(r['severity'])}</td><td>{esc(r['state'])}</td>"
+                f"<td>{r['burn_s']} s</td></tr>"
+            )
+        out.append("</table>")
+
+    compile_state = state["device"]["compile"]
+    out.append(
+        f"<h2>Compilations (total: {compile_state['total_compiles']})</h2>"
+    )
+    if not compile_state["sites"]:
+        out.append("<p class=nodata>no tracked dispatches yet</p>")
+    else:
+        out.append(
+            "<table><tr><th>site</th><th>shapes</th><th>compiles</th>"
+            "<th>hits</th><th>hit ratio</th><th>compile p50/max ms</th></tr>"
+        )
+        for site, entry in compile_state["sites"].items():
+            lat = entry.get("compile_ms")
+            lat_s = f"{lat['p50']} / {lat['max']}" if lat else "-"
+            ratio = entry["hit_ratio"]
+            out.append(
+                f"<tr><td>{esc(site)}</td><td>{len(entry['shapes'])}</td>"
+                f"<td>{entry['compiles']}</td><td>{entry['hits']}</td>"
+                f"<td>{'-' if ratio is None else ratio}</td>"
+                f"<td>{lat_s}</td></tr>"
+            )
+        out.append("</table>")
+        out.append("<details><summary>per-shape dispatch counts</summary>")
+        out.append(
+            "<table><tr><th>site</th><th>shape</th><th>compiles</th>"
+            "<th>hits</th></tr>"
+        )
+        for site, entry in compile_state["sites"].items():
+            for key, counts in entry["shapes"].items():
+                out.append(
+                    f"<tr><td>{esc(site)}</td><td>{esc(key)}</td>"
+                    f"<td>{counts['compiles']}</td>"
+                    f"<td>{counts['hits']}</td></tr>"
+                )
+        out.append("</table></details>")
+
+    hbm = state["device"]["hbm"]
+    out.append("<h2>HBM</h2>")
+    out.append(
+        f"<p>live: {_fmt_bytes(hbm['live_bytes'])} "
+        f"(source: {esc(str(hbm['source']))}, samples: {hbm['samples']}, "
+        f"phase: {esc(str(hbm['current_phase']))})</p>"
+    )
+    if hbm["watermark_bytes"]:
+        out.append(
+            "<table><tr><th>phase</th><th>watermark</th></tr>"
+        )
+        for phase, watermark in hbm["watermark_bytes"].items():
+            out.append(
+                f"<tr><td>{esc(phase)}</td>"
+                f"<td>{_fmt_bytes(watermark)}</td></tr>"
+            )
+        out.append("</table>")
+    out.append("</body></html>")
+    return "".join(out)

@@ -13,6 +13,14 @@ Prometheus metric `base` with label pairs, so per-role/per-level
 instruments (`request_ms{role=leader}`) become one metric family with
 labeled series instead of colliding flat names. Characters outside
 `[a-zA-Z0-9_:]` in names (the registry's dotted names) sanitize to `_`.
+
+Histogram exports may carry per-bucket **exemplars** (`{"exemplars":
+{"50.0": {"value": 48.2, "trace_id": "...", "ts": ...}}}`); each lands
+on its `_bucket` line in the OpenMetrics exemplar syntax —
+`... # {trace_id="..."} 48.2 1700000000.0` — linking the bucket to the
+flight-recorder trace that most recently filled it. Plain Prometheus
+scrapers tolerate the suffix on the text format; OpenMetrics scrapers
+ingest it.
 """
 
 from __future__ import annotations
@@ -123,20 +131,23 @@ def render_prometheus(
         fam = family(base, "histogram")
         full = prefix + _sanitize_name(base)
         buckets = hist.get("buckets", {})
+        exemplars = hist.get("exemplars", {})
         ordered = sorted(buckets.items(), key=lambda kv: _bucket_bound(kv[0]))
         cumulative = 0
         lines: List[Tuple[str, object]] = []
         for key, count in ordered:
             cumulative += int(count)
             le = "+Inf" if key == "+inf" else _fmt(_bucket_bound(key))
-            lines.append(
-                (
-                    full
-                    + "_bucket"
-                    + _render_labels({**labels, "le": le}),
-                    cumulative,
-                )
+            line = (
+                full
+                + "_bucket"
+                + _render_labels({**labels, "le": le}),
+                cumulative,
             )
+            exemplar = exemplars.get(key)
+            if exemplar and exemplar.get("trace_id"):
+                line = line + (_render_exemplar(exemplar),)
+            lines.append(line)
         count = int(hist.get("count", 0))
         if not ordered or _bucket_bound(ordered[-1][0]) != math.inf:
             lines.append(
@@ -156,6 +167,18 @@ def render_prometheus(
     for base in sorted(families):
         fam = families[base]
         out.append(f"# TYPE {base} {fam['type']}")
-        for series_name, value in fam["series"]:
-            out.append(f"{series_name} {_fmt(value)}")
+        for series in fam["series"]:
+            series_name, value = series[0], series[1]
+            suffix = series[2] if len(series) > 2 else ""
+            out.append(f"{series_name} {_fmt(value)}{suffix}")
     return "\n".join(out) + ("\n" if out else "")
+
+
+def _render_exemplar(exemplar: dict) -> str:
+    """OpenMetrics exemplar suffix for a `_bucket` line."""
+    trace_id = _escape_value(str(exemplar["trace_id"]))
+    suffix = f' # {{trace_id="{trace_id}"}} {_fmt(exemplar["value"])}'
+    ts = exemplar.get("ts")
+    if ts is not None:
+        suffix += f" {_fmt(round(float(ts), 3))}"
+    return suffix

@@ -1,0 +1,248 @@
+"""Declarative SLOs evaluated against the metrics registry.
+
+The ROADMAP's "fast as the hardware allows" north star needs
+machine-checkable objectives, not eyeballed JSON tails: a process
+declares ceilings and floors over its own instruments and the tracker
+continuously grades them, so `/statusz` shows burn state and
+`/healthz` degrades to 503 while a *hard* objective is in breach
+(load balancers drain the process; recovery flips it back).
+
+Objective kinds:
+
+* ``p99_ms_max``   — p99 of a registry histogram must stay <= threshold
+                     (latency ceiling).
+* ``rate_min``     — the per-second growth rate of a registry counter
+                     must stay >= threshold (a q/s floor). Rates need
+                     two observations; until then the objective reports
+                     ``no_data`` (never a breach — a process that has
+                     not served yet is not failing its SLO).
+* ``counter_max``  — a registry counter must stay <= threshold
+                     (compile-count budget per process: a serving
+                     binary whose bucket discipline holds compiles a
+                     bounded number of programs, so compile count
+                     crossing the budget is a bug, not load).
+* ``gauge_max``    — a registry gauge must stay <= threshold (HBM
+                     watermark ceilings).
+
+Config is data, not code (`SloTracker.from_config` accepts the parsed
+dict or a JSON path):
+
+    {"objectives": [
+        {"name": "plain_latency", "kind": "p99_ms_max",
+         "metric": "plain.request_ms", "threshold": 50.0,
+         "severity": "hard"},
+        {"name": "throughput_floor", "kind": "rate_min",
+         "metric": "batcher.requests_submitted", "threshold": 100.0,
+         "severity": "soft"},
+        {"name": "compile_budget", "kind": "counter_max",
+         "metric": "device.compiles{site=batcher.evaluate}",
+         "threshold": 8, "severity": "hard"}]}
+
+Like everything in `observability/`, the registry is duck-typed
+(`.export() -> dict`) and nothing here imports serving/pir —
+`tools/check_layers.py` enforces it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["SloObjective", "SloTracker", "KINDS"]
+
+KINDS = ("p99_ms_max", "rate_min", "counter_max", "gauge_max")
+
+
+@dataclasses.dataclass(frozen=True)
+class SloObjective:
+    """One declarative objective over a registry instrument."""
+
+    name: str
+    kind: str
+    metric: str
+    threshold: float
+    severity: str = "hard"  # "hard" degrades /healthz; "soft" is advisory
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown SLO kind {self.kind!r}; expected one of {KINDS}"
+            )
+        if self.severity not in ("hard", "soft"):
+            raise ValueError(
+                f"severity must be 'hard' or 'soft', got {self.severity!r}"
+            )
+
+
+class SloTracker:
+    """Grades objectives against registry exports; remembers burn state.
+
+    Evaluation is pull-based: `/healthz` and `/statusz` call
+    `evaluate()` on scrape (tests drive it directly), and an optional
+    `start(period_s)` daemon keeps burn clocks honest between scrapes.
+    """
+
+    def __init__(
+        self,
+        objectives: List[SloObjective],
+        registry,
+        clock=time.monotonic,
+    ):
+        self._objectives = list(objectives)
+        self._registry = registry
+        self._clock = clock
+        self._lock = threading.Lock()
+        # name -> monotonic time the current breach started
+        self._burning_since: Dict[str, float] = {}
+        # name -> (counter value, monotonic ts) for rate_min objectives
+        self._rate_marks: Dict[str, tuple] = {}
+        self._last_eval: Optional[List[dict]] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    @classmethod
+    def from_config(cls, config, registry) -> "SloTracker":
+        """Build from a parsed config dict or a JSON file path."""
+        if isinstance(config, str):
+            with open(config) as f:
+                config = json.load(f)
+        objectives = [
+            SloObjective(
+                name=str(o["name"]),
+                kind=str(o["kind"]),
+                metric=str(o["metric"]),
+                threshold=float(o["threshold"]),
+                severity=str(o.get("severity", "hard")),
+            )
+            for o in config.get("objectives", [])
+        ]
+        return cls(objectives, registry)
+
+    @property
+    def objectives(self) -> List[SloObjective]:
+        return list(self._objectives)
+
+    # -- grading ------------------------------------------------------------
+
+    def _observe(self, objective: SloObjective, export: dict, now: float):
+        """(observed value or None, state) for one objective."""
+        if objective.kind == "p99_ms_max":
+            hist = export.get("histograms", {}).get(objective.metric)
+            p99 = hist.get("p99") if hist else None
+            if p99 is None:
+                return None, "no_data"
+            return p99, ("ok" if p99 <= objective.threshold else "breach")
+        if objective.kind == "counter_max":
+            value = export.get("counters", {}).get(objective.metric)
+            if value is None:
+                return None, "no_data"
+            return value, ("ok" if value <= objective.threshold else "breach")
+        if objective.kind == "gauge_max":
+            value = export.get("gauges", {}).get(objective.metric)
+            if value is None:
+                return None, "no_data"
+            return value, ("ok" if value <= objective.threshold else "breach")
+        # rate_min: needs a previous mark to compute a rate.
+        value = export.get("counters", {}).get(objective.metric)
+        if value is None:
+            return None, "no_data"
+        mark = self._rate_marks.get(objective.name)
+        self._rate_marks[objective.name] = (value, now)
+        if mark is None or now <= mark[1]:
+            return None, "no_data"
+        rate = (value - mark[0]) / (now - mark[1])
+        return (
+            round(rate, 4),
+            "ok" if rate >= objective.threshold else "breach",
+        )
+
+    def evaluate(self) -> List[dict]:
+        """Grade every objective now. Returns one record per objective:
+        {name, kind, metric, threshold, severity, observed, state,
+        burn_s} where state is ok|breach|no_data and burn_s is how long
+        the objective has been continuously in breach."""
+        export = self._registry.export()
+        now = self._clock()
+        results = []
+        with self._lock:
+            for objective in self._objectives:
+                observed, state = self._observe(objective, export, now)
+                if state == "breach":
+                    self._burning_since.setdefault(objective.name, now)
+                else:
+                    self._burning_since.pop(objective.name, None)
+                burn = self._burning_since.get(objective.name)
+                results.append(
+                    {
+                        "name": objective.name,
+                        "kind": objective.kind,
+                        "metric": objective.metric,
+                        "threshold": objective.threshold,
+                        "severity": objective.severity,
+                        "observed": observed,
+                        "state": state,
+                        "burn_s": (
+                            round(now - burn, 3) if burn is not None else 0.0
+                        ),
+                    }
+                )
+            self._last_eval = results
+        return results
+
+    def healthy(self) -> bool:
+        """False iff any *hard* objective is currently in breach.
+        Re-evaluates, so recovery flips health back on the next probe."""
+        return not self.breaches(evaluate=True)
+
+    def breaches(self, evaluate: bool = False) -> List[dict]:
+        if evaluate or self._last_eval is None:
+            self.evaluate()
+        with self._lock:
+            last = self._last_eval or []
+            return [
+                r for r in last
+                if r["state"] == "breach" and r["severity"] == "hard"
+            ]
+
+    def export(self) -> dict:
+        """Last grading (evaluating if never graded) for /statusz."""
+        results = self.evaluate()
+        return {
+            "objectives": results,
+            "healthy": not any(
+                r["state"] == "breach" and r["severity"] == "hard"
+                for r in results
+            ),
+        }
+
+    # -- optional background burner -----------------------------------------
+
+    def start(self, period_s: float = 10.0) -> "SloTracker":
+        """Evaluate every `period_s` seconds on a daemon thread so burn
+        durations accrue even when nobody scrapes."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def run():
+            while not self._stop.wait(period_s):
+                try:
+                    self.evaluate()
+                except Exception:  # pragma: no cover - keep burning
+                    pass
+
+        self._thread = threading.Thread(
+            target=run, daemon=True, name="slo-tracker"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._thread = None

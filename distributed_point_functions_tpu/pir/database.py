@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability.device import default_telemetry
+
 from ..ops.inner_product import (
     xor_inner_product,
     xor_inner_product_bitplane,
@@ -180,7 +182,10 @@ class DenseDpfPirDatabase:
         """uint32[num_records_padded, record_words] device buffer."""
         with self._stage_lock:
             if self._db_words is None:
-                self._db_words = jnp.asarray(self._host_words)
+                with default_telemetry().hbm.phase("db_staging"):
+                    self._db_words = jax.block_until_ready(
+                        jnp.asarray(self._host_words)
+                    )
             return self._db_words
 
     def record(self, i: int) -> bytes:
@@ -219,7 +224,10 @@ class DenseDpfPirDatabase:
             return self.db_words
         with self._stage_lock:
             if self._db_words_rev is None:
-                self._db_words_rev = jnp.asarray(self._host_words_bitrev())
+                with default_telemetry().hbm.phase("db_staging"):
+                    self._db_words_rev = jax.block_until_ready(
+                        jnp.asarray(self._host_words_bitrev())
+                    )
                 # The host-side permuted copy only exists to feed device
                 # stagings; keeping it would hold a second full database
                 # in host RSS for the process lifetime. (Rebuilt from
@@ -232,17 +240,19 @@ class DenseDpfPirDatabase:
         with self._stage_lock:
             if bitrev_blocks:
                 if self._db_perm_rev is None:
-                    self._db_perm_rev = jax.block_until_ready(
-                        permute_db_bitmajor(
-                            jnp.asarray(self._host_words_bitrev())
+                    with default_telemetry().hbm.phase("db_staging"):
+                        self._db_perm_rev = jax.block_until_ready(
+                            permute_db_bitmajor(
+                                jnp.asarray(self._host_words_bitrev())
+                            )
                         )
-                    )
                     self._host_rev = None  # see _row_words
                 return self._db_perm_rev
             if self._db_perm is None:
-                self._db_perm = jax.block_until_ready(
-                    permute_db_bitmajor(jnp.asarray(self._host_words))
-                )
+                with default_telemetry().hbm.phase("db_staging"):
+                    self._db_perm = jax.block_until_ready(
+                        permute_db_bitmajor(jnp.asarray(self._host_words))
+                    )
             return self._db_perm
 
     def streaming_chunks(
@@ -272,16 +282,19 @@ class DenseDpfPirDatabase:
                 self._host_words_padded(), cut_levels
             )
             nc = 1 << cut_levels
-            if bitmajor:
-                from ..ops.inner_product_pallas import stage_db_chunks_bitmajor
+            with default_telemetry().hbm.phase("db_staging"):
+                if bitmajor:
+                    from ..ops.inner_product_pallas import (
+                        stage_db_chunks_bitmajor,
+                    )
 
-                arr = jax.block_until_ready(
-                    stage_db_chunks_bitmajor(jnp.asarray(host), nc)
-                )
-            else:
-                arr = jax.block_until_ready(
-                    jnp.asarray(host.reshape(nc, -1, host.shape[1]))
-                )
+                    arr = jax.block_until_ready(
+                        stage_db_chunks_bitmajor(jnp.asarray(host), nc)
+                    )
+                else:
+                    arr = jax.block_until_ready(
+                        jnp.asarray(host.reshape(nc, -1, host.shape[1]))
+                    )
             self._streaming_stage = (key, arr)
             return arr
 

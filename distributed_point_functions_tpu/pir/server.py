@@ -31,6 +31,7 @@ from typing import Callable, Optional
 
 from ..dpf import DistributedPointFunction, DpfParameters
 from ..observability import tracing
+from ..observability.device import default_telemetry, shape_key
 from ..prng import Aes128CtrSeededPrng, xor_bytes
 from ..value_types import XorType
 from . import messages
@@ -324,24 +325,45 @@ class DenseDpfPirServer(DpfPirServer):
             # smaller than the database): the bitrev staging has no
             # zero-extension story there, so serve natural order.
             bitrev = False
+        telemetry = default_telemetry()
         if self._mesh is not None:
             staged = stage_keys(keys)
-            with tracing.span("evaluate_sharded", num_keys=len(keys)):
+            key = shape_key(
+                ("m", "sharded"), ("q", len(keys)), ("b", self._num_blocks)
+            )
+            with tracing.span("evaluate_sharded", num_keys=len(keys)), \
+                    telemetry.hbm.phase("selection"), \
+                    telemetry.compile_tracker.dispatch("pir.plain", key):
                 inner_products = self._inner_products_sharded(
                     staged, len(keys)
                 )
         else:
             plan = self._plan_serving(len(keys), bitrev)
             if plan.mode == "streaming":
+                key = shape_key(
+                    ("m", f"streaming-{plan.ip}"),
+                    ("q", len(keys)),
+                    ("b", self._num_blocks),
+                    ("c", plan.cut_levels),
+                )
                 with tracing.span(
                     "evaluate_streaming", num_keys=len(keys), ip=plan.ip
-                ):
+                ), telemetry.hbm.phase("selection"), \
+                        telemetry.compile_tracker.dispatch("pir.plain", key):
                     inner_products = self._inner_products_streaming(
                         plan, keys
                     )
             elif plan.mode == "chunked":
                 staged = stage_keys(keys)
-                with tracing.span("evaluate_chunked", num_keys=len(keys)):
+                key = shape_key(
+                    ("m", "chunked"),
+                    ("q", len(keys)),
+                    ("b", self._num_blocks),
+                    ("c", plan.chunk_levels),
+                )
+                with tracing.span("evaluate_chunked", num_keys=len(keys)), \
+                        telemetry.hbm.phase("selection"), \
+                        telemetry.compile_tracker.dispatch("pir.plain", key):
                     inner_products = self._inner_products_chunked(
                         staged, len(keys), plan
                     )
@@ -351,9 +373,15 @@ class DenseDpfPirServer(DpfPirServer):
                 # device AES per batch); the device step starts at the
                 # expansion root. DPF_TPU_HOST_WALK=0 restores the
                 # on-device walk.
+                key = shape_key(
+                    ("m", "bitrev" if bitrev else "materialized"),
+                    ("q", len(keys)),
+                    ("b", self._num_blocks),
+                )
                 with tracing.span(
                     "evaluate_materialized", num_keys=len(keys)
-                ):
+                ), telemetry.hbm.phase("selection"), \
+                        telemetry.compile_tracker.dispatch("pir.plain", key):
                     staged, device_walk = stage_keys_walked(
                         keys, self._walk_levels
                     )

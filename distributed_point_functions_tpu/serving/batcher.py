@@ -38,6 +38,7 @@ from collections import deque
 from typing import Callable, List, Optional, Sequence
 
 from ..observability import tracing
+from ..observability.device import default_telemetry, shape_key
 from .metrics import MetricsRegistry
 
 
@@ -239,7 +240,12 @@ class DynamicBatcher:
             self._h_pad_waste.observe(pad_waste)
             try:
                 t_eval = time.perf_counter()
-                with self.metrics.timed(f"{self._name}.evaluate_ms"):
+                tracker = default_telemetry().compile_tracker
+                with self.metrics.timed(f"{self._name}.evaluate_ms"), \
+                        tracker.dispatch(
+                            f"{self._name}.evaluate",
+                            shape_key(("k", bucket)),
+                        ):
                     results = list(self._evaluate(padded))
                 eval_ms = (time.perf_counter() - t_eval) * 1e3
                 if len(results) < len(flat):

@@ -22,6 +22,15 @@ label set always maps to the same instrument. The Prometheus renderer
 pairs, turning e.g. `request_ms{role=leader}` and
 `request_ms{role=helper}` into one labeled metric family instead of
 two colliding flat names.
+
+**Exemplars.** When a histogram observation happens inside an active
+request trace (`observability.tracing.current_trace()`), the bucket it
+lands in remembers that observation's value and trace id (most recent
+wins). The Prometheus renderer exposes them OpenMetrics-style
+(`_bucket{le="50"} 12 # {trace_id="deadbeef..."} 48.2 <ts>`), so an
+operator staring at a slow bucket can jump straight to the matching
+flight-recorder trace on `/tracez` instead of guessing which request
+put it there.
 """
 
 from __future__ import annotations
@@ -33,6 +42,7 @@ import threading
 import time
 from typing import Dict, Optional, Sequence
 
+from ..observability import tracing
 from ..utils.profiling import annotate
 
 # Default latency bucket upper bounds, in milliseconds.
@@ -117,13 +127,20 @@ class Histogram:
         self._samples = collections.deque(maxlen=_RESERVOIR)
         self._count = 0
         self._sum = 0.0
+        # bucket index -> (value, trace_id, unix_ts); most recent
+        # traced observation per bucket (see module docstring).
+        self._exemplars: Dict[int, tuple] = {}
 
     def observe(self, v: float) -> None:
+        trace = tracing.current_trace()
         with self._lock:
-            self._counts[bisect.bisect_left(self._bounds, v)] += 1
+            idx = bisect.bisect_left(self._bounds, v)
+            self._counts[idx] += 1
             self._samples.append(v)
             self._count += 1
             self._sum += v
+            if trace is not None:
+                self._exemplars[idx] = (v, trace.trace_id, time.time())
 
     @property
     def count(self) -> int:
@@ -151,12 +168,14 @@ class Histogram:
             counts = list(self._counts)
             count, total = self._count, self._sum
             ordered = sorted(self._samples)
+            exemplars = dict(self._exemplars)
 
         def pct(p):
             v = self._rank(ordered, p)
             return None if v is None else round(v, 4)
 
-        return {
+        keys = [str(b) for b in self._bounds] + ["+inf"]
+        out = {
             "count": count,
             "sum": round(total, 4),
             "mean": round(total / count, 4) if count else None,
@@ -169,6 +188,16 @@ class Histogram:
                 "+inf": counts[-1],
             },
         }
+        if exemplars:
+            out["exemplars"] = {
+                keys[idx]: {
+                    "value": round(value, 4),
+                    "trace_id": trace_id,
+                    "ts": round(ts, 3),
+                }
+                for idx, (value, trace_id, ts) in sorted(exemplars.items())
+            }
+        return out
 
 
 class MetricsRegistry:

@@ -47,6 +47,10 @@ from typing import Optional
 
 from .. import serialization
 from ..observability import propagation, tracing
+from ..observability.device import (
+    default_telemetry,
+    install_jax_monitoring_listener,
+)
 from ..pir import messages
 from ..pir.database import DenseDpfPirDatabase
 from ..pir.server import DenseDpfPirServer
@@ -117,6 +121,12 @@ class _Session:
         self._config = config if config is not None else ServingConfig()
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._name = name
+        # Device telemetry rides the session's registry: compile events
+        # and HBM watermarks from the dispatch sites below show up on
+        # this session's /metrics and /statusz. The jax.monitoring
+        # bridge is one process-wide listener (idempotent install).
+        default_telemetry().bind_registry(self.metrics)
+        install_jax_monitoring_listener(default_telemetry().compile_tracker)
         self._batcher: Optional[DynamicBatcher] = None
         if self._config.batching:
             self._batcher = DynamicBatcher(

@@ -85,10 +85,13 @@ def _serving_config():
 # ---------------------------------------------------------------------------
 
 
-def _maybe_admin(admin_port, registry, name: str):
+def _maybe_admin(admin_port, registry, name: str, slo_config=None):
     """Start the operator telemetry endpoint when --admin-port is given
-    (0 = auto-pick). Serves /metrics, /varz, /tracez, /healthz, and
-    /profilez off the role's live registry and flight recorder."""
+    (0 = auto-pick). Serves /metrics, /varz, /statusz, /tracez,
+    /healthz, and /profilez off the role's live registry, flight
+    recorder, and device telemetry. `--slo-config <json>` attaches a
+    declarative SLO tracker: hard breaches degrade /healthz to 503 and
+    /statusz shows the burn table."""
     if admin_port is None:
         return None
     from distributed_point_functions_tpu.observability import (
@@ -96,22 +99,31 @@ def _maybe_admin(admin_port, registry, name: str):
         tracing,
     )
 
+    slo = None
+    if slo_config is not None:
+        from distributed_point_functions_tpu.observability.slo import (
+            SloTracker,
+        )
+
+        slo = SloTracker.from_config(slo_config, registry)
     admin = AdminServer(
         registry=registry,
         recorder=tracing.default_recorder(),
         port=admin_port,
         name=name,
+        slo=slo,
     )
     admin.start()
     print(
         f"[{name}] admin endpoint on :{admin.port} "
-        "(/metrics /varz /tracez /healthz /profilez)",
+        "(/metrics /varz /statusz /tracez /healthz /profilez"
+        f"{'; SLOs: ' + ','.join(o.name for o in slo.objectives) if slo else ''})",
         flush=True,
     )
     return admin
 
 
-def run_helper(port: int, admin_port=None) -> None:
+def run_helper(port: int, admin_port=None, slo_config=None) -> None:
     from distributed_point_functions_tpu.serving import (
         FramedTcpServer,
         HelperSession,
@@ -120,13 +132,15 @@ def run_helper(port: int, admin_port=None) -> None:
 
     db, _ = build_database()
     session = HelperSession(db, encrypt_decrypt.decrypt, _serving_config())
-    _maybe_admin(admin_port, session.metrics, "helper")
+    _maybe_admin(admin_port, session.metrics, "helper", slo_config)
     server = FramedTcpServer(session.handle_wire, port=port, name="helper")
     print(f"[helper] listening on :{server.port}", flush=True)
     server.serve_forever()
 
 
-def run_leader(port: int, helper_addr: str, admin_port=None) -> None:
+def run_leader(
+    port: int, helper_addr: str, admin_port=None, slo_config=None
+) -> None:
     from distributed_point_functions_tpu.serving import (
         FramedTcpServer,
         LeaderSession,
@@ -139,7 +153,7 @@ def run_leader(port: int, helper_addr: str, admin_port=None) -> None:
     session = LeaderSession(
         db, TcpTransport(helper_host, helper_port), _serving_config()
     )
-    _maybe_admin(admin_port, session.metrics, "leader")
+    _maybe_admin(admin_port, session.metrics, "leader", slo_config)
     server = FramedTcpServer(session.handle_wire, port=port, name="leader")
     print(f"[leader] listening on :{server.port}", flush=True)
     server.serve_forever()
@@ -246,8 +260,13 @@ def main():
     ap.add_argument("--indices", default="3,42,99")
     ap.add_argument("--admin-port", type=int, default=None,
                     help="serve the operator telemetry endpoint "
-                    "(/metrics /varz /tracez /healthz /profilez) on this "
-                    "port (0 = auto-pick; helper and leader roles)")
+                    "(/metrics /varz /statusz /tracez /healthz /profilez) "
+                    "on this port (0 = auto-pick; helper and leader roles)")
+    ap.add_argument("--slo-config", default=None,
+                    help="JSON file of declarative SLO objectives (see "
+                    "docs/DESIGN.md §11); with --admin-port, hard "
+                    "breaches degrade /healthz to 503 and /statusz "
+                    "shows the burn table")
     ap.add_argument("--demo", action="store_true",
                     help="spawn helper+leader and run a client against them")
     ap.add_argument("--platform", default="",
@@ -266,9 +285,11 @@ def main():
     if args.demo:
         run_demo(args.port, platform)
     elif args.role == "helper":
-        run_helper(args.port, admin_port=args.admin_port)
+        run_helper(args.port, admin_port=args.admin_port,
+                   slo_config=args.slo_config)
     elif args.role == "leader":
-        run_leader(args.port, args.helper, admin_port=args.admin_port)
+        run_leader(args.port, args.helper, admin_port=args.admin_port,
+                   slo_config=args.slo_config)
     elif args.role == "client":
         indices = [int(x) for x in args.indices.split(",")]
         for i, rec in enumerate(

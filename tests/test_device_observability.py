@@ -1,0 +1,281 @@
+"""Device telemetry tests: the compile tracker and the HBM accountant.
+
+The compile-tracker contract mirrors jax's executable cache for
+shape-bucketed callers: exactly one compile per new (site, shape) pair,
+a cache hit for every re-dispatch — asserted both on the tracker
+directly and through the dynamic batcher's real dispatch path, where
+the power-of-two bucket discipline is what bounds the shape count. The
+HBM accountant's contract is per-phase watermarks: monotone within one
+phase occurrence, reset on re-entry (driven by an injected sampler so
+the tests are byte-exact and JAX-free).
+"""
+
+import threading
+
+import pytest
+
+from distributed_point_functions_tpu.observability.device import (
+    CompileTracker,
+    DeviceTelemetry,
+    HbmAccountant,
+    default_telemetry,
+    set_default_telemetry,
+    shape_key,
+)
+from distributed_point_functions_tpu.serving.batcher import DynamicBatcher
+from distributed_point_functions_tpu.serving.metrics import MetricsRegistry
+
+
+@pytest.fixture
+def telemetry():
+    """Swap in a fresh process-default telemetry, restore on exit."""
+    prev = default_telemetry()
+    fresh = set_default_telemetry(DeviceTelemetry())
+    try:
+        yield fresh
+    finally:
+        set_default_telemetry(prev)
+
+
+class TestShapeKey:
+    def test_prefixed_parts(self):
+        assert shape_key(("q", 64), ("b", 8192)) == "q64.b8192"
+
+    def test_reserved_label_chars_sanitized(self):
+        key = shape_key("a,b", "c=d", "{e}")
+        for c in ",={}":
+            assert c not in key
+
+    def test_array_like_renders_shape_and_dtype(self):
+        class Arr:
+            shape = (4, 128)
+            dtype = "uint32"
+
+        assert shape_key(("x", Arr())) == "x4x128.uint32"
+
+    def test_empty_is_default(self):
+        assert shape_key() == "default"
+
+
+class TestCompileTracker:
+    def test_one_compile_per_new_shape_zero_on_redispatch(self):
+        t = CompileTracker()
+        assert t.record_dispatch("site", "q64") is True
+        assert t.record_dispatch("site", "q64") is False
+        assert t.record_dispatch("site", "q64") is False
+        assert t.record_dispatch("site", "q128") is True
+        assert t.compiles("site") == 2
+        assert t.hits("site") == 2
+
+    def test_sites_are_independent(self):
+        t = CompileTracker()
+        t.record_dispatch("a", "q64")
+        t.record_dispatch("b", "q64")
+        assert t.compiles("a") == 1
+        assert t.compiles("b") == 1
+        assert t.compiles() == 2
+
+    def test_dispatch_times_first_call_as_compile(self):
+        t = CompileTracker()
+        with t.dispatch("site", "q64"):
+            pass
+        with t.dispatch("site", "q64"):
+            pass
+        export = t.export()["sites"]["site"]
+        assert export["compiles"] == 1
+        assert export["hits"] == 1
+        # First call's wall time lands in the compile histogram; the
+        # re-dispatch does not.
+        assert export["compile_ms"]["count"] == 1
+
+    def test_dispatch_records_even_when_call_raises(self):
+        t = CompileTracker()
+        with pytest.raises(RuntimeError):
+            with t.dispatch("site", "q64"):
+                raise RuntimeError("boom")
+        assert t.compiles("site") == 1
+
+    def test_registry_mirroring(self):
+        reg = MetricsRegistry()
+        t = CompileTracker(reg)
+        t.record_dispatch("site", "q64", compile_ms=12.5)
+        t.record_dispatch("site", "q64")
+        export = reg.export()
+        assert export["counters"]["device.compiles{site=site}"] == 1
+        assert export["counters"]["device.dispatch_hits{site=site}"] == 1
+        assert export["gauges"]["device.distinct_shapes{site=site}"] == 1
+        hist = export["histograms"]["device.compile_ms{site=site}"]
+        assert hist["count"] == 1
+
+    def test_authoritative_state_survives_registry_reset(self):
+        reg = MetricsRegistry()
+        t = CompileTracker(reg)
+        t.record_dispatch("site", "q64")
+        reg.reset()
+        assert t.compiles("site") == 1
+
+    def test_track_wrapper(self):
+        t = CompileTracker()
+        calls = []
+
+        def fn(n):
+            calls.append(n)
+            return n * 2
+
+        wrapped = t.track("site", fn, key_fn=lambda n: shape_key(("n", n)))
+        assert wrapped(3) == 6
+        assert wrapped(3) == 6
+        assert wrapped(4) == 8
+        assert t.compiles("site") == 2
+        assert t.hits("site") == 1
+
+    def test_export_hit_ratio_and_reset(self):
+        t = CompileTracker()
+        t.record_dispatch("site", "a")
+        t.record_dispatch("site", "a")
+        t.record_dispatch("site", "a")
+        t.record_dispatch("site", "a")
+        entry = t.export()["sites"]["site"]
+        assert entry["hit_ratio"] == 0.75
+        t.reset()
+        assert t.export()["sites"] == {}
+
+    def test_thread_safety_single_compile_under_contention(self):
+        t = CompileTracker()
+        compiles = []
+        barrier = threading.Barrier(8)
+
+        def worker():
+            barrier.wait()
+            for _ in range(100):
+                if t.record_dispatch("site", "q64"):
+                    compiles.append(1)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert len(compiles) == 1
+        assert t.compiles("site") == 1
+        assert t.hits("site") == 799
+
+
+class TestBatcherIntegration:
+    def test_one_compile_per_bucket_zero_on_redispatch(self, telemetry):
+        """The real batcher dispatch path: the first batch landing in a
+        power-of-two bucket is the compile; every later batch of the
+        same bucket is a cache hit; a new bucket compiles once."""
+        with DynamicBatcher(
+            lambda keys: [k * 2 for k in keys],
+            max_batch_size=1,  # one request per batch: bucket == 1
+            name="dev_obs",
+        ) as b:
+            b.submit([10])
+            b.submit([11])
+            b.submit([12])
+        tracker = telemetry.compile_tracker
+        assert tracker.compiles("dev_obs.evaluate") == 1
+        assert tracker.hits("dev_obs.evaluate") == 2
+
+    def test_distinct_buckets_compile_independently(self, telemetry):
+        with DynamicBatcher(
+            lambda keys: list(keys), max_batch_size=2, name="dev_obs2"
+        ) as b:
+            b.submit([1])  # bucket 1 -> compile
+            b.submit([2])  # bucket 1 -> hit
+        tracker = telemetry.compile_tracker
+        export = tracker.export()["sites"]["dev_obs2.evaluate"]
+        assert export["compiles"] == len(export["shapes"])
+        assert tracker.compiles("dev_obs2.evaluate") + tracker.hits(
+            "dev_obs2.evaluate"
+        ) == 2
+
+
+class TestHbmAccountant:
+    def _accountant(self, values):
+        it = iter(values)
+
+        def sampler():
+            return next(it), "test"
+
+        return HbmAccountant(sampler=sampler)
+
+    def test_watermark_monotone_within_phase(self):
+        acc = self._accountant([100, 900, 400, 200])
+        with acc.phase("db_staging"):  # entry sample: 100
+            acc.sample()  # 900 raises the watermark
+            acc.sample()  # 400 does not lower it
+            # exit sample: 200
+        assert acc.watermark("db_staging") == 900
+
+    def test_watermark_resets_between_phases(self):
+        acc = self._accountant([1000, 1000, 50, 80])
+        with acc.phase("selection"):
+            pass
+        assert acc.watermark("selection") == 1000
+        with acc.phase("selection"):  # re-entry resets to this pass
+            pass
+        assert acc.watermark("selection") == 80
+
+    def test_phases_do_not_nest_innermost_wins(self):
+        acc = self._accountant([10, 500, 20, 30, 40, 25])
+        with acc.phase("outer"):  # entry 10
+            with acc.phase("inner"):  # entry 500
+                acc.sample()  # 20 -> inner
+            # inner exit 30; outer resumes
+            acc.sample()  # 40 -> outer
+        # outer exit sample: 25 (does not lower the 40 watermark)
+        assert acc.watermark("inner") == 500
+        assert acc.watermark("outer") == 40
+
+    def test_sample_outside_phase_attributes_to_process(self):
+        acc = self._accountant([77])
+        acc.sample()
+        assert acc.watermark("process") == 77
+
+    def test_registry_gauges(self):
+        reg = MetricsRegistry()
+        it = iter([5, 10, 3])
+
+        def sampler():
+            return next(it), "test"
+
+        acc = HbmAccountant(registry=reg, sampler=sampler)
+        with acc.phase("db_staging"):
+            acc.sample()
+        export = reg.export()
+        assert export["gauges"]["device.hbm_live_bytes"] == 3
+        assert (
+            export["gauges"]["device.hbm_watermark_bytes{phase=db_staging}"]
+            == 10
+        )
+        assert export["counters"]["device.hbm_samples"] == 3
+
+    def test_export_and_reset(self):
+        acc = self._accountant([123])
+        acc.sample()
+        export = acc.export()
+        assert export["live_bytes"] == 123
+        assert export["source"] == "test"
+        assert export["samples"] == 1
+        acc.reset()
+        assert acc.export()["samples"] == 0
+        assert acc.export()["watermark_bytes"] == {}
+
+    def test_live_bytes_real_backend_samples(self):
+        """The real sampler (CPU: live_arrays fallback) sees a staged
+        device buffer grow the db_staging watermark."""
+        jnp = pytest.importorskip("jax.numpy")
+        acc = HbmAccountant()
+        with acc.phase("db_staging"):
+            buf = jnp.zeros((1024, 32), jnp.uint32)
+            buf.block_until_ready()
+            acc.sample()
+        assert acc.watermark("db_staging") >= 1024 * 32 * 4
+        del buf
+
+    def test_default_telemetry_swap(self, telemetry):
+        telemetry.hbm.sample()
+        assert default_telemetry() is telemetry
+        assert telemetry.hbm.export()["samples"] == 1

@@ -316,6 +316,38 @@ def test_render_prometheus_escapes_label_values():
     assert 'dpf_x{k="he\\"y"} 1' in text
 
 
+def test_histogram_records_exemplar_inside_active_trace(recorder):
+    reg = MetricsRegistry()
+    with tracing.trace_request("req", recorder=recorder) as trace:
+        reg.histogram("lat_ms").observe(42.0)
+    export = reg.export()["histograms"]["lat_ms"]
+    (bucket,) = export["exemplars"].keys()
+    exemplar = export["exemplars"][bucket]
+    assert exemplar["value"] == 42.0
+    assert exemplar["trace_id"] == trace.trace_id
+    assert float(bucket) >= 42.0  # lands on its own bucket bound
+
+
+def test_histogram_without_trace_has_no_exemplars():
+    reg = MetricsRegistry()
+    reg.histogram("lat_ms").observe(42.0)
+    assert "exemplars" not in reg.export()["histograms"]["lat_ms"]
+
+
+def test_render_prometheus_exemplar_on_bucket_line(recorder):
+    reg = MetricsRegistry()
+    with tracing.trace_request("req", recorder=recorder) as trace:
+        reg.histogram("lat_ms", buckets=(50.0, 100.0)).observe(42.0)
+    reg.histogram("lat_ms", buckets=(50.0, 100.0)).observe(60.0)
+    text = exposition.render_prometheus(reg.export())
+    exemplar_lines = [
+        ln for ln in text.splitlines() if "# {trace_id=" in ln
+    ]
+    (line,) = exemplar_lines  # only the traced bucket carries one
+    assert line.startswith('dpf_lat_ms_bucket{le="50"}')
+    assert f'# {{trace_id="{trace.trace_id}"}} 42' in line
+
+
 # ---------------------------------------------------------------------------
 # Trace-context envelope codec
 # ---------------------------------------------------------------------------

@@ -1,0 +1,224 @@
+"""Regression-gate tests: verdicts over synthetic history fixtures.
+
+The verdict table under test (ISSUE acceptance): identical history ->
+``ok`` exit 0; an injected 30% throughput drop -> ``regression`` exit
+nonzero; a hung-init record (``status: infra_error``, the BENCH_r05
+shape) -> ``infra_error`` exit 0; not enough clean history ->
+``first_run``.
+"""
+
+import json
+
+from benchmarks.regression_gate import (
+    append_record,
+    direction_of,
+    gate,
+    judge_metric,
+    load_history,
+    main,
+)
+
+METRIC = "dense_pir_queries_per_sec_chip_1048576x256B"
+
+
+def _rec(value, metric=METRIC, status="ok", unit="queries/s", **extra):
+    return {
+        "metric": metric, "value": value, "unit": unit,
+        "status": status, **extra,
+    }
+
+
+def _clean_history(values=(7080.0, 7240.0, 7150.0, 7200.0, 7188.0)):
+    return [_rec(v) for v in values]
+
+
+class TestDirection:
+    def test_throughput_units_higher(self):
+        assert direction_of({"unit": "queries/s"}) == "higher"
+        assert direction_of({"unit": "lanes/s"}) == "higher"
+        assert direction_of({"unit": "GB/s"}) == "higher"
+
+    def test_time_units_lower(self):
+        assert direction_of({"unit": "ns/leaf"}) == "lower"
+        assert direction_of({"unit": "ms"}) == "lower"
+
+    def test_explicit_direction_wins(self):
+        assert direction_of({"unit": "ms", "direction": "higher"}) == (
+            "higher"
+        )
+
+    def test_unknown_unit_defaults_higher(self):
+        assert direction_of({"unit": "furlongs"}) == "higher"
+
+
+class TestVerdicts:
+    def test_stable_history_is_ok(self):
+        v = judge_metric(_clean_history())
+        assert v["verdict"] == "ok"
+        assert abs(v["delta_pct"]) < 5
+
+    def test_thirty_percent_drop_is_regression(self):
+        history = _clean_history() + [_rec(7188.0 * 0.70)]
+        v = judge_metric(history)
+        assert v["verdict"] == "regression"
+        assert v["delta_pct"] < -15
+        assert "noise band" in v["reason"]
+
+    def test_drop_inside_band_is_ok(self):
+        history = _clean_history() + [_rec(7188.0 * 0.90)]
+        assert judge_metric(history)["verdict"] == "ok"
+
+    def test_jump_above_band_is_improved_not_failure(self):
+        history = _clean_history() + [_rec(7188.0 * 1.40)]
+        assert judge_metric(history)["verdict"] == "improved"
+
+    def test_lower_is_better_metric_regresses_upward(self):
+        history = [
+            _rec(23.0, metric="expand_ns_leaf", unit="ns/leaf")
+            for _ in range(4)
+        ] + [_rec(40.0, metric="expand_ns_leaf", unit="ns/leaf")]
+        v = judge_metric(history)
+        assert v["direction"] == "lower"
+        assert v["verdict"] == "regression"
+
+    def test_infra_error_never_fails_and_carries_last_good(self):
+        history = _clean_history() + [
+            _rec(0.0, status="infra_error",
+                 error="TPU backend init hung past 900s budget",
+                 last_good=7188.0),
+        ]
+        v = judge_metric(history)
+        assert v["verdict"] == "infra_error"
+        assert v["last_good"] == 7188.0
+        assert "hung" in v["reason"]
+
+    def test_infra_errors_do_not_pollute_the_median(self):
+        # Interleave zero-valued infra errors with clean runs: the
+        # median must form over clean values only, so the newest clean
+        # run stays ok.
+        history = []
+        for v in (7080.0, 7240.0, 7150.0):
+            history.append(_rec(v))
+            history.append(_rec(0.0, status="infra_error"))
+        history.append(_rec(7200.0))
+        v = judge_metric(history)
+        assert v["verdict"] == "ok"
+        assert v["median"] == 7150.0
+
+    def test_first_run_with_insufficient_clean_history(self):
+        assert judge_metric([_rec(7000.0)])["verdict"] == "first_run"
+        assert judge_metric(
+            [_rec(7000.0), _rec(7010.0)]
+        )["verdict"] == "first_run"
+
+    def test_window_limits_the_median(self):
+        # Ancient bad values outside the window must not drag the
+        # median; only the `window` most recent clean priors count.
+        history = [_rec(100.0)] * 10 + [_rec(7000.0)] * 5 + [_rec(7010.0)]
+        v = judge_metric(history, window=5)
+        assert v["verdict"] == "ok"
+        assert v["median"] == 7000.0
+
+    def test_gate_groups_by_metric(self):
+        records = (
+            _clean_history()
+            + [_rec(1.9e6, metric="hh_lanes") for _ in range(3)]
+            + [_rec(1.0e6, metric="hh_lanes")]
+        )
+        verdicts = {v["metric"]: v["verdict"] for v in gate(records)}
+        assert verdicts[METRIC] == "ok"
+        assert verdicts["hh_lanes"] == "regression"
+
+
+class TestHistoryStore:
+    def test_append_and_load_roundtrip(self, tmp_path):
+        path = str(tmp_path / "history.jsonl")
+        append_record(_rec(7000.0), path)
+        append_record(_rec(7010.0), path)
+        records, skipped = load_history(path)
+        assert skipped == 0
+        assert [r["value"] for r in records] == [7000.0, 7010.0]
+        assert all("ts_unix" in r for r in records)
+
+    def test_malformed_lines_skipped_not_fatal(self, tmp_path):
+        path = tmp_path / "history.jsonl"
+        path.write_text(
+            json.dumps(_rec(7000.0)) + "\n"
+            + "{not json\n"
+            + json.dumps({"no_metric": True}) + "\n"
+            + json.dumps(_rec(7010.0)) + "\n"
+        )
+        records, skipped = load_history(str(path))
+        assert len(records) == 2 and skipped == 2
+
+    def test_missing_file_is_empty(self, tmp_path):
+        assert load_history(str(tmp_path / "nope.jsonl")) == ([], 0)
+
+
+class TestCli:
+    def _write(self, tmp_path, records):
+        path = str(tmp_path / "history.jsonl")
+        for r in records:
+            append_record(r, path)
+        return path
+
+    def test_identical_history_twice_exits_zero(self, tmp_path, capsys):
+        path = self._write(tmp_path, _clean_history())
+        assert main(["--history", path]) == 0
+        assert main(["--history", path]) == 0  # deterministic re-run
+        out = capsys.readouterr().out
+        assert "ok" in out and "0 regression(s)" in out
+
+    def test_injected_drop_exits_nonzero(self, tmp_path, capsys):
+        path = self._write(
+            tmp_path, _clean_history() + [_rec(7188.0 * 0.70)]
+        )
+        assert main(["--history", path]) == 1
+        assert "regression" in capsys.readouterr().out
+
+    def test_hung_init_record_exits_zero_infra_error(
+        self, tmp_path, capsys
+    ):
+        path = self._write(
+            tmp_path,
+            _clean_history()
+            + [_rec(0.0, status="infra_error",
+                    error="TPU backend init hung past 900s budget",
+                    last_good=7188.0)],
+        )
+        assert main(["--history", path]) == 0
+        assert "infra_error" in capsys.readouterr().out
+
+    def test_missing_history_errors_unless_check_only(self, tmp_path):
+        missing = str(tmp_path / "none.jsonl")
+        assert main(["--history", missing]) == 2
+        assert main(["--history", missing, "--check-only"]) == 0
+
+    def test_committed_fixture_passes_check_only(self):
+        import os
+
+        fixture = os.path.join(
+            os.path.dirname(__file__), "..", "benchmarks", "fixtures",
+            "history_fixture.jsonl",
+        )
+        assert main(["--history", fixture, "--check-only"]) == 0
+
+    def test_metric_filter_and_json_output(self, tmp_path, capsys):
+        path = self._write(
+            tmp_path,
+            _clean_history()
+            + [_rec(1.0, metric="other") for _ in range(4)],
+        )
+        assert main(
+            ["--history", path, "--metric", METRIC, "--json"]
+        ) == 0
+        out = capsys.readouterr().out
+        doc = json.loads(out[: out.rindex("}") + 1])
+        assert [v["metric"] for v in doc["verdicts"]] == [METRIC]
+
+    def test_band_is_configurable(self, tmp_path):
+        path = self._write(
+            tmp_path, _clean_history() + [_rec(7188.0 * 0.90)]
+        )
+        assert main(["--history", path]) == 0  # inside the 15% band
+        assert main(["--history", path, "--band", "0.05"]) == 1

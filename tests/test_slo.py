@@ -1,0 +1,211 @@
+"""SLO tracker tests: grading kinds, burn state, and the /healthz
+degrade-to-503 / recover-to-200 contract through a live AdminServer."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributed_point_functions_tpu.observability import AdminServer
+from distributed_point_functions_tpu.observability.device import (
+    DeviceTelemetry,
+)
+from distributed_point_functions_tpu.observability.slo import (
+    KINDS,
+    SloObjective,
+    SloTracker,
+)
+from distributed_point_functions_tpu.serving.metrics import MetricsRegistry
+
+
+def _get(url):
+    """(status, body) tolerating HTTP error statuses."""
+    try:
+        with urllib.request.urlopen(url) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestObjectiveValidation:
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown SLO kind"):
+            SloObjective(name="x", kind="p42", metric="m", threshold=1)
+
+    def test_bad_severity_rejected(self):
+        with pytest.raises(ValueError, match="severity"):
+            SloObjective(
+                name="x", kind="p99_ms_max", metric="m", threshold=1,
+                severity="panic",
+            )
+
+    def test_all_kinds_construct(self):
+        for kind in KINDS:
+            SloObjective(name=kind, kind=kind, metric="m", threshold=1)
+
+
+class TestGrading:
+    def test_p99_ceiling_ok_breach_and_no_data(self):
+        reg = MetricsRegistry()
+        tracker = SloTracker(
+            [SloObjective(name="lat", kind="p99_ms_max",
+                          metric="req_ms", threshold=50.0)],
+            registry=reg,
+        )
+        (r,) = tracker.evaluate()
+        assert r["state"] == "no_data" and r["observed"] is None
+        reg.histogram("req_ms").observe(10.0)
+        (r,) = tracker.evaluate()
+        assert r["state"] == "ok"
+        reg.histogram("req_ms").observe(500.0)
+        (r,) = tracker.evaluate()
+        assert r["state"] == "breach"
+
+    def test_counter_max_compile_budget(self):
+        reg = MetricsRegistry()
+        tracker = SloTracker(
+            [SloObjective(name="compile_budget", kind="counter_max",
+                          metric="device.compiles{site=s}", threshold=2)],
+            registry=reg,
+        )
+        c = reg.counter("device.compiles", labels={"site": "s"})
+        c.inc(2)
+        (r,) = tracker.evaluate()
+        assert r["state"] == "ok"
+        c.inc()
+        (r,) = tracker.evaluate()
+        assert r["state"] == "breach" and r["observed"] == 3
+
+    def test_gauge_max(self):
+        reg = MetricsRegistry()
+        tracker = SloTracker(
+            [SloObjective(name="hbm", kind="gauge_max",
+                          metric="device.hbm_live_bytes",
+                          threshold=1000.0)],
+            registry=reg,
+        )
+        reg.gauge("device.hbm_live_bytes").set(2000)
+        (r,) = tracker.evaluate()
+        assert r["state"] == "breach"
+
+    def test_rate_min_needs_two_marks_then_grades(self):
+        reg = MetricsRegistry()
+        clock = [0.0]
+        tracker = SloTracker(
+            [SloObjective(name="qps", kind="rate_min",
+                          metric="served", threshold=10.0)],
+            registry=reg, clock=lambda: clock[0],
+        )
+        reg.counter("served").inc(100)
+        (r,) = tracker.evaluate()  # first mark
+        assert r["state"] == "no_data"
+        clock[0] = 10.0
+        reg.counter("served").inc(500)  # 50/s since the mark
+        (r,) = tracker.evaluate()
+        assert r["state"] == "ok" and r["observed"] == 50.0
+        clock[0] = 20.0
+        reg.counter("served").inc(1)  # 0.1/s: below the floor
+        (r,) = tracker.evaluate()
+        assert r["state"] == "breach"
+
+    def test_burn_accrues_while_breaching_and_clears(self):
+        reg = MetricsRegistry()
+        clock = [0.0]
+        tracker = SloTracker(
+            [SloObjective(name="lat", kind="p99_ms_max",
+                          metric="req_ms", threshold=1.0)],
+            registry=reg, clock=lambda: clock[0],
+        )
+        reg.histogram("req_ms").observe(100.0)
+        (r,) = tracker.evaluate()
+        assert r["burn_s"] == 0.0
+        clock[0] = 30.0
+        (r,) = tracker.evaluate()
+        assert r["burn_s"] == 30.0
+        reg.reset()  # metric gone -> no_data -> burn clears
+        (r,) = tracker.evaluate()
+        assert r["state"] == "no_data" and r["burn_s"] == 0.0
+
+    def test_soft_breach_never_unhealthy(self):
+        reg = MetricsRegistry()
+        tracker = SloTracker(
+            [SloObjective(name="lat", kind="p99_ms_max",
+                          metric="req_ms", threshold=1.0,
+                          severity="soft")],
+            registry=reg,
+        )
+        reg.histogram("req_ms").observe(100.0)
+        assert tracker.healthy()
+        assert tracker.breaches(evaluate=True) == []
+        assert tracker.export()["objectives"][0]["state"] == "breach"
+
+    def test_from_config_dict_and_json_path(self, tmp_path):
+        config = {
+            "objectives": [
+                {"name": "lat", "kind": "p99_ms_max",
+                 "metric": "req_ms", "threshold": 50.0},
+                {"name": "qps", "kind": "rate_min",
+                 "metric": "served", "threshold": 10,
+                 "severity": "soft"},
+            ]
+        }
+        reg = MetricsRegistry()
+        t1 = SloTracker.from_config(config, reg)
+        assert [o.name for o in t1.objectives] == ["lat", "qps"]
+        path = tmp_path / "slo.json"
+        path.write_text(json.dumps(config))
+        t2 = SloTracker.from_config(str(path), reg)
+        assert t2.objectives == t1.objectives
+
+
+class TestHealthzIntegration:
+    def test_breach_flips_healthz_503_and_recovery_flips_back(self):
+        reg = MetricsRegistry()
+        tracker = SloTracker(
+            [SloObjective(name="lat", kind="p99_ms_max",
+                          metric="plain.request_ms", threshold=5.0)],
+            registry=reg,
+        )
+        with AdminServer(
+            registry=reg, slo=tracker, device=DeviceTelemetry()
+        ) as admin:
+            base = f"http://127.0.0.1:{admin.port}"
+            # No data yet: healthy.
+            assert _get(base + "/healthz") == (200, "ok\n")
+            reg.histogram("plain.request_ms").observe(100.0)
+            status, body = _get(base + "/healthz")
+            assert status == 503
+            assert "slo breach: lat" in body
+            # Recovery: the slow sample ages out (registry reset is the
+            # test's stand-in); the very next probe is healthy again.
+            reg.reset()
+            assert _get(base + "/healthz") == (200, "ok\n")
+
+    def test_statusz_shows_burn_table(self):
+        reg = MetricsRegistry()
+        tracker = SloTracker(
+            [SloObjective(name="lat", kind="p99_ms_max",
+                          metric="req_ms", threshold=5.0)],
+            registry=reg,
+        )
+        reg.histogram("req_ms").observe(50.0)
+        with AdminServer(
+            registry=reg, slo=tracker, device=DeviceTelemetry()
+        ) as admin:
+            base = f"http://127.0.0.1:{admin.port}"
+            status, body = _get(base + "/statusz")
+            assert status == 200
+            assert "SLO burn" in body and "UNHEALTHY" in body
+            status, body = _get(base + "/statusz?format=json")
+            state = json.loads(body)
+            assert state["slo"]["healthy"] is False
+            (obj,) = state["slo"]["objectives"]
+            assert obj["name"] == "lat" and obj["state"] == "breach"
+
+    def test_healthz_without_slo_is_bare_liveness(self):
+        with AdminServer(
+            registry=MetricsRegistry(), device=DeviceTelemetry()
+        ) as admin:
+            base = f"http://127.0.0.1:{admin.port}"
+            assert _get(base + "/healthz") == (200, "ok\n")

@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """Layer-DAG and import-cycle presubmit check (stdlib AST, no deps).
 
-Two rules over `distributed_point_functions_tpu/`:
+Three rules over `distributed_point_functions_tpu/`:
 
 1. **Layer DAG** — `heavy_hitters -> serving -> pir -> ops ->
    observability`, never the reverse, with restricted layers: the
@@ -10,9 +10,10 @@ Two rules over `distributed_point_functions_tpu/`:
    application-facing — no library layer imports it (applications —
    examples/, bench.py, benchmarks/ — may import anything).
    `observability` sits at the bottom on purpose: every layer may
-   instrument itself (spans, runtime counters), but observability
-   imports only `utils/` — never pir/ops/serving — so tracing can
-   never create an upward edge. Checked over ALL imports, including
+   instrument itself (spans, runtime counters, compile/HBM telemetry),
+   but observability — `device.py` and `slo.py` included — imports
+   only `utils/` — never pir/ops/serving — so telemetry can never
+   create an upward edge. Checked over ALL imports, including
    function-level ones, because a reversed dependency is wrong
    wherever the import statement sits.
 
@@ -20,6 +21,13 @@ Two rules over `distributed_point_functions_tpu/`:
    breaking genuine cycles is the function-level import, so only
    imports that execute at module import time participate in the cycle
    graph.
+
+3. **Library never imports applications** — `bench.py`, `benchmarks/`
+   (the regression gate and its history store), `examples/`, and
+   `tools/` sit *outside* the package and may import any layer
+   (`benchmarks/` imports observability for exposition); no package
+   module may import them back. In particular the regression gate
+   depends on observability, never the reverse.
 
 Exit 0 on success; prints each violation and exits 1 otherwise.
 """
@@ -49,6 +57,11 @@ LAYERS = {
 # consumer is the heavy_hitters session; heavy_hitters is a true leaf
 # only applications may import.
 RESTRICTED = {"serving": {"heavy_hitters"}, "heavy_hitters": set()}
+
+# Application namespaces living outside the package: they may import
+# any layer, but no package module may import them (rule 3). Keeps
+# benchmarks/ -> observability a one-way edge.
+APPLICATIONS = {"bench", "benchmarks", "examples", "tools"}
 
 
 def module_name(path: Path) -> str:
@@ -154,6 +167,13 @@ def main() -> int:
             continue
         src_layer = layer_of(module)
         for name in all_imports:
+            if name.split(".")[0] in APPLICATIONS:
+                violations.append(
+                    f"{module}: imports {name} — library modules must "
+                    f"never import application code (bench/benchmarks/"
+                    f"examples/tools); the dependency runs the other way"
+                )
+                continue
             tgt_layer = layer_of(name)
             if tgt_layer is None or src_layer == tgt_layer:
                 continue
