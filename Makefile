@@ -21,6 +21,8 @@ test-fast:     ## ~8 min hermetic signal incl. core invariants + tiny Pallas
 	    tests/test_serving_batcher.py tests/test_serving_transport.py \
 	    tests/test_serving_service.py tests/test_observability.py \
 	    tests/test_device_observability.py tests/test_slo.py \
+	    tests/test_phase_recorder.py tests/test_transfer_ledger.py \
+	    tests/test_autoprofile.py \
 	    tests/test_regression_gate.py \
 	    tests/test_heavy_hitters.py tests/test_incremental_reuse.py \
 	    tests/test_pallas_fast.py tests/test_bench_ladder.py -q

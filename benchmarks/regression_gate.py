@@ -33,14 +33,26 @@ through):
      "vs_baseline": 450.2,
      "git_rev": "6cfabdc",                # best-effort
      "device": "tpu", "topology": "1x1",  # backend + device count
+     "jax_version": "0.4.35",             # optional; stack stamp
+     "backend": "tpu",                    # optional; stack stamp
      "error": "...",                      # failure paths only
      "last_good": 7203.53,                # failure paths: prior capture
+     "p99": 12.4, "samples": 512,         # latency records only
      "direction": "higher"}               # optional; inferred from unit
 
 Direction (is bigger better?) is inferred from the unit — throughput
 units (`queries/s`, `lanes/s`, `GB/s`) are higher-is-better, time
 units (`ns/leaf`, `ms`, `s`) lower-is-better — and can be pinned per
-record with `direction`.
+record with `direction`. Verdicts honor it on both sides: the
+median comparison flips which band edge is "worse", and `vs_baseline`
+(passed through to the verdict) reads as an improvement below 1.0 for
+lower-is-better metrics.
+
+Stack stamps (`jax_version`, `backend`) group the rolling median: a
+prior record with a *different* stamp never enters the newest run's
+median (a JAX upgrade or a CPU run must not mask a TPU regression).
+Records missing a stamp — all pre-stamp history — match any stack, so
+existing history keeps judging.
 
 CLI (``python -m benchmarks.regression_gate``): exits 0 unless a real
 regression is present. ``--check-only`` is the presubmit mode: same
@@ -159,6 +171,16 @@ def _is_clean(record: dict) -> bool:
     )
 
 
+_STACK_KEYS = ("jax_version", "backend")
+
+
+def _same_stack(record: dict, stack: dict) -> bool:
+    """Whether `record` may enter a median for a run stamped `stack`.
+    A missing stamp on the record is a wildcard (pre-stamp history);
+    a present-but-different stamp excludes it."""
+    return all(record.get(k) in (None, v) for k, v in stack.items())
+
+
 def judge_metric(
     records: List[dict],
     window: int = DEFAULT_WINDOW,
@@ -176,6 +198,12 @@ def judge_metric(
         "git_rev": newest.get("git_rev"),
         "n_records": len(records),
     }
+    if newest.get("vs_baseline") is not None:
+        verdict["vs_baseline"] = newest["vs_baseline"]
+        verdict["vs_baseline_direction"] = direction_of(newest)
+    for k in _STACK_KEYS:
+        if newest.get(k) is not None:
+            verdict[k] = newest[k]
     if not _is_clean(newest):
         # Harness failure, not a measurement: report, carry the
         # last-good context forward, never fail the gate.
@@ -187,13 +215,21 @@ def judge_metric(
             last_good=newest.get("last_good"),
         )
         return verdict
-    prior_clean = [r for r in records[:-1] if _is_clean(r)][-window:]
+    stack = {
+        k: newest.get(k) for k in _STACK_KEYS
+        if newest.get(k) is not None
+    }
+    prior_clean = [
+        r for r in records[:-1]
+        if _is_clean(r) and _same_stack(r, stack)
+    ][-window:]
     if len(prior_clean) < MIN_HISTORY:
         verdict.update(
             verdict="first_run",
             reason=(
-                f"only {len(prior_clean)} clean prior run(s); "
-                f"need {MIN_HISTORY} to judge"
+                f"only {len(prior_clean)} clean prior run(s)"
+                + (" on this stack" if stack else "")
+                + f"; need {MIN_HISTORY} to judge"
             ),
         )
         return verdict

@@ -14,9 +14,11 @@
 #   7. admin-smoke — operator telemetry endpoint: serve one traced
 #                    request, then scrape /healthz, /metrics (Prometheus
 #                    text with exemplars), /statusz (compile counts, HBM
-#                    watermarks, SLO burn) and /tracez off a live
-#                    AdminServer, and check a hard SLO breach degrades
-#                    /healthz to 503
+#                    watermarks, SLO burn, phase waterfall, transfer
+#                    ledger, auto-captured profiles) and /tracez off a
+#                    live AdminServer, check a hard SLO breach degrades
+#                    /healthz to 503, and check a synthetic latency-SLO
+#                    burn produces exactly one auto-capture entry
 #   8. perf-gate   — benchmarks/regression_gate.py --check-only against
 #                    the committed history fixture (CPU-safe: judges
 #                    records, runs no bench)
@@ -62,6 +64,7 @@ stage hh-smoke env JAX_PLATFORMS=cpu \
 stage admin-smoke env JAX_PLATFORMS=cpu python -c '
 import json, urllib.error, urllib.request
 from distributed_point_functions_tpu import observability as obs
+from distributed_point_functions_tpu.observability import phases as pm
 from distributed_point_functions_tpu.observability.slo import (
     SloObjective, SloTracker,
 )
@@ -70,11 +73,16 @@ from distributed_point_functions_tpu.serving.metrics import MetricsRegistry
 reg = MetricsRegistry()
 rec = obs.tracing.FlightRecorder()
 dev = obs.DeviceTelemetry(registry=reg)
+phases = obs.PhaseRecorder()
 with obs.tracing.trace_request("smoke.request", recorder=rec):
     with reg.timed("smoke.request_ms"):
-        with obs.tracing.span("device_compute"):
-            with dev.hbm.phase("db_staging"):
-                dev.hbm.sample()
+        with phases.request("smoke"):
+            with pm.phase("h2d_transfer"):
+                dev.transfers.record_h2d(4096, "key_staging")
+            pm.record("device_compute", 2.5)
+            with obs.tracing.span("device_compute"):
+                with dev.hbm.phase("db_staging"):
+                    dev.hbm.sample()
 with dev.compile_tracker.dispatch("smoke.evaluate", "q64.b8192"):
     pass
 with dev.compile_tracker.dispatch("smoke.evaluate", "q64.b8192"):
@@ -84,8 +92,16 @@ slo = SloTracker(
                   metric="smoke.request_ms", threshold=1e-9)],
     registry=reg,
 )
+prof = obs.AutoProfiler(
+    slo, capture_fn=lambda r: {"log_dir": "/tmp/smoke-capture"},
+    async_capture=False,
+)
+slo.evaluate()  # synthetic latency-SLO burn -> one inline capture
+slo.evaluate()  # continuing breach must NOT re-fire
+assert len(prof.captures()) == 1, prof.export()
 with obs.AdminServer(registry=reg, recorder=rec, device=dev,
-                     slo=slo) as admin:
+                     slo=slo, phases=phases,
+                     autoprofiler=prof) as admin:
     base = f"http://127.0.0.1:{admin.port}"
     text = urllib.request.urlopen(base + "/metrics").read().decode()
     assert "# TYPE dpf_smoke_request_ms histogram" in text, text
@@ -94,8 +110,18 @@ with obs.AdminServer(registry=reg, recorder=rec, device=dev,
     assert "dpf_device_compiles" in text, text
     statusz = urllib.request.urlopen(base + "/statusz").read().decode()
     for needle in ("smoke.evaluate", "q64.b8192", "db_staging",
-                   "SLO burn", "smoke_p99"):
+                   "SLO burn", "smoke_p99",
+                   "Phase waterfall", "h2d_transfer", "device_compute",
+                   "transfers", "key_staging",
+                   "Auto-captured profiles", "/tmp/smoke-capture"):
         assert needle in statusz, (needle, statusz)
+    sz_json = json.load(
+        urllib.request.urlopen(base + "/statusz?format=json")
+    )
+    assert sz_json["phases"]["smoke"]["requests"] == 1, sz_json["phases"]
+    led = sz_json["device"]["transfers"]["phases"]["key_staging"]
+    assert led["h2d_copies"] == 1 and led["h2d_bytes"] == 4096, led
+    assert len(sz_json["profiles"]["captures"]) == 1, sz_json["profiles"]
     sz = json.load(urllib.request.urlopen(base + "/statusz?format=json"))
     site = sz["device"]["compile"]["sites"]["smoke.evaluate"]
     assert site["compiles"] == 1 and site["hits"] == 1, site
@@ -112,8 +138,10 @@ with obs.AdminServer(registry=reg, recorder=rec, device=dev,
         assert "slo breach: smoke_p99" in body, body
     reg.reset()  # breach clears -> next probe recovers
     assert urllib.request.urlopen(base + "/healthz").read() == b"ok\n"
-print("admin-smoke: OK (/metrics incl. exemplars, /statusz, /tracez, "
-      "/healthz incl. SLO degrade+recover)")
+assert len(prof.captures()) == 1, prof.export()  # still exactly one
+print("admin-smoke: OK (/metrics incl. exemplars, /statusz incl. phase "
+      "waterfall + transfer ledger + auto-captures, /tracez, /healthz "
+      "incl. SLO degrade+recover, one capture per burn)")
 '
 
 stage perf-gate python -m benchmarks.regression_gate --check-only \

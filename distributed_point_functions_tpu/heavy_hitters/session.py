@@ -51,6 +51,7 @@ from typing import Optional
 import numpy as np
 
 from ..observability import tracing
+from ..observability import phases as phases_mod
 from ..serving.metrics import MetricsRegistry
 from ..serving.transport import Transport, TransportError, TransportTimeout
 from .protocol import (
@@ -226,12 +227,17 @@ class HeavyHittersHelper:
             role="hh-helper",
             round=round_index,
         ):
-            with tracing.span(
-                "helper_evaluate", frontier_width=int(frontier.shape[0])
+            # fresh for the same in-process reason as the trace: the
+            # Helper must not merge its phases into the Leader's record.
+            with phases_mod.default_phase_recorder().request(
+                "hh-helper", fresh=True
             ):
-                shares = self._server.evaluate_round(
-                    round_index, frontier.tolist()
-                )
+                with tracing.span(
+                    "helper_evaluate", frontier_width=int(frontier.shape[0])
+                ), phases_mod.phase("device_compute"):
+                    shares = self._server.evaluate_round(
+                        round_index, frontier.tolist()
+                    )
         helper_ms = (time.perf_counter() - t0) * 1e3
         return encode_eval_response(
             round_index, shares, version=version, helper_ms=helper_ms
@@ -327,7 +333,9 @@ class HeavyHittersLeader:
         sweep = FrontierSweep(config)
         with tracing.trace_request(
             "hh.sweep", role="hh-leader", domain_bits=config.domain_bits
-        ) as trace:
+        ) as trace, phases_mod.default_phase_recorder().request(
+            "hh-leader"
+        ):
             while not sweep.done:
                 r = sweep.round_index
                 frontier = sweep.frontier
@@ -338,7 +346,8 @@ class HeavyHittersLeader:
                     # (and again on a wire-version downgrade resend);
                     # the share must only be computed once.
                     if not own_share:
-                        with tracing.span("leader_own_share", round=r):
+                        with tracing.span("leader_own_share", round=r), \
+                                phases_mod.phase("device_compute"):
                             own_share.append(
                                 self._server.evaluate_round(r, frontier)
                             )
@@ -358,12 +367,16 @@ class HeavyHittersLeader:
                         self._round_trip(r, frontier, compute_own_share, trace)
                     )
                 round_ms = (time.perf_counter() - t0) * 1e3
+                # Out-of-band: overlaps the own-share device_compute
+                # above when the transport's on_sent window runs it.
+                phases_mod.record("helper_rtt", round_ms)
                 if helper_round != r:
                     raise ProtocolError(
                         f"helper answered round {helper_round} during "
                         f"round {r}"
                     )
-                with tracing.span("reconstruct", round=r):
+                with tracing.span("reconstruct", round=r), \
+                        phases_mod.phase("respond"):
                     counts = reconstruct_counts(
                         own_share[0], helper_share, config.count_bits
                     )

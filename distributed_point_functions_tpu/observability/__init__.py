@@ -1,21 +1,30 @@
 """End-to-end request observability: tracing, device telemetry, SLOs,
 exposition, admin surface.
 
-Six pieces, importable from any layer above `utils/` (the layer DAG is
-serving -> observability -> utils; this package never imports pir/,
+Eight pieces, importable from any layer above `utils/` (the layer DAG
+is serving -> observability -> utils; this package never imports pir/,
 ops/, or serving/ — `device`/`slo` reach JAX lazily and only for
 device facts):
 
 * `tracing` — per-request spans with trace ids, a bounded flight
   recorder retaining the slowest/errored traces, process-wide stage
   aggregates, and runtime counters for layers below serving.
+* `phases` — per-request latency attribution into a canonical phase
+  taxonomy (queue -> batch -> h2d_transfer -> compile -> dispatch ->
+  device_compute -> helper_rtt -> respond), aggregated per role into
+  the `/statusz` waterfall.
 * `device` — compile-event tracker (one compile per new dispatch
   shape, cache hits, compile-latency histograms, a `jax.monitoring`
-  bridge) and the HBM accountant (live-bytes watermarks with
-  per-phase attribution).
+  bridge), the HBM accountant (live-bytes watermarks with per-phase
+  attribution), and the host<->device `TransferLedger` (per-phase
+  copy counts and bytes — the round-trip counter ROADMAP item 3
+  drives to zero).
 * `slo` — declarative latency/throughput/compile-budget objectives
   graded continuously against the metrics registry; hard breaches
-  degrade `/healthz` to 503.
+  degrade `/healthz` to 503 and fire burn listeners.
+* `autoprofile` — SLO-triggered profiling: one bounded xprof capture
+  per latency-burn transition, with cooldown and a capture ring on
+  `/statusz`.
 * `propagation` — the versioned envelope that carries a trace id on
   the Leader->Helper wire and the Helper's stage timings back
   (old-version peers interop by detection).
@@ -26,14 +35,24 @@ device facts):
 """
 
 from .admin import AdminServer
+from .autoprofile import AutoProfiler
 from .device import (
     CompileTracker,
     DeviceTelemetry,
     HbmAccountant,
+    TransferLedger,
     default_telemetry,
     install_jax_monitoring_listener,
     set_default_telemetry,
     shape_key,
+)
+from .phases import (
+    PHASES,
+    PhaseRecorder,
+    RequestPhases,
+    current_request,
+    default_phase_recorder,
+    set_default_phase_recorder,
 )
 from .exposition import parse_labeled_name, render_prometheus
 from .slo import SloObjective, SloTracker
@@ -62,17 +81,24 @@ from .tracing import (
 
 __all__ = [
     "AdminServer",
+    "AutoProfiler",
     "CompileTracker",
     "CounterGroup",
     "DeviceTelemetry",
     "EnvelopeError",
     "FlightRecorder",
     "HbmAccountant",
+    "PHASES",
+    "PhaseRecorder",
+    "RequestPhases",
     "SloObjective",
     "SloTracker",
     "Trace",
+    "TransferLedger",
     "add_span",
+    "current_request",
     "current_trace",
+    "default_phase_recorder",
     "default_recorder",
     "default_telemetry",
     "encode_request",
@@ -83,6 +109,7 @@ __all__ = [
     "render_prometheus",
     "reset_stages",
     "runtime_counters",
+    "set_default_phase_recorder",
     "set_default_recorder",
     "set_default_telemetry",
     "shape_key",

@@ -14,11 +14,13 @@ tracker) is in-process; this server is the scrape surface:
                              plus the observability runtime counters
     /varz                    the same state as one JSON document
                              (registry export, stage summary, uptime)
-    /statusz                 operator incident page (HTML): compile
-                             counts and cache-hit ratios per dispatch
-                             site, HBM watermarks per phase, SLO burn
-                             table; `?format=json` for the same data
-                             machine-readable
+    /statusz                 operator incident page (HTML): per-role
+                             phase-latency waterfall, host<->device
+                             transfer ledger, auto-captured profiles,
+                             compile counts and cache-hit ratios per
+                             dispatch site, HBM watermarks per phase,
+                             SLO burn table; `?format=json` for the
+                             same data machine-readable
     /tracez                  flight-recorder dump (slowest / errored /
                              recent traces, JSON)
     /profilez?duration_ms=N  on-demand xprof capture via
@@ -47,6 +49,7 @@ from typing import Optional
 from ..utils.profiling import trace as xprof_trace
 from . import tracing
 from .device import DeviceTelemetry, default_telemetry
+from .phases import PhaseRecorder, default_phase_recorder
 
 logger = logging.getLogger(__name__)
 
@@ -74,6 +77,8 @@ class AdminServer:
         profile_dir: Optional[str] = None,
         device: Optional[DeviceTelemetry] = None,
         slo=None,
+        phases: Optional[PhaseRecorder] = None,
+        autoprofiler=None,
     ):
         self._registry = registry
         self._recorder = (
@@ -85,6 +90,13 @@ class AdminServer:
         # stays a bare liveness probe.
         self._device = device if device is not None else default_telemetry()
         self._slo = slo
+        # phases defaults to the process-wide recorder the serving paths
+        # report into; autoprofiler (an `autoprofile.AutoProfiler` or
+        # anything with `export()`) is opt-in.
+        self._phases = (
+            phases if phases is not None else default_phase_recorder()
+        )
+        self._autoprofiler = autoprofiler
         self._name = name
         self._profile_dir = profile_dir
         self._profile_lock = threading.Lock()
@@ -217,6 +229,12 @@ class AdminServer:
             "uptime_s": round(time.time() - self._started_unix, 1),
             "device": self._device.export(),
             "slo": self._slo.export() if self._slo is not None else None,
+            "phases": self._phases.waterfall(),
+            "profiles": (
+                self._autoprofiler.export()
+                if self._autoprofiler is not None
+                else None
+            ),
         }
         return state
 
@@ -361,6 +379,90 @@ def _render_statusz(state: dict) -> str:
                 f"<td>{r['burn_s']} s</td></tr>"
             )
         out.append("</table>")
+
+    waterfall = state.get("phases") or {}
+    out.append("<h2>Phase waterfall</h2>")
+    if not waterfall:
+        out.append("<p class=nodata>no attributed requests yet</p>")
+    for role, summary in waterfall.items():
+        e2e = summary["end_to_end_ms"]
+        out.append(
+            f"<h3>{esc(role)} ({summary['requests']} requests, "
+            f"end-to-end p50 {e2e['p50_ms']} ms / "
+            f"p99 {e2e['p99_ms']} ms)</h3>"
+        )
+        out.append(
+            "<table><tr><th>phase</th><th>count</th><th>mean ms</th>"
+            "<th>p50 ms</th><th>p99 ms</th><th>max ms</th>"
+            "<th>share</th></tr>"
+        )
+        for name, entry in summary["phases"].items():
+            out.append(
+                f"<tr><td>{esc(name)}</td><td>{entry['count']}</td>"
+                f"<td>{entry['mean_ms']}</td><td>{entry['p50_ms']}</td>"
+                f"<td>{entry['p99_ms']}</td><td>{entry['max_ms']}</td>"
+                f"<td>{entry['share'] * 100:.1f}%</td></tr>"
+            )
+        out.append("</table>")
+
+    transfers = state["device"].get("transfers") or {}
+    out.append("<h2>Host&#8596;device transfers</h2>")
+    if not transfers.get("phases"):
+        out.append("<p class=nodata>no recorded transfers</p>")
+    else:
+        totals = transfers["totals"]
+        out.append(
+            f"<p>total: {totals['h2d_copies']} h2d copies "
+            f"({_fmt_bytes(totals['h2d_bytes'])}), "
+            f"{totals['d2h_copies']} d2h copies "
+            f"({_fmt_bytes(totals['d2h_bytes'])}), "
+            f"{totals['syncs']} sync waits</p>"
+        )
+        out.append(
+            "<table><tr><th>phase</th><th>h2d copies</th>"
+            "<th>h2d bytes</th><th>d2h copies</th><th>d2h bytes</th>"
+            "<th>syncs</th></tr>"
+        )
+        for phase, entry in transfers["phases"].items():
+            out.append(
+                f"<tr><td>{esc(phase)}</td><td>{entry['h2d_copies']}</td>"
+                f"<td>{_fmt_bytes(entry['h2d_bytes'])}</td>"
+                f"<td>{entry['d2h_copies']}</td>"
+                f"<td>{_fmt_bytes(entry['d2h_bytes'])}</td>"
+                f"<td>{entry['syncs']}</td></tr>"
+            )
+        out.append("</table>")
+
+    profiles = state.get("profiles")
+    if profiles is not None:
+        out.append("<h2>Auto-captured profiles</h2>")
+        out.append(
+            f"<p>fired: {profiles['fired']}, suppressed "
+            f"(cooldown/in-flight/kind): "
+            f"{profiles['suppressed_cooldown']}/"
+            f"{profiles['suppressed_inflight']}/"
+            f"{profiles['suppressed_kind']}, "
+            f"cooldown: {profiles['cooldown_s']} s</p>"
+        )
+        if not profiles["captures"]:
+            out.append("<p class=nodata>no captures yet</p>")
+        else:
+            out.append(
+                "<table><tr><th>when (unix)</th><th>objective</th>"
+                "<th>metric</th><th>observed</th><th>threshold</th>"
+                "<th>trace dir</th></tr>"
+            )
+            for cap in profiles["captures"]:
+                where = cap.get("log_dir") or cap.get("error") or "-"
+                out.append(
+                    f"<tr><td>{cap.get('ts_unix')}</td>"
+                    f"<td>{esc(str(cap.get('objective')))}</td>"
+                    f"<td>{esc(str(cap.get('metric')))}</td>"
+                    f"<td>{cap.get('observed')}</td>"
+                    f"<td>{cap.get('threshold')}</td>"
+                    f"<td>{esc(str(where))}</td></tr>"
+                )
+            out.append("</table>")
 
     compile_state = state["device"]["compile"]
     out.append(

@@ -49,6 +49,7 @@ from typing import Callable, Dict, Optional, Tuple
 __all__ = [
     "CompileTracker",
     "HbmAccountant",
+    "TransferLedger",
     "DeviceTelemetry",
     "default_telemetry",
     "set_default_telemetry",
@@ -193,6 +194,15 @@ class CompileTracker:
         return wrapper
 
     # -- reading ------------------------------------------------------------
+
+    def seen(self, site: str, key: str) -> bool:
+        """Whether (site, key) has dispatched before — i.e. whether the
+        NEXT dispatch at this shape hits an existing executable. Callers
+        attribute a request's device step to the `compile` vs.
+        `device_compute` phase with this, before entering `dispatch()`
+        (which registers the shape on exit)."""
+        with self._lock:
+            return (site, key) in self._shapes
 
     def compiles(self, site: Optional[str] = None) -> int:
         with self._lock:
@@ -367,6 +377,183 @@ class HbmAccountant:
 
 
 # ---------------------------------------------------------------------------
+# Host<->device transfer accounting
+# ---------------------------------------------------------------------------
+
+
+def _tree_nbytes(x) -> int:
+    """Total byte size of a value or pytree of array-likes. Leaves
+    without `.nbytes` (or `.size`/`.dtype.itemsize`) count as 0 — the
+    ledger measures traffic, it never raises."""
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(x)
+    except Exception:  # pragma: no cover - jax is baked into the image
+        leaves = [x]
+    total = 0
+    for leaf in leaves:
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            size = getattr(leaf, "size", None)
+            itemsize = getattr(
+                getattr(leaf, "dtype", None), "itemsize", None
+            )
+            nbytes = (
+                size * itemsize
+                if size is not None and itemsize is not None
+                else 0
+            )
+        total += int(nbytes)
+    return total
+
+
+class TransferLedger:
+    """Per-phase host<->device transfer counts and bytes.
+
+    ROADMAP item 3 ("kill host<->device round-trips on the hot path")
+    needs a denominator: how many copies, how many bytes, and in which
+    phase of the request they happen. Call sites route their transfers
+    through the ledger's wrappers —
+
+    * `device_put(x, sharding=None, phase=...)` — one counted h2d copy
+      of a value or pytree (`jax.device_put`, sharding passed through);
+    * `to_host(x, phase=...)` — one counted d2h readback
+      (`np.asarray`);
+    * `block_until_ready(x, phase=...)` — one counted host<->device
+      sync round trip (no payload, pure latency);
+
+    or report out-of-band with `record_h2d`/`record_d2h`/`record_sync`.
+    Phases name the staging site (`db_staging`, `key_staging`,
+    `result_readback`), so `/statusz` shows exactly which table row the
+    latency program must drive to zero. With `enabled=False` the
+    wrappers degrade to bare passthroughs (no lock, no counters) — the
+    zero-overhead escape hatch.
+    """
+
+    def __init__(self, registry=None, enabled: bool = True):
+        self._lock = threading.Lock()
+        self._registry = registry
+        self.enabled = enabled
+        # phase -> {h2d_copies, h2d_bytes, d2h_copies, d2h_bytes, syncs}
+        self._phases: Dict[str, Dict[str, int]] = {}
+
+    def bind_registry(self, registry) -> None:
+        with self._lock:
+            self._registry = registry
+
+    # -- recording ----------------------------------------------------------
+
+    def _record(self, phase: str, field: str, copies: int,
+                nbytes: int = 0) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            entry = self._phases.setdefault(
+                phase,
+                {"h2d_copies": 0, "h2d_bytes": 0, "d2h_copies": 0,
+                 "d2h_bytes": 0, "syncs": 0},
+            )
+            if field == "sync":
+                entry["syncs"] += copies
+            else:
+                entry[f"{field}_copies"] += copies
+                entry[f"{field}_bytes"] += nbytes
+            registry = self._registry
+        if registry is not None:
+            try:
+                labels = {"phase": phase}
+                if field == "sync":
+                    registry.counter(
+                        "device.sync_waits", labels=labels
+                    ).inc(copies)
+                else:
+                    registry.counter(
+                        f"device.{field}_copies", labels=labels
+                    ).inc(copies)
+                    registry.counter(
+                        f"device.{field}_bytes", labels=labels
+                    ).inc(nbytes)
+            except Exception:  # pragma: no cover - telemetry never raises
+                pass
+
+    def record_h2d(self, nbytes: int, phase: str, copies: int = 1) -> None:
+        self._record(phase, "h2d", copies, int(nbytes))
+
+    def record_d2h(self, nbytes: int, phase: str, copies: int = 1) -> None:
+        self._record(phase, "d2h", copies, int(nbytes))
+
+    def record_sync(self, phase: str) -> None:
+        self._record(phase, "sync", 1)
+
+    # -- counted wrappers ---------------------------------------------------
+
+    def device_put(self, x, sharding=None, *, phase: str = "unattributed"):
+        """Counted `jax.device_put` (one h2d copy of the whole value /
+        pytree; `sharding` is any jax.device_put target)."""
+        import jax
+
+        out = (
+            jax.device_put(x, sharding)
+            if sharding is not None
+            else jax.device_put(x)
+        )
+        if self.enabled:
+            self.record_h2d(_tree_nbytes(x), phase)
+        return out
+
+    def to_host(self, x, *, phase: str = "unattributed"):
+        """Counted device->host readback (`np.asarray`)."""
+        import numpy as np
+
+        out = np.asarray(x)
+        if self.enabled:
+            self.record_d2h(out.nbytes, phase)
+        return out
+
+    def block_until_ready(self, x, *, phase: str = "unattributed"):
+        """Counted `jax.block_until_ready` (one sync round trip)."""
+        import jax
+
+        out = jax.block_until_ready(x)
+        if self.enabled:
+            self.record_sync(phase)
+        return out
+
+    # -- reading ------------------------------------------------------------
+
+    def copies(self, phase: Optional[str] = None) -> int:
+        """h2d copy count (one phase, or all phases)."""
+        with self._lock:
+            return sum(
+                e["h2d_copies"] for p, e in self._phases.items()
+                if phase is None or p == phase
+            )
+
+    def bytes_h2d(self, phase: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(
+                e["h2d_bytes"] for p, e in self._phases.items()
+                if phase is None or p == phase
+            )
+
+    def export(self) -> dict:
+        with self._lock:
+            phases = {p: dict(e) for p, e in sorted(self._phases.items())}
+        totals = {"h2d_copies": 0, "h2d_bytes": 0, "d2h_copies": 0,
+                  "d2h_bytes": 0, "syncs": 0}
+        for entry in phases.values():
+            for k in totals:
+                totals[k] += entry[k]
+        return {"enabled": self.enabled, "totals": totals,
+                "phases": phases}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._phases.clear()
+
+
+# ---------------------------------------------------------------------------
 # jax.monitoring bridge
 # ---------------------------------------------------------------------------
 
@@ -420,26 +607,31 @@ def install_jax_monitoring_listener(tracker: "CompileTracker") -> bool:
 
 
 class DeviceTelemetry:
-    """One process's device telemetry: a compile tracker plus an HBM
-    accountant, bound to at most one metrics registry."""
+    """One process's device telemetry: a compile tracker, an HBM
+    accountant, and a transfer ledger, bound to at most one metrics
+    registry."""
 
     def __init__(self, registry=None):
         self.compile_tracker = CompileTracker(registry)
         self.hbm = HbmAccountant(registry)
+        self.transfers = TransferLedger(registry)
 
     def bind_registry(self, registry) -> None:
         self.compile_tracker.bind_registry(registry)
         self.hbm.bind_registry(registry)
+        self.transfers.bind_registry(registry)
 
     def export(self) -> dict:
         return {
             "compile": self.compile_tracker.export(),
             "hbm": self.hbm.export(),
+            "transfers": self.transfers.export(),
         }
 
     def reset(self) -> None:
         self.compile_tracker.reset()
         self.hbm.reset()
+        self.transfers.reset()
 
 
 _DEFAULT = DeviceTelemetry()

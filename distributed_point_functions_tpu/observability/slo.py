@@ -95,6 +95,11 @@ class SloTracker:
         self._registry = registry
         self._clock = clock
         self._lock = threading.Lock()
+        # Called once per burn *transition* (an objective newly entering
+        # breach), with that objective's evaluation record — outside the
+        # lock, after the full evaluation pass. The SLO-triggered
+        # auto-profiler hangs off this.
+        self._burn_listeners: List = []
         # name -> monotonic time the current breach started
         self._burning_since: Dict[str, float] = {}
         # name -> (counter value, monotonic ts) for rate_min objectives
@@ -124,6 +129,15 @@ class SloTracker:
     @property
     def objectives(self) -> List[SloObjective]:
         return list(self._objectives)
+
+    def add_burn_listener(self, listener) -> None:
+        """Register `listener(record)` to fire once each time an
+        objective transitions into breach (NOT on every evaluation of a
+        continuing breach). `record` is the objective's `evaluate()`
+        dict. Listeners run outside the tracker lock and must not
+        raise; exceptions are swallowed — grading always completes."""
+        with self._lock:
+            self._burn_listeners.append(listener)
 
     # -- grading ------------------------------------------------------------
 
@@ -167,29 +181,42 @@ class SloTracker:
         export = self._registry.export()
         now = self._clock()
         results = []
+        new_burns = []
         with self._lock:
             for objective in self._objectives:
                 observed, state = self._observe(objective, export, now)
+                entered_burn = (
+                    state == "breach"
+                    and objective.name not in self._burning_since
+                )
                 if state == "breach":
                     self._burning_since.setdefault(objective.name, now)
                 else:
                     self._burning_since.pop(objective.name, None)
                 burn = self._burning_since.get(objective.name)
-                results.append(
-                    {
-                        "name": objective.name,
-                        "kind": objective.kind,
-                        "metric": objective.metric,
-                        "threshold": objective.threshold,
-                        "severity": objective.severity,
-                        "observed": observed,
-                        "state": state,
-                        "burn_s": (
-                            round(now - burn, 3) if burn is not None else 0.0
-                        ),
-                    }
-                )
+                record = {
+                    "name": objective.name,
+                    "kind": objective.kind,
+                    "metric": objective.metric,
+                    "threshold": objective.threshold,
+                    "severity": objective.severity,
+                    "observed": observed,
+                    "state": state,
+                    "burn_s": (
+                        round(now - burn, 3) if burn is not None else 0.0
+                    ),
+                }
+                results.append(record)
+                if entered_burn:
+                    new_burns.append(record)
             self._last_eval = results
+            listeners = list(self._burn_listeners)
+        for record in new_burns:
+            for listener in listeners:
+                try:
+                    listener(record)
+                except Exception:  # pragma: no cover - grading completes
+                    pass
         return results
 
     def healthy(self) -> bool:

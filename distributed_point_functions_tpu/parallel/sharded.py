@@ -311,8 +311,11 @@ def sharded_dense_pir_step_multi(
 
 def shard_database(mesh: Mesh, db_words: jnp.ndarray, axis_name: str = "x"):
     """Place a database buffer sharded over its record axis."""
-    return jax.device_put(
-        db_words, NamedSharding(mesh, P(axis_name, None))
+    from ..observability.device import default_telemetry
+
+    return default_telemetry().transfers.device_put(
+        db_words, NamedSharding(mesh, P(axis_name, None)),
+        phase="db_staging",
     )
 
 
@@ -501,8 +504,12 @@ def stage_streaming_chunks(mesh: Mesh, db_chunks, axis_name: str = "x"):
     """Place a streaming chunk staging (`database.streaming_chunks`
     layout: uint32[nc, ...] row- or bit-major per chunk) sharded over the
     chunk axis: each device holds a contiguous span of scan steps."""
+    from ..observability.device import default_telemetry
+
     spec = P(*((axis_name,) + (None,) * (db_chunks.ndim - 1)))
-    return jax.device_put(db_chunks, NamedSharding(mesh, spec))
+    return default_telemetry().transfers.device_put(
+        db_chunks, NamedSharding(mesh, spec), phase="db_staging"
+    )
 
 
 def sharded_dense_pir_step_streaming(

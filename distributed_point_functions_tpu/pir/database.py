@@ -182,9 +182,13 @@ class DenseDpfPirDatabase:
         """uint32[num_records_padded, record_words] device buffer."""
         with self._stage_lock:
             if self._db_words is None:
-                with default_telemetry().hbm.phase("db_staging"):
-                    self._db_words = jax.block_until_ready(
-                        jnp.asarray(self._host_words)
+                telemetry = default_telemetry()
+                with telemetry.hbm.phase("db_staging"):
+                    self._db_words = telemetry.transfers.block_until_ready(
+                        telemetry.transfers.device_put(
+                            self._host_words, phase="db_staging"
+                        ),
+                        phase="db_staging",
                     )
             return self._db_words
 
@@ -224,9 +228,16 @@ class DenseDpfPirDatabase:
             return self.db_words
         with self._stage_lock:
             if self._db_words_rev is None:
-                with default_telemetry().hbm.phase("db_staging"):
-                    self._db_words_rev = jax.block_until_ready(
-                        jnp.asarray(self._host_words_bitrev())
+                telemetry = default_telemetry()
+                with telemetry.hbm.phase("db_staging"):
+                    self._db_words_rev = (
+                        telemetry.transfers.block_until_ready(
+                            telemetry.transfers.device_put(
+                                self._host_words_bitrev(),
+                                phase="db_staging",
+                            ),
+                            phase="db_staging",
+                        )
                     )
                 # The host-side permuted copy only exists to feed device
                 # stagings; keeping it would hold a second full database
@@ -238,20 +249,30 @@ class DenseDpfPirDatabase:
     def _staged_perm(self, bitrev_blocks: bool = False) -> jnp.ndarray:
         """Bit-major layout (`permute_db_bitmajor`), staged once."""
         with self._stage_lock:
+            ledger = default_telemetry().transfers
             if bitrev_blocks:
                 if self._db_perm_rev is None:
                     with default_telemetry().hbm.phase("db_staging"):
-                        self._db_perm_rev = jax.block_until_ready(
+                        self._db_perm_rev = ledger.block_until_ready(
                             permute_db_bitmajor(
-                                jnp.asarray(self._host_words_bitrev())
-                            )
+                                ledger.device_put(
+                                    self._host_words_bitrev(),
+                                    phase="db_staging",
+                                )
+                            ),
+                            phase="db_staging",
                         )
                     self._host_rev = None  # see _row_words
                 return self._db_perm_rev
             if self._db_perm is None:
                 with default_telemetry().hbm.phase("db_staging"):
-                    self._db_perm = jax.block_until_ready(
-                        permute_db_bitmajor(jnp.asarray(self._host_words))
+                    self._db_perm = ledger.block_until_ready(
+                        permute_db_bitmajor(
+                            ledger.device_put(
+                                self._host_words, phase="db_staging"
+                            )
+                        ),
+                        phase="db_staging",
                     )
             return self._db_perm
 
@@ -282,18 +303,27 @@ class DenseDpfPirDatabase:
                 self._host_words_padded(), cut_levels
             )
             nc = 1 << cut_levels
+            ledger = default_telemetry().transfers
             with default_telemetry().hbm.phase("db_staging"):
                 if bitmajor:
                     from ..ops.inner_product_pallas import (
                         stage_db_chunks_bitmajor,
                     )
 
-                    arr = jax.block_until_ready(
-                        stage_db_chunks_bitmajor(jnp.asarray(host), nc)
+                    arr = ledger.block_until_ready(
+                        stage_db_chunks_bitmajor(
+                            ledger.device_put(host, phase="db_staging"),
+                            nc,
+                        ),
+                        phase="db_staging",
                     )
                 else:
-                    arr = jax.block_until_ready(
-                        jnp.asarray(host.reshape(nc, -1, host.shape[1]))
+                    arr = ledger.block_until_ready(
+                        ledger.device_put(
+                            host.reshape(nc, -1, host.shape[1]),
+                            phase="db_staging",
+                        ),
+                        phase="db_staging",
                     )
             self._streaming_stage = (key, arr)
             return arr
@@ -425,7 +455,8 @@ class DenseDpfPirDatabase:
                 selections = jnp.pad(
                     selections, ((0, 0), (0, pad), (0, 0))
                 )
-        out = np.asarray(
-            self._inner_product_device(selections, bitrev_blocks)
+        out = default_telemetry().transfers.to_host(
+            self._inner_product_device(selections, bitrev_blocks),
+            phase="result_readback",
         )
         return words_to_record_bytes(out, out.shape[0], self._max_value_size)

@@ -35,6 +35,7 @@ from jax import lax
 
 from .. import keys as fixed_keys
 from ..dpf import DpfKey
+from ..observability.device import default_telemetry
 from ..ops import aes
 
 U32 = jnp.uint32
@@ -355,14 +356,22 @@ def stage_keys(keys: Sequence[DpfKey], host_walk_levels: int = 0):
         cw_seeds = cw_seeds[host_walk_levels:]
         cw_left = cw_left[host_walk_levels:]
         cw_right = cw_right[host_walk_levels:]
-    return (
-        jnp.asarray(seeds0),
-        jnp.asarray(control0),
-        jnp.asarray(cw_seeds),
-        jnp.asarray(cw_left),
-        jnp.asarray(cw_right),
-        jnp.asarray(last_vc),
+    # One device_put for the whole staging: all six blocks are uint32,
+    # so they pack into a single flat transfer and slice back apart on
+    # device (value_types.host_const's batching note, applied). Six
+    # per-array transfers cost six dispatches on the serving hot path;
+    # the TransferLedger counts this as one h2d copy.
+    blocks = (seeds0, control0, cw_seeds, cw_left, cw_right, last_vc)
+    flat = np.concatenate([b.ravel() for b in blocks])
+    dev = default_telemetry().transfers.device_put(
+        flat, phase="key_staging"
     )
+    out = []
+    offset = 0
+    for b in blocks:
+        out.append(dev[offset:offset + b.size].reshape(b.shape))
+        offset += b.size
+    return tuple(out)
 
 
 @functools.partial(

@@ -31,6 +31,7 @@ from typing import Callable, Optional
 
 from ..dpf import DistributedPointFunction, DpfParameters
 from ..observability import tracing
+from ..observability import phases as phases_mod
 from ..observability.device import default_telemetry, shape_key
 from ..prng import Aes128CtrSeededPrng, xor_bytes
 from ..value_types import XorType
@@ -326,14 +327,23 @@ class DenseDpfPirServer(DpfPirServer):
             # zero-extension story there, so serve natural order.
             bitrev = False
         telemetry = default_telemetry()
+        # Phase attribution: the first dispatch of `pir.plain` at a new
+        # shape is dominated by trace+compile ("compile"); re-dispatches
+        # are the steady-state device step ("device_compute"). `seen` is
+        # checked BEFORE entering dispatch() — dispatch registers the
+        # shape on exit.
+        seen = telemetry.compile_tracker.seen
         if self._mesh is not None:
-            staged = stage_keys(keys)
+            with phases_mod.phase("h2d_transfer"):
+                staged = stage_keys(keys)
             key = shape_key(
                 ("m", "sharded"), ("q", len(keys)), ("b", self._num_blocks)
             )
+            step = "device_compute" if seen("pir.plain", key) else "compile"
             with tracing.span("evaluate_sharded", num_keys=len(keys)), \
                     telemetry.hbm.phase("selection"), \
-                    telemetry.compile_tracker.dispatch("pir.plain", key):
+                    telemetry.compile_tracker.dispatch("pir.plain", key), \
+                    phases_mod.phase(step):
                 inner_products = self._inner_products_sharded(
                     staged, len(keys)
                 )
@@ -346,24 +356,33 @@ class DenseDpfPirServer(DpfPirServer):
                     ("b", self._num_blocks),
                     ("c", plan.cut_levels),
                 )
+                step = (
+                    "device_compute" if seen("pir.plain", key) else "compile"
+                )
                 with tracing.span(
                     "evaluate_streaming", num_keys=len(keys), ip=plan.ip
                 ), telemetry.hbm.phase("selection"), \
-                        telemetry.compile_tracker.dispatch("pir.plain", key):
+                        telemetry.compile_tracker.dispatch("pir.plain", key), \
+                        phases_mod.phase(step):
                     inner_products = self._inner_products_streaming(
                         plan, keys
                     )
             elif plan.mode == "chunked":
-                staged = stage_keys(keys)
+                with phases_mod.phase("h2d_transfer"):
+                    staged = stage_keys(keys)
                 key = shape_key(
                     ("m", "chunked"),
                     ("q", len(keys)),
                     ("b", self._num_blocks),
                     ("c", plan.chunk_levels),
                 )
+                step = (
+                    "device_compute" if seen("pir.plain", key) else "compile"
+                )
                 with tracing.span("evaluate_chunked", num_keys=len(keys)), \
                         telemetry.hbm.phase("selection"), \
-                        telemetry.compile_tracker.dispatch("pir.plain", key):
+                        telemetry.compile_tracker.dispatch("pir.plain", key), \
+                        phases_mod.phase(step):
                     inner_products = self._inner_products_chunked(
                         staged, len(keys), plan
                     )
@@ -378,13 +397,21 @@ class DenseDpfPirServer(DpfPirServer):
                     ("q", len(keys)),
                     ("b", self._num_blocks),
                 )
+                step = (
+                    "device_compute" if seen("pir.plain", key) else "compile"
+                )
                 with tracing.span(
                     "evaluate_materialized", num_keys=len(keys)
                 ), telemetry.hbm.phase("selection"), \
-                        telemetry.compile_tracker.dispatch("pir.plain", key):
-                    staged, device_walk = stage_keys_walked(
-                        keys, self._walk_levels
-                    )
+                        telemetry.compile_tracker.dispatch("pir.plain", key), \
+                        phases_mod.phase(step):
+                    # Nested bracket: staging time lands in h2d_transfer
+                    # and is deducted from the enclosing compute phase
+                    # (exclusive-time semantics).
+                    with phases_mod.phase("h2d_transfer"):
+                        staged, device_walk = stage_keys_walked(
+                            keys, self._walk_levels
+                        )
                     selections = impl(
                         *staged,
                         walk_levels=device_walk,
@@ -447,7 +474,8 @@ class DenseDpfPirServer(DpfPirServer):
         from .dense_eval_planes_v2 import streaming_pir_inner_products_v2
 
         num_keys = len(keys)
-        staged, device_walk = stage_keys_walked(keys, self._walk_levels)
+        with phases_mod.phase("h2d_transfer"):
+            staged, device_walk = stage_keys_walked(keys, self._walk_levels)
 
         def run(ip: str):
             db_chunks = self._database.streaming_chunks(

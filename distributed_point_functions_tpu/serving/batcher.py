@@ -38,6 +38,7 @@ from collections import deque
 from typing import Callable, List, Optional, Sequence
 
 from ..observability import tracing
+from ..observability import phases as phases_mod
 from ..observability.device import default_telemetry, shape_key
 from .metrics import MetricsRegistry
 
@@ -60,7 +61,7 @@ def bucket_size(num_keys: int) -> int:
 class _Pending:
     __slots__ = (
         "keys", "deadline", "event", "result", "error", "t0", "abandoned",
-        "trace",
+        "trace", "phases",
     )
 
     def __init__(self, keys, deadline):
@@ -72,8 +73,11 @@ class _Pending:
         self.t0 = time.monotonic()
         self.abandoned = False
         # The submitting request's trace: the worker thread appends the
-        # queue-wait / device-compute spans onto it by reference.
+        # queue-wait / device-compute spans onto it by reference. Same
+        # deal for the phase record — the worker attributes
+        # queue/batch/compile/device phases onto it.
         self.trace = tracing.current_trace()
+        self.phases = phases_mod.current_request()
 
 
 class DynamicBatcher:
@@ -241,13 +245,26 @@ class DynamicBatcher:
             try:
                 t_eval = time.perf_counter()
                 tracker = default_telemetry().compile_tracker
+                recorder = phases_mod.default_phase_recorder()
                 with self.metrics.timed(f"{self._name}.evaluate_ms"), \
                         tracker.dispatch(
                             f"{self._name}.evaluate",
                             shape_key(("k", bucket)),
-                        ):
+                        ), \
+                        recorder.collect() as batch_phases:
+                    # The batch-scoped record soaks up phase() brackets
+                    # inside the evaluation path (h2d staging,
+                    # compile-vs-compute in pir/server); the fan-out
+                    # below re-attributes them to every live request.
                     results = list(self._evaluate(padded))
                 eval_ms = (time.perf_counter() - t_eval) * 1e3
+                collected = (
+                    batch_phases.snapshot()
+                    if batch_phases is not None else {}
+                )
+                # Whatever the evaluation spent outside any phase
+                # bracket is batcher/handler overhead: dispatch.
+                dispatch_ms = max(0.0, eval_ms - sum(collected.values()))
                 if len(results) < len(flat):
                     raise RuntimeError(
                         f"evaluate returned {len(results)} results for "
@@ -286,6 +303,11 @@ class DynamicBatcher:
                         "device_compute", eval_ms,
                         pad_waste_ratio=round(pad_waste, 4),
                     )
+                if p.phases is not None:
+                    p.phases.add("queue", queue_wait_ms)
+                    p.phases.add("batch", assembly_s * 1e3)
+                    p.phases.add_many(collected)
+                    p.phases.add("dispatch", dispatch_ms)
                 p.event.set()
 
     # -- lifecycle ----------------------------------------------------------

@@ -47,6 +47,7 @@ from typing import Optional
 
 from .. import serialization
 from ..observability import propagation, tracing
+from ..observability import phases as phases_mod
 from ..observability.device import (
     default_telemetry,
     install_jax_monitoring_listener,
@@ -127,6 +128,7 @@ class _Session:
         # bridge is one process-wide listener (idempotent install).
         default_telemetry().bind_registry(self.metrics)
         install_jax_monitoring_listener(default_telemetry().compile_tracker)
+        phases_mod.default_phase_recorder().bind_registry(self.metrics)
         self._batcher: Optional[DynamicBatcher] = None
         if self._config.batching:
             self._batcher = DynamicBatcher(
@@ -190,8 +192,11 @@ class _Session:
             with tracing.trace_request(
                 f"{self._name}.request", role=self._name
             ):
-                with self.metrics.timed(f"{self._name}.request_ms"):
-                    return self._server.handle_request(request)
+                with phases_mod.default_phase_recorder().request(
+                    self._name
+                ):
+                    with self.metrics.timed(f"{self._name}.request_ms"):
+                        return self._server.handle_request(request)
         finally:
             _DEADLINE.reset(token)
 
@@ -214,16 +219,23 @@ class _Session:
             fresh=trace_id is not None,
             role=self._name,
         ) as trace:
-            with tracing.span("decode"):
-                proto = pir_pb2.PirRequest.FromString(inner)
-                request = serialization.pir_request_from_proto(
-                    self._server.dpf, proto
-                )
-            response = self.handle_request(request)
-            with tracing.span("encode"):
-                out = serialization.pir_response_to_proto(
-                    response
-                ).SerializeToString()
+            # fresh at the RPC boundary for the same reason as the
+            # trace: an in-process transport runs this on the Leader's
+            # thread, and the Helper's phases must not merge into the
+            # Leader's record.
+            with phases_mod.default_phase_recorder().request(
+                self._name, fresh=trace_id is not None
+            ):
+                with tracing.span("decode"), phases_mod.phase("respond"):
+                    proto = pir_pb2.PirRequest.FromString(inner)
+                    request = serialization.pir_request_from_proto(
+                        self._server.dpf, proto
+                    )
+                response = self.handle_request(request)
+                with tracing.span("encode"), phases_mod.phase("respond"):
+                    out = serialization.pir_response_to_proto(
+                        response
+                    ).SerializeToString()
             if trace_id is None:
                 return out
             return propagation.encode_response(
@@ -404,6 +416,10 @@ class LeaderSession(_Session):
             ) from last
         # A misbehaving-but-fast helper could answer before the share ran.
         leader_share_once()
+        # Out-of-band attribution: the helper leg's RTT overlaps the
+        # Leader's own-share compute (by design), so the waterfall's
+        # helper_rtt phase can exceed end-to-end minus device_compute.
+        phases_mod.record("helper_rtt", rtt_ms)
         meta, inner = (
             propagation.try_decode_response(data)
             if enveloped

@@ -1,0 +1,181 @@
+"""AutoProfiler: SLO-burn-triggered capture policy.
+
+Driven entirely through a real `SloTracker` over a real registry — the
+tests push metric values over/under the threshold and call
+`evaluate()`, exactly the way a /healthz scrape drives production. The
+capture itself is a stub (`capture_fn`) and the cooldown clock is a
+fake, so the tests are deterministic and JAX-free.
+"""
+
+from distributed_point_functions_tpu.observability.autoprofile import (
+    LATENCY_KINDS,
+    AutoProfiler,
+)
+from distributed_point_functions_tpu.observability.slo import (
+    SloObjective,
+    SloTracker,
+)
+from distributed_point_functions_tpu.serving.metrics import MetricsRegistry
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, s):
+        self.now += s
+
+
+def make_latency_rig(threshold=50.0, **profiler_kwargs):
+    reg = MetricsRegistry()
+    tracker = SloTracker(
+        [SloObjective(name="lat", kind="p99_ms_max",
+                      metric="req_ms", threshold=threshold)],
+        registry=reg,
+    )
+    clock = FakeClock()
+    captured = []
+
+    def capture_fn(record):
+        captured.append(record)
+        return {"log_dir": f"/tmp/fake-{len(captured)}"}
+
+    profiler_kwargs.setdefault("cooldown_s", 60.0)
+    prof = AutoProfiler(
+        tracker,
+        capture_fn=capture_fn,
+        clock=clock,
+        async_capture=False,
+        **profiler_kwargs,
+    )
+    return reg, tracker, clock, prof, captured
+
+
+def breach(reg, ms=500.0):
+    # The histogram is cumulative; reset so the new p99 IS this sample.
+    reg.reset()
+    reg.histogram("req_ms").observe(ms)
+
+
+def recover(reg):
+    reg.reset()
+    reg.histogram("req_ms").observe(1.0)
+
+
+def test_burn_transition_fires_exactly_one_capture():
+    reg, tracker, clock, prof, captured = make_latency_rig()
+    breach(reg)
+    tracker.evaluate()
+    assert len(captured) == 1
+    assert prof.export()["fired"] == 1
+    entry = prof.captures()[0]
+    assert entry["objective"] == "lat"
+    assert entry["metric"] == "req_ms"
+    assert entry["observed"] >= entry["threshold"] == 50.0
+    assert entry["log_dir"] == "/tmp/fake-1"
+
+
+def test_continuing_breach_never_refires():
+    reg, tracker, clock, prof, captured = make_latency_rig()
+    breach(reg)
+    for _ in range(5):
+        tracker.evaluate()  # still in breach every scrape
+        clock.advance(120.0)  # well past cooldown — state, not window
+    assert len(captured) == 1
+    export = prof.export()
+    assert export["fired"] == 1
+    assert export["suppressed_cooldown"] == 0
+
+
+def test_flapping_objective_respects_cooldown():
+    reg, tracker, clock, prof, captured = make_latency_rig(cooldown_s=60.0)
+    breach(reg)
+    tracker.evaluate()  # burn #1 -> capture
+    recover(reg)
+    tracker.evaluate()  # back to ok
+    clock.advance(10.0)
+    breach(reg, ms=10_000.0)
+    tracker.evaluate()  # burn #2 inside cooldown -> suppressed
+    assert len(captured) == 1
+    assert prof.export()["suppressed_cooldown"] == 1
+
+    recover(reg)
+    tracker.evaluate()
+    clock.advance(120.0)
+    breach(reg, ms=10_000_000.0)
+    tracker.evaluate()  # burn #3 past cooldown -> fires again
+    assert len(captured) == 2
+    assert prof.export()["fired"] == 2
+
+
+def test_non_latency_kinds_are_filtered():
+    reg = MetricsRegistry()
+    tracker = SloTracker(
+        [SloObjective(name="compiles", kind="counter_max",
+                      metric="device.compiles", threshold=1)],
+        registry=reg,
+    )
+    captured = []
+    prof = AutoProfiler(
+        tracker, capture_fn=lambda r: captured.append(r),
+        clock=FakeClock(), async_capture=False,
+    )
+    reg.counter("device.compiles").inc(5)
+    tracker.evaluate()
+    assert captured == []
+    export = prof.export()
+    assert export["fired"] == 0
+    assert export["suppressed_kind"] == 1
+    assert list(export["kinds"]) == list(LATENCY_KINDS)
+
+
+def test_ring_buffer_evicts_oldest():
+    reg, tracker, clock, prof, captured = make_latency_rig(
+        cooldown_s=1.0, max_captures=2
+    )
+    for _ in range(3):
+        breach(reg, ms=10_000.0)
+        tracker.evaluate()
+        recover(reg)
+        tracker.evaluate()
+        clock.advance(5.0)
+    assert len(captured) == 3
+    entries = prof.captures()
+    assert len(entries) == 2  # ring kept only the last two
+    assert [e["log_dir"] for e in entries] == ["/tmp/fake-2", "/tmp/fake-3"]
+    assert prof.export()["fired"] == 3
+
+
+def test_failed_capture_is_an_error_entry_not_a_crash():
+    reg = MetricsRegistry()
+    tracker = SloTracker(
+        [SloObjective(name="lat", kind="p99_ms_max",
+                      metric="req_ms", threshold=50.0)],
+        registry=reg,
+    )
+
+    def boom(record):
+        raise RuntimeError("profiler backend exploded")
+
+    prof = AutoProfiler(
+        tracker, capture_fn=boom, clock=FakeClock(), async_capture=False
+    )
+    breach(reg)
+    tracker.evaluate()  # must not raise through the scrape
+    (entry,) = prof.captures()
+    assert "profiler backend exploded" in entry["error"]
+    export = prof.export()
+    assert export["fired"] == 1 and export["in_flight"] is False
+
+
+def test_capture_xprof_writes_a_directory(tmp_path):
+    from distributed_point_functions_tpu.observability.autoprofile import (
+        capture_xprof,
+    )
+
+    result = capture_xprof(str(tmp_path), "unit", duration_ms=1.0)
+    assert result["log_dir"].startswith(str(tmp_path))
+    assert result["duration_ms"] >= 1.0

@@ -1,0 +1,239 @@
+"""PhaseRecorder: per-request latency attribution (observability/phases).
+
+Covers the ISSUE 6 contract: attributed phases + `other` sum to the
+request's end-to-end time (within scheduler tolerance), nested brackets
+attribute exclusive time, the batcher re-attributes worker-side phases
+onto submitter records, Leader and Helper sessions produce their
+distinct phase sets, and a disabled recorder is a no-op.
+"""
+
+import threading
+import time
+
+import pytest
+
+from distributed_point_functions_tpu.observability import phases as pm
+from distributed_point_functions_tpu.observability.phases import (
+    PHASES,
+    PhaseRecorder,
+    RequestPhases,
+)
+
+
+@pytest.fixture
+def recorder():
+    rec = PhaseRecorder()
+    old = pm.default_phase_recorder()
+    pm.set_default_phase_recorder(rec)
+    yield rec
+    pm.set_default_phase_recorder(old)
+
+
+def test_phases_sum_close_to_end_to_end(recorder):
+    with recorder.request("unit") as req:
+        with pm.phase("h2d_transfer"):
+            time.sleep(0.01)
+        with pm.phase("device_compute"):
+            time.sleep(0.02)
+        time.sleep(0.005)  # unattributed -> "other"
+        total = req.elapsed_ms()
+    wf = recorder.waterfall()["unit"]
+    phase_sum = sum(p["total_ms"] for p in wf["phases"].values())
+    e2e = wf["end_to_end_ms"]["total_ms"]
+    # close() happens at context exit, microseconds after elapsed_ms()
+    assert e2e == pytest.approx(total, rel=0.25)
+    # attributed + other == e2e by construction (other is the remainder)
+    assert phase_sum == pytest.approx(e2e, rel=0.01)
+    assert wf["phases"]["h2d_transfer"]["total_ms"] >= 8.0
+    assert wf["phases"]["device_compute"]["total_ms"] >= 15.0
+    assert wf["phases"]["other"]["total_ms"] >= 3.0
+
+
+def test_nested_brackets_attribute_exclusive_time(recorder):
+    with recorder.request("unit"):
+        with pm.phase("device_compute"):
+            with pm.phase("h2d_transfer"):
+                time.sleep(0.02)
+            time.sleep(0.01)
+    wf = recorder.waterfall()["unit"]["phases"]
+    # The inner bracket's elapsed is deducted from the outer phase:
+    # no double counting.
+    assert wf["h2d_transfer"]["total_ms"] >= 15.0
+    assert wf["device_compute"]["total_ms"] < 18.0
+    assert wf["device_compute"]["total_ms"] >= 8.0
+
+
+def test_out_of_band_record_and_share(recorder):
+    with recorder.request("unit"):
+        time.sleep(0.002)
+        pm.record("helper_rtt", 40.0)
+    wf = recorder.waterfall()["unit"]
+    assert wf["phases"]["helper_rtt"]["total_ms"] == pytest.approx(40.0)
+    # helper_rtt overlaps other phases by design: share may exceed 1.
+    assert wf["phases"]["helper_rtt"]["share"] > 1.0
+
+
+def test_nested_request_reuses_outer_record(recorder):
+    with recorder.request("outer") as outer:
+        with recorder.request("inner") as inner:
+            assert inner is outer
+            pm.record("respond", 5.0)
+    wf = recorder.waterfall()
+    assert "inner" not in wf
+    assert wf["outer"]["phases"]["respond"]["total_ms"] == pytest.approx(5.0)
+
+
+def test_fresh_request_isolates_rpc_halves(recorder):
+    """fresh=True (the in-process RPC boundary) must NOT merge the
+    server half's phases into the client half's record."""
+    with recorder.request("client"):
+        pm.record("queue", 1.0)
+        with recorder.request("server", fresh=True):
+            pm.record("device_compute", 2.0)
+        # back on the client record
+        pm.record("respond", 3.0)
+    wf = recorder.waterfall()
+    assert {"queue", "respond"} <= set(wf["client"]["phases"])
+    assert "device_compute" not in wf["client"]["phases"]
+    assert "device_compute" in wf["server"]["phases"]
+    assert "queue" not in wf["server"]["phases"]
+
+
+def test_cross_thread_attribution_by_reference(recorder):
+    """The batcher pattern: a worker thread adds phases onto the
+    submitting request's record."""
+    with recorder.request("submitter") as req:
+        worker = threading.Thread(
+            target=lambda: req.add("device_compute", 12.5)
+        )
+        worker.start()
+        worker.join()
+    wf = recorder.waterfall()["submitter"]
+    assert wf["phases"]["device_compute"]["total_ms"] == pytest.approx(12.5)
+
+
+def test_closed_record_drops_late_adds():
+    req = RequestPhases("x")
+    req.add("queue", 1.0)
+    final = req.close()
+    req.add("queue", 99.0)  # a worker finishing after abandonment
+    assert final == {"queue": 1.0}
+    assert req.snapshot() == {"queue": 1.0}
+
+
+def test_collect_does_not_feed_aggregates(recorder):
+    with recorder.collect() as batch:
+        pm.record("h2d_transfer", 7.0)
+    assert batch.snapshot() == {"h2d_transfer": 7.0}
+    assert recorder.waterfall() == {}
+
+
+def test_disabled_recorder_is_noop():
+    rec = PhaseRecorder(enabled=False)
+    old = pm.default_phase_recorder()
+    pm.set_default_phase_recorder(rec)
+    try:
+        with rec.request("unit") as req:
+            assert req is None
+            assert pm.current_request() is None
+            with pm.phase("device_compute"):
+                pass
+            pm.record("queue", 5.0)
+        with rec.collect() as batch:
+            assert batch is None
+        assert rec.waterfall() == {}
+    finally:
+        pm.set_default_phase_recorder(old)
+
+
+def test_waterfall_orders_phases_canonically(recorder):
+    with recorder.request("unit"):
+        pm.record("respond", 1.0)
+        pm.record("queue", 1.0)
+        pm.record("device_compute", 1.0)
+    names = list(recorder.waterfall()["unit"]["phases"])
+    order = {n: i for i, n in enumerate(PHASES)}
+    assert names == sorted(names, key=lambda n: order[n])
+
+
+def test_registry_mirror(recorder):
+    from distributed_point_functions_tpu.serving.metrics import (
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+    recorder.bind_registry(reg)
+    with recorder.request("unit"):
+        pm.record("queue", 2.0)
+    export = reg.export()
+    hist_names = set(export["histograms"])
+    assert any("phase_ms" in n and "queue" in n for n in hist_names)
+    assert any("phase_total_ms" in n for n in hist_names)
+
+
+def test_trace_attachment(recorder):
+    from distributed_point_functions_tpu.observability import tracing
+
+    with tracing.trace_request("t.request", record=False) as trace:
+        with recorder.request("unit"):
+            pm.record("device_compute", 4.0)
+    assert trace.attrs["phases"]["device_compute"] == pytest.approx(4.0)
+    assert trace.attrs["phase_total_ms"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# Serving integration: Leader vs Helper phase sets
+# ---------------------------------------------------------------------------
+
+
+def test_leader_vs_helper_phase_sets(recorder):
+    """A two-party request produces a leader waterfall WITH helper_rtt
+    and a helper waterfall WITHOUT it (the Helper has no helper leg);
+    both see device phases via the batcher re-attribution."""
+    import numpy as np
+
+    from distributed_point_functions_tpu.pir import (
+        DenseDpfPirClient,
+        DenseDpfPirDatabase,
+    )
+    from distributed_point_functions_tpu.serving import (
+        HelperSession,
+        InProcessTransport,
+        LeaderSession,
+        ServingConfig,
+    )
+    from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+    rng = np.random.default_rng(99)
+    builder = DenseDpfPirDatabase.Builder()
+    records = [
+        bytes(rng.integers(0, 256, 16, dtype=np.uint8)) for _ in range(64)
+    ]
+    for r in records:
+        builder.insert(r)
+    database = builder.build()
+    config = ServingConfig(max_batch_size=4, max_wait_ms=5.0)
+
+    helper = HelperSession(database, encrypt_decrypt.decrypt, config)
+    leader = LeaderSession(
+        database, InProcessTransport(helper.handle_wire), config
+    )
+    with helper, leader:
+        client = DenseDpfPirClient.create(
+            len(records), encrypt_decrypt.encrypt
+        )
+        request, state = client.create_request([3, 42])
+        response = leader.handle_request(request)
+        got = client.handle_response(response, state)
+    assert got == [records[3], records[42]]
+
+    wf = recorder.waterfall()
+    assert "leader" in wf and "helper" in wf
+    leader_phases = set(wf["leader"]["phases"])
+    helper_phases = set(wf["helper"]["phases"])
+    assert "helper_rtt" in leader_phases
+    assert "helper_rtt" not in helper_phases
+    # Both roles ran a batched device step: queue + a device phase.
+    for phases in (leader_phases, helper_phases):
+        assert "queue" in phases
+        assert phases & {"compile", "device_compute"}

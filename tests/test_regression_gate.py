@@ -222,3 +222,92 @@ class TestCli:
         )
         assert main(["--history", path]) == 0  # inside the 15% band
         assert main(["--history", path, "--band", "0.05"]) == 1
+
+
+class TestLatencyRecords:
+    """The bench latency records: explicit `direction: lower`, `ms`
+    unit, and p99 riding alongside the judged p50."""
+
+    def _lat(self, value, **extra):
+        return _rec(
+            value, metric="serving_leader_e2e_ms", unit="ms",
+            direction="lower", **extra,
+        )
+
+    def test_injected_latency_regression_flagged(self):
+        history = [self._lat(12.0 + i * 0.1) for i in range(4)]
+        history.append(self._lat(30.0))  # p50 latency blew up
+        v = judge_metric(history)
+        assert v["direction"] == "lower"
+        assert v["verdict"] == "regression"
+        assert v["delta_pct"] > 15
+
+    def test_latency_drop_is_improved(self):
+        history = [self._lat(12.0) for _ in range(4)] + [self._lat(6.0)]
+        assert judge_metric(history)["verdict"] == "improved"
+
+    def test_ms_unit_implies_lower_without_explicit_field(self):
+        history = [
+            _rec(12.0, metric="dense_leader_phase_queue_ms", unit="ms")
+            for _ in range(4)
+        ] + [_rec(30.0, metric="dense_leader_phase_queue_ms", unit="ms")]
+        v = judge_metric(history)
+        assert v["direction"] == "lower"
+        assert v["verdict"] == "regression"
+
+    def test_vs_baseline_passthrough_with_direction(self):
+        history = _clean_history() + [_rec(7190.0, vs_baseline=1.02)]
+        v = judge_metric(history)
+        assert v["vs_baseline"] == 1.02
+        assert v["vs_baseline_direction"] == "higher"
+        lat = [self._lat(12.0) for _ in range(4)]
+        lat.append(self._lat(12.1, vs_baseline=0.98))
+        v = judge_metric(lat)
+        assert v["vs_baseline_direction"] == "lower"
+
+
+class TestStackGrouping:
+    """jax_version/backend stamps partition the rolling median; records
+    without stamps (pre-stamp history) stay judgeable everywhere."""
+
+    def test_other_stack_excluded_from_median(self):
+        # Three fast priors on TPU, three slow priors on CPU; the new
+        # TPU run must be judged against the TPU median only.
+        history = (
+            [_rec(7200.0, backend="tpu", jax_version="0.4.30")
+             for _ in range(3)]
+            + [_rec(80.0, backend="cpu", jax_version="0.4.30")
+               for _ in range(3)]
+            + [_rec(7150.0, backend="tpu", jax_version="0.4.30")]
+        )
+        v = judge_metric(history)
+        assert v["verdict"] == "ok"
+        assert v["median"] == 7200.0
+        assert v["backend"] == "tpu"
+        assert v["jax_version"] == "0.4.30"
+
+    def test_unstamped_history_still_counts(self):
+        # Pre-stamp records have no backend/jax_version: they wildcard
+        # into every stack, so the first stamped run is not first_run.
+        history = _clean_history() + [_rec(7188.0, backend="tpu")]
+        v = judge_metric(history)
+        assert v["verdict"] == "ok"
+        assert v["window"] == 5
+
+    def test_unstamped_newest_sees_all_history(self):
+        history = (
+            [_rec(7200.0, backend="tpu") for _ in range(3)]
+            + [_rec(7180.0)]
+        )
+        assert judge_metric(history)["verdict"] == "ok"
+
+    def test_stack_switch_is_first_run_not_false_regression(self):
+        # Moving to a new jax requires re-baselining, not comparing
+        # against the old stack's median.
+        history = (
+            [_rec(7200.0, jax_version="0.4.30") for _ in range(5)]
+            + [_rec(5000.0, jax_version="0.5.0")]
+        )
+        v = judge_metric(history)
+        assert v["verdict"] == "first_run"
+        assert "on this stack" in v["reason"]

@@ -1,0 +1,191 @@
+"""TransferLedger: host<->device copy/byte accounting per phase.
+
+Unit half: recording lands in the right phase bucket, readers and
+export roll up, the registry mirror counts, and a disabled ledger is a
+bare passthrough with no counters. Integration half: the staging paths
+actually wired through the ledger — `dense_eval.stage_keys` and
+`dpf.stage_key_batch` each cost exactly ONE h2d copy per batch (the
+`value_types.host_const` batching contract), and database staging
+lands in `db_staging`.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.observability.device import (
+    DeviceTelemetry,
+    TransferLedger,
+    default_telemetry,
+    set_default_telemetry,
+)
+
+
+@pytest.fixture
+def telemetry():
+    prev = default_telemetry()
+    fresh = set_default_telemetry(DeviceTelemetry())
+    try:
+        yield fresh
+    finally:
+        set_default_telemetry(prev)
+
+
+# ---------------------------------------------------------------------------
+# Unit: recording, attribution, export
+# ---------------------------------------------------------------------------
+
+
+def test_records_land_in_the_right_phase():
+    led = TransferLedger()
+    led.record_h2d(1024, "key_staging")
+    led.record_h2d(4096, "db_staging", copies=2)
+    led.record_d2h(256, "result_readback")
+    led.record_sync("db_staging")
+
+    assert led.copies("key_staging") == 1
+    assert led.copies("db_staging") == 2
+    assert led.copies("result_readback") == 0
+    assert led.copies() == 3
+    assert led.bytes_h2d("key_staging") == 1024
+    assert led.bytes_h2d() == 5120
+
+    export = led.export()
+    assert export["enabled"] is True
+    assert export["totals"] == {
+        "h2d_copies": 3, "h2d_bytes": 5120,
+        "d2h_copies": 1, "d2h_bytes": 256, "syncs": 1,
+    }
+    assert export["phases"]["result_readback"]["d2h_bytes"] == 256
+    assert export["phases"]["db_staging"]["syncs"] == 1
+    assert export["phases"]["key_staging"]["syncs"] == 0
+
+
+def test_wrappers_count_and_preserve_values():
+    led = TransferLedger()
+    x = np.arange(8, dtype=np.uint32)
+    dev = led.device_put(x, phase="key_staging")
+    np.testing.assert_array_equal(np.asarray(dev), x)
+    host = led.to_host(dev, phase="result_readback")
+    np.testing.assert_array_equal(host, x)
+    led.block_until_ready(dev, phase="key_staging")
+
+    export = led.export()
+    assert export["phases"]["key_staging"]["h2d_copies"] == 1
+    assert export["phases"]["key_staging"]["h2d_bytes"] == x.nbytes
+    assert export["phases"]["key_staging"]["syncs"] == 1
+    assert export["phases"]["result_readback"]["d2h_copies"] == 1
+    assert export["phases"]["result_readback"]["d2h_bytes"] == x.nbytes
+
+
+def test_device_put_counts_a_pytree_once():
+    led = TransferLedger()
+    tree = {"a": np.zeros(4, np.uint32), "b": [np.zeros(2, np.uint32)]}
+    led.device_put(tree, phase="key_staging")
+    assert led.copies("key_staging") == 1
+    assert led.bytes_h2d("key_staging") == 16 + 8
+
+
+def test_disabled_ledger_is_bare_passthrough():
+    led = TransferLedger(enabled=False)
+    led.record_h2d(1024, "key_staging")
+    led.record_d2h(256, "result_readback")
+    led.record_sync("db_staging")
+    x = np.ones(4, np.uint32)
+    dev = led.device_put(x, phase="key_staging")
+    led.block_until_ready(dev, phase="key_staging")
+    np.testing.assert_array_equal(led.to_host(dev, phase="r"), x)
+
+    export = led.export()
+    assert export["enabled"] is False
+    assert export["phases"] == {}
+    assert led.copies() == 0
+    assert led.bytes_h2d() == 0
+
+
+def test_registry_mirror_counts():
+    from distributed_point_functions_tpu.serving.metrics import (
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+    led = TransferLedger(registry=reg)
+    led.record_h2d(100, "key_staging", copies=3)
+    led.record_sync("db_staging")
+    counters = reg.export()["counters"]
+    h2d = {k: v for k, v in counters.items() if "h2d_copies" in k}
+    assert sum(h2d.values()) == 3
+    assert any("key_staging" in k for k in h2d)
+    assert any("sync_waits" in k for k in counters)
+
+
+def test_reset_clears_phases():
+    led = TransferLedger()
+    led.record_h2d(10, "key_staging")
+    led.reset()
+    assert led.copies() == 0
+    assert led.export()["phases"] == {}
+
+
+def test_default_telemetry_carries_a_ledger(telemetry):
+    assert isinstance(telemetry.transfers, TransferLedger)
+    telemetry.transfers.record_h2d(1, "db_staging")
+    assert default_telemetry().transfers.copies("db_staging") == 1
+    assert "transfers" in telemetry.export()
+
+
+# ---------------------------------------------------------------------------
+# Integration: the staging paths cost ONE copy per batch
+# ---------------------------------------------------------------------------
+
+
+def test_stage_keys_is_a_single_h2d_copy(telemetry):
+    """`dense_eval.stage_keys` packs every key block into one flat
+    uint32 array and one `device_put` (the `value_types.host_const`
+    batching contract)."""
+    from distributed_point_functions_tpu.pir import DenseDpfPirClient
+    from distributed_point_functions_tpu.pir.dense_eval import stage_keys
+
+    client = DenseDpfPirClient.create(256, lambda pt, ci: pt)
+    keys = next(iter(client._generate_key_pairs([3, 99])))
+    ledger = telemetry.transfers
+    ledger.reset()
+    staged = stage_keys(keys)
+    assert ledger.copies("key_staging") == 1
+    assert ledger.copies() == 1
+    assert ledger.bytes_h2d("key_staging") == sum(
+        np.asarray(a).nbytes for a in staged
+    )
+
+
+def test_stage_key_batch_is_a_single_h2d_copy(telemetry):
+    """`dpf.stage_key_batch` takes the same single-transfer fast path
+    for uniform uint32 key material."""
+    from distributed_point_functions_tpu.dpf import (
+        DistributedPointFunction,
+        DpfParameters,
+    )
+    from distributed_point_functions_tpu.value_types import IntType
+
+    params = [DpfParameters(i, IntType(32)) for i in range(1, 5)]
+    d = DistributedPointFunction.create_incremental(params)
+    k0, k1 = d.generate_keys_incremental(3, [1, 1, 1, 1])
+    ledger = telemetry.transfers
+    ledger.reset()
+    d.stage_key_batch([k0, k1])
+    assert ledger.copies("key_staging") == 1
+    assert ledger.copies() == 1
+
+
+def test_database_staging_attributes_to_db_staging(telemetry):
+    from distributed_point_functions_tpu.pir import DenseDpfPirDatabase
+
+    builder = DenseDpfPirDatabase.Builder()
+    for i in range(32):
+        builder.insert(bytes([i]) * 8)
+    database = builder.build()
+    ledger = telemetry.transfers
+    ledger.reset()
+    _ = database.db_words  # first touch stages the database
+    assert ledger.copies("db_staging") >= 1
+    assert ledger.copies("key_staging") == 0
+    assert ledger.bytes_h2d("db_staging") > 0
