@@ -21,8 +21,10 @@ from .protocol import (
     HeavyHittersConfig,
     HeavyHittersResult,
     HeavyHittersServer,
+    IntegrityError,
     ProtocolError,
     RoundStats,
+    config_fingerprint,
     plaintext_heavy_hitters,
     reconstruct_counts,
     run_protocol,
@@ -46,10 +48,12 @@ __all__ = [
     "HeavyHittersLeader",
     "HeavyHittersResult",
     "HeavyHittersServer",
+    "IntegrityError",
     "LevelAggregator",
     "LevelPlan",
     "ProtocolError",
     "RoundStats",
+    "config_fingerprint",
     "decode_eval_request",
     "decode_eval_request_full",
     "decode_eval_response",

@@ -46,6 +46,15 @@ class ProtocolError(RuntimeError):
     """The peers disagree on round order or message shape."""
 
 
+class IntegrityError(ProtocolError):
+    """A frame failed its checksum: the bytes changed in flight.
+
+    Distinct from the base class on purpose: a bad CRC means THIS copy
+    of the message is damaged, not that the peer speaks an older wire
+    version — so the session retries the round instead of downgrading
+    its wire version."""
+
+
 @dataclasses.dataclass(frozen=True)
 class HeavyHittersConfig:
     """Shape of one heavy-hitters deployment (shared by both servers
@@ -99,6 +108,18 @@ class HeavyHittersConfig:
         return DistributedPointFunction.create_incremental(
             self.parameters()
         )
+
+
+def config_fingerprint(config: "HeavyHittersConfig") -> dict:
+    """The config fields a checkpoint must agree on to be resumable
+    (JSON-stable; `budget_bytes` is a per-process tuning knob and
+    deliberately not part of the identity)."""
+    return {
+        "domain_bits": config.domain_bits,
+        "level_bits": config.level_bits,
+        "threshold": config.threshold,
+        "count_bits": config.count_bits,
+    }
 
 
 def reconstruct_counts(
@@ -208,6 +229,46 @@ class FrontierSweep:
             self.round_index += 1
         return stats
 
+    # -- checkpoint/resume --------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-serializable state after the last *completed* round.
+        Captures everything `restore` needs to continue the sweep,
+        plus the config fingerprint so a checkpoint can never resume
+        under a different hierarchy."""
+        return {
+            "config": config_fingerprint(self._config),
+            "round_index": int(self.round_index),
+            "frontier": [int(p) for p in self.frontier],
+            "done": bool(self.done),
+            "result": [[int(a), int(c)] for a, c in self.result],
+            "rounds": [dataclasses.asdict(r) for r in self.rounds],
+        }
+
+    @classmethod
+    def restore(
+        cls, config: "HeavyHittersConfig", state: dict
+    ) -> "FrontierSweep":
+        """Rebuild a sweep from `snapshot()` output. Raises
+        `ProtocolError` when the checkpoint belongs to a different
+        hierarchy config."""
+        if state.get("config") != config_fingerprint(config):
+            raise ProtocolError(
+                f"checkpoint config {state.get('config')} does not match "
+                f"{config_fingerprint(config)}"
+            )
+        sweep = cls(config)
+        sweep.round_index = int(state["round_index"])
+        if not 0 <= sweep.round_index < config.num_rounds:
+            raise ProtocolError(
+                f"checkpoint round {sweep.round_index} outside the sweep"
+            )
+        sweep.frontier = np.asarray(state["frontier"], dtype=np.uint64)
+        sweep.done = bool(state["done"])
+        sweep.result = [(int(a), int(c)) for a, c in state["result"]]
+        sweep.rounds = [RoundStats(**r) for r in state["rounds"]]
+        return sweep
+
 
 class HeavyHittersServer:
     """One aggregation server's sweep state over its clients' keys.
@@ -215,6 +276,21 @@ class HeavyHittersServer:
     Wraps a `LevelAggregator`; `evaluate_round` enforces the
     level-synchronized order (round r = hierarchy level r) so the cut
     states cached by round r−1 always serve round r.
+
+    `allow_resume=True` relaxes the order check for fault recovery —
+    at a privacy-neutral cost, since every answer is still a share of
+    a frontier the Leader chose:
+
+    * a round AHEAD of the expected one is served from the root (a
+      fresh process joining a sweep mid-way; `LevelAggregator` proves
+      from-root equals resume bit-for-bit, the PR 3 invariant), and
+    * the LAST round already answered may be replayed with the same
+      frontier (the Leader lost our response to a corrupt frame or a
+      crash after we advanced) and returns the cached shares —
+      idempotent, so a resend cannot double-count.
+
+    Replays of older rounds or with a different frontier still raise
+    `ProtocolError`.
     """
 
     def __init__(
@@ -224,6 +300,7 @@ class HeavyHittersServer:
         budget_bytes: Optional[int] = None,
         mesh=None,
         metrics=None,
+        allow_resume: bool = False,
     ):
         self._config = config
         self._dpf = config.make_dpf()
@@ -238,6 +315,10 @@ class HeavyHittersServer:
             metrics=metrics,
         )
         self._next_round = 0
+        self._allow_resume = allow_resume
+        # (round_index, frontier tuple, shares) of the last answered
+        # round — the replay cache.
+        self._last_round: Optional[tuple] = None
 
     @property
     def config(self) -> HeavyHittersConfig:
@@ -254,21 +335,41 @@ class HeavyHittersServer:
     def evaluate_round(
         self, round_index: int, frontier: Sequence[int]
     ) -> np.ndarray:
-        if round_index != self._next_round:
-            raise ProtocolError(
-                f"round {round_index} out of order (expected "
-                f"{self._next_round})"
-            )
         if round_index >= self._config.num_rounds:
             raise ProtocolError(f"round {round_index} beyond the sweep")
+        if round_index != self._next_round:
+            replay = (
+                self._allow_resume
+                and self._last_round is not None
+                and round_index == self._last_round[0]
+            )
+            if replay:
+                if tuple(int(p) for p in frontier) != self._last_round[1]:
+                    raise ProtocolError(
+                        f"replay of round {round_index} with a "
+                        f"different frontier"
+                    )
+                return self._last_round[2].copy()
+            if not (self._allow_resume and round_index > self._next_round):
+                raise ProtocolError(
+                    f"round {round_index} out of order (expected "
+                    f"{self._next_round})"
+                )
         shares = self._agg.evaluate_level(round_index, frontier)
-        self._next_round += 1
+        self._next_round = round_index + 1
+        if self._allow_resume:
+            self._last_round = (
+                round_index,
+                tuple(int(p) for p in frontier),
+                shares.copy(),
+            )
         return shares
 
     def reset(self) -> None:
         """Start a fresh sweep over the same staged keys."""
         self._agg.reset()
         self._next_round = 0
+        self._last_round = None
 
 
 def run_protocol(

@@ -79,6 +79,7 @@ class AdminServer:
         slo=None,
         phases: Optional[PhaseRecorder] = None,
         autoprofiler=None,
+        breakers=None,
     ):
         self._registry = registry
         self._recorder = (
@@ -97,6 +98,12 @@ class AdminServer:
             phases if phases is not None else default_phase_recorder()
         )
         self._autoprofiler = autoprofiler
+        # breakers: {name: breaker} where each value is duck-typed
+        # (anything with `export() -> dict`, in production a
+        # `robustness.CircuitBreaker` or a session's `breaker_export`
+        # via a small adapter). Opt-in; /statusz grows a "Circuit
+        # breakers" section when present.
+        self._breakers = breakers
         self._name = name
         self._profile_dir = profile_dir
         self._profile_lock = threading.Lock()
@@ -233,6 +240,14 @@ class AdminServer:
             "profiles": (
                 self._autoprofiler.export()
                 if self._autoprofiler is not None
+                else None
+            ),
+            "breakers": (
+                {
+                    name: breaker.export()
+                    for name, breaker in self._breakers.items()
+                }
+                if self._breakers
                 else None
             ),
         }
@@ -377,6 +392,31 @@ def _render_statusz(state: dict) -> str:
                 f"<td>{observed}</td><td>{r['threshold']}</td>"
                 f"<td>{esc(r['severity'])}</td><td>{esc(r['state'])}</td>"
                 f"<td>{r['burn_s']} s</td></tr>"
+            )
+        out.append("</table>")
+
+    breakers = state.get("breakers")
+    if breakers is not None:
+        out.append("<h2>Circuit breakers</h2>")
+        out.append(
+            "<table><tr><th>breaker</th><th>state</th>"
+            "<th>consecutive failures</th><th>threshold</th>"
+            "<th>opens</th><th>fast-fails</th><th>open for</th>"
+            "<th>degraded</th></tr>"
+        )
+        for name, b in breakers.items():
+            cls = "ok" if b.get("state") == "closed" else "breach"
+            open_for = b.get("open_for_s")
+            degraded = b.get("degraded_mode")
+            out.append(
+                f"<tr class={cls}><td>{esc(str(name))}</td>"
+                f"<td>{esc(str(b.get('state')))}</td>"
+                f"<td>{b.get('consecutive_failures')}</td>"
+                f"<td>{b.get('failure_threshold')}</td>"
+                f"<td>{b.get('opens')}</td>"
+                f"<td>{b.get('fast_fails')}</td>"
+                f"<td>{'-' if open_for is None else f'{open_for} s'}</td>"
+                f"<td>{'-' if degraded is None else degraded}</td></tr>"
             )
         out.append("</table>")
 

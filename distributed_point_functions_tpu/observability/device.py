@@ -33,10 +33,12 @@ Both mirror into a duck-typed metrics registry (anything with
 `counter`/`gauge`/`histogram(name, labels=...)` — in production the
 `serving/metrics.py` registry) but keep their own authoritative state,
 so `/statusz` and tests read consistent numbers even across registry
-resets. The layer DAG still holds: this module imports only `utils/`
-and stdlib at module scope (JAX is reached lazily inside functions and
+resets. The layer DAG still holds: this module imports only `utils/`,
+`robustness/` (the stdlib-only fault-injection layer beneath it) and
+stdlib at module scope (JAX is reached lazily inside functions and
 only when the caller asks for device facts), and `tools/check_layers.py`
-pins `device.py`/`slo.py` to the bottom — no serving/pir imports, ever.
+pins `device.py`/`slo.py` near the bottom — no serving/pir imports,
+ever.
 """
 
 from __future__ import annotations
@@ -45,6 +47,8 @@ import contextlib
 import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
+
+from ..robustness import failpoints
 
 __all__ = [
     "CompileTracker",
@@ -168,7 +172,14 @@ class CompileTracker:
     def dispatch(self, site: str, key: str):
         """Bracket one dispatch: times the call, and attributes the
         wall time as compile latency iff the shape is new (first call
-        through a jit entry point includes trace+compile)."""
+        through a jit entry point includes trace+compile).
+
+        Chaos site `device.dispatch.<site>`: armed with action="oom"
+        it raises `SimulatedResourceExhausted` at dispatch, standing
+        in for the XLA allocator failing this program — the hook
+        `pir/server.py`'s runtime tier demotion is tested against.
+        """
+        failpoints.fire(f"device.dispatch.{site}")
         t0 = time.perf_counter()
         try:
             yield

@@ -157,6 +157,7 @@ def plan_dense_serving(
     backend: str | None = None,
     budget_bytes: int | None = None,
     force_ip: str | None = None,
+    force_mode: str | None = None,
 ) -> ServingPlan:
     """Choose the serving mode and its parameters for one batch.
 
@@ -164,6 +165,13 @@ def plan_dense_serving(
     the full padded domain (bitrev staging: ``2**expand_levels``
     blocks) or truncate to ``num_blocks``; it sets the materialized
     byte cost, not streaming applicability.
+
+    ``force_mode`` pins the outcome regardless of the budget model:
+    ``"streaming"`` forces the fused scan when the geometry allows it
+    (falling through to chunked otherwise), ``"chunked"`` forces the
+    legacy limb-space loop.  Runtime OOM demotion (`server.py`) uses
+    it to step a shape down a tier after the budget model proved
+    optimistic on the live device.
     """
     budget = selection_budget_bytes() if budget_bytes is None else budget_bytes
     mode = streaming_mode()
@@ -173,6 +181,13 @@ def plan_dense_serving(
     eff_blocks = (1 << expand_levels) if serving_bitrev else num_blocks
     mat_bytes = materialized_selection_bytes(num_keys, eff_blocks)
     over_budget = mat_bytes > budget and expand_levels > 0
+    if force_mode == "streaming" and not streaming_ok:
+        # Geometry (or DPF_TPU_STREAMING=0) rules streaming out; the
+        # next tier down is the legacy chunked loop.
+        force_mode = "chunked"
+    if force_mode == "chunked" and expand_levels > 0:
+        over_budget = True
+        streaming_ok = False
 
     common = dict(
         num_keys=num_keys,
@@ -180,7 +195,7 @@ def plan_dense_serving(
         expand_levels=expand_levels,
         budget_bytes=budget,
     )
-    if streaming_ok and (over_budget or mode == "1"):
+    if streaming_ok and (over_budget or mode == "1" or force_mode == "streaming"):
         chunk_levels = _pick_streaming_split(num_keys, expand_levels, budget)
         cut_levels = expand_levels - chunk_levels
         ip = force_ip or streaming_ip(backend)

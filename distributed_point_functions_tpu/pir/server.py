@@ -233,6 +233,10 @@ class DenseDpfPirServer(DpfPirServer):
         self._chunked_db = None
         self._chunked_db_lock = threading.Lock()
         self._streaming_ip_failed = False
+        # Runtime tier demotion: num_keys -> minimum tier index in
+        # _TIERS after a device OOM proved the budget model optimistic
+        # for that batch shape.
+        self._tier_floor: dict[int, int] = {}
         self._log_domain_size = max(
             0, math.ceil(math.log2(database.size))
         )
@@ -348,85 +352,137 @@ class DenseDpfPirServer(DpfPirServer):
                     staged, len(keys)
                 )
         else:
-            plan = self._plan_serving(len(keys), bitrev)
-            if plan.mode == "streaming":
-                key = shape_key(
-                    ("m", f"streaming-{plan.ip}"),
-                    ("q", len(keys)),
-                    ("b", self._num_blocks),
-                    ("c", plan.cut_levels),
-                )
-                step = (
-                    "device_compute" if seen("pir.plain", key) else "compile"
-                )
-                with tracing.span(
-                    "evaluate_streaming", num_keys=len(keys), ip=plan.ip
-                ), telemetry.hbm.phase("selection"), \
-                        telemetry.compile_tracker.dispatch("pir.plain", key), \
-                        phases_mod.phase(step):
-                    inner_products = self._inner_products_streaming(
-                        plan, keys
-                    )
-            elif plan.mode == "chunked":
-                with phases_mod.phase("h2d_transfer"):
-                    staged = stage_keys(keys)
-                key = shape_key(
-                    ("m", "chunked"),
-                    ("q", len(keys)),
-                    ("b", self._num_blocks),
-                    ("c", plan.chunk_levels),
-                )
-                step = (
-                    "device_compute" if seen("pir.plain", key) else "compile"
-                )
-                with tracing.span("evaluate_chunked", num_keys=len(keys)), \
-                        telemetry.hbm.phase("selection"), \
-                        telemetry.compile_tracker.dispatch("pir.plain", key), \
-                        phases_mod.phase(step):
-                    inner_products = self._inner_products_chunked(
-                        staged, len(keys), plan
-                    )
-            else:
-                # Walk the shared all-zeros prefix on the host during
-                # staging (sub-ms there vs ~1.4 ms of dispatch-bound
-                # device AES per batch); the device step starts at the
-                # expansion root. DPF_TPU_HOST_WALK=0 restores the
-                # on-device walk.
-                key = shape_key(
-                    ("m", "bitrev" if bitrev else "materialized"),
-                    ("q", len(keys)),
-                    ("b", self._num_blocks),
-                )
-                step = (
-                    "device_compute" if seen("pir.plain", key) else "compile"
-                )
-                with tracing.span(
-                    "evaluate_materialized", num_keys=len(keys)
-                ), telemetry.hbm.phase("selection"), \
-                        telemetry.compile_tracker.dispatch("pir.plain", key), \
-                        phases_mod.phase(step):
-                    # Nested bracket: staging time lands in h2d_transfer
-                    # and is deducted from the enclosing compute phase
-                    # (exclusive-time semantics).
-                    with phases_mod.phase("h2d_transfer"):
-                        staged, device_walk = stage_keys_walked(
-                            keys, self._walk_levels
-                        )
-                    selections = impl(
-                        *staged,
-                        walk_levels=device_walk,
-                        expand_levels=self._expand_levels,
-                        num_blocks=self._num_blocks,
-                        **({"bitrev_leaves": True} if bitrev else {}),
-                    )
-                    inner_products = self._database.inner_product_with(
-                        selections, bitrev_blocks=bitrev
-                    )
+            inner_products = self._serve_single_device(
+                keys, bitrev, impl, telemetry, seen
+            )
         return messages.PirResponse(
             dpf_pir_response=messages.DpfPirResponse(
                 masked_response=inner_products
             )
         )
+
+    # -- single-device serving with runtime tier demotion --------------------
+
+    # Planner tiers ordered by decreasing peak HBM appetite; a device
+    # OOM at dispatch demotes the shape to the next tier and retries.
+    _TIERS = ("materialized", "streaming", "chunked")
+
+    def _serve_single_device(self, keys, bitrev, impl, telemetry, seen):
+        """Plan and execute one single-device batch, retrying at the
+        next planner tier down when the device reports OOM at dispatch
+        (the budget model is an estimate; the device is the truth)."""
+        while True:
+            plan = self._plan_serving(len(keys), bitrev)
+            try:
+                return self._execute_plan(
+                    plan, keys, bitrev, impl, telemetry, seen
+                )
+            except Exception as exc:  # noqa: BLE001 - OOM-gated below
+                if not self._demote_tier_on_oom(plan, len(keys), exc):
+                    raise
+
+    def _execute_plan(self, plan, keys, bitrev, impl, telemetry, seen):
+        if plan.mode == "streaming":
+            key = shape_key(
+                ("m", f"streaming-{plan.ip}"),
+                ("q", plan.num_keys),
+                ("b", self._num_blocks),
+                ("c", plan.cut_levels),
+            )
+            step = (
+                "device_compute" if seen("pir.plain", key) else "compile"
+            )
+            with tracing.span(
+                "evaluate_streaming", num_keys=plan.num_keys, ip=plan.ip
+            ), telemetry.hbm.phase("selection"), \
+                    telemetry.compile_tracker.dispatch("pir.plain", key), \
+                    phases_mod.phase(step):
+                return self._inner_products_streaming(plan, keys)
+        if plan.mode == "chunked":
+            with phases_mod.phase("h2d_transfer"):
+                staged = stage_keys(keys)
+            key = shape_key(
+                ("m", "chunked"),
+                ("q", plan.num_keys),
+                ("b", self._num_blocks),
+                ("c", plan.chunk_levels),
+            )
+            step = (
+                "device_compute" if seen("pir.plain", key) else "compile"
+            )
+            with tracing.span("evaluate_chunked", num_keys=plan.num_keys), \
+                    telemetry.hbm.phase("selection"), \
+                    telemetry.compile_tracker.dispatch("pir.plain", key), \
+                    phases_mod.phase(step):
+                return self._inner_products_chunked(
+                    staged, plan.num_keys, plan
+                )
+        # Walk the shared all-zeros prefix on the host during staging
+        # (sub-ms there vs ~1.4 ms of dispatch-bound device AES per
+        # batch); the device step starts at the expansion root.
+        # DPF_TPU_HOST_WALK=0 restores the on-device walk.
+        key = shape_key(
+            ("m", "bitrev" if bitrev else "materialized"),
+            ("q", plan.num_keys),
+            ("b", self._num_blocks),
+        )
+        step = "device_compute" if seen("pir.plain", key) else "compile"
+        with tracing.span(
+            "evaluate_materialized", num_keys=plan.num_keys
+        ), telemetry.hbm.phase("selection"), \
+                telemetry.compile_tracker.dispatch("pir.plain", key), \
+                phases_mod.phase(step):
+            # Nested bracket: staging time lands in h2d_transfer
+            # and is deducted from the enclosing compute phase
+            # (exclusive-time semantics).
+            with phases_mod.phase("h2d_transfer"):
+                staged, device_walk = stage_keys_walked(
+                    keys, self._walk_levels
+                )
+            selections = impl(
+                *staged,
+                walk_levels=device_walk,
+                expand_levels=self._expand_levels,
+                num_blocks=self._num_blocks,
+                **({"bitrev_leaves": True} if bitrev else {}),
+            )
+            return self._database.inner_product_with(
+                selections, bitrev_blocks=bitrev
+            )
+
+    @staticmethod
+    def _is_resource_exhausted(exc: BaseException) -> bool:
+        text = f"{type(exc).__name__}: {exc}"
+        return any(
+            marker in text
+            for marker in ("RESOURCE_EXHAUSTED", "Out of memory", "OOM")
+        )
+
+    def _demote_tier_on_oom(
+        self, plan: ServingPlan, num_keys: int, exc: BaseException
+    ) -> bool:
+        """Record a device OOM against `num_keys` and say whether the
+        batch can retry one tier down. Non-OOM errors never demote."""
+        if not self._is_resource_exhausted(exc):
+            return False
+        current = self._TIERS.index(plan.mode)
+        if current + 1 >= len(self._TIERS) or self._expand_levels <= 0:
+            return False  # already at the floor tier; nothing below
+        floor = max(self._tier_floor.get(num_keys, 0), current + 1)
+        self._tier_floor[num_keys] = floor
+        demoted = self._TIERS[floor]
+        tracing.runtime_counters.inc("pir.tier_demotions")
+        tracing.runtime_counters.inc(
+            f"pir.tier_demote.{plan.mode}_to_{demoted}"
+        )
+        import warnings
+
+        warnings.warn(
+            f"device OOM serving {num_keys} keys in {plan.mode} mode; "
+            f"demoting this shape to the {demoted} tier "
+            f"({str(exc).splitlines()[0][:200]})"
+        )
+        return True
 
     # -- over-budget serving (selection tensor larger than the HBM budget) ---
 
@@ -435,7 +491,8 @@ class DenseDpfPirServer(DpfPirServer):
         and the streaming cut/chunk split (see `planner.py` for the HBM
         budget model). A remembered streaming inner-product failure
         (e.g. a Mosaic compile crash) demotes the scan tier to jnp for
-        the rest of the process."""
+        the rest of the process, and a remembered device OOM for this
+        batch shape pins the planner at a lower tier."""
         import jax
 
         plan = plan_dense_serving(
@@ -445,6 +502,16 @@ class DenseDpfPirServer(DpfPirServer):
             serving_bitrev=bitrev,
             backend=jax.default_backend(),
         )
+        floor = self._tier_floor.get(num_keys, 0)
+        if floor and self._TIERS.index(plan.mode) < floor:
+            plan = plan_dense_serving(
+                num_keys=num_keys,
+                num_blocks=self._num_blocks,
+                expand_levels=self._expand_levels,
+                serving_bitrev=bitrev,
+                backend=jax.default_backend(),
+                force_mode=self._TIERS[floor],
+            )
         if plan.mode == "streaming" and self._streaming_ip_failed:
             import dataclasses
 

@@ -1,0 +1,48 @@
+"""Failure machinery: deterministic fault injection and recovery.
+
+The serving stack (PRs 2-6) can *see* failures — this package makes
+them *happen on purpose* and *survivable*:
+
+* `failpoints` — a seeded registry of named fault sites threaded
+  through the transports, the batcher worker, the Leader's helper leg,
+  and the device dispatch bracket. Armed from code or from the
+  `DPF_TPU_FAILPOINTS` environment, disarmed it costs one attribute
+  read per site.
+* `breaker` — the closed/open/half-open circuit breaker the Leader
+  puts on its Helper leg so a dead Helper costs <1 ms per request
+  (fast-fail) instead of the full timeout+backoff ladder.
+* `checkpoint` — atomic JSON checkpoint store (tmp + `os.replace`)
+  backing the heavy-hitters sweep's resume-across-restart.
+
+This package sits at the BOTTOM of the layer DAG (below
+`observability`): it imports only stdlib, so every layer — including
+observability's device dispatch bracket — may call into it without
+creating an upward edge.
+"""
+
+from .breaker import CircuitBreaker
+from .checkpoint import CheckpointError, CheckpointStore
+from .failpoints import (
+    FailpointError,
+    FailpointRegistry,
+    FailpointSpec,
+    SimulatedResourceExhausted,
+    default_failpoints,
+    fire,
+    mutate,
+    set_default_failpoints,
+)
+
+__all__ = [
+    "CircuitBreaker",
+    "CheckpointError",
+    "CheckpointStore",
+    "FailpointError",
+    "FailpointRegistry",
+    "FailpointSpec",
+    "SimulatedResourceExhausted",
+    "default_failpoints",
+    "fire",
+    "mutate",
+    "set_default_failpoints",
+]

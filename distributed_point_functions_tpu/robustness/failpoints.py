@@ -1,0 +1,294 @@
+"""Seeded fault-injection registry with named sites.
+
+A *failpoint* is a named hook compiled into a hot path:
+
+    failpoints.fire("transport.tcp.recv", error=TransportError)
+    data = failpoints.mutate("transport.response", data)
+
+Disarmed (the default, and the only state production ever sees) each
+hook is one attribute read — the module-level helpers bail on
+`registry.armed` before taking any lock. Armed, the spec for the site
+decides what happens:
+
+    action="error"     raise (the site's `error` constructor, or
+                       `FailpointError`) after an optional delay
+    action="oom"       raise `SimulatedResourceExhausted`, whose text
+                       carries RESOURCE_EXHAUSTED so OOM triage treats
+                       it exactly like an XLA allocator failure
+    action="delay"     sleep `delay_ms` and continue (latency spike)
+    action="corrupt"   (mutate sites) flip one byte at a seeded index
+    action="truncate"  (mutate sites) cut the payload short
+
+Scheduling knobs make fault *schedules* scriptable and deterministic:
+`times` bounds how often a spec fires (None = every hit), `after`
+skips the first N hits, and `probability` draws from the registry's
+seeded RNG — the same seed replays the same schedule.
+
+Activation comes from code (`registry.arm(...)` in tests and the chaos
+harness) or from the environment at process start:
+
+    DPF_TPU_FAILPOINTS="transport.tcp.recv=error:times=2;batcher.evaluate=delay:delay_ms=50"
+    DPF_TPU_FAILPOINTS_SEED=7
+
+Stdlib-only on purpose: this module sits at the bottom of the layer
+DAG so the transports, the batcher, the Leader's helper leg, and the
+device dispatch bracket can all call it without an upward edge.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+__all__ = [
+    "FailpointError",
+    "SimulatedResourceExhausted",
+    "FailpointSpec",
+    "FailpointRegistry",
+    "default_failpoints",
+    "set_default_failpoints",
+    "fire",
+    "mutate",
+]
+
+_ENV_SPECS = "DPF_TPU_FAILPOINTS"
+_ENV_SEED = "DPF_TPU_FAILPOINTS_SEED"
+
+_ACTIONS = ("error", "oom", "delay", "corrupt", "truncate")
+
+
+class FailpointError(RuntimeError):
+    """An injected fault (the default error an armed site raises)."""
+
+
+class SimulatedResourceExhausted(FailpointError):
+    """An injected device OOM. The message carries RESOURCE_EXHAUSTED
+    so `pir/server.py`'s OOM triage cannot tell it from the real XLA
+    allocator failure it stands in for."""
+
+
+@dataclasses.dataclass
+class FailpointSpec:
+    """One armed site: what happens and how often."""
+
+    site: str
+    action: str = "error"
+    times: Optional[int] = 1  # max fires; None = unlimited
+    after: int = 0  # skip the first `after` hits
+    probability: float = 1.0
+    delay_ms: float = 0.0
+    message: str = ""
+    hits: int = 0  # times the site was reached while armed
+    fired: int = 0  # times the fault actually happened
+
+    def __post_init__(self):
+        if self.action not in _ACTIONS:
+            raise ValueError(
+                f"unknown failpoint action {self.action!r} "
+                f"(one of {_ACTIONS})"
+            )
+
+
+class FailpointRegistry:
+    """Thread-safe site -> spec map with a seeded RNG.
+
+    `armed` is a plain attribute deliberately: the module-level `fire`/
+    `mutate` helpers read it lock-free as the disarmed fast path, and
+    it is only ever flipped under the lock.
+    """
+
+    def __init__(self, seed: Optional[int] = None, env: bool = True):
+        if seed is None:
+            raw = os.environ.get(_ENV_SEED, "").strip()
+            seed = int(raw) if raw else 0
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._specs: Dict[str, FailpointSpec] = {}
+        self.armed = False
+        if env:
+            spec_env = os.environ.get(_ENV_SPECS, "").strip()
+            if spec_env:
+                self.arm_from_string(spec_env)
+
+    # -- arming -------------------------------------------------------------
+
+    def arm(self, site: str, action: str = "error", **kwargs) -> FailpointSpec:
+        """Arm `site`; returns the live spec (its `hits`/`fired`
+        counters update as the schedule plays out)."""
+        spec = FailpointSpec(site=site, action=action, **kwargs)
+        with self._lock:
+            self._specs[site] = spec
+            self.armed = True
+        return spec
+
+    def arm_from_string(self, text: str) -> None:
+        """Parse `site=action[:k=v[:k=v...]]` specs separated by `;`
+        (the `DPF_TPU_FAILPOINTS` format)."""
+        for item in text.split(";"):
+            item = item.strip()
+            if not item:
+                continue
+            head, _, opts = item.partition(":")
+            site, _, action = head.partition("=")
+            kwargs: dict = {}
+            for opt in filter(None, opts.split(":")):
+                k, _, v = opt.partition("=")
+                k = k.strip()
+                if k in ("times", "after"):
+                    kwargs[k] = None if v == "none" else int(v)
+                elif k in ("probability", "p"):
+                    kwargs["probability"] = float(v)
+                elif k == "delay_ms":
+                    kwargs[k] = float(v)
+                elif k in ("message", "msg"):
+                    kwargs["message"] = v
+                else:
+                    raise ValueError(f"unknown failpoint option {k!r}")
+            self.arm(site.strip(), (action or "error").strip(), **kwargs)
+
+    def disarm(self, site: str) -> None:
+        with self._lock:
+            self._specs.pop(site, None)
+            self.armed = bool(self._specs)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._specs.clear()
+            self.armed = False
+
+    def spec(self, site: str) -> Optional[FailpointSpec]:
+        with self._lock:
+            return self._specs.get(site)
+
+    # -- firing -------------------------------------------------------------
+
+    def _draw(self, site: str) -> Optional[FailpointSpec]:
+        """Count a hit at `site`; returns the spec iff it fires now."""
+        with self._lock:
+            spec = self._specs.get(site)
+            if spec is None:
+                return None
+            spec.hits += 1
+            if spec.hits <= spec.after:
+                return None
+            if spec.times is not None and spec.fired >= spec.times:
+                return None
+            if spec.probability < 1.0 and (
+                self._rng.random() >= spec.probability
+            ):
+                return None
+            spec.fired += 1
+            return spec
+
+    def fire(
+        self, site: str, error: Optional[Callable[[str], Exception]] = None
+    ) -> None:
+        """Run `site`'s armed schedule: sleep for a delay action, raise
+        for error/oom. `error` lets the instrumented site supply its
+        native exception type (e.g. TransportError) without this module
+        importing it."""
+        spec = self._draw(site)
+        if spec is None:
+            return
+        if spec.delay_ms > 0.0:
+            time.sleep(spec.delay_ms / 1e3)
+        if spec.action == "delay":
+            return
+        message = spec.message or f"injected fault at {site}"
+        if spec.action == "oom":
+            raise SimulatedResourceExhausted(
+                f"RESOURCE_EXHAUSTED: {message}"
+            )
+        if spec.action in ("corrupt", "truncate"):
+            # A mutate action on a fire-only site is an arming mistake;
+            # surface it instead of silently not injecting.
+            raise FailpointError(
+                f"failpoint {site} armed with mutate action "
+                f"{spec.action!r} but reached via fire()"
+            )
+        ctor = error if error is not None else FailpointError
+        raise ctor(message)
+
+    def mutate(self, site: str, data: bytes) -> bytes:
+        """Apply a corrupt/truncate schedule to `data` (frame-level
+        sites); non-mutate actions behave as in `fire`."""
+        spec = self._draw(site)
+        if spec is None or not data:
+            return data
+        if spec.delay_ms > 0.0:
+            time.sleep(spec.delay_ms / 1e3)
+        if spec.action == "delay":
+            return data
+        if spec.action == "corrupt":
+            with self._lock:
+                idx = self._rng.randrange(len(data))
+                flip = 1 + self._rng.randrange(255)
+            out = bytearray(data)
+            out[idx] ^= flip
+            return bytes(out)
+        if spec.action == "truncate":
+            with self._lock:
+                cut = self._rng.randrange(len(data))
+            return data[:cut]
+        message = spec.message or f"injected fault at {site}"
+        if spec.action == "oom":
+            raise SimulatedResourceExhausted(
+                f"RESOURCE_EXHAUSTED: {message}"
+            )
+        raise FailpointError(message)
+
+    # -- reading ------------------------------------------------------------
+
+    def export(self) -> dict:
+        with self._lock:
+            return {
+                "armed": self.armed,
+                "seed": self.seed,
+                "sites": {
+                    site: {
+                        "action": s.action,
+                        "times": s.times,
+                        "after": s.after,
+                        "probability": s.probability,
+                        "delay_ms": s.delay_ms,
+                        "hits": s.hits,
+                        "fired": s.fired,
+                    }
+                    for site, s in sorted(self._specs.items())
+                },
+            }
+
+
+_default_registry = FailpointRegistry()
+
+
+def default_failpoints() -> FailpointRegistry:
+    return _default_registry
+
+
+def set_default_failpoints(registry: FailpointRegistry) -> FailpointRegistry:
+    global _default_registry
+    _default_registry = registry
+    return registry
+
+
+def fire(
+    site: str, error: Optional[Callable[[str], Exception]] = None
+) -> None:
+    """Module-level hook for instrumented sites; one attribute read
+    when nothing is armed."""
+    registry = _default_registry
+    if registry.armed:
+        registry.fire(site, error=error)
+
+
+def mutate(site: str, data: bytes) -> bytes:
+    registry = _default_registry
+    if registry.armed:
+        return registry.mutate(site, data)
+    return data

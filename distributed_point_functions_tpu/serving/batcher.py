@@ -40,6 +40,7 @@ from typing import Callable, List, Optional, Sequence
 from ..observability import tracing
 from ..observability import phases as phases_mod
 from ..observability.device import default_telemetry, shape_key
+from ..robustness import failpoints
 from .metrics import MetricsRegistry
 
 
@@ -243,6 +244,9 @@ class DynamicBatcher:
             self._h_batch.observe(len(flat))
             self._h_pad_waste.observe(pad_waste)
             try:
+                # Chaos site: a worker-side fault here must fan out to
+                # every live request and leave the worker serving.
+                failpoints.fire("batcher.evaluate")
                 t_eval = time.perf_counter()
                 tracker = default_telemetry().compile_tracker
                 recorder = phases_mod.default_phase_recorder()

@@ -17,6 +17,18 @@ and crypto callbacks; these sessions wrap them into deployable objects:
   `handle_plain_request` share so the session keeps answering (the
   response is flagged in metrics; a client that sees degraded service
   must fall back to plain single-server queries to read real records);
+* a **circuit breaker** (`robustness/breaker.py`) fronts the helper
+  leg: after `breaker_failure_threshold` consecutive leg failures it
+  opens and requests fast-fail to `HelperUnavailable` in well under a
+  millisecond instead of paying the timeout+backoff ladder each; after
+  `breaker_reset_ms` one half-open probe request runs the real leg,
+  and its success closes the breaker AND exits degraded mode — the
+  next responses are full two-share answers again. Breaker state is a
+  gauge (`leader.breaker_state`: 0 closed / 1 half-open / 2 open —
+  point an SLO `gauge_max` objective at it for a burn signal), an
+  export on the session (`breaker_export()`, the /statusz row), and
+  counters (`leader.breaker_opens`, `leader.breaker_fast_fails`,
+  `leader.degraded_exits`);
 * a `MetricsRegistry` per session (injectable, so co-located sessions
   can share one) records queue/batch/retry/latency counters, exported
   with `session.metrics.export()`;
@@ -55,6 +67,8 @@ from ..observability.device import (
 from ..pir import messages
 from ..pir.database import DenseDpfPirDatabase
 from ..pir.server import DenseDpfPirServer
+from ..robustness import failpoints
+from ..robustness.breaker import CircuitBreaker
 from .batcher import DeadlineExceeded, DynamicBatcher, Overloaded
 from .metrics import MetricsRegistry
 from .transport import Transport, TransportError, TransportTimeout
@@ -85,6 +99,13 @@ class ServingConfig:
     `allow_degraded=True` opts into Leader-share-only responses when the
     Helper is permanently down (see module docstring for the privacy
     and correctness contract).
+
+    The breaker fields shape the Leader's helper-leg circuit breaker:
+    it opens after `breaker_failure_threshold` consecutive failed legs
+    (each exhausted retry ladder counts its attempts individually) and
+    admits one half-open probe per `breaker_reset_ms` window.
+    `breaker_enabled=False` restores the PR 2 behavior (every request
+    pays the full ladder).
     """
 
     max_batch_size: int = 64
@@ -97,6 +118,9 @@ class ServingConfig:
     helper_backoff_max_ms: float = 250.0
     allow_degraded: bool = False
     batching: bool = True
+    breaker_enabled: bool = True
+    breaker_failure_threshold: int = 5
+    breaker_reset_ms: float = 1000.0
 
 
 # The deadline travels from handle_request into the server's plain
@@ -322,6 +346,52 @@ class LeaderSession(_Session):
         # False = peer rejected it once (bare proto from then on);
         # True = peer answered an envelope.
         self._peer_envelope: Optional[bool] = None
+        # Degraded mode is now *state*, not just a per-response counter:
+        # entered when a request falls back to its Leader-only share,
+        # exited the moment the breaker's half-open probe closes it.
+        self._degraded = False
+        self._g_degraded = m.gauge("leader.degraded_mode")
+        self._c_degraded_exits = m.counter("leader.degraded_exits")
+        self._g_breaker = m.gauge("leader.breaker_state")
+        self._c_breaker_opens = m.counter("leader.breaker_opens")
+        self._c_fast_fails = m.counter("leader.breaker_fast_fails")
+        self._breaker: Optional[CircuitBreaker] = None
+        if self._config.breaker_enabled:
+            self._breaker = CircuitBreaker(
+                failure_threshold=self._config.breaker_failure_threshold,
+                reset_timeout_ms=self._config.breaker_reset_ms,
+                name="leader.helper",
+            )
+            self._breaker.on_transition(self._on_breaker_transition)
+
+    @property
+    def degraded(self) -> bool:
+        """Whether the session is currently answering Leader-share-only
+        responses (recoverable: a successful half-open probe exits)."""
+        return self._degraded
+
+    @property
+    def breaker(self) -> Optional[CircuitBreaker]:
+        return self._breaker
+
+    def breaker_export(self) -> Optional[dict]:
+        """The /statusz row for this session's helper-leg breaker."""
+        if self._breaker is None:
+            return None
+        out = self._breaker.export()
+        out["degraded_mode"] = self._degraded
+        return out
+
+    def _on_breaker_transition(self, old: str, new: str) -> None:
+        self._g_breaker.set(float(self._breaker.state_code()))
+        if new == "open":
+            self._c_breaker_opens.inc()
+        if new == "closed" and self._degraded:
+            # The half-open probe proved the Helper healthy again:
+            # degraded mode ends here, not at process restart.
+            self._degraded = False
+            self._g_degraded.set(0.0)
+            self._c_degraded_exits.inc()
 
     # -- helper leg ---------------------------------------------------------
 
@@ -338,6 +408,15 @@ class LeaderSession(_Session):
         proto before the normal retry policy resumes. Timeouts do NOT
         downgrade — a slow Helper is not an old one.
         """
+        breaker = self._breaker
+        if breaker is not None and not breaker.allow():
+            # Open breaker: fail in microseconds — no serialization, no
+            # connect, no backoff. The caller's degraded path (or the
+            # client's retry policy) takes over.
+            self._c_fast_fails.inc()
+            raise HelperUnavailable(
+                "helper circuit breaker is open (fast-fail)"
+            )
         wire = serialization.pir_request_to_proto(
             self._server.dpf, helper_request
         ).SerializeToString()
@@ -370,6 +449,10 @@ class LeaderSession(_Session):
                 else wire
             )
             try:
+                # Chaos site: an injected fault here exercises the
+                # retry ladder and the breaker exactly like a helper
+                # timeout would.
+                failpoints.fire("service.helper_leg", error=TransportTimeout)
                 t0 = time.perf_counter()
                 with self.metrics.timed("leader.helper_leg_ms"):
                     data = self._transport.roundtrip(
@@ -377,6 +460,8 @@ class LeaderSession(_Session):
                         on_sent=leader_share_once,
                     )
                 rtt_ms = (time.perf_counter() - t0) * 1e3
+                if breaker is not None:
+                    breaker.record_success()
                 break
             except Exception as e:  # noqa: BLE001 - triaged below
                 is_transport = isinstance(e, TransportError)
@@ -389,13 +474,17 @@ class LeaderSession(_Session):
                     # envelope. Downgrade this transport to bare proto
                     # and re-send immediately — the probe does not
                     # consume a retry attempt (downgrading is sticky,
-                    # so this branch runs at most once per transport).
+                    # so this branch runs at most once per transport),
+                    # and does not feed the breaker: a version mismatch
+                    # is not a dead Helper.
                     self._peer_envelope = False
                     self._c_downgrades.inc()
                     last = e
                     continue
                 if not is_transport:
                     raise
+                if breaker is not None:
+                    breaker.record_failure()
                 last = e
                 if isinstance(e, TransportTimeout):
                     self._c_timeouts.inc()
@@ -476,8 +565,13 @@ class LeaderSession(_Session):
             # record from this (the Helper's share is missing) — it is a
             # liveness signal telling clients to fall back to plain
             # queries — but the session stays up and keeps its batcher,
-            # metrics, and deadlines exercised.
+            # metrics, and deadlines exercised. The mode is recoverable:
+            # the breaker's half-open probe closing it flips
+            # `self._degraded` back off (see _on_breaker_transition).
             self._c_degraded.inc()
+            if not self._degraded:
+                self._degraded = True
+                self._g_degraded.set(1.0)
             token = _DEADLINE.set(deadline)
             try:
                 return self._server._dispatch_plain(

@@ -13,7 +13,10 @@ that framing extracted into library classes:
   serving sessions are transport-blind.
 * `TcpTransport` — a client connection with reuse across round trips,
   per-call timeouts, and one transparent reconnect when a pooled
-  connection has gone stale (helper restarted between requests).
+  connection has gone stale (helper restarted between requests). The
+  whole call — both legs AND the reconnect+resend — runs against one
+  absolute deadline derived from `timeout`, so a caller's budget is
+  never overshot by a retry.
 * `FramedTcpServer` — the serving side: a threading TCP server that
   feeds each framed request to a `handler(bytes) -> bytes` and writes
   the framed response back on the same connection.
@@ -21,6 +24,14 @@ that framing extracted into library classes:
 Errors normalize to `TransportError` (connectivity) and its subclass
 `TransportTimeout` (deadline on one leg) so retry policy in
 `serving/service.py` can tell a slow Helper from a dead one.
+
+Fault-injection sites (`robustness/failpoints.py`; inert unless armed):
+`transport.tcp.connect`, `transport.tcp.send`, `transport.tcp.recv`,
+`transport.inproc.roundtrip` raise transport faults; the frame-level
+`transport.request` / `transport.response` mutate sites corrupt or
+truncate payloads on BOTH transports — the chaos harness uses them to
+prove a flipped byte surfaces as a protocol error, never a wrong
+decoded share.
 """
 
 from __future__ import annotations
@@ -30,7 +41,10 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Callable, Optional
+
+from ..robustness import failpoints
 
 logger = logging.getLogger(__name__)
 
@@ -122,9 +136,11 @@ class InProcessTransport(Transport):
         self._handler = handler
 
     def roundtrip(self, payload, timeout=None, on_sent=None):
+        failpoints.fire("transport.inproc.roundtrip", error=TransportError)
+        payload = failpoints.mutate("transport.request", payload)
         if on_sent is not None:
             on_sent()
-        return self._handler(payload)
+        return failpoints.mutate("transport.response", self._handler(payload))
 
 
 class TcpTransport(Transport):
@@ -136,11 +152,23 @@ class TcpTransport(Transport):
     timed-out request must never be read as the answer to a later one.
     A stale pooled connection (peer restarted) gets one transparent
     reconnect+resend; a fresh connection failing is the peer's problem
-    and raises immediately.
+    and raises immediately. The reconnect+resend runs inside the SAME
+    per-call deadline as the original attempt (an absolute deadline is
+    taken at entry and every leg — including the reconnect's TCP
+    handshake — gets only the remaining budget), so a caller asking
+    for `timeout` seconds never waits longer than that.
+
+    `metrics`, when given (duck-typed: anything with `counter(name)`),
+    counts transparent reconnects in `transport.reconnects` alongside
+    the instance's `reconnects` attribute.
     """
 
     def __init__(
-        self, host: str, port: int, connect_timeout: float = 5.0
+        self,
+        host: str,
+        port: int,
+        connect_timeout: float = 5.0,
+        metrics=None,
     ):
         self._host = host
         self._port = port
@@ -148,11 +176,20 @@ class TcpTransport(Transport):
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self.reconnects = 0
+        self._c_reconnects = (
+            metrics.counter("transport.reconnects")
+            if metrics is not None
+            else None
+        )
 
-    def _connect(self) -> socket.socket:
+    def _connect(self, budget: Optional[float] = None) -> socket.socket:
+        timeout = self._connect_timeout
+        if budget is not None:
+            timeout = min(timeout, max(budget, 1e-3))
+        failpoints.fire("transport.tcp.connect", error=TransportError)
         try:
             return socket.create_connection(
-                (self._host, self._port), timeout=self._connect_timeout
+                (self._host, self._port), timeout=timeout
             )
         except OSError as e:
             raise TransportError(
@@ -167,20 +204,39 @@ class TcpTransport(Transport):
                 pass
             self._sock = None
 
+    def _count_reconnect(self) -> None:
+        self.reconnects += 1
+        if self._c_reconnects is not None:
+            self._c_reconnects.inc()
+
     def _exchange(self, sock, payload, timeout, on_sent) -> bytes:
+        if timeout is not None and timeout <= 0:
+            raise socket.timeout("per-call deadline exhausted")
         sock.settimeout(timeout)
+        failpoints.fire("transport.tcp.send", error=TransportError)
+        payload = failpoints.mutate("transport.request", payload)
         send_msg(sock, payload)
         if on_sent is not None:
             on_sent()
-        return recv_msg(sock)
+        failpoints.fire("transport.tcp.recv", error=TransportError)
+        return failpoints.mutate("transport.response", recv_msg(sock))
 
     def roundtrip(self, payload, timeout=None, on_sent=None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        def remaining() -> Optional[float]:
+            return (
+                None if deadline is None else deadline - time.monotonic()
+            )
+
         with self._lock:
             reused = self._sock is not None
             if not reused:
-                self._sock = self._connect()
+                self._sock = self._connect(remaining())
             try:
-                return self._exchange(self._sock, payload, timeout, on_sent)
+                return self._exchange(
+                    self._sock, payload, remaining(), on_sent
+                )
             except (socket.timeout, TimeoutError) as e:
                 self._drop()
                 raise TransportTimeout(
@@ -192,12 +248,20 @@ class TcpTransport(Transport):
                 if not reused:
                     raise TransportError(str(e)) from e
                 # Pooled connection went stale (peer restarted between
-                # round trips): reconnect once and resend.
-                self.reconnects += 1
-                self._sock = self._connect()
+                # round trips): reconnect once and resend — but only
+                # within what is left of THIS call's deadline.
+                budget = remaining()
+                if budget is not None and budget <= 0:
+                    raise TransportTimeout(
+                        f"connection to {self._host}:{self._port} went "
+                        f"stale and no budget remains of {timeout}s to "
+                        f"reconnect"
+                    ) from e
+                self._count_reconnect()
+                self._sock = self._connect(budget)
                 try:
                     return self._exchange(
-                        self._sock, payload, timeout, on_sent
+                        self._sock, payload, remaining(), on_sent
                     )
                 except (socket.timeout, TimeoutError) as e2:
                     self._drop()

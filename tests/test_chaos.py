@@ -1,0 +1,514 @@
+"""Chaos harness: scripted fault schedules through the real stack.
+
+The invariant under test everywhere: **no injected fault may change
+bytes**. Every response a client actually receives — after retries,
+reconnects, wire downgrades, breaker probes, checkpoint resume, tier
+demotion — must be bit-identical to the fault-free oracle. Faults may
+cost latency or surface as typed errors; they may never silently
+corrupt a share.
+
+Schedules are armed on the process-default failpoint registry (that is
+what the instrumented sites consult), so the autouse fixture clears it
+around every test.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu import heavy_hitters as hh
+from distributed_point_functions_tpu.observability import tracing
+from distributed_point_functions_tpu.pir import (
+    DenseDpfPirClient,
+    DenseDpfPirDatabase,
+    DenseDpfPirServer,
+)
+from distributed_point_functions_tpu.robustness import failpoints
+from distributed_point_functions_tpu.serving import (
+    HelperSession,
+    HelperUnavailable,
+    InProcessTransport,
+    LeaderSession,
+    PlainSession,
+    ServingConfig,
+)
+from distributed_point_functions_tpu.serving.metrics import MetricsRegistry
+from distributed_point_functions_tpu.serving.transport import (
+    FramedTcpServer,
+    TcpTransport,
+)
+from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+NUM_RECORDS = 128
+RECORD_BYTES = 16
+RNG = np.random.default_rng(99)
+
+
+def build_database():
+    records = [
+        bytes(RNG.integers(0, 256, RECORD_BYTES, dtype=np.uint8))
+        for _ in range(NUM_RECORDS)
+    ]
+    builder = DenseDpfPirDatabase.Builder()
+    for r in records:
+        builder.insert(r)
+    return builder.build(), records
+
+
+DATABASE, RECORDS = build_database()
+
+HH_VALUES = [3, 3, 3, 77, 77, 9, 9, 200]
+HH_CONFIG = hh.HeavyHittersConfig(domain_bits=8, level_bits=4, threshold=2)
+HH_ORACLE = hh.plaintext_heavy_hitters(HH_VALUES, HH_CONFIG)
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    reg = failpoints.default_failpoints()
+    reg.clear()
+    yield reg
+    reg.clear()
+
+
+@pytest.fixture(scope="module")
+def hh_key_pairs():
+    client = hh.HeavyHittersClient(HH_CONFIG)
+    pairs = [client.generate_report(v) for v in HH_VALUES]
+    return [p[0] for p in pairs], [p[1] for p in pairs]
+
+
+def hh_servers(hh_key_pairs, **kwargs):
+    keys0, keys1 = hh_key_pairs
+    return (
+        hh.HeavyHittersServer(HH_CONFIG, keys0, **kwargs),
+        hh.HeavyHittersServer(HH_CONFIG, keys1, **kwargs),
+    )
+
+
+def make_config(**overrides):
+    base = dict(
+        max_batch_size=4,
+        max_wait_ms=5.0,
+        helper_timeout_ms=None,
+        helper_retries=2,
+        helper_backoff_ms=1.0,
+        helper_backoff_max_ms=2.0,
+    )
+    base.update(overrides)
+    return ServingConfig(**base)
+
+
+def leader_helper_pair(leader_config=None):
+    helper = HelperSession(DATABASE, encrypt_decrypt.decrypt, make_config())
+    leader = LeaderSession(
+        DATABASE,
+        InProcessTransport(helper.handle_wire),
+        leader_config if leader_config is not None else make_config(),
+    )
+    return leader, helper
+
+
+def run_query(leader, indices):
+    client = DenseDpfPirClient.create(NUM_RECORDS, encrypt_decrypt.encrypt)
+    request, state = client.create_request(indices)
+    response = leader.handle_request(request)
+    return client.handle_response(response, state)
+
+
+# ---------------------------------------------------------------------------
+# PIR serving under fault schedules
+# ---------------------------------------------------------------------------
+
+
+def test_helper_leg_fault_schedule_retries_to_bit_identical_answer(
+    clean_failpoints,
+):
+    # Two injected faults on the helper leg: the retry ladder absorbs
+    # them (helper_retries=2) and the answer must equal the records.
+    clean_failpoints.arm("service.helper_leg", "error", times=2)
+    leader, helper = leader_helper_pair()
+    with helper, leader:
+        got = run_query(leader, [3, 42, 127])
+        counters = leader.metrics.export()["counters"]
+    assert got == [RECORDS[3], RECORDS[42], RECORDS[127]]
+    assert counters["leader.helper_retries"] == 2
+    assert counters["leader.helper_failures"] == 0
+
+
+def test_latency_spike_schedule_changes_timing_not_bytes(clean_failpoints):
+    clean_failpoints.arm(
+        "service.helper_leg", "delay", times=None, delay_ms=20.0
+    )
+    leader, helper = leader_helper_pair()
+    with helper, leader:
+        got = run_query(leader, [7])
+    assert got == [RECORDS[7]]
+
+
+def test_breaker_opens_and_fast_fails_under_a_millisecond(clean_failpoints):
+    # Permanent helper-leg failure: after threshold consecutive leg
+    # failures the breaker opens, and every later request fast-fails
+    # to HelperUnavailable without serialization/backoff.
+    clean_failpoints.arm("service.helper_leg", "error", times=None)
+    config = make_config(
+        helper_retries=0,
+        breaker_failure_threshold=3,
+        breaker_reset_ms=60_000.0,  # stays open for the whole test
+    )
+    leader, helper = leader_helper_pair(leader_config=config)
+    client = DenseDpfPirClient.create(NUM_RECORDS, encrypt_decrypt.encrypt)
+    with helper, leader:
+        for _ in range(3):
+            with pytest.raises(HelperUnavailable):
+                run_query(leader, [1])
+        assert leader.breaker.state == "open"
+
+        # End-to-end: an open breaker surfaces as HelperUnavailable.
+        request, _ = client.create_request([1])
+        with pytest.raises(HelperUnavailable, match="fast-fail"):
+            leader.handle_request(request)
+
+        # Acceptance bar: the open-breaker helper leg costs well under
+        # 1 ms per request — no serialization, no connect, no backoff.
+        # (Timed at the leg, where the breaker guards; handle_request
+        # wraps it in batching waits that are not breaker cost.)
+        durations = []
+        for _ in range(30):
+            t0 = time.perf_counter()
+            with pytest.raises(HelperUnavailable, match="fast-fail"):
+                leader._send_to_helper(None, lambda: None)
+            durations.append(time.perf_counter() - t0)
+        counters = leader.metrics.export()["counters"]
+        export = leader.breaker_export()
+    durations.sort()
+    median = durations[len(durations) // 2]
+    assert median < 1e-3, f"fast-fail median {median * 1e3:.3f} ms"
+    assert counters["leader.breaker_opens"] == 1
+    assert counters["leader.breaker_fast_fails"] >= 30
+    assert export["state"] == "open"
+    assert export["state_code"] == 2
+
+
+def test_degraded_mode_recovers_when_probe_closes_breaker(clean_failpoints):
+    # Helper leg fails exactly 3 times -> breaker (threshold 3) opens
+    # and the Leader serves degraded. Once the fault schedule is
+    # exhausted, the half-open probe succeeds, the breaker closes, and
+    # responses return to full two-share answers.
+    clean_failpoints.arm("service.helper_leg", "error", times=3)
+    config = make_config(
+        helper_retries=0,
+        allow_degraded=True,
+        breaker_failure_threshold=3,
+        breaker_reset_ms=30.0,
+    )
+    leader, helper = leader_helper_pair(leader_config=config)
+    client = DenseDpfPirClient.create(NUM_RECORDS, encrypt_decrypt.encrypt)
+    with helper, leader:
+        for _ in range(3):
+            request, _ = client.create_request([11])
+            degraded = leader.handle_request(request)
+        assert leader.breaker.state == "open"
+        assert leader.degraded
+        assert leader.breaker_export()["degraded_mode"] is True
+        # Degraded answers are Leader-share-only: NOT the record.
+        masked = degraded.dpf_pir_response.masked_response
+        assert len(masked) == 1 and masked[0] != RECORDS[11]
+
+        time.sleep(0.05)  # past the reset window: next request probes
+        recovered = run_query(leader, [11])
+        counters = leader.metrics.export()["counters"]
+    assert recovered == [RECORDS[11]]  # full two-share answer again
+    assert leader.breaker.state == "closed"
+    assert not leader.degraded
+    assert counters["leader.degraded_exits"] == 1
+    assert counters["leader.degraded_responses"] == 3
+
+
+def test_own_share_computes_once_even_when_on_sent_fires_twice():
+    # Regression: a transparent reconnect (or fault resend) re-invokes
+    # on_sent; the Leader's own share must be computed exactly once or
+    # the XOR combination would double-fold it.
+    calls = {"n": 0}
+
+    class DoubleOnSentTransport(InProcessTransport):
+        def roundtrip(self, payload, timeout=None, on_sent=None):
+            if on_sent is not None:
+                on_sent()
+                calls["n"] += 1
+            return super().roundtrip(payload, timeout, on_sent)
+
+    helper = HelperSession(DATABASE, encrypt_decrypt.decrypt, make_config())
+    leader = LeaderSession(
+        DATABASE,
+        DoubleOnSentTransport(helper.handle_wire),
+        make_config(),
+    )
+    with helper, leader:
+        got = run_query(leader, [64])
+    assert calls["n"] >= 1  # the hook really did fire an extra time
+    assert got == [RECORDS[64]]
+
+
+def test_batcher_worker_fault_fans_out_and_worker_survives(clean_failpoints):
+    clean_failpoints.arm("batcher.evaluate", "error", times=1)
+    client = DenseDpfPirClient.create(NUM_RECORDS, lambda pt, ci: pt)
+    with PlainSession(DATABASE, make_config()) as session:
+        with pytest.raises(Exception, match="injected fault"):
+            session.handle_request(client.create_plain_requests([5])[0])
+        # The worker thread survived the fault and keeps serving.
+        request = client.create_plain_requests([5])[0]
+        got = session.handle_request(request)
+        oracle = DenseDpfPirServer.create_plain(DATABASE)
+        want = oracle.handle_plain_request(request)
+    assert (
+        got.dpf_pir_response.masked_response
+        == want.dpf_pir_response.masked_response
+    )
+
+
+def test_device_oom_demotes_tier_and_stays_bit_identical(clean_failpoints):
+    # A multi-block database (8 selection blocks, expand_levels 3) so
+    # there IS a lower tier to demote to; DATABASE above is one block.
+    rng = np.random.default_rng(5)
+    builder = DenseDpfPirDatabase.Builder()
+    for _ in range(1024):
+        builder.insert(bytes(rng.integers(0, 256, 8, dtype=np.uint8)))
+    database = builder.build()
+    client = DenseDpfPirClient.create(1024, lambda pt, ci: pt)
+    request = client.create_plain_requests([23, 999])[0]
+    want = (
+        DenseDpfPirServer.create_plain(database)
+        .handle_plain_request(request)
+        .dpf_pir_response
+    )
+
+    before = tracing.runtime_counters.get("pir.tier_demotions")
+    clean_failpoints.arm("device.dispatch.pir.plain", "oom", times=1)
+    server = DenseDpfPirServer.create_plain(database)
+    with pytest.warns(UserWarning, match="demoting this shape"):
+        got = server.handle_plain_request(request).dpf_pir_response
+    assert got.masked_response == want.masked_response
+    assert tracing.runtime_counters.get("pir.tier_demotions") == before + 1
+    # The demotion floor is sticky for this shape: later batches plan
+    # straight at the lower tier, no OOM required.
+    again = server.handle_plain_request(request).dpf_pir_response
+    assert again.masked_response == want.masked_response
+    assert server._tier_floor == {2: 1}  # num_keys=2 -> streaming floor
+
+
+# ---------------------------------------------------------------------------
+# Heavy-hitters sweep under fault schedules
+# ---------------------------------------------------------------------------
+
+
+def test_hh_inproc_fault_schedule_resends_to_oracle(
+    clean_failpoints, hh_key_pairs
+):
+    # Drop one round trip outright: the round retry resends, the
+    # Helper's replay cache keeps the resend idempotent, and the final
+    # heavy-hitter set equals the plaintext oracle.
+    clean_failpoints.arm(
+        "transport.inproc.roundtrip", "error", times=1, after=1
+    )
+    s0, s1 = hh_servers(hh_key_pairs, allow_resume=True)
+    metrics = MetricsRegistry()
+    leader = hh.HeavyHittersLeader(
+        s0,
+        InProcessTransport(hh.HeavyHittersHelper(s1).handle_wire),
+        metrics=metrics,
+        round_retries=2,
+    )
+    result = leader.run()
+    assert result.as_dict() == HH_ORACLE
+    # The dropped trip is absorbed either by the version-downgrade
+    # probe (a TransportError is indistinguishable from an old peer on
+    # the first fault) or by the round retry — both are free resends.
+    counters = metrics.export()["counters"]
+    assert (
+        counters["hh.round_retries"] + counters["hh.wire_downgrades"]
+    ) >= 1
+
+
+def test_hh_corrupt_frame_never_decodes_to_wrong_share(
+    clean_failpoints, hh_key_pairs
+):
+    # A flipped byte anywhere in the response frame must surface as a
+    # typed error (IntegrityError checksum, or a header/body
+    # ProtocolError) and be resent — never decode into a wrong share.
+    clean_failpoints.arm("transport.response", "corrupt", times=2)
+    s0, s1 = hh_servers(hh_key_pairs, allow_resume=True)
+    metrics = MetricsRegistry()
+    leader = hh.HeavyHittersLeader(
+        s0,
+        InProcessTransport(hh.HeavyHittersHelper(s1).handle_wire),
+        metrics=metrics,
+        round_retries=4,
+    )
+    result = leader.run()
+    counters = metrics.export()["counters"]
+    assert result.as_dict() == HH_ORACLE
+    recovered = (
+        counters["hh.round_retries"]
+        + counters["hh.corrupt_frames"]
+        + counters["hh.wire_downgrades"]
+    )
+    assert recovered >= 1
+
+
+def test_hh_corrupt_frame_over_tcp_matches_oracle_too(
+    clean_failpoints, hh_key_pairs
+):
+    clean_failpoints.arm("transport.response", "corrupt", times=1)
+    s0, s1 = hh_servers(hh_key_pairs, allow_resume=True)
+    metrics = MetricsRegistry()
+    helper = hh.HeavyHittersHelper(s1)
+    with FramedTcpServer(helper.handle_wire, name="hh-chaos") as srv:
+        with TcpTransport("localhost", srv.port) as transport:
+            leader = hh.HeavyHittersLeader(
+                s0,
+                transport,
+                metrics=metrics,
+                round_timeout_ms=120_000.0,
+                round_retries=4,
+            )
+            result = leader.run()
+    counters = metrics.export()["counters"]
+    assert result.as_dict() == HH_ORACLE
+    assert (
+        counters["hh.round_retries"]
+        + counters["hh.corrupt_frames"]
+        + counters["hh.wire_downgrades"]
+    ) >= 1
+
+
+def test_hh_request_corruption_rejected_by_helper(
+    clean_failpoints, hh_key_pairs
+):
+    # Corrupt the REQUEST leg: the Helper must reject the frame with a
+    # typed error and count it — and the sweep still converges to the
+    # oracle via the round retry.
+    clean_failpoints.arm("transport.request", "corrupt", times=1)
+    s0, s1 = hh_servers(hh_key_pairs, allow_resume=True)
+    helper_metrics = MetricsRegistry()
+    leader_metrics = MetricsRegistry()
+    leader = hh.HeavyHittersLeader(
+        s0,
+        InProcessTransport(
+            hh.HeavyHittersHelper(s1, metrics=helper_metrics).handle_wire
+        ),
+        metrics=leader_metrics,
+        round_retries=4,
+    )
+    result = leader.run()
+    assert result.as_dict() == HH_ORACLE
+    helper_counters = helper_metrics.export()["counters"]
+    leader_counters = leader_metrics.export()["counters"]
+    # Either the CRC caught it on the Helper (IntegrityError) or the
+    # flip landed in the header and the Leader re-sent one version
+    # down; both are typed recoveries, neither is a wrong count.
+    assert (
+        helper_counters.get("hh.corrupt_frames", 0)
+        + leader_counters["hh.wire_downgrades"]
+        + leader_counters["hh.round_retries"]
+    ) >= 1
+
+
+def test_hh_helper_restart_mid_sweep_detected_and_survived(hh_key_pairs):
+    # The Helper "restarts" between rounds: a fresh Helper instance
+    # (new session epoch, empty sweep state) takes over the handler.
+    # The epoch change is counted, the new Helper rebuilds the round
+    # from the root (allow_resume), and the result stays oracle-exact.
+    s0, s1 = hh_servers(hh_key_pairs, allow_resume=True)
+    _, keys1 = hh_key_pairs
+    helper_a = hh.HeavyHittersHelper(s1, epoch=1)
+    restarted_server = hh.HeavyHittersServer(
+        HH_CONFIG, keys1, allow_resume=True
+    )
+    helper_b = hh.HeavyHittersHelper(restarted_server, epoch=2)
+    seen = {"n": 0}
+
+    def handler(payload):
+        seen["n"] += 1
+        helper = helper_a if seen["n"] <= 1 else helper_b
+        return helper.handle_wire(payload)
+
+    metrics = MetricsRegistry()
+    leader = hh.HeavyHittersLeader(
+        s0, InProcessTransport(handler), metrics=metrics
+    )
+    result = leader.run()
+    assert result.as_dict() == HH_ORACLE
+    assert leader.helper_epoch == 2
+    assert metrics.export()["counters"]["hh.helper_restarts"] == 1
+
+
+def test_hh_sweep_checkpoint_resume_after_leader_crash(
+    clean_failpoints, hh_key_pairs, tmp_path
+):
+    # Kill the sweep after round 0 completes (fault on the round-1
+    # trip, no retries). A fresh Leader — new process, new server
+    # instance — resumes from the checkpoint and must land on the
+    # oracle WITHOUT replaying round 0.
+    ckpt = str(tmp_path / "sweep.json")
+    keys0, keys1 = hh_key_pairs
+    helper_server = hh.HeavyHittersServer(HH_CONFIG, keys1, allow_resume=True)
+    transport = InProcessTransport(
+        hh.HeavyHittersHelper(helper_server).handle_wire
+    )
+
+    clean_failpoints.arm(
+        "transport.inproc.roundtrip", "error", times=None, after=1
+    )
+    crashed = hh.HeavyHittersLeader(
+        hh.HeavyHittersServer(HH_CONFIG, keys0),
+        transport,
+        checkpoint=ckpt,
+    )
+    with pytest.raises(Exception, match="injected fault"):
+        crashed.run()
+    clean_failpoints.clear()
+
+    # "Restarted" Leader: fresh server (its sweep state starts empty;
+    # evaluate_round rebuilds the resumed round from the root — the
+    # PR 3 invariant), same checkpoint path.
+    metrics = MetricsRegistry()
+    resumed = hh.HeavyHittersLeader(
+        hh.HeavyHittersServer(HH_CONFIG, keys0, allow_resume=True),
+        transport,
+        metrics=metrics,
+        checkpoint=ckpt,
+    )
+    result = resumed.run()
+    counters = metrics.export()["counters"]
+    assert result.as_dict() == HH_ORACLE
+    assert counters["hh.sweep_resumes"] == 1
+    # Only the crashed round re-ran: the full sweep is 2 rounds and
+    # the resumed run sent exactly the remaining one.
+    assert counters["hh.rounds"] == 1
+    # Both rounds' stats survive in the result via the checkpoint.
+    assert len(result.rounds) == 2
+    import os
+
+    assert not os.path.exists(ckpt)  # deleted on completion
+
+
+def test_hh_checkpoint_config_mismatch_refuses_resume(
+    hh_key_pairs, tmp_path
+):
+    ckpt = str(tmp_path / "sweep.json")
+    keys0, keys1 = hh_key_pairs
+    from distributed_point_functions_tpu.robustness import CheckpointStore
+
+    sweep = hh.FrontierSweep(HH_CONFIG)
+    CheckpointStore(ckpt).save(sweep.snapshot())
+    other = hh.HeavyHittersConfig(domain_bits=8, level_bits=2, threshold=2)
+    client = hh.HeavyHittersClient(other)
+    keys = [client.generate_report(1)[0]]
+    leader = hh.HeavyHittersLeader(
+        hh.HeavyHittersServer(other, keys),
+        InProcessTransport(lambda p: p),
+        checkpoint=ckpt,
+    )
+    with pytest.raises(hh.ProtocolError, match="checkpoint"):
+        leader.run()

@@ -223,3 +223,61 @@ def test_metrics_snapshot_reset_isolation():
     # Instruments recreate on next use after a reset.
     registry.counter("hh.rounds").inc(1)
     assert registry.snapshot()["counters"]["hh.rounds"] == 1
+
+
+def test_wire_v3_checksum_epoch_and_downgrade_compat():
+    frontier = np.array([0, 5, 1 << 40], dtype=np.uint64)
+    shares = np.array([7, 0, 0xFFFFFFFF], dtype=np.uint32)
+
+    # v3 round trip carries the helper epoch and a frame checksum.
+    resp = hh.encode_eval_response(2, shares, helper_ms=1.5, epoch=42)
+    r, decoded, version, helper_ms, epoch = hh.decode_eval_response_full(
+        resp
+    )
+    assert (r, version, epoch) == (2, 3, 42)
+    assert helper_ms == pytest.approx(1.5)
+    np.testing.assert_array_equal(decoded, shares)
+
+    # A flipped byte in the body fails the checksum as a typed
+    # IntegrityError — which IS a ProtocolError, so every existing
+    # handler that catches ProtocolError also catches damaged frames.
+    assert issubclass(hh.IntegrityError, hh.ProtocolError)
+    corrupt = bytearray(resp)
+    corrupt[-1] ^= 0xFF
+    with pytest.raises(hh.IntegrityError, match="checksum"):
+        hh.decode_eval_response_full(bytes(corrupt))
+
+    req = hh.encode_eval_request(1, frontier, trace_id="ab" * 8)
+    r, decoded, version, trace_id = hh.decode_eval_request_full(req)
+    assert (r, version, trace_id) == (1, 3, "ab" * 8)
+    corrupt = bytearray(req)
+    corrupt[len(corrupt) // 2] ^= 0x01
+    with pytest.raises(hh.IntegrityError, match="checksum"):
+        hh.decode_eval_request_full(bytes(corrupt))
+
+    # Older wire versions still decode (no checksum to verify).
+    for old in (1, 2):
+        old_resp = hh.encode_eval_response(2, shares, version=old)
+        r, decoded, version, _, epoch = hh.decode_eval_response_full(
+            old_resp
+        )
+        assert (r, version, epoch) == (2, old, None)
+        np.testing.assert_array_equal(decoded, shares)
+
+
+def test_helper_replay_cache_makes_resends_idempotent(key_pairs):
+    keys0, _ = key_pairs
+    server = hh.HeavyHittersServer(CONFIG, keys0, allow_resume=True)
+    sweep = hh.FrontierSweep(CONFIG)
+    frontier = sweep.frontier
+    first = server.evaluate_round(0, frontier)
+    replay = server.evaluate_round(0, frontier)  # resend after a fault
+    np.testing.assert_array_equal(first, replay)
+    # A replay with a DIFFERENT frontier is not a resend — reject it.
+    with pytest.raises(hh.ProtocolError, match="different frontier"):
+        server.evaluate_round(0, frontier[:-1])
+    # Without allow_resume the PR 2 contract stands: strict order.
+    strict = hh.HeavyHittersServer(CONFIG, keys0)
+    strict.evaluate_round(0, frontier)
+    with pytest.raises(hh.ProtocolError, match="out of order"):
+        strict.evaluate_round(0, frontier)

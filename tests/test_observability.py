@@ -555,35 +555,39 @@ def hh_keys():
 def test_hh_wire_v2_codec_roundtrip():
     frontier = np.array([0, 5, 1 << 40], dtype=np.uint64)
     tid = tracing.new_trace_id()
-    req = hh.encode_eval_request(3, frontier, trace_id=tid)
+    req = hh.encode_eval_request(3, frontier, trace_id=tid, version=2)
     r, decoded, version, got_tid = hh.decode_eval_request_full(req)
     assert (r, version, got_tid) == (3, 2, tid)
     np.testing.assert_array_equal(decoded, frontier)
     # No trace id -> zeros on the wire -> None on decode.
     _, _, _, none_tid = hh.decode_eval_request_full(
-        hh.encode_eval_request(3, frontier)
+        hh.encode_eval_request(3, frontier, version=2)
     )
     assert none_tid is None
 
     shares = np.array([7, 0, 0xFFFFFFFF], dtype=np.uint32)
-    resp = hh.encode_eval_response(3, shares, helper_ms=12.5)
-    r, decoded, version, helper_ms = hh.decode_eval_response_full(resp)
-    assert (r, version, helper_ms) == (3, 2, 12.5)
+    resp = hh.encode_eval_response(3, shares, helper_ms=12.5, version=2)
+    r, decoded, version, helper_ms, epoch = hh.decode_eval_response_full(
+        resp
+    )
+    assert (r, version, helper_ms, epoch) == (3, 2, 12.5, None)
     np.testing.assert_array_equal(decoded, shares)
 
-    # The 2-tuple decoders keep working for both versions.
+    # The 2-tuple decoders keep working for every version.
     assert hh.decode_eval_response(resp)[0] == 3
     v1_req = hh.encode_eval_request(1, frontier, version=1)
     r, decoded = hh.decode_eval_request(v1_req)
     assert r == 1
     np.testing.assert_array_equal(decoded, frontier)
     # v1 requests carry no extension: 8 bytes shorter than v2.
-    assert len(v1_req) + 8 == len(hh.encode_eval_request(1, frontier))
+    assert len(v1_req) + 8 == len(
+        hh.encode_eval_request(1, frontier, version=2)
+    )
 
     with pytest.raises(hh.ProtocolError, match="v2 extension"):
         hh.decode_eval_request_full(req[:20])
     with pytest.raises(ValueError, match="wire version"):
-        hh.encode_eval_request(0, frontier, version=3)
+        hh.encode_eval_request(0, frontier, version=4)
 
 
 def _hh_oracle():
@@ -599,7 +603,7 @@ def test_hh_v2_sweep_propagates_trace_and_helper_timing(recorder, hh_keys):
     )
     result = leader.run()
     assert result.as_dict() == _hh_oracle()
-    assert leader.wire_version == 2
+    assert leader.wire_version == 3
     snap = leader.metrics.export()
     assert snap["counters"]["hh.wire_downgrades"] == 0
     rounds = snap["counters"]["hh.rounds"]
@@ -646,7 +650,8 @@ def test_hh_leader_downgrades_for_v1_helper_in_process(hh_keys):
     result = leader.run()
     assert result.as_dict() == _hh_oracle()
     assert leader.wire_version == 1
-    assert leader.metrics.export()["counters"]["hh.wire_downgrades"] == 1
+    # Stepwise: v3 -> v2 -> v1, one downgrade per rejected probe.
+    assert leader.metrics.export()["counters"]["hh.wire_downgrades"] == 2
     # v1 responses carry no helper timing, so no remote/network split.
     assert "hh.helper_remote_ms" not in leader.metrics.export()["histograms"]
 
@@ -670,7 +675,7 @@ def test_hh_leader_downgrades_for_v1_helper_over_tcp(hh_keys):
         server.stop()
     assert result.as_dict() == _hh_oracle()
     assert leader.wire_version == 1
-    assert leader.metrics.export()["counters"]["hh.wire_downgrades"] == 1
+    assert leader.metrics.export()["counters"]["hh.wire_downgrades"] == 2
 
 
 def test_hh_helper_answers_v1_leaders_in_v1(hh_keys):
@@ -681,6 +686,46 @@ def test_hh_helper_answers_v1_leaders_in_v1(hh_keys):
         hh.encode_eval_request(0, frontier, version=1)
     )
     assert reply[4] == 1  # version byte: the Helper answered in v1
-    r, shares, version, helper_ms = hh.decode_eval_response_full(reply)
-    assert (r, version, helper_ms) == (0, 1, None)
+    r, shares, version, helper_ms, epoch = hh.decode_eval_response_full(
+        reply
+    )
+    assert (r, version, helper_ms, epoch) == (0, 1, None, None)
     assert shares.shape == (16,)
+
+
+def test_statusz_renders_circuit_breaker_rows():
+    from distributed_point_functions_tpu.observability.admin import (
+        AdminServer,
+    )
+    from distributed_point_functions_tpu.robustness import CircuitBreaker
+
+    breaker = CircuitBreaker(
+        failure_threshold=1, reset_timeout_ms=60_000.0, name="leader.helper"
+    )
+    breaker.record_failure()  # drive it open for the breach styling
+    assert breaker.state == "open"
+
+    class SessionShim:
+        # Mirrors LeaderSession.breaker_export(): breaker export plus
+        # the degraded-mode flag the /statusz row shows alongside it.
+        def export(self):
+            out = breaker.export()
+            out["degraded_mode"] = True
+            return out
+
+    with AdminServer(
+        registry=MetricsRegistry(), breakers={"leader.helper": SessionShim()}
+    ) as admin:
+        base = f"http://127.0.0.1:{admin.port}"
+        html = urllib.request.urlopen(base + "/statusz").read().decode()
+        assert "Circuit breakers" in html
+        assert "leader.helper" in html
+        assert "open" in html
+
+        state = json.load(
+            urllib.request.urlopen(base + "/statusz?format=json")
+        )
+        row = state["breakers"]["leader.helper"]
+        assert row["state"] == "open"
+        assert row["state_code"] == 2
+        assert row["degraded_mode"] is True

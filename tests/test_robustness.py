@@ -1,0 +1,345 @@
+"""Unit coverage for the robustness layer: failpoints, breaker,
+checkpoint store.
+
+Everything here is stdlib-speed — no jax, no sockets. The chaos
+end-to-end schedules that drive these pieces through the real serving
+stack live in `test_chaos.py`.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from distributed_point_functions_tpu.robustness import (
+    CheckpointError,
+    CheckpointStore,
+    CircuitBreaker,
+    FailpointError,
+    FailpointRegistry,
+    SimulatedResourceExhausted,
+    failpoints,
+)
+from distributed_point_functions_tpu.robustness.breaker import STATE_CODES
+
+
+# ---------------------------------------------------------------------------
+# Failpoint registry
+# ---------------------------------------------------------------------------
+
+
+def test_disarmed_registry_is_a_no_op():
+    reg = FailpointRegistry(env=False)
+    reg.fire("any.site")  # nothing armed: returns silently
+    assert reg.mutate("any.site", b"data") == b"data"
+    assert reg.export()["armed"] is False
+
+
+def test_error_action_uses_site_native_exception_type():
+    reg = FailpointRegistry(env=False)
+
+    class MyTransportError(Exception):
+        pass
+
+    reg.arm("t.send", "error", message="boom")
+    with pytest.raises(MyTransportError, match="boom"):
+        reg.fire("t.send", error=MyTransportError)
+    # times=1 default: second hit passes clean.
+    reg.fire("t.send", error=MyTransportError)
+
+
+def test_error_action_defaults_to_failpoint_error():
+    reg = FailpointRegistry(env=False)
+    reg.arm("x", "error")
+    with pytest.raises(FailpointError, match="injected fault at x"):
+        reg.fire("x")
+
+
+def test_oom_action_reads_as_resource_exhausted():
+    reg = FailpointRegistry(env=False)
+    reg.arm("device.dispatch", "oom")
+    with pytest.raises(SimulatedResourceExhausted, match="RESOURCE_EXHAUSTED"):
+        reg.fire("device.dispatch")
+
+
+def test_times_after_schedule():
+    reg = FailpointRegistry(env=False)
+    spec = reg.arm("s", "error", times=2, after=1)
+    outcomes = []
+    for _ in range(5):
+        try:
+            reg.fire("s")
+            outcomes.append("ok")
+        except FailpointError:
+            outcomes.append("boom")
+    # Hit 1 skipped (after=1), hits 2-3 fire (times=2), rest pass.
+    assert outcomes == ["ok", "boom", "boom", "ok", "ok"]
+    assert spec.hits == 5
+    assert spec.fired == 2
+
+
+def test_probability_schedule_is_seed_deterministic():
+    def run(seed):
+        reg = FailpointRegistry(seed=seed, env=False)
+        reg.arm("p", "error", times=None, probability=0.5)
+        out = []
+        for _ in range(20):
+            try:
+                reg.fire("p")
+                out.append(0)
+            except FailpointError:
+                out.append(1)
+        return out
+
+    assert run(7) == run(7)
+    assert 0 < sum(run(7)) < 20
+
+
+def test_corrupt_mutation_flips_exactly_one_byte():
+    reg = FailpointRegistry(seed=3, env=False)
+    reg.arm("frame", "corrupt")
+    data = bytes(range(64))
+    out = reg.mutate("frame", data)
+    assert len(out) == len(data)
+    diff = [i for i in range(64) if out[i] != data[i]]
+    assert len(diff) == 1
+    # Disarmed after times=1.
+    assert reg.mutate("frame", data) == data
+
+
+def test_truncate_mutation_shortens_frame():
+    reg = FailpointRegistry(seed=11, env=False)
+    reg.arm("frame", "truncate")
+    data = bytes(range(64))
+    out = reg.mutate("frame", data)
+    assert len(out) < len(data)
+    assert out == data[: len(out)]
+
+
+def test_mutate_action_reached_via_fire_is_an_arming_error():
+    reg = FailpointRegistry(env=False)
+    reg.arm("site", "corrupt")
+    with pytest.raises(FailpointError, match="mutate action"):
+        reg.fire("site")
+
+
+def test_arm_from_string_env_format():
+    reg = FailpointRegistry(env=False)
+    reg.arm_from_string(
+        "transport.tcp.recv=error:times=2;"
+        "batcher.evaluate=delay:delay_ms=5;"
+        "frame=corrupt:p=0.5:after=1"
+    )
+    assert reg.spec("transport.tcp.recv").times == 2
+    assert reg.spec("batcher.evaluate").action == "delay"
+    assert reg.spec("batcher.evaluate").delay_ms == 5.0
+    assert reg.spec("frame").probability == 0.5
+    assert reg.spec("frame").after == 1
+
+
+def test_env_activation(monkeypatch):
+    monkeypatch.setenv("DPF_TPU_FAILPOINTS", "a.site=error:times=3")
+    monkeypatch.setenv("DPF_TPU_FAILPOINTS_SEED", "42")
+    reg = FailpointRegistry()
+    assert reg.seed == 42
+    assert reg.armed
+    assert reg.spec("a.site").times == 3
+
+
+def test_unknown_action_and_option_rejected():
+    reg = FailpointRegistry(env=False)
+    with pytest.raises(ValueError, match="unknown failpoint action"):
+        reg.arm("s", "explode")
+    with pytest.raises(ValueError, match="unknown failpoint option"):
+        reg.arm_from_string("s=error:frequency=1")
+
+
+def test_module_level_helpers_use_default_registry():
+    reg = FailpointRegistry(env=False)
+    old = failpoints.default_failpoints()
+    failpoints.set_default_failpoints(reg)
+    try:
+        failpoints.fire("anything")  # disarmed fast path
+        reg.arm("hot", "error")
+        with pytest.raises(FailpointError):
+            failpoints.fire("hot")
+    finally:
+        failpoints.set_default_failpoints(old)
+
+
+def test_export_reports_schedule_state():
+    reg = FailpointRegistry(seed=5, env=False)
+    reg.arm("a", "delay", delay_ms=1.0, times=None)
+    reg.fire("a")
+    snap = reg.export()
+    assert snap["seed"] == 5
+    assert snap["sites"]["a"]["hits"] == 1
+    assert snap["sites"]["a"]["fired"] == 1
+    reg.clear()
+    assert reg.export() == {"armed": False, "seed": 5, "sites": {}}
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def make_breaker(threshold=3, reset_ms=1000.0):
+    clock = FakeClock()
+    b = CircuitBreaker(
+        failure_threshold=threshold,
+        reset_timeout_ms=reset_ms,
+        name="test",
+        clock=clock,
+    )
+    return b, clock
+
+
+def test_breaker_opens_after_consecutive_failures_only():
+    b, _ = make_breaker(threshold=3)
+    b.record_failure()
+    b.record_failure()
+    b.record_success()  # resets the consecutive count
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()
+    assert b.state == "open"
+    assert not b.allow()
+
+
+def test_breaker_half_open_probe_success_closes():
+    b, clock = make_breaker(threshold=1, reset_ms=1000.0)
+    b.record_failure()
+    assert b.state == "open"
+    assert not b.allow()  # within the reset window: fast-fail
+    clock.now += 1.1
+    assert b.allow()  # the single half-open probe
+    assert b.state == "half_open"
+    assert not b.allow()  # second caller fast-fails while probing
+    b.record_success()
+    assert b.state == "closed"
+    assert b.allow()
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    b, clock = make_breaker(threshold=1, reset_ms=1000.0)
+    b.record_failure()
+    clock.now += 1.1
+    assert b.allow()
+    b.record_failure()
+    assert b.state == "open"
+    assert not b.allow()
+    clock.now += 1.1
+    assert b.allow()  # next window: probe again
+
+
+def test_breaker_vanished_probe_unblocks_after_another_window():
+    b, clock = make_breaker(threshold=1, reset_ms=1000.0)
+    b.record_failure()
+    clock.now += 1.1
+    assert b.allow()  # probe taken, never reports back
+    clock.now += 1.1
+    assert b.allow()  # replacement probe rather than wedging
+
+
+def test_breaker_transitions_notify_listeners():
+    b, clock = make_breaker(threshold=2, reset_ms=100.0)
+    seen = []
+    b.on_transition(lambda old, new: seen.append((old, new)))
+    b.record_failure()
+    b.record_failure()
+    clock.now += 0.2
+    b.allow()
+    b.record_success()
+    assert seen == [
+        ("closed", "open"),
+        ("open", "half_open"),
+        ("half_open", "closed"),
+    ]
+
+
+def test_breaker_export_and_codes():
+    b, clock = make_breaker(threshold=1, reset_ms=1000.0)
+    assert STATE_CODES == {"closed": 0, "half_open": 1, "open": 2}
+    assert b.state_code() == 0
+    b.record_failure()
+    b.allow()
+    b.allow()
+    clock.now += 0.5
+    snap = b.export()
+    assert snap["state"] == "open"
+    assert snap["state_code"] == 2
+    assert snap["opens"] == 1
+    assert snap["fast_fails"] == 2
+    assert snap["open_for_s"] == pytest.approx(0.5)
+    assert snap["failure_threshold"] == 1
+
+
+def test_breaker_threshold_validation():
+    with pytest.raises(ValueError):
+        CircuitBreaker(failure_threshold=0)
+
+
+def test_breaker_is_thread_safe_under_contention():
+    b, _ = make_breaker(threshold=1000)
+
+    def hammer():
+        for _ in range(200):
+            b.allow()
+            b.record_failure()
+            b.record_success()
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert b.state in ("closed", "open")
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint store
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_save_load_roundtrip(tmp_path):
+    store = CheckpointStore(str(tmp_path / "sweep.json"))
+    assert store.load() is None
+    payload = {"round_index": 3, "frontier": [1, 2, 3]}
+    store.save(payload)
+    assert store.load() == payload
+    # The tmp staging file never lingers.
+    assert not os.path.exists(store.path + ".tmp")
+
+
+def test_checkpoint_creates_parent_directories(tmp_path):
+    store = CheckpointStore(str(tmp_path / "a" / "b" / "sweep.json"))
+    store.save({"x": 1})
+    assert store.load() == {"x": 1}
+
+
+def test_checkpoint_corrupt_file_raises_not_silently_restarts(tmp_path):
+    path = tmp_path / "sweep.json"
+    store = CheckpointStore(str(path))
+    store.save({"x": 1})
+    path.write_text(json.dumps({"x": 1})[:-4])  # torn copy
+    with pytest.raises(CheckpointError, match="unreadable checkpoint"):
+        store.load()
+
+
+def test_checkpoint_delete_is_idempotent(tmp_path):
+    store = CheckpointStore(str(tmp_path / "sweep.json"))
+    store.save({"x": 1})
+    store.delete()
+    assert store.load() is None
+    store.delete()  # already gone: no error

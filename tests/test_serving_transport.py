@@ -170,3 +170,89 @@ def test_concurrent_clients_one_server():
             th.join()
         for i in range(8):
             assert results[i] == (b"payload-%d" % i)[::-1]
+
+
+# ---------------------------------------------------------------------------
+# Reconnect accounting and per-call deadline (robustness satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_tcp_reconnect_counted_in_metrics_registry():
+    from distributed_point_functions_tpu.serving.metrics import (
+        MetricsRegistry,
+    )
+
+    handler = lambda data: b"ok:" + data  # noqa: E731
+    metrics = MetricsRegistry()
+    server = FramedTcpServer(handler)
+    server.start()
+    port = server.port
+    t = TcpTransport("localhost", port, metrics=metrics)
+    try:
+        assert t.roundtrip(b"a") == b"ok:a"
+        server.stop()
+        server = FramedTcpServer(handler, port=port)
+        server.start()
+        assert t.roundtrip(b"b") == b"ok:b"
+        assert t.reconnects >= 1
+        counters = metrics.export()["counters"]
+        assert counters["transport.reconnects"] == t.reconnects
+    finally:
+        t.close()
+        server.stop()
+
+
+def test_tcp_stale_reconnect_honors_remaining_deadline():
+    # The transparent reconnect+resend must run inside the SAME
+    # per-call deadline as the original attempt: when a stale pooled
+    # connection surfaces after the budget is gone, the call times out
+    # instead of borrowing a fresh connect_timeout.
+    from distributed_point_functions_tpu.robustness import failpoints
+
+    reg = failpoints.default_failpoints()
+    reg.clear()
+    handler = lambda data: b"ok:" + data  # noqa: E731
+    with FramedTcpServer(handler) as server:
+        t = TcpTransport("localhost", server.port, connect_timeout=5.0)
+        try:
+            assert t.roundtrip(b"a", timeout=1.0) == b"ok:a"
+            # The pooled connection "goes stale" only after the whole
+            # 200 ms budget is burned: a send fault delayed past the
+            # deadline.
+            reg.arm(
+                "transport.tcp.send",
+                "error",
+                times=1,
+                delay_ms=300.0,
+                message="stale pooled connection",
+            )
+            t0 = time.time()
+            with pytest.raises(TransportTimeout, match="no budget remains"):
+                t.roundtrip(b"b", timeout=0.2)
+            elapsed = time.time() - t0
+            # No reconnect happened (nothing left to spend on it) and
+            # the call never borrowed the 5 s connect_timeout.
+            assert t.reconnects == 0
+            assert elapsed < 2.0
+        finally:
+            reg.clear()
+            t.close()
+
+
+def test_tcp_zero_remaining_budget_raises_timeout_not_hang():
+    from distributed_point_functions_tpu.robustness import failpoints
+
+    reg = failpoints.default_failpoints()
+    reg.clear()
+
+    def slow(data):
+        time.sleep(0.15)
+        return b"ok:" + data
+
+    with FramedTcpServer(slow) as server:
+        t = TcpTransport("localhost", server.port)
+        try:
+            with pytest.raises(TransportTimeout):
+                t.roundtrip(b"x", timeout=0.05)
+        finally:
+            t.close()

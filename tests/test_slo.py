@@ -209,3 +209,33 @@ class TestHealthzIntegration:
         ) as admin:
             base = f"http://127.0.0.1:{admin.port}"
             assert _get(base + "/healthz") == (200, "ok\n")
+
+
+class TestBreakerBurnSignal:
+    def test_open_breaker_gauge_breaches_and_recovery_clears(self):
+        # The Leader mirrors its helper-leg breaker into the
+        # `leader.breaker_state` gauge (0 closed / 1 half-open /
+        # 2 open), so a plain gauge_max objective at threshold 0 turns
+        # an open breaker into SLO burn — and a closed one clears it.
+        from distributed_point_functions_tpu.robustness.breaker import (
+            STATE_CODES,
+        )
+
+        reg = MetricsRegistry()
+        gauge = reg.gauge("leader.breaker_state")
+        tracker = SloTracker(
+            [SloObjective(name="helper_breaker", kind="gauge_max",
+                          metric="leader.breaker_state", threshold=0.0)],
+            registry=reg,
+        )
+        gauge.set(float(STATE_CODES["closed"]))
+        (r,) = tracker.evaluate()
+        assert r["state"] == "ok"
+        gauge.set(float(STATE_CODES["open"]))
+        (r,) = tracker.evaluate()
+        assert r["state"] == "breach" and r["observed"] == 2.0
+        assert tracker.breaches()
+        gauge.set(float(STATE_CODES["closed"]))
+        (r,) = tracker.evaluate()
+        assert r["state"] == "ok"
+        assert not tracker.breaches()

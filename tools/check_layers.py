@@ -4,18 +4,21 @@
 Three rules over `distributed_point_functions_tpu/`:
 
 1. **Layer DAG** — `heavy_hitters -> serving -> pir -> ops ->
-   observability`, never the reverse, with restricted layers: the
-   serving runtime may only be imported by `heavy_hitters/` (the one
-   in-library session kind built on it), and `heavy_hitters` itself is
-   application-facing — no library layer imports it (applications —
-   examples/, bench.py, benchmarks/ — may import anything).
-   `observability` sits at the bottom on purpose: every layer may
-   instrument itself (spans, runtime counters, compile/HBM telemetry),
-   but observability — `device.py` and `slo.py` included — imports
-   only `utils/` — never pir/ops/serving — so telemetry can never
-   create an upward edge. Checked over ALL imports, including
-   function-level ones, because a reversed dependency is wrong
-   wherever the import statement sits.
+   observability -> robustness`, never the reverse, with restricted
+   layers: the serving runtime may only be imported by
+   `heavy_hitters/` (the one in-library session kind built on it), and
+   `heavy_hitters` itself is application-facing — no library layer
+   imports it (applications — examples/, bench.py, benchmarks/ — may
+   import anything). `observability` sits near the bottom on purpose:
+   every layer may instrument itself (spans, runtime counters,
+   compile/HBM telemetry), but observability — `device.py` and
+   `slo.py` included — imports only `utils/`, stdlib, and
+   `robustness/` — never pir/ops/serving — so telemetry can never
+   create an upward edge. `robustness` (fault injection, circuit
+   breaker, checkpoints) is the true bottom: stdlib-only, so even the
+   device dispatch bracket can host a failpoint. Checked over ALL
+   imports, including function-level ones, because a reversed
+   dependency is wrong wherever the import statement sits.
 
 2. **No module-level import cycles** — the repo's sanctioned idiom for
    breaking genuine cycles is the function-level import, so only
@@ -45,11 +48,12 @@ ROOT = Path(__file__).resolve().parent.parent
 # layers only. Subpackages not listed are unconstrained by rule 1
 # (but still cycle-checked by rule 2).
 LAYERS = {
-    "heavy_hitters": 5,
-    "serving": 4,
-    "pir": 3,
-    "ops": 2,
-    "observability": 1,
+    "heavy_hitters": 6,
+    "serving": 5,
+    "pir": 4,
+    "ops": 3,
+    "observability": 2,
+    "robustness": 1,
 }
 
 # Restricted layers: importable only from the listed source layers
@@ -198,7 +202,7 @@ def main() -> int:
                 violations.append(
                     f"{module}: imports {name} — reverses the "
                     f"heavy_hitters -> serving -> pir -> ops -> "
-                    f"observability layer DAG"
+                    f"observability -> robustness layer DAG"
                 )
         graph[module] = {
             n for imp in top_imports
