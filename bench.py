@@ -111,6 +111,8 @@ def _default_metric_unit():
         return "heavy_hitters_sweep_lanes_per_sec", "lanes/s"
     if os.environ.get("BENCH_SERVING", "") == "1":
         return "serving_closed_loop_queries_per_sec", "queries/s"
+    if os.environ.get("BENCH_OVERLOAD", "") == "1":
+        return "serving_overload_goodput_queries_per_sec", "queries/s"
     if os.environ.get("BENCH_ONLY_NSLEAF", "") == "1":
         ld = _nsleaf_ld()
         return f"dpf_full_domain_eval_ns_per_leaf_ld{ld}_u64", "ns/leaf"
@@ -740,6 +742,33 @@ def main():
             _emit(
                 0.0, 0.0,
                 error=f"serving bench failed: "
+                f"{str(e).splitlines()[0][:200]}",
+            )
+        return
+
+    if os.environ.get("BENCH_OVERLOAD", "") == "1":
+        # Overload benchmark (BENCH_OVERLOAD=1): offered-load ladder
+        # through cost-aware admission; headline is goodput at the
+        # highest over-capacity point (direction: higher — a drop means
+        # the shed-early contract regressed into queue collapse).
+        # vs_baseline is goodput retention vs the same run's saturation
+        # point. CPU-scale, runs before _ensure_backend like serving.
+        _PROGRESS["stage"] = "overload-bench"
+        try:
+            from benchmarks.overload_bench import run_overload_bench
+
+            report = run_overload_bench()
+            _emit(
+                report["overloaded_goodput_qps"],
+                report["goodput_retention"],
+                error=None
+                if report["correctness_ok"]
+                else "responses under overload diverged from the oracle",
+            )
+        except Exception as e:  # noqa: BLE001 - the JSON line must print
+            _emit(
+                0.0, 0.0,
+                error=f"overload bench failed: "
                 f"{str(e).splitlines()[0][:200]}",
             )
         return

@@ -25,10 +25,16 @@
 #                    row), and a heavy-hitters sweep killed mid-run
 #                    must resume from its checkpoint to the plaintext
 #                    answer
-#   9. perf-gate   — benchmarks/regression_gate.py --check-only against
+#   9. overload-smoke — synthetic burst against cost-aware admission:
+#                    a tiny tenant quota must shed at admission with a
+#                    typed RetryAfter hint (never reaching the batcher),
+#                    and a breaching SLO signal must walk the brownout
+#                    ladder to critical_only (visible on /statusz) and
+#                    fully auto-revert when the signal clears
+#  10. perf-gate   — benchmarks/regression_gate.py --check-only against
 #                    the committed history fixture (CPU-safe: judges
 #                    records, runs no bench)
-#  10. dryrun      — 8-virtual-device multichip compile+step
+#  11. dryrun      — 8-virtual-device multichip compile+step
 # Benchmarks are excluded exactly as the reference excludes
 # `--test_tag_filters=-benchmark`. `FULL=1` appends the whole suite.
 set -u -o pipefail
@@ -251,6 +257,84 @@ assert counters["hh.rounds"] == 1, counters  # only the killed round re-ran
 assert not os.path.exists(ckpt)  # deleted on completion
 print("chaos-smoke: OK (breaker-open fast-fail <1 ms + /statusz row, "
       "sweep resumed from checkpoint and matched plaintext)")
+'
+
+stage overload-smoke env JAX_PLATFORMS=cpu python -c '
+import urllib.request
+import numpy as np
+from distributed_point_functions_tpu.capacity import (
+    BrownoutController, TenantPolicy,
+)
+from distributed_point_functions_tpu.observability import AdminServer
+from distributed_point_functions_tpu.pir import (
+    DenseDpfPirClient, DenseDpfPirDatabase,
+)
+from distributed_point_functions_tpu.serving import (
+    Overloaded, PlainSession, ServingConfig,
+)
+
+builder = DenseDpfPirDatabase.Builder()
+rng = np.random.default_rng(1)
+for _ in range(16):
+    builder.insert(bytes(rng.integers(0, 256, 8, dtype=np.uint8)))
+db = builder.build()
+config = ServingConfig(
+    max_batch_size=4, max_wait_ms=1.0, admission_enabled=True
+)
+client = DenseDpfPirClient.create(16, lambda pt, ci: pt)
+request = client.create_plain_requests([3])[0]
+with PlainSession(db, config) as session:
+    want = session.handle_request(request).dpf_pir_response.masked_response
+
+    # --- synthetic burst: a tiny tenant quota must shed at admission
+    # with a typed RetryAfter hint, before any batching/evaluation. ----
+    session.set_tenant("burst", TenantPolicy(rate_qps=1.0, burst=1.0))
+    got = session.handle_request(request, tenant="burst")
+    assert got.dpf_pir_response.masked_response == want
+    hint = None
+    sheds = 0
+    for _ in range(5):
+        try:
+            session.handle_request(request, tenant="burst")
+            raise AssertionError("burst past the quota was admitted")
+        except Overloaded as e:
+            sheds += 1
+            hint = e
+    assert hint.retry_after_s > 0 and hint.reason == "quota", vars(hint)
+    counters = session.metrics.export()["counters"]
+    assert counters["plain.admission.shed{reason=quota}"] == sheds, counters
+
+    # --- brownout: a breaching signal walks the ladder to the top,
+    # shows on /statusz, and fully auto-reverts once healthy. ----------
+    breaching = {"v": True}
+    brown = BrownoutController(
+        signal=lambda: breaching["v"],
+        engage_after_s=0.0, escalate_after_s=0.0, revert_after_s=0.0,
+        metrics=session.metrics,
+    )
+    session.attach_brownout(brown, batch_cap=2, cheap_tier="streaming")
+    for _ in range(4):
+        brown.evaluate()
+    assert brown.export()["level"] == 4, brown.export()
+    with AdminServer(registry=session.metrics, brownout=brown,
+                     admission=session.admission) as admin:
+        statusz = urllib.request.urlopen(
+            f"http://127.0.0.1:{admin.port}/statusz"
+        ).read().decode()
+        for needle in ("Brownout ladder", "critical_only",
+                       "Admission", "burst"):
+            assert needle in statusz, needle
+    breaching["v"] = False
+    for _ in range(4):
+        brown.evaluate()
+    assert brown.export()["level"] == 0, brown.export()
+    # Knobs restored: the default tenant (shed at critical_only) serves
+    # again, bit-identical.
+    got = session.handle_request(request).dpf_pir_response.masked_response
+    assert got == want
+print("overload-smoke: OK (quota burst shed at admission with "
+      f"RetryAfter={hint.retry_after_s:.2f}s, brownout ladder walked "
+      "to critical_only on /statusz and fully reverted)")
 '
 
 stage perf-gate python -m benchmarks.regression_gate --check-only \

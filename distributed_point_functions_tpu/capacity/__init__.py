@@ -1,0 +1,65 @@
+"""One capacity model for every budgeted workload, and the admission
+control built on it.
+
+Before this package, the HBM/cost knowledge that decides how work is
+served lived in two hand-rolled copies: `pir/planner.py`'s
+selection-bytes budget and `heavy_hitters/aggregator.plan_level`'s
+frontier-bytes budget — and the serving batcher's admission bound
+(`max_queue=256`) knew nothing about what a request *costs*. This
+package is the single serving brain:
+
+* `model` — `CapacityModel`: prices any admitted unit of work (a dense
+  PIR batch at a given tier/shape, a heavy-hitters level chunk) in peak
+  HBM bytes and estimated device-milliseconds, calibrated from the
+  measured per-tier throughput in `benchmarks/results/history.jsonl`.
+  `pir/planner.py` and `heavy_hitters/aggregator.py` are thin clients:
+  neither contains byte-budget arithmetic of its own.
+* `admission` — cost-aware admission control for the serving batcher:
+  per-tenant token-bucket quotas, a weighted-fair queue so one hot
+  tenant cannot starve the rest, and shed-early (estimated queue drain
+  time vs. request deadline -> `RetryAfter` hint) instead of queuing
+  doomed work.
+* `brownout` — an escalating, auto-reverting ladder of load-shedding
+  steps driven by SLO burn states (shed low-priority tenants -> cap
+  batch sizes -> force cheaper serving tiers -> reject non-critical
+  traffic), every transition exported for `/statusz` and traced.
+
+Layering (`tools/check_layers.py`): capacity sits *below* pir, serving,
+and heavy_hitters (all three consume it) and *above* ops/observability/
+robustness — it never imports a workload.
+"""
+
+from .admission import (
+    AdmissionController,
+    AdmissionDecision,
+    ShedReason,
+    TenantPolicy,
+    TokenBucket,
+    WeightedFairQueue,
+)
+from .brownout import BROWNOUT_STEPS, BrownoutController
+from .model import (
+    CapacityModel,
+    LevelChunking,
+    ThroughputCalibration,
+    WorkCost,
+    default_capacity_model,
+    set_default_capacity_model,
+)
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionDecision",
+    "BROWNOUT_STEPS",
+    "BrownoutController",
+    "CapacityModel",
+    "LevelChunking",
+    "ShedReason",
+    "TenantPolicy",
+    "ThroughputCalibration",
+    "TokenBucket",
+    "WeightedFairQueue",
+    "WorkCost",
+    "default_capacity_model",
+    "set_default_capacity_model",
+]

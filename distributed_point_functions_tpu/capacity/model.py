@@ -1,0 +1,438 @@
+"""The unified capacity model: bytes and device-milliseconds for any
+admitted unit of work.
+
+Two planners used to carry private copies of the same HBM arithmetic:
+
+* dense PIR (`pir/planner.py`): selection-attributable bytes per tier —
+  materialized ``num_keys * eff_blocks * 16``, streaming
+  ``num_keys * 16 * (2**cut_levels + 2 * 2**chunk_levels)`` (cut-state
+  plus a double-buffered chunk), chunked
+  ``num_keys * 2**chunk_expand_levels * 16`` — against the
+  ``DPF_TPU_SELECTION_BYTES_BUDGET`` budget (default 1 GiB);
+* heavy hitters (`heavy_hitters/aggregator.plan_level`): per-lane live
+  bytes ``16 * (walk_levels + value_blocks + 3)`` against the
+  ``DPF_TPU_HH_BYTES_BUDGET`` budget (default 256 MiB).
+
+Those formulas now live HERE, once, as methods of `CapacityModel`;
+the planners are thin clients. On top of the byte model the capacity
+model adds a *time* model: measured per-tier throughput (loaded from
+the perf-gate trajectory `benchmarks/results/history.jsonl`, newest
+clean record per metric, conservative built-in fallbacks when no
+history exists) prices work in estimated device-milliseconds — the
+quantity serving admission reasons about (estimated queue drain time
+vs. request deadline, see `capacity/admission.py`).
+
+Environment knobs: ``DPF_TPU_SELECTION_BYTES_BUDGET``,
+``DPF_TPU_HH_BYTES_BUDGET`` (byte budgets, unchanged semantics),
+``DPF_TPU_DEVICE_MEMORY_BYTES`` (pins the device memory the budgets
+derive from when no explicit budget is set),
+``DPF_TPU_CAPACITY_HISTORY`` (alternate history.jsonl path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import threading
+from typing import Dict, Optional
+
+_SELECTION_BLOCK_BYTES = 16
+_HH_BLOCK_BYTES = 16
+_DEFAULT_SELECTION_BUDGET = 1 << 30  # 1 GiB
+_DEFAULT_FRONTIER_BUDGET = 1 << 28  # 256 MiB
+# When no explicit budget or env override is given but the device
+# memory is known, selection tensors get 1/16 of it (the database, cut
+# states, and runtime scratch share the rest) and the heavy-hitters
+# frontier 1/64 — chosen so a 16 GiB v5e chip derives exactly the
+# historical fixed defaults (1 GiB / 256 MiB).
+_SELECTION_MEMORY_FRACTION = 16
+_FRONTIER_MEMORY_FRACTION = 64
+
+# Conservative built-in throughput fallbacks, used only when the
+# history store has no clean record for the metric. Sourced from the
+# committed TPU v5e captures (see ROADMAP "Recent"), derated 2x so an
+# uncalibrated process over-sheds rather than over-admits.
+_FALLBACK_THROUGHPUT = {
+    "serving_closed_loop_queries_per_sec": 1300.0,
+    "heavy_hitters_sweep_lanes_per_sec": 950_000.0,
+}
+
+# History metrics that calibrate each unit of work.
+_SERVING_QPS_METRIC = "serving_closed_loop_queries_per_sec"
+_HH_LANES_METRIC = "heavy_hitters_sweep_lanes_per_sec"
+
+
+def _env_int(name: str) -> Optional[int]:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return None
+
+
+def default_history_path() -> str:
+    """The perf-gate trajectory this repo commits; overridable for
+    deployments whose history lives elsewhere."""
+    env = os.environ.get("DPF_TPU_CAPACITY_HISTORY", "").strip()
+    if env:
+        return env
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    return os.path.join(repo_root, "benchmarks", "results", "history.jsonl")
+
+
+class ThroughputCalibration:
+    """Measured per-metric throughput from the bench history store.
+
+    Reads `history.jsonl` once, lazily, keeping the newest *clean*
+    (`status == "ok"`, finite value) record per metric — the same
+    cleanliness rule the regression gate applies. Missing file,
+    malformed lines, and absent metrics all degrade to the built-in
+    conservative fallbacks; calibration must never take serving down.
+    """
+
+    def __init__(self, history_path: Optional[str] = None):
+        self._path = history_path or default_history_path()
+        self._lock = threading.Lock()
+        self._loaded = False
+        self._newest: Dict[str, float] = {}
+
+    def _load(self) -> None:
+        with self._lock:
+            if self._loaded:
+                return
+            self._loaded = True
+            try:
+                with open(self._path) as f:
+                    lines = f.readlines()
+            except OSError:
+                return
+            for line in lines:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                value = rec.get("value")
+                if (
+                    rec.get("status", "ok") == "ok"
+                    and isinstance(value, (int, float))
+                    and math.isfinite(float(value))
+                    and float(value) > 0
+                ):
+                    # File order is append order: last clean wins.
+                    self._newest[str(rec.get("metric"))] = float(value)
+
+    def lookup(self, metric: str) -> Optional[float]:
+        """Newest clean measurement for `metric`, or None."""
+        self._load()
+        return self._newest.get(metric)
+
+    def throughput(self, metric: str, fallback: float) -> float:
+        value = self.lookup(metric)
+        return value if value is not None else fallback
+
+    def export(self) -> dict:
+        self._load()
+        return {
+            "history_path": self._path,
+            "calibrated_metrics": dict(sorted(self._newest.items())),
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkCost:
+    """Price of one admitted unit of work."""
+
+    bytes_peak: int  # modeled peak live HBM bytes while it runs
+    device_ms: float  # estimated device milliseconds to serve it
+    quantity: int  # how many atoms (keys, lanes) it contains
+    unit: str  # "pir_keys" | "hh_lanes"
+
+
+@dataclasses.dataclass(frozen=True)
+class LevelChunking:
+    """Resolved prefix chunking for one heavy-hitters level."""
+
+    chunk_prefixes: int  # power of two
+    num_chunks: int
+    lane_bytes: int
+    bytes_peak: int  # modeled peak for one chunk
+    budget_bytes: int
+
+
+class CapacityModel:
+    """Prices any admitted unit of work in bytes and device-ms.
+
+    One instance per process is the normal deployment
+    (`default_capacity_model()`); tests construct their own with pinned
+    budgets and calibration so the model is deterministic.
+    """
+
+    def __init__(
+        self,
+        device_memory_bytes: Optional[int] = None,
+        selection_budget: Optional[int] = None,
+        frontier_budget: Optional[int] = None,
+        calibration: Optional[ThroughputCalibration] = None,
+    ):
+        if device_memory_bytes is None:
+            device_memory_bytes = _env_int("DPF_TPU_DEVICE_MEMORY_BYTES")
+        if device_memory_bytes is None:
+            from ..observability.device import (
+                device_memory_bytes as probe_device_memory,
+            )
+
+            # None on CPU/uninitialized JAX: the byte budgets then fall
+            # back to their historical fixed defaults.
+            device_memory_bytes = probe_device_memory()
+        self._device_memory = device_memory_bytes
+        self._selection_budget = selection_budget
+        self._frontier_budget = frontier_budget
+        self.calibration = (
+            calibration if calibration is not None else ThroughputCalibration()
+        )
+
+    # -- budgets -------------------------------------------------------------
+
+    @property
+    def device_memory_bytes(self) -> Optional[int]:
+        return self._device_memory
+
+    def selection_budget_bytes(self) -> int:
+        """HBM budget for selection-attributable tensors: env override,
+        then explicit construction, then a fraction of known device
+        memory, then the historical 1 GiB default."""
+        env = _env_int("DPF_TPU_SELECTION_BYTES_BUDGET")
+        if env is not None:
+            return env
+        if self._selection_budget is not None:
+            return max(1, int(self._selection_budget))
+        if self._device_memory is not None:
+            return max(1, self._device_memory // _SELECTION_MEMORY_FRACTION)
+        return _DEFAULT_SELECTION_BUDGET
+
+    def frontier_budget_bytes(self) -> int:
+        """Byte budget for one fused heavy-hitters level evaluation."""
+        env = _env_int("DPF_TPU_HH_BYTES_BUDGET")
+        if env is not None:
+            return env
+        if self._frontier_budget is not None:
+            return max(1, int(self._frontier_budget))
+        if self._device_memory is not None:
+            return max(1, self._device_memory // _FRONTIER_MEMORY_FRACTION)
+        return _DEFAULT_FRONTIER_BUDGET
+
+    # -- dense-PIR selection bytes (the pir/planner byte model) --------------
+
+    def materialized_selection_bytes(
+        self, num_keys: int, eff_blocks: int
+    ) -> int:
+        """Full selection matrix live at once: one 128-bit block per
+        (key, block)."""
+        return num_keys * eff_blocks * _SELECTION_BLOCK_BYTES
+
+    def streaming_selection_bytes(
+        self, num_keys: int, cut_levels: int, chunk_levels: int
+    ) -> int:
+        """Cut-state seeds for the whole scan plus one double-buffered
+        chunk's selections (factor 2: XLA prefetches the next database
+        span while the current chunk multiplies)."""
+        return num_keys * _SELECTION_BLOCK_BYTES * (
+            (1 << cut_levels) + 2 * (1 << chunk_levels)
+        )
+
+    def chunked_selection_bytes(
+        self, num_keys: int, chunk_expand_levels: int
+    ) -> int:
+        """Legacy chunked loop: one chunk's selections at a time."""
+        return num_keys * (1 << chunk_expand_levels) * _SELECTION_BLOCK_BYTES
+
+    def pick_streaming_split(
+        self,
+        num_keys: int,
+        expand_levels: int,
+        budget_bytes: Optional[int] = None,
+    ) -> int:
+        """Largest chunk_levels whose modeled streaming peak fits the
+        budget (bigger chunks amortize per-step overhead); if no split
+        fits, the peak-minimizing split (near
+        ``(expand_levels - 1) / 2``)."""
+        budget = (
+            self.selection_budget_bytes()
+            if budget_bytes is None
+            else budget_bytes
+        )
+        feasible = [
+            r
+            for r in range(expand_levels + 1)
+            if self.streaming_selection_bytes(num_keys, expand_levels - r, r)
+            <= budget
+        ]
+        if feasible:
+            return max(feasible)
+        return min(
+            range(expand_levels + 1),
+            key=lambda r: self.streaming_selection_bytes(
+                num_keys, expand_levels - r, r
+            ),
+        )
+
+    def pick_chunked_expand_levels(
+        self,
+        num_keys: int,
+        expand_levels: int,
+        granule_levels: int,
+        budget_bytes: Optional[int] = None,
+    ) -> int:
+        """Largest chunk_expand_levels (capped at the MXU-friendly
+        granule) whose one-chunk selections fit the budget; floor 0."""
+        budget = (
+            self.selection_budget_bytes()
+            if budget_bytes is None
+            else budget_bytes
+        )
+        cel = min(expand_levels, granule_levels)
+        while cel > 0 and self.chunked_selection_bytes(num_keys, cel) > budget:
+            cel -= 1
+        return cel
+
+    # -- heavy-hitters frontier bytes (the aggregator byte model) ------------
+
+    def hh_lane_bytes(self, walk_levels: int, value_blocks: int) -> int:
+        """Modeled live bytes per (key, prefix) lane of one fused level:
+        the walk state, the repeated correction words for the levels
+        walked, the path, and the leaf value blocks (+3 covers seeds
+        in/out and the path)."""
+        return _HH_BLOCK_BYTES * (walk_levels + value_blocks + 3)
+
+    def plan_hh_level(
+        self,
+        num_keys: int,
+        num_prefixes: int,
+        walk_levels: int,
+        value_blocks: int,
+        budget_bytes: Optional[int] = None,
+    ) -> LevelChunking:
+        """Largest power-of-two prefix chunk whose modeled bytes fit
+        the budget (bigger chunks amortize dispatch); floor of one
+        prefix. Chunked evaluation is bit-identical to unchunked
+        because lanes are independent."""
+        budget = (
+            self.frontier_budget_bytes()
+            if budget_bytes is None
+            else budget_bytes
+        )
+        lb = self.hh_lane_bytes(walk_levels, value_blocks)
+        chunk = 1 << max(0, (max(1, num_prefixes) - 1).bit_length())
+        while chunk > 1 and num_keys * chunk * lb > budget:
+            chunk //= 2
+        return LevelChunking(
+            chunk_prefixes=chunk,
+            num_chunks=-(-num_prefixes // chunk),
+            lane_bytes=lb,
+            bytes_peak=num_keys * chunk * lb,
+            budget_bytes=budget,
+        )
+
+    # -- time model ----------------------------------------------------------
+
+    def serving_queries_per_sec(self) -> float:
+        """Calibrated end-to-end serving throughput (queries/s) — the
+        denominator of admission's queue-drain estimate."""
+        return self.calibration.throughput(
+            _SERVING_QPS_METRIC,
+            _FALLBACK_THROUGHPUT[_SERVING_QPS_METRIC],
+        )
+
+    def hh_lanes_per_sec(self) -> float:
+        return self.calibration.throughput(
+            _HH_LANES_METRIC, _FALLBACK_THROUGHPUT[_HH_LANES_METRIC]
+        )
+
+    def price_pir_keys(
+        self, num_keys: int, num_blocks: Optional[int] = None
+    ) -> WorkCost:
+        """Price a serving request of `num_keys` DPF keys. The byte
+        peak assumes the materialized tier when the database geometry
+        is known (the most HBM-hungry tier the planner could pick);
+        device-ms comes from calibrated serving throughput."""
+        qps = max(1e-6, self.serving_queries_per_sec())
+        return WorkCost(
+            bytes_peak=(
+                self.materialized_selection_bytes(num_keys, num_blocks)
+                if num_blocks
+                else 0
+            ),
+            device_ms=num_keys * 1e3 / qps,
+            quantity=num_keys,
+            unit="pir_keys",
+        )
+
+    def price_hh_level(
+        self,
+        num_keys: int,
+        num_prefixes: int,
+        walk_levels: int,
+        value_blocks: int,
+    ) -> WorkCost:
+        """Price one heavy-hitters level chunk (`num_keys x
+        num_prefixes` lanes)."""
+        chunking = self.plan_hh_level(
+            num_keys, num_prefixes, walk_levels, value_blocks
+        )
+        lanes = num_keys * num_prefixes
+        lps = max(1e-6, self.hh_lanes_per_sec())
+        return WorkCost(
+            bytes_peak=chunking.bytes_peak,
+            device_ms=lanes * 1e3 / lps,
+            quantity=lanes,
+            unit="hh_lanes",
+        )
+
+    def export(self) -> dict:
+        """The /statusz view of the model."""
+        return {
+            "device_memory_bytes": self._device_memory,
+            "selection_budget_bytes": self.selection_budget_bytes(),
+            "frontier_budget_bytes": self.frontier_budget_bytes(),
+            "serving_queries_per_sec": round(
+                self.serving_queries_per_sec(), 2
+            ),
+            "hh_lanes_per_sec": round(self.hh_lanes_per_sec(), 2),
+            "calibration": self.calibration.export(),
+        }
+
+
+_default_model: Optional[CapacityModel] = None
+_default_lock = threading.Lock()
+
+
+def default_capacity_model() -> CapacityModel:
+    """The process-wide model every planner delegates to by default."""
+    global _default_model
+    with _default_lock:
+        if _default_model is None:
+            _default_model = CapacityModel()
+        return _default_model
+
+
+def set_default_capacity_model(
+    model: Optional[CapacityModel],
+) -> Optional[CapacityModel]:
+    """Swap the process-wide model (tests; None restores lazy default).
+    Returns the previous model."""
+    global _default_model
+    with _default_lock:
+        previous = _default_model
+        _default_model = model
+        return previous

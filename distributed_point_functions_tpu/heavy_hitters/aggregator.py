@@ -8,19 +8,15 @@ cached by level ℓ−1 so each level only hashes the newly revealed tree
 levels — never re-expanding from the root.
 
 Like `pir/planner.py` for dense PIR, an explicit byte-budget model
-decides how the `keys x frontier` product is served. Per lane
-(key, prefix) the fused program holds the walk state, the repeated
-correction words for the levels walked, the path, and the leaf value
-blocks:
-
-    lane_bytes = 16 * (walk_levels + value_blocks + 3)
-
-(16 bytes per 128-bit block; +3 covers seeds in/out and the path). The
-planner picks the largest power-of-two prefix-chunk width whose
-`num_keys * chunk * lane_bytes` fits the budget
-(`DPF_TPU_HH_BYTES_BUDGET`, default 256 MiB) and the aggregator runs
-the frontier through it chunk by chunk — chunked evaluation is
-bit-identical to the unchunked program because lanes are independent.
+decides how the `keys x frontier` product is served — and like the
+planner, the arithmetic itself lives in :mod:`..capacity.model`
+(`CapacityModel.hh_lane_bytes` / `plan_hh_level`): the largest
+power-of-two prefix-chunk width whose `num_keys * chunk * lane_bytes`
+fits the budget (`DPF_TPU_HH_BYTES_BUDGET`, default 256 MiB), where
+`lane_bytes = 16 * (walk_levels + value_blocks + 3)`. The aggregator
+runs the frontier through the resolved chunking chunk by chunk —
+chunked evaluation is bit-identical to the unchunked program because
+lanes are independent.
 
 The per-key-per-prefix share sums reduce over the key axis on device;
 with a `jax.sharding.Mesh` the reduction (and, under GSPMD, the AES
@@ -31,39 +27,26 @@ walk feeding it) shards over keys via
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..capacity.model import default_capacity_model
 from ..dpf import BatchCutState, DistributedPointFunction
 from ..observability.device import default_telemetry, shape_key
 from ..value_types import IntType
 
-_DEFAULT_BUDGET_BYTES = 1 << 28  # 256 MiB
-_BLOCK_BYTES = 16
-
 
 def frontier_budget_bytes() -> int:
-    """Byte budget for one fused level evaluation, from the env."""
-    raw = os.environ.get("DPF_TPU_HH_BYTES_BUDGET", "").strip()
-    if raw:
-        try:
-            return max(1, int(raw))
-        except ValueError:
-            pass
-    return _DEFAULT_BUDGET_BYTES
-
-
-def _next_pow2(n: int) -> int:
-    return 1 << max(0, (n - 1).bit_length())
+    """Byte budget for one fused level evaluation (capacity model)."""
+    return default_capacity_model().frontier_budget_bytes()
 
 
 def lane_bytes(walk_levels: int, value_blocks: int) -> int:
     """Modeled live bytes per (key, prefix) lane of one fused level."""
-    return _BLOCK_BYTES * (walk_levels + value_blocks + 3)
+    return default_capacity_model().hh_lane_bytes(walk_levels, value_blocks)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -87,23 +70,21 @@ def plan_level(
     value_blocks: int,
     budget_bytes: Optional[int] = None,
 ) -> LevelPlan:
-    """Largest power-of-two prefix chunk whose modeled bytes fit the
-    budget (bigger chunks amortize dispatch); floor of one prefix."""
-    budget = frontier_budget_bytes() if budget_bytes is None else budget_bytes
-    lb = lane_bytes(walk_levels, value_blocks)
-    chunk = _next_pow2(max(1, num_prefixes))
-    while chunk > 1 and num_keys * chunk * lb > budget:
-        chunk //= 2
-    num_chunks = -(-num_prefixes // chunk)
+    """Thin client of `CapacityModel.plan_hh_level`: largest
+    power-of-two prefix chunk whose modeled bytes fit the budget."""
+    chunking = default_capacity_model().plan_hh_level(
+        num_keys, num_prefixes, walk_levels, value_blocks,
+        budget_bytes=budget_bytes,
+    )
     return LevelPlan(
         num_keys=num_keys,
         num_prefixes=num_prefixes,
         walk_levels=walk_levels,
-        chunk_prefixes=chunk,
-        num_chunks=num_chunks,
-        lane_bytes=lb,
-        bytes_peak=num_keys * chunk * lb,
-        budget_bytes=budget,
+        chunk_prefixes=chunking.chunk_prefixes,
+        num_chunks=chunking.num_chunks,
+        lane_bytes=chunking.lane_bytes,
+        bytes_peak=chunking.bytes_peak,
+        budget_bytes=chunking.budget_bytes,
     )
 
 

@@ -80,6 +80,8 @@ class AdminServer:
         phases: Optional[PhaseRecorder] = None,
         autoprofiler=None,
         breakers=None,
+        brownout=None,
+        admission=None,
     ):
         self._registry = registry
         self._recorder = (
@@ -104,6 +106,12 @@ class AdminServer:
         # via a small adapter). Opt-in; /statusz grows a "Circuit
         # breakers" section when present.
         self._breakers = breakers
+        # brownout (`capacity.BrownoutController`) and admission
+        # (`capacity.AdmissionController`) are duck-typed
+        # (`export() -> dict`) and opt-in; /statusz grows a "Brownout
+        # ladder" needle and a per-tenant admission table when present.
+        self._brownout = brownout
+        self._admission = admission
         self._name = name
         self._profile_dir = profile_dir
         self._profile_lock = threading.Lock()
@@ -248,6 +256,16 @@ class AdminServer:
                     for name, breaker in self._breakers.items()
                 }
                 if self._breakers
+                else None
+            ),
+            "brownout": (
+                self._brownout.export()
+                if self._brownout is not None
+                else None
+            ),
+            "admission": (
+                self._admission.export()
+                if self._admission is not None
                 else None
             ),
         }
@@ -419,6 +437,60 @@ def _render_statusz(state: dict) -> str:
                 f"<td>{'-' if degraded is None else degraded}</td></tr>"
             )
         out.append("</table>")
+
+    brownout = state.get("brownout")
+    if brownout is not None:
+        out.append("<h2>Brownout ladder</h2>")
+        level, max_level = brownout["level"], brownout["max_level"]
+        cls = "ok" if level == 0 else "breach"
+        needle = " &rarr; ".join(
+            f"<b>[{esc(s)}]</b>" if i < level else esc(s)
+            for i, s in enumerate(brownout["ladder"])
+        )
+        out.append(
+            f"<p class={cls}>level {level}/{max_level}: {needle}</p>"
+        )
+        if brownout["transitions"]:
+            out.append(
+                "<table><tr><th>when (unix)</th><th>step</th>"
+                "<th>action</th><th>level after</th></tr>"
+            )
+            for t in brownout["transitions"][-16:]:
+                t_cls = "breach" if t["action"] == "engage" else "ok"
+                out.append(
+                    f"<tr class={t_cls}><td>{round(t['wall_time'], 1)}</td>"
+                    f"<td>{esc(t['step'])}</td><td>{esc(t['action'])}</td>"
+                    f"<td>{t['level_after']}</td></tr>"
+                )
+            out.append("</table>")
+        else:
+            out.append("<p class=nodata>no transitions yet</p>")
+
+    admission = state.get("admission")
+    if admission is not None:
+        out.append("<h2>Admission (cost-aware)</h2>")
+        out.append(
+            f"<p>queued cost: {admission['outstanding_ms']} ms of "
+            f"{admission['queue_budget_ms']} ms budget; priority floor: "
+            f"{admission['min_priority']}</p>"
+        )
+        if admission["tenants"]:
+            out.append(
+                "<table><tr><th>tenant</th><th>weight</th>"
+                "<th>priority</th><th>rate (keys/s)</th><th>tokens</th>"
+                "<th>admitted</th><th>shed</th></tr>"
+            )
+            for tenant, row in admission["tenants"].items():
+                out.append(
+                    f"<tr><td>{esc(str(tenant))}</td>"
+                    f"<td>{row['weight']}</td><td>{row['priority']}</td>"
+                    f"<td>{row['rate_qps'] if row['rate_qps'] is not None else '-'}</td>"
+                    f"<td>{row['tokens'] if row['tokens'] is not None else '-'}</td>"
+                    f"<td>{row['admitted']}</td><td>{row['shed']}</td></tr>"
+                )
+            out.append("</table>")
+        else:
+            out.append("<p class=nodata>no tenants seen yet</p>")
 
     waterfall = state.get("phases") or {}
     out.append("<h2>Phase waterfall</h2>")

@@ -56,6 +56,7 @@ __all__ = [
     "TransferLedger",
     "DeviceTelemetry",
     "default_telemetry",
+    "device_memory_bytes",
     "set_default_telemetry",
     "shape_key",
     "install_jax_monitoring_listener",
@@ -292,6 +293,22 @@ def _live_bytes() -> Tuple[int, str]:
         )
     except Exception:
         return 0, "unavailable"
+
+
+def device_memory_bytes() -> Optional[int]:
+    """Total memory of the default device (`memory_stats()`'s
+    `bytes_limit`), or None when the backend does not report one (CPU,
+    uninitialized JAX). The capacity model seeds its byte budgets from
+    this when no explicit budget or env override is given."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if stats and "bytes_limit" in stats:
+        return int(stats["bytes_limit"])
+    return None
 
 
 class HbmAccountant:

@@ -14,6 +14,15 @@ Wire layout (all integers big-endian):
     response = MAGIC(4) | u8 version | u8 kind=2 | u32 meta_len
              | meta JSON (trace_id, server_ms, spans)
              | u32 inner_len | inner proto bytes
+    error    = MAGIC(4) | u8 version | u8 kind=3 | u32 meta_len
+             | meta JSON (trace_id, error_type, message, retry_after_s)
+
+The error kind is additive under version 1: it carries typed refusals
+(today `overloaded`, with the server's `retry_after_s` drain hint)
+instead of the generic dropped-connection transport fault, so a Leader
+can distinguish "Helper is shedding load, back off this much" from
+"Helper is dead". `try_decode_response` raises it as
+`WireErrorResponse`; peers that never send envelopes never see it.
 
 **Old-peer interop is by construction + detection, not negotiation.**
 MAGIC starts with byte 0xFF: as a protobuf tag that is field 31 with
@@ -35,6 +44,8 @@ from typing import Optional, Tuple
 __all__ = [
     "EnvelopeError",
     "PROPAGATION_VERSION",
+    "WireErrorResponse",
+    "encode_error",
     "encode_request",
     "try_decode_request",
     "encode_response",
@@ -46,6 +57,8 @@ _MAGIC = b"\xffDPT"
 PROPAGATION_VERSION = 1
 _KIND_REQUEST = 1
 _KIND_RESPONSE = 2
+_KIND_ERROR = 3
+
 
 _HEAD = struct.Struct(">4sBB")
 _LEN = struct.Struct(">I")
@@ -53,6 +66,24 @@ _LEN = struct.Struct(">I")
 
 class EnvelopeError(ValueError):
     """Magic matched but the envelope is malformed or unsupported."""
+
+
+class WireErrorResponse(RuntimeError):
+    """The peer answered with a typed error envelope (kind 3) instead
+    of a result. `error_type` is a stable string (`"overloaded"`),
+    `retry_after_s` the peer's backoff hint (0 = none given)."""
+
+    def __init__(
+        self,
+        error_type: str,
+        message: str = "",
+        retry_after_s: float = 0.0,
+        trace_id: Optional[str] = None,
+    ):
+        super().__init__(message or error_type)
+        self.error_type = error_type
+        self.retry_after_s = retry_after_s
+        self.trace_id = trace_id
 
 
 def encode_request(trace_id: str, inner: bytes) -> bytes:
@@ -116,9 +147,34 @@ def encode_response(
     )
 
 
+def encode_error(
+    error_type: str,
+    message: str = "",
+    retry_after_s: float = 0.0,
+    trace_id: Optional[str] = None,
+) -> bytes:
+    """Typed refusal reply (kind 3): the peer decodes it back into a
+    `WireErrorResponse` via `try_decode_response`."""
+    meta = json.dumps(
+        {
+            "trace_id": trace_id,
+            "error_type": str(error_type),
+            "message": str(message)[:512],
+            "retry_after_s": round(max(0.0, float(retry_after_s)), 6),
+        },
+        separators=(",", ":"),
+    ).encode()
+    return (
+        _HEAD.pack(_MAGIC, PROPAGATION_VERSION, _KIND_ERROR)
+        + _LEN.pack(len(meta))
+        + meta
+    )
+
+
 def try_decode_response(payload: bytes) -> Tuple[Optional[dict], bytes]:
     """-> (meta | None, inner bytes). No magic: a bare proto reply from
-    an old-version Helper, returned untouched."""
+    an old-version Helper, returned untouched. A kind-3 error envelope
+    raises `WireErrorResponse`."""
     if not payload.startswith(_MAGIC):
         return None, payload
     if len(payload) < _HEAD.size + _LEN.size:
@@ -126,6 +182,21 @@ def try_decode_response(payload: bytes) -> Tuple[Optional[dict], bytes]:
     _, version, kind = _HEAD.unpack_from(payload)
     if version != PROPAGATION_VERSION:
         raise EnvelopeError(f"unsupported envelope version {version}")
+    if kind == _KIND_ERROR:
+        (meta_len,) = _LEN.unpack_from(payload, _HEAD.size)
+        meta_end = _HEAD.size + _LEN.size + meta_len
+        if len(payload) < meta_end:
+            raise EnvelopeError("truncated error envelope meta")
+        try:
+            meta = json.loads(payload[_HEAD.size + _LEN.size:meta_end])
+        except ValueError as e:
+            raise EnvelopeError(f"bad error envelope meta: {e}") from e
+        raise WireErrorResponse(
+            str(meta.get("error_type", "unknown")),
+            message=str(meta.get("message", "")),
+            retry_after_s=float(meta.get("retry_after_s", 0.0)),
+            trace_id=meta.get("trace_id"),
+        )
     if kind != _KIND_RESPONSE:
         raise EnvelopeError(f"unexpected envelope kind {kind}")
     (meta_len,) = _LEN.unpack_from(payload, _HEAD.size)

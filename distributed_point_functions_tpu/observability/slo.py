@@ -234,6 +234,19 @@ class SloTracker:
                 if r["state"] == "breach" and r["severity"] == "hard"
             ]
 
+    def burn_summary(self) -> dict:
+        """Fresh compact burn view (drives the brownout ladder and the
+        /statusz needle): whether any hard objective is burning, the
+        longest continuous burn, and the burning objective names."""
+        breaching = self.breaches(evaluate=True)
+        return {
+            "breaching": bool(breaching),
+            "max_burn_s": max(
+                (r["burn_s"] for r in breaching), default=0.0
+            ),
+            "objectives": [r["name"] for r in breaching],
+        }
+
     def export(self) -> dict:
         """Last grading (evaluating if never graded) for /statusz."""
         results = self.evaluate()

@@ -29,32 +29,19 @@ scattered ``_needs_chunking`` heuristics.  Three modes:
     padded block count) and as a fallback when streaming is disabled
     via ``DPF_TPU_STREAMING=0``.
 
-HBM budget model (all byte counts are *selection-attributable*, i.e.
-tensors whose size is proportional to the number of selection bits):
-
-- materialized: ``num_keys * eff_blocks * 16`` bytes live at once
-  (16 bytes = one 128-bit selection block).
-- streaming: the cut-level state holds one 16-byte seed per query per
-  subtree for the whole scan (``num_keys * 2**cut_levels * 16``), and
-  each scan step materializes one chunk's selections
-  (``num_keys * 2**chunk_levels * 16``), double-buffered by XLA while
-  the next database span is prefetched, hence the factor 2:
-
-      peak = num_keys * 16 * (2**cut_levels + 2 * 2**chunk_levels)
-
-  The planner picks the largest ``chunk_levels`` whose peak fits the
-  budget (bigger chunks amortize per-step overhead); if no split fits
-  it minimizes the peak, which lands near ``chunk_levels ~
-  (expand_levels - 1) / 2``.
-- chunked: one chunk's selections at a time,
-  ``num_keys * 2**chunk_expand_levels * 16``.
-
-The budget defaults to 1 GiB and is overridden with
-``DPF_TPU_SELECTION_BYTES_BUDGET``.  ``DPF_TPU_STREAMING`` gates the
-streaming mode (``auto`` = use when over budget, ``1`` = use whenever
-applicable even under budget, ``0`` = never).  ``DPF_TPU_STREAMING_IP``
-picks the inner-product tier inside the scan (``auto`` = pallas2 on
-TPU, jnp elsewhere).
+The HBM byte model (what each tier keeps live, and how big a
+streaming/chunked split may be) lives in
+:mod:`..capacity.model` — one `CapacityModel` shared with the
+heavy-hitters level planner and the serving admission controller.
+This module is a thin client: it asks the model for tier byte costs
+and feasible splits, then encodes the mode decision tree
+(materialized-if-it-fits, streaming when over budget or forced,
+chunked as the floor).  The budget defaults to 1 GiB and is overridden
+with ``DPF_TPU_SELECTION_BYTES_BUDGET``.  ``DPF_TPU_STREAMING`` gates
+the streaming mode (``auto`` = use when over budget, ``1`` = use
+whenever applicable even under budget, ``0`` = never).
+``DPF_TPU_STREAMING_IP`` picks the inner-product tier inside the scan
+(``auto`` = pallas2 on TPU, jnp elsewhere).
 """
 
 from __future__ import annotations
@@ -62,10 +49,8 @@ from __future__ import annotations
 import dataclasses
 import os
 
+from ..capacity.model import CapacityModel, default_capacity_model
 from ..observability.tracing import runtime_counters
-
-_DEFAULT_BUDGET_BYTES = 1 << 30
-_SELECTION_BLOCK_BYTES = 16
 
 # Legacy chunked path: pad the block count so chunks stay at least this
 # many doubling levels (keeps per-chunk tensors MXU-friendly).
@@ -73,14 +58,8 @@ CHUNK_GRANULE_LEVELS = 10
 
 
 def selection_budget_bytes() -> int:
-    """HBM budget for selection-attributable tensors, from the env."""
-    raw = os.environ.get("DPF_TPU_SELECTION_BYTES_BUDGET", "").strip()
-    if raw:
-        try:
-            return max(1, int(raw))
-        except ValueError:
-            pass
-    return _DEFAULT_BUDGET_BYTES
+    """HBM budget for selection-attributable tensors (capacity model)."""
+    return default_capacity_model().selection_budget_bytes()
 
 
 def streaming_mode() -> str:
@@ -96,17 +75,23 @@ def streaming_ip(backend: str | None) -> str:
 
 
 def materialized_selection_bytes(num_keys: int, eff_blocks: int) -> int:
-    return num_keys * eff_blocks * _SELECTION_BLOCK_BYTES
+    return default_capacity_model().materialized_selection_bytes(
+        num_keys, eff_blocks
+    )
 
 
-def streaming_selection_bytes(num_keys: int, cut_levels: int, chunk_levels: int) -> int:
-    return num_keys * _SELECTION_BLOCK_BYTES * (
-        (1 << cut_levels) + 2 * (1 << chunk_levels)
+def streaming_selection_bytes(
+    num_keys: int, cut_levels: int, chunk_levels: int
+) -> int:
+    return default_capacity_model().streaming_selection_bytes(
+        num_keys, cut_levels, chunk_levels
     )
 
 
 def chunked_selection_bytes(num_keys: int, chunk_expand_levels: int) -> int:
-    return num_keys * (1 << chunk_expand_levels) * _SELECTION_BLOCK_BYTES
+    return default_capacity_model().chunked_selection_bytes(
+        num_keys, chunk_expand_levels
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -134,17 +119,9 @@ class ServingPlan:
 
 def _pick_streaming_split(num_keys: int, expand_levels: int, budget: int) -> int:
     """Largest chunk_levels whose modeled peak fits `budget`, else the
-    peak-minimizing split."""
-    feasible = [
-        r
-        for r in range(expand_levels + 1)
-        if streaming_selection_bytes(num_keys, expand_levels - r, r) <= budget
-    ]
-    if feasible:
-        return max(feasible)
-    return min(
-        range(expand_levels + 1),
-        key=lambda r: streaming_selection_bytes(num_keys, expand_levels - r, r),
+    peak-minimizing split (delegated to the capacity model)."""
+    return default_capacity_model().pick_streaming_split(
+        num_keys, expand_levels, budget_bytes=budget
     )
 
 
@@ -158,6 +135,7 @@ def plan_dense_serving(
     budget_bytes: int | None = None,
     force_ip: str | None = None,
     force_mode: str | None = None,
+    model: CapacityModel | None = None,
 ) -> ServingPlan:
     """Choose the serving mode and its parameters for one batch.
 
@@ -171,15 +149,19 @@ def plan_dense_serving(
     (falling through to chunked otherwise), ``"chunked"`` forces the
     legacy limb-space loop.  Runtime OOM demotion (`server.py`) uses
     it to step a shape down a tier after the budget model proved
-    optimistic on the live device.
+    optimistic on the live device — and the brownout ladder uses the
+    same floor to force cheaper tiers under SLO burn.
+
+    ``model`` overrides the process-wide capacity model (tests).
     """
-    budget = selection_budget_bytes() if budget_bytes is None else budget_bytes
+    cm = model if model is not None else default_capacity_model()
+    budget = cm.selection_budget_bytes() if budget_bytes is None else budget_bytes
     mode = streaming_mode()
     streaming_ok = (
         mode != "0" and expand_levels > 0 and (1 << expand_levels) >= num_blocks
     )
     eff_blocks = (1 << expand_levels) if serving_bitrev else num_blocks
-    mat_bytes = materialized_selection_bytes(num_keys, eff_blocks)
+    mat_bytes = cm.materialized_selection_bytes(num_keys, eff_blocks)
     over_budget = mat_bytes > budget and expand_levels > 0
     if force_mode == "streaming" and not streaming_ok:
         # Geometry (or DPF_TPU_STREAMING=0) rules streaming out; the
@@ -196,14 +178,16 @@ def plan_dense_serving(
         budget_bytes=budget,
     )
     if streaming_ok and (over_budget or mode == "1" or force_mode == "streaming"):
-        chunk_levels = _pick_streaming_split(num_keys, expand_levels, budget)
+        chunk_levels = cm.pick_streaming_split(
+            num_keys, expand_levels, budget_bytes=budget
+        )
         cut_levels = expand_levels - chunk_levels
         ip = force_ip or streaming_ip(backend)
         runtime_counters.inc("pir.plan.streaming")
         runtime_counters.inc(f"pir.plan.streaming_ip.{ip}")
         return ServingPlan(
             mode="streaming",
-            selection_bytes_peak=streaming_selection_bytes(
+            selection_bytes_peak=cm.streaming_selection_bytes(
                 num_keys, cut_levels, chunk_levels
             ),
             cut_levels=cut_levels,
@@ -213,13 +197,13 @@ def plan_dense_serving(
             **common,
         )
     if over_budget:
-        cel = min(expand_levels, CHUNK_GRANULE_LEVELS)
-        while cel > 0 and chunked_selection_bytes(num_keys, cel) > budget:
-            cel -= 1
+        cel = cm.pick_chunked_expand_levels(
+            num_keys, expand_levels, CHUNK_GRANULE_LEVELS, budget_bytes=budget
+        )
         runtime_counters.inc("pir.plan.chunked")
         return ServingPlan(
             mode="chunked",
-            selection_bytes_peak=chunked_selection_bytes(num_keys, cel),
+            selection_bytes_peak=cm.chunked_selection_bytes(num_keys, cel),
             cut_levels=expand_levels - cel,
             chunk_levels=cel,
             num_chunks=1 << (expand_levels - cel),

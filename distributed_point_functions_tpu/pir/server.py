@@ -53,6 +53,41 @@ DecryptHelperRequestFn = Callable[[bytes, bytes], bytes]
 ENCRYPTION_CONTEXT_INFO = b"DpfPirServer"
 
 
+# -- process-wide brownout tier floor ----------------------------------------
+#
+# The brownout ladder (`capacity/brownout.py`, wired by serving's
+# `attach_brownout`) forces *every* server in the process to plan at or
+# below a tier: cheaper tiers shrink peak HBM so concurrent sweeps and
+# serving stop fighting for memory under SLO burn. 0 = unconstrained.
+# Per-shape OOM demotion (`_tier_floor`) composes with it — the planner
+# honors whichever floor is lower-tier.
+
+_GLOBAL_TIER_FLOOR = 0
+_GLOBAL_TIER_FLOOR_LOCK = threading.Lock()
+
+
+def set_tier_floor(mode: Optional[str]) -> None:
+    """Force every dense-PIR plan in this process to `mode` or cheaper
+    ("materialized" / "streaming" / "chunked"); None or "materialized"
+    clears the constraint."""
+    global _GLOBAL_TIER_FLOOR
+    tiers = DenseDpfPirServer._TIERS
+    floor = 0 if mode is None else tiers.index(mode)
+    with _GLOBAL_TIER_FLOOR_LOCK:
+        _GLOBAL_TIER_FLOOR = floor
+    tracing.runtime_counters.inc(
+        f"pir.tier_floor.{'cleared' if floor == 0 else mode}"
+    )
+
+
+def clear_tier_floor() -> None:
+    set_tier_floor(None)
+
+
+def tier_floor() -> str:
+    return DenseDpfPirServer._TIERS[_GLOBAL_TIER_FLOOR]
+
+
 class DpfPirServer:
     """Role dispatch shared by all DPF-based PIR servers."""
 
@@ -502,7 +537,7 @@ class DenseDpfPirServer(DpfPirServer):
             serving_bitrev=bitrev,
             backend=jax.default_backend(),
         )
-        floor = self._tier_floor.get(num_keys, 0)
+        floor = max(self._tier_floor.get(num_keys, 0), _GLOBAL_TIER_FLOOR)
         if floor and self._TIERS.index(plan.mode) < floor:
             plan = plan_dense_serving(
                 num_keys=num_keys,

@@ -4,7 +4,10 @@ Promotes the two-server deployment model from a demo script into a
 subsystem: dynamic shape-bucketed batching (`batcher`), session objects
 with deadlines, Helper retry, and degradation (`service`), reusable
 framed transports (`transport`), and a dependency-free metrics registry
-(`metrics`). Layering: serving -> pir -> ops -> observability, never
+(`metrics`). Cost-aware admission, per-tenant QoS, and the brownout
+ladder plug in from `capacity/` (enable with
+`ServingConfig.admission_enabled`; see `_Session.attach_brownout`).
+Layering: serving -> pir -> capacity -> ops -> observability, never
 the reverse (enforced by `tools/check_layers.py` in presubmit).
 
 Observability rides along everywhere: sessions root a trace per
@@ -26,6 +29,7 @@ from .service import (
     LeaderSession,
     PlainSession,
     ServingConfig,
+    TenantPolicy,
 )
 from .transport import (
     FramedTcpServer,
@@ -55,6 +59,7 @@ __all__ = [
     "PlainSession",
     "ServingConfig",
     "TcpTransport",
+    "TenantPolicy",
     "Transport",
     "TransportError",
     "TransportTimeout",

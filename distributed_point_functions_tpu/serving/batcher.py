@@ -19,11 +19,19 @@ missing piece:
   jit/planner cache entry.
 * Admission is a **bounded queue**: when `max_queue` requests are
   already waiting, `submit` sheds load with `Overloaded` instead of
-  growing an unbounded backlog.
+  growing an unbounded backlog. With an `AdmissionController`
+  (`capacity/admission.py`) attached, the count bound becomes a
+  backstop behind cost-aware admission: each request is priced in
+  estimated device-ms, doomed work (drain estimate past the deadline)
+  and over-quota tenants are shed *at admission* with a
+  `retry_after_s` hint on the `Overloaded`, and the queue dequeues
+  across tenants in weighted-fair order instead of global FIFO.
 * Requests carry an optional absolute **deadline** (`time.monotonic()`
-  seconds). The worker drops expired requests while forming a batch —
-  they fail with `DeadlineExceeded` *without evaluating* — and the
-  submitting thread enforces the same deadline on its wait.
+  seconds). The worker drops requests that expired while the batch was
+  forming *before* any device work is dispatched — they fail with
+  `DeadlineExceeded` without evaluating, and a bucket whose every
+  request died skips the dispatch entirely — and the submitting thread
+  enforces the same deadline on its wait.
 
 The batcher is generic over the evaluation function
 (`evaluate(keys) -> list of per-key results`), so it serves any of the
@@ -37,6 +45,7 @@ import time
 from collections import deque
 from typing import Callable, List, Optional, Sequence
 
+from ..capacity.admission import AdmissionController, WeightedFairQueue
 from ..observability import tracing
 from ..observability import phases as phases_mod
 from ..observability.device import default_telemetry, shape_key
@@ -45,7 +54,20 @@ from .metrics import MetricsRegistry
 
 
 class Overloaded(RuntimeError):
-    """Admission queue full: the request was shed, not enqueued."""
+    """The request was shed at admission, not enqueued. `retry_after_s`
+    is the server's drain-based backoff hint (0 = none given) and
+    `reason` the admission `ShedReason` value string, when cost-aware
+    admission made the call."""
+
+    def __init__(
+        self,
+        message: str = "",
+        retry_after_s: float = 0.0,
+        reason: Optional[str] = None,
+    ):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+        self.reason = reason
 
 
 class DeadlineExceeded(TimeoutError):
@@ -62,12 +84,14 @@ def bucket_size(num_keys: int) -> int:
 class _Pending:
     __slots__ = (
         "keys", "deadline", "event", "result", "error", "t0", "abandoned",
-        "trace", "phases",
+        "trace", "phases", "tenant", "cost",
     )
 
-    def __init__(self, keys, deadline):
+    def __init__(self, keys, deadline, tenant="default", cost=None):
         self.keys = keys
         self.deadline = deadline
+        self.tenant = tenant
+        self.cost = cost  # admission WorkCost, released on completion
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
@@ -96,6 +120,7 @@ class DynamicBatcher:
         max_queue: int = 256,
         metrics: Optional[MetricsRegistry] = None,
         name: str = "batcher",
+        admission: Optional[AdmissionController] = None,
     ):
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
@@ -103,8 +128,10 @@ class DynamicBatcher:
             raise ValueError("max_queue must be >= 1")
         self._evaluate = evaluate
         self._max_batch_size = max_batch_size
+        self._batch_cap: Optional[int] = None  # brownout step 2
         self._max_wait_s = max(0.0, max_wait_ms) / 1e3
         self._max_queue = max_queue
+        self._admission = admission
         self._name = name
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         m, n = self.metrics, name
@@ -125,8 +152,14 @@ class DynamicBatcher:
             f"{n}.pad_waste_ratio",
             buckets=(0.0, 0.05, 0.1, 0.25, 0.5, 0.75, 0.875, 1.0),
         )
+        self._c_expired_in_batch = m.counter(f"{n}.expired_in_batch")
+        self._c_batches_skipped = m.counter(f"{n}.batches_skipped_dead")
         self._cond = threading.Condition()
-        self._queue: deque = deque()
+        # Weighted-fair across tenants under cost-aware admission;
+        # plain FIFO otherwise (and WFQ degenerates to FIFO for a
+        # single tenant, so either way one-tenant order is arrival
+        # order).
+        self._queue = WeightedFairQueue() if admission is not None else deque()
         self._seen_buckets: set = set()
         self._closed = False
         self._worker = threading.Thread(
@@ -137,11 +170,15 @@ class DynamicBatcher:
     # -- client side --------------------------------------------------------
 
     def submit(
-        self, keys: Sequence, deadline: Optional[float] = None
+        self,
+        keys: Sequence,
+        deadline: Optional[float] = None,
+        tenant: str = "default",
     ) -> List:
         """Evaluate `keys` as part of a coalesced batch; returns one
         result per key, in order. `deadline` is absolute
-        `time.monotonic()` seconds."""
+        `time.monotonic()` seconds; `tenant` keys the QoS policy when
+        cost-aware admission is attached (ignored otherwise)."""
         keys = list(keys)
         if not keys:
             raise ValueError("keys must not be empty")
@@ -149,13 +186,40 @@ class DynamicBatcher:
             if self._closed:
                 raise RuntimeError("batcher is closed")
             if len(self._queue) >= self._max_queue:
+                # Count bound: the whole admission story without a
+                # controller, a backstop behind it (in case the cost
+                # model underprices a pathological workload).
                 self._c_shed.inc()
                 raise Overloaded(
                     f"{self._name}: admission queue full "
                     f"({self._max_queue} requests waiting)"
                 )
-            pending = _Pending(keys, deadline)
-            self._queue.append(pending)
+            cost = None
+            if self._admission is not None:
+                decision = self._admission.admit(
+                    len(keys), tenant=tenant, deadline=deadline
+                )
+                if not decision.admitted:
+                    self._c_shed.inc()
+                    raise Overloaded(
+                        f"{self._name}: shed at admission "
+                        f"({decision.reason.value}); retry after "
+                        f"{decision.retry_after_s:.3f}s",
+                        retry_after_s=decision.retry_after_s,
+                        reason=decision.reason.value,
+                    )
+                cost = decision.cost
+            pending = _Pending(keys, deadline, tenant=tenant, cost=cost)
+            if self._admission is not None:
+                policy = self._admission.policy(tenant)
+                self._queue.push(
+                    pending,
+                    tenant=tenant,
+                    weight=policy.weight,
+                    cost=float(len(keys)),
+                )
+            else:
+                self._queue.append(pending)
             self._g_depth.set(len(self._queue))
             self._c_submitted.inc()
             self._cond.notify()
@@ -177,7 +241,42 @@ class DynamicBatcher:
             raise pending.error
         return pending.result
 
+    # -- brownout hook ------------------------------------------------------
+
+    def set_batch_cap(self, cap: Optional[int]) -> None:
+        """Cap the effective batch size below `max_batch_size` (the
+        brownout ladder's `cap_batches` step trades peak throughput
+        for shorter queue drains); None clears."""
+        if cap is not None and cap < 1:
+            raise ValueError("batch cap must be >= 1 (or None)")
+        with self._cond:
+            self._batch_cap = cap
+
     # -- worker -------------------------------------------------------------
+
+    def _pop_next(self):
+        # Caller holds self._cond.
+        return (
+            self._queue.pop()
+            if self._admission is not None
+            else self._queue.popleft()
+        )
+
+    def _peek_next(self):
+        # Caller holds self._cond.
+        if not self._queue:
+            return None
+        return (
+            self._queue.peek()
+            if self._admission is not None
+            else self._queue[0]
+        )
+
+    def _release(self, pending: _Pending) -> None:
+        """An admitted request reached a terminal state: give its
+        estimated cost back to the admission drain model."""
+        if self._admission is not None:
+            self._admission.release(pending.cost)
 
     def _collect(self):
         """Block for the first request, then fill the batch until
@@ -191,15 +290,18 @@ class DynamicBatcher:
                     return None
                 self._cond.wait()
             t_first = time.monotonic()
-            batch = [self._queue.popleft()]
+            batch = [self._pop_next()]
             num_keys = len(batch[0].keys)
+            max_batch = self._max_batch_size
+            if self._batch_cap is not None:
+                max_batch = min(max_batch, self._batch_cap)
             close_at = time.monotonic() + self._max_wait_s
-            while num_keys < self._max_batch_size:
+            while num_keys < max_batch:
                 if self._queue:
-                    nxt = self._queue[0]
-                    if num_keys + len(nxt.keys) > self._max_batch_size:
+                    nxt = self._peek_next()
+                    if num_keys + len(nxt.keys) > max_batch:
                         break
-                    self._queue.popleft()
+                    self._pop_next()
                     batch.append(nxt)
                     num_keys += len(nxt.keys)
                     continue
@@ -216,6 +318,9 @@ class DynamicBatcher:
             if collected is None:
                 return
             batch, assembly_s = collected
+            # Pre-dispatch deadline gate: requests that expired while
+            # the batch was forming are dropped HERE, before any device
+            # work, so an expired request never costs an evaluation.
             now = time.monotonic()
             live = []
             for p in batch:
@@ -224,11 +329,17 @@ class DynamicBatcher:
                 ):
                     # Dropped unevaluated; the submitter raises
                     # DeadlineExceeded (and counts it) on its side.
+                    self._c_expired_in_batch.inc()
+                    self._release(p)
                     p.error = DeadlineExceeded("expired in queue")
                     p.event.set()
                     continue
                 live.append(p)
             if not live:
+                # Every request in the bucket died while batching:
+                # skip padding, bucketing, and the device dispatch
+                # entirely.
+                self._c_batches_skipped.inc()
                 continue
             flat = [k for p in live for k in p.keys]
             bucket = bucket_size(len(flat))
@@ -276,6 +387,7 @@ class DynamicBatcher:
                     )
             except Exception as e:  # noqa: BLE001 - fan the error out
                 for p in live:
+                    self._release(p)
                     p.error = e
                     p.event.set()
                 continue
@@ -312,6 +424,7 @@ class DynamicBatcher:
                     p.phases.add("batch", assembly_s * 1e3)
                     p.phases.add_many(collected)
                     p.phases.add("dispatch", dispatch_ms)
+                self._release(p)
                 p.event.set()
 
     # -- lifecycle ----------------------------------------------------------

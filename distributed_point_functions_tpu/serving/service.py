@@ -57,7 +57,11 @@ import dataclasses
 import time
 from typing import Optional
 
+import threading
+
 from .. import serialization
+from ..capacity.admission import AdmissionController, TenantPolicy
+from ..capacity.brownout import BrownoutController
 from ..observability import propagation, tracing
 from ..observability import phases as phases_mod
 from ..observability.device import (
@@ -66,7 +70,7 @@ from ..observability.device import (
 )
 from ..pir import messages
 from ..pir.database import DenseDpfPirDatabase
-from ..pir.server import DenseDpfPirServer
+from ..pir.server import DenseDpfPirServer, clear_tier_floor, set_tier_floor
 from ..robustness import failpoints
 from ..robustness.breaker import CircuitBreaker
 from .batcher import DeadlineExceeded, DynamicBatcher, Overloaded
@@ -81,6 +85,7 @@ __all__ = [
     "HelperSession",
     "DeadlineExceeded",
     "Overloaded",
+    "TenantPolicy",
 ]
 
 
@@ -106,6 +111,22 @@ class ServingConfig:
     admits one half-open probe per `breaker_reset_ms` window.
     `breaker_enabled=False` restores the PR 2 behavior (every request
     pays the full ladder).
+
+    `admission_enabled=True` replaces the batcher's request-count
+    bound with cost-aware admission (`capacity/admission.py`): doomed
+    and over-quota requests shed at submit with a `retry_after_s`
+    hint, per-tenant quotas/weights via `session.set_tenant()`, and
+    weighted-fair dequeue. `admission_queue_budget_ms` is the queued
+    estimated-device-ms the controller will hold before shedding.
+
+    The helper retry *budget* bounds the retry:success ratio so
+    retries cannot amplify an overload: each successful leg earns
+    `helper_retry_budget_ratio` retry tokens (capped at
+    `helper_retry_budget_min`, which is also the starting balance) and
+    each retry spends one; an empty budget skips the remaining ladder
+    and raises `HelperUnavailable` immediately (counted in
+    `leader.retries_budget_exhausted`). The PR 7 breaker handles a
+    *dead* Helper; the budget handles a *slow* one.
     """
 
     max_batch_size: int = 64
@@ -121,6 +142,10 @@ class ServingConfig:
     breaker_enabled: bool = True
     breaker_failure_threshold: int = 5
     breaker_reset_ms: float = 1000.0
+    admission_enabled: bool = False
+    admission_queue_budget_ms: float = 250.0
+    helper_retry_budget_ratio: float = 0.1
+    helper_retry_budget_min: float = 10.0
 
 
 # The deadline travels from handle_request into the server's plain
@@ -129,6 +154,11 @@ class ServingConfig:
 # through the reference-mirroring server signatures.
 _DEADLINE: contextvars.ContextVar = contextvars.ContextVar(
     "serving_deadline", default=None
+)
+# The requesting tenant rides the same way: set at handle_request,
+# read where the plain handler submits to the batcher.
+_TENANT: contextvars.ContextVar = contextvars.ContextVar(
+    "serving_tenant", default="default"
 )
 
 
@@ -153,6 +183,13 @@ class _Session:
         default_telemetry().bind_registry(self.metrics)
         install_jax_monitoring_listener(default_telemetry().compile_tracker)
         phases_mod.default_phase_recorder().bind_registry(self.metrics)
+        self.admission: Optional[AdmissionController] = None
+        if self._config.admission_enabled:
+            self.admission = AdmissionController(
+                queue_budget_ms=self._config.admission_queue_budget_ms,
+                metrics=self.metrics,
+                name=f"{name}.admission",
+            )
         self._batcher: Optional[DynamicBatcher] = None
         if self._config.batching:
             self._batcher = DynamicBatcher(
@@ -162,6 +199,7 @@ class _Session:
                 max_queue=self._config.max_queue,
                 metrics=self.metrics,
                 name=f"{name}.batcher",
+                admission=self.admission,
             )
             server.set_plain_handler(self._batched_plain_handler)
 
@@ -172,6 +210,60 @@ class _Session:
     @property
     def config(self) -> ServingConfig:
         return self._config
+
+    @property
+    def batcher(self) -> Optional[DynamicBatcher]:
+        return self._batcher
+
+    # -- QoS / brownout -----------------------------------------------------
+
+    def set_tenant(self, tenant: str, policy: TenantPolicy) -> None:
+        """Register a tenant's QoS contract (requires
+        `admission_enabled`)."""
+        if self.admission is None:
+            raise RuntimeError(
+                "set_tenant requires ServingConfig.admission_enabled"
+            )
+        self.admission.set_tenant(tenant, policy)
+
+    def attach_brownout(
+        self,
+        brownout: BrownoutController,
+        batch_cap: int = 8,
+        cheap_tier: str = "streaming",
+    ) -> BrownoutController:
+        """Wire the ladder's steps to this session's knobs: admission
+        priority floors (steps 1 and 4, when admission is enabled),
+        the batcher's batch cap (step 2), and the process-wide PIR
+        tier floor (step 3). Returns `brownout` for chaining."""
+        if self.admission is not None:
+            adm = self.admission
+            brownout.add_step_action(
+                "shed_low_priority",
+                lambda: adm.set_min_priority(1),
+                lambda: adm.set_min_priority(0),
+            )
+            # Reverts land in reverse engage order, so critical_only
+            # reverting returns the floor to 1 (shed_low_priority is
+            # still engaged at that point).
+            brownout.add_step_action(
+                "critical_only",
+                lambda: adm.set_min_priority(2),
+                lambda: adm.set_min_priority(1),
+            )
+        if self._batcher is not None:
+            batcher = self._batcher
+            brownout.add_step_action(
+                "cap_batches",
+                lambda: batcher.set_batch_cap(batch_cap),
+                lambda: batcher.set_batch_cap(None),
+            )
+        brownout.add_step_action(
+            "force_cheap_tier",
+            lambda: set_tier_floor(cheap_tier),
+            clear_tier_floor,
+        )
+        return brownout
 
     # -- batching -----------------------------------------------------------
 
@@ -187,7 +279,9 @@ class _Session:
 
     def _batched_plain_handler(self, request):
         out = self._batcher.submit(
-            request.plain_request.dpf_keys, deadline=_DEADLINE.get()
+            request.plain_request.dpf_keys,
+            deadline=_DEADLINE.get(),
+            tenant=_TENANT.get(),
         )
         return messages.PirResponse(
             dpf_pir_response=messages.DpfPirResponse(
@@ -206,12 +300,15 @@ class _Session:
         self,
         request: "messages.PirRequest",
         deadline: Optional[float] = None,
+        tenant: str = "default",
     ) -> "messages.PirResponse":
         """Serve one request; `deadline` is absolute `time.monotonic()`
-        seconds (defaults from `request_timeout_ms`)."""
+        seconds (defaults from `request_timeout_ms`); `tenant` keys the
+        admission QoS policy when enabled."""
         if deadline is None:
             deadline = self._default_deadline()
         token = _DEADLINE.set(deadline)
+        tenant_token = _TENANT.set(tenant)
         try:
             with tracing.trace_request(
                 f"{self._name}.request", role=self._name
@@ -222,6 +319,7 @@ class _Session:
                     with self.metrics.timed(f"{self._name}.request_ms"):
                         return self._server.handle_request(request)
         finally:
+            _TENANT.reset(tenant_token)
             _DEADLINE.reset(token)
 
     def handle_wire(self, data: bytes) -> bytes:
@@ -232,6 +330,12 @@ class _Session:
         propagated trace id and the reply wraps back with this side's
         stage spans. A bare proto (old-version peer, or a client) is
         served and answered bare, unchanged.
+
+        A shed request (`Overloaded`) from an *enveloped* peer answers
+        with a typed kind-3 error envelope carrying the `retry_after_s`
+        hint; a bare-proto peer sees the exception propagate to the
+        transport exactly as before (old peers could not parse the
+        envelope anyway).
         """
         from ..protos import private_information_retrieval_pb2 as pir_pb2
 
@@ -255,7 +359,20 @@ class _Session:
                     request = serialization.pir_request_from_proto(
                         self._server.dpf, proto
                     )
-                response = self.handle_request(request)
+                try:
+                    response = self.handle_request(request)
+                except Overloaded as e:
+                    if trace_id is None:
+                        raise
+                    self.metrics.counter(
+                        f"{self._name}.wire_overloads"
+                    ).inc()
+                    return propagation.encode_error(
+                        "overloaded",
+                        message=str(e),
+                        retry_after_s=getattr(e, "retry_after_s", 0.0),
+                        trace_id=trace.trace_id,
+                    )
                 with tracing.span("encode"), phases_mod.phase("respond"):
                     out = serialization.pir_response_to_proto(
                         response
@@ -355,6 +472,16 @@ class LeaderSession(_Session):
         self._g_breaker = m.gauge("leader.breaker_state")
         self._c_breaker_opens = m.counter("leader.breaker_opens")
         self._c_fast_fails = m.counter("leader.breaker_fast_fails")
+        self._c_helper_overloaded = m.counter("leader.helper_overloaded")
+        # Retry budget: bounds the retry:success ratio so an overloaded
+        # (slow-but-alive) Helper is not hammered with amplified load.
+        self._c_budget_exhausted = m.counter(
+            "leader.retries_budget_exhausted"
+        )
+        self._retry_lock = threading.Lock()
+        self._retry_tokens = float(self._config.helper_retry_budget_min)
+        self._g_retry_tokens = m.gauge("leader.retry_budget_tokens")
+        self._g_retry_tokens.set(self._retry_tokens)
         self._breaker: Optional[CircuitBreaker] = None
         if self._config.breaker_enabled:
             self._breaker = CircuitBreaker(
@@ -394,6 +521,28 @@ class LeaderSession(_Session):
             self._c_degraded_exits.inc()
 
     # -- helper leg ---------------------------------------------------------
+
+    def _retry_budget_take(self) -> bool:
+        """Spend one retry token; False means the budget is exhausted
+        and the ladder must stop retrying."""
+        with self._retry_lock:
+            if self._retry_tokens >= 1.0:
+                self._retry_tokens -= 1.0
+                self._g_retry_tokens.set(round(self._retry_tokens, 3))
+                return True
+            return False
+
+    def _retry_budget_earn(self) -> None:
+        """A successful leg earns back `helper_retry_budget_ratio`
+        tokens, capped at the starting balance — the cap is what bounds
+        the long-run retry:success ratio."""
+        cfg = self._config
+        with self._retry_lock:
+            self._retry_tokens = min(
+                float(cfg.helper_retry_budget_min),
+                self._retry_tokens + cfg.helper_retry_budget_ratio,
+            )
+            self._g_retry_tokens.set(round(self._retry_tokens, 3))
 
     def _send_to_helper(self, helper_request, while_waiting):
         """`ForwardHelperRequestFn` with retry: serialize, round-trip
@@ -462,6 +611,7 @@ class LeaderSession(_Session):
                 rtt_ms = (time.perf_counter() - t0) * 1e3
                 if breaker is not None:
                     breaker.record_success()
+                self._retry_budget_earn()
                 break
             except Exception as e:  # noqa: BLE001 - triaged below
                 is_transport = isinstance(e, TransportError)
@@ -494,6 +644,17 @@ class LeaderSession(_Session):
                         f"helper leg failed after {attempt + 1} "
                         f"attempt(s): {e}"
                     ) from e
+                if not self._retry_budget_take():
+                    # The fleet-level retry:success ratio is spent:
+                    # retrying now would amplify the very overload
+                    # that is making the Helper slow. Fail fast and
+                    # let the client's backoff spread the load.
+                    self._c_budget_exhausted.inc()
+                    self._c_failures.inc()
+                    raise HelperUnavailable(
+                        f"helper retry budget exhausted after "
+                        f"{attempt + 1} attempt(s): {e}"
+                    ) from e
                 self._c_retries.inc()
                 time.sleep(min(backoff, cfg.helper_backoff_max_ms / 1e3))
                 backoff *= 2
@@ -509,11 +670,26 @@ class LeaderSession(_Session):
         # Leader's own-share compute (by design), so the waterfall's
         # helper_rtt phase can exceed end-to-end minus device_compute.
         phases_mod.record("helper_rtt", rtt_ms)
-        meta, inner = (
-            propagation.try_decode_response(data)
-            if enveloped
-            else (None, data)
-        )
+        try:
+            meta, inner = (
+                propagation.try_decode_response(data)
+                if enveloped
+                else (None, data)
+            )
+        except propagation.WireErrorResponse as e:
+            # A typed refusal is a live, envelope-speaking peer (the
+            # breaker already recorded the round-trip as a success) —
+            # surface it as Overloaded with the peer's backoff hint
+            # rather than burning retries against a shedding Helper.
+            self._peer_envelope = True
+            if e.error_type == "overloaded":
+                self._c_helper_overloaded.inc()
+                raise Overloaded(
+                    f"helper shed the request: {e}",
+                    retry_after_s=e.retry_after_s,
+                    reason="helper_overloaded",
+                ) from e
+            raise
         if enveloped:
             self._peer_envelope = meta is not None
         if meta is not None:

@@ -159,8 +159,14 @@ def run_leader(
     server.serve_forever()
 
 
-def run_client(leader_addr: str, indices: list[int]) -> list[bytes]:
+def run_client(
+    leader_addr: str, indices: list[int], max_attempts: int = 8
+) -> list[bytes]:
     from distributed_point_functions_tpu import serialization
+    from distributed_point_functions_tpu.observability import (
+        propagation,
+        tracing,
+    )
     from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
     from distributed_point_functions_tpu.protos import (
         private_information_retrieval_pb2 as pir_pb2,
@@ -173,15 +179,40 @@ def run_client(leader_addr: str, indices: list[int]) -> list[bytes]:
 
     client = DenseDpfPirClient.create(NUM_RECORDS, encrypt_decrypt.encrypt)
     request, state = client.create_request(indices)
-    wire = serialization.pir_request_to_proto(
+    inner = serialization.pir_request_to_proto(
         client.dpf, request
     ).SerializeToString()
 
     host, port = parse_hostport(leader_addr)
     with TcpTransport(host, port) as transport:
-        data = transport.roundtrip(wire)
+        # Enveloped request: carries a trace id out, and lets an
+        # overloaded leader answer with a typed kind-3 refusal (the
+        # RetryAfter hint) instead of a broken pipe.
+        for attempt in range(max_attempts):
+            data = transport.roundtrip(
+                propagation.encode_request(tracing.new_trace_id(), inner)
+            )
+            try:
+                _, payload = propagation.try_decode_response(data)
+                break
+            except propagation.WireErrorResponse as e:
+                # Typed shed (admission quota, cost budget, brownout):
+                # honor the server's backoff hint instead of hammering.
+                if attempt + 1 >= max_attempts:
+                    raise SystemExit(
+                        f"leader still overloaded after "
+                        f"{max_attempts} attempts: {e}"
+                    )
+                backoff = max(e.retry_after_s, 0.05)
+                print(
+                    f"[client] {e.error_type}: {e} — retrying in "
+                    f"{backoff:.2f}s "
+                    f"(attempt {attempt + 2}/{max_attempts})",
+                    flush=True,
+                )
+                time.sleep(backoff)
     response = serialization.pir_response_from_proto(
-        pir_pb2.PirResponse.FromString(data)
+        pir_pb2.PirResponse.FromString(payload)
     )
     return client.handle_response(response, state)
 

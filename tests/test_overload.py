@@ -1,0 +1,588 @@
+"""Overload chaos suite: shed-early admission, QoS fairness, brownout.
+
+The contract under test, end to end:
+
+* a scripted 2x over-capacity load keeps goodput (completed-in-deadline
+  work per second) within 70% of the saturation throughput measured in
+  the *same run* — overload costs the excess, never the service;
+* the excess is shed AT ADMISSION with a `retry_after_s` hint, before
+  any device work runs on its behalf;
+* backlogged tenants drain in proportion to their weights (within 15%);
+* the brownout ladder engages under a breach signal, actually moves the
+  serving knobs (admission floor, batch cap, PIR tier floor), and fully
+  reverts when the breach clears;
+* and — the chaos invariant — every response a client actually receives
+  under overload is bit-identical to the fault-free oracle. Sheds may
+  cost retries; they may never corrupt bytes.
+
+The throughput-shaped tests run the real `DynamicBatcher` +
+`AdmissionController` over a stub evaluator with a deterministic
+per-key service time, so capacity is exact and no JAX timing noise
+enters the measurement. The bit-identity and wire tests run the real
+serving sessions.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.capacity import (
+    AdmissionController,
+    BrownoutController,
+    CapacityModel,
+    TenantPolicy,
+    ThroughputCalibration,
+)
+from distributed_point_functions_tpu.pir import (
+    DenseDpfPirClient,
+    DenseDpfPirDatabase,
+    DenseDpfPirServer,
+)
+from distributed_point_functions_tpu.pir import server as pir_server
+from distributed_point_functions_tpu.serving import (
+    HelperSession,
+    HelperUnavailable,
+    InProcessTransport,
+    LeaderSession,
+    Overloaded,
+    PlainSession,
+    ServingConfig,
+)
+from distributed_point_functions_tpu.serving.batcher import DynamicBatcher
+from distributed_point_functions_tpu.serving.metrics import MetricsRegistry
+from distributed_point_functions_tpu.serving.transport import (
+    TransportTimeout,
+)
+from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+NUM_RECORDS = 128
+RECORD_BYTES = 16
+RNG = np.random.default_rng(99)
+
+
+def build_database():
+    records = [
+        bytes(RNG.integers(0, 256, RECORD_BYTES, dtype=np.uint8))
+        for _ in range(NUM_RECORDS)
+    ]
+    builder = DenseDpfPirDatabase.Builder()
+    for r in records:
+        builder.insert(r)
+    return builder.build(), records
+
+
+DATABASE, RECORDS = build_database()
+
+
+def exact_model(tmp_path, qps):
+    """A capacity model whose serving throughput is pinned to the stub
+    evaluator's real service rate, via a throwaway calibration file."""
+    path = tmp_path / "history.jsonl"
+    path.write_text(
+        json.dumps(
+            {"metric": "serving_closed_loop_queries_per_sec", "value": qps}
+        )
+        + "\n"
+    )
+    return CapacityModel(
+        device_memory_bytes=16 << 30,
+        calibration=ThroughputCalibration(str(path)),
+    )
+
+
+@pytest.fixture(autouse=True)
+def _clear_global_tier_floor():
+    yield
+    pir_server.clear_tier_floor()
+
+
+# ---------------------------------------------------------------------------
+# Goodput under 2x overload >= 70% of same-run saturation, shed early
+# ---------------------------------------------------------------------------
+
+
+def test_goodput_survives_2x_overload_with_early_shed(tmp_path):
+    # Stub service: 1 ms per key => capacity is exactly 1000 keys/s.
+    def evaluate(keys):
+        time.sleep(len(keys) * 0.001)
+        return list(keys)
+
+    adm = AdmissionController(
+        exact_model(tmp_path, qps=1000.0),
+        queue_budget_ms=60.0,
+        metrics=MetricsRegistry(),
+    )
+    batcher = DynamicBatcher(
+        evaluate,
+        max_batch_size=16,
+        max_wait_ms=0.5,
+        max_queue=100_000,
+        metrics=adm.metrics,
+        admission=adm,
+    )
+    keys_per_request = 8
+    lock = threading.Lock()
+    stats = {"ok_keys": 0, "shed": 0, "bad_hints": 0, "deadline": 0}
+
+    def run_phase(num_threads, duration_s):
+        with lock:
+            stats.update(ok_keys=0, shed=0, bad_hints=0, deadline=0)
+        stop = time.monotonic() + duration_s
+        def worker(i):
+            while time.monotonic() < stop:
+                payload = [f"t{i}"] * keys_per_request
+                try:
+                    out = batcher.submit(
+                        payload, deadline=time.monotonic() + 0.5
+                    )
+                    assert out == payload
+                    with lock:
+                        stats["ok_keys"] += keys_per_request
+                except Overloaded as e:
+                    with lock:
+                        stats["shed"] += 1
+                        if e.retry_after_s <= 0 or e.reason is None:
+                            stats["bad_hints"] += 1
+                    # The client contract: honor the hint.
+                    time.sleep(min(e.retry_after_s, 0.05))
+                except Exception:
+                    with lock:
+                        stats["deadline"] += 1
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(num_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return dict(stats)
+
+    try:
+        # Phase 1 — saturation: 6 closed-loop threads x 8 keys sit just
+        # under the 60 ms cost budget, so (almost) nothing sheds.
+        sat = run_phase(num_threads=6, duration_s=1.0)
+        saturation_kps = sat["ok_keys"] / 1.0
+        # Phase 2 — 2x the threads: the queued-cost estimate now
+        # overflows the budget and the excess must shed at admission.
+        over = run_phase(num_threads=12, duration_s=1.0)
+        goodput_kps = over["ok_keys"] / 1.0
+    finally:
+        batcher.close()
+
+    assert saturation_kps > 0
+    assert goodput_kps >= 0.70 * saturation_kps, (
+        f"goodput collapsed under overload: {goodput_kps:.0f} keys/s vs "
+        f"saturation {saturation_kps:.0f} keys/s"
+    )
+    assert over["shed"] > 0, "2x overload shed nothing"
+    assert over["bad_hints"] == 0, "a shed lacked retry_after_s/reason"
+    counters = adm.metrics.export()["counters"]
+    # Shed-early: every refusal happened at admission (batcher shed
+    # counter), none after queuing (no expired-in-batch deadline work).
+    assert counters["batcher.requests_shed"] >= over["shed"]
+    assert counters.get("batcher.expired_in_batch", 0) == 0
+    shed_reasons = {
+        k: v for k, v in counters.items()
+        if k.startswith("admission.shed{")
+    }
+    assert sum(shed_reasons.values()) >= over["shed"]
+
+
+# ---------------------------------------------------------------------------
+# Weighted-fair shares across backlogged tenants, within 15%
+# ---------------------------------------------------------------------------
+
+
+def test_weighted_fair_shares_hold_within_15_percent(tmp_path):
+    def evaluate(keys):
+        time.sleep(len(keys) * 0.001)
+        return list(keys)
+
+    adm = AdmissionController(
+        exact_model(tmp_path, qps=1000.0), queue_budget_ms=10_000.0
+    )
+    weights = {"gold": 3.0, "silver": 2.0, "bronze": 1.0}
+    for tenant, w in weights.items():
+        adm.set_tenant(tenant, TenantPolicy(weight=w))
+    # max_batch_size=1: each dequeue is one service, so completion
+    # counts measure the WFQ's dequeue order directly.
+    batcher = DynamicBatcher(
+        evaluate,
+        max_batch_size=1,
+        max_wait_ms=0.0,
+        max_queue=100_000,
+        admission=adm,
+    )
+    served = {t: 0 for t in weights}
+    lock = threading.Lock()
+    stop = time.monotonic() + 1.5
+
+    def worker(tenant):
+        while time.monotonic() < stop:
+            batcher.submit([tenant], tenant=tenant)
+            with lock:
+                served[tenant] += 1
+
+    try:
+        threads = [
+            threading.Thread(target=worker, args=(t,))
+            for t in weights for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        batcher.close()
+
+    total = sum(served.values())
+    total_w = sum(weights.values())
+    assert total > 200, f"too few services to judge fairness: {served}"
+    for tenant, w in weights.items():
+        share = served[tenant] / total
+        expected = w / total_w
+        assert share == pytest.approx(expected, rel=0.15), (
+            f"{tenant}: share {share:.3f}, expected {expected:.3f} "
+            f"(served={served})"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Pre-dispatch deadline gate: expired work never reaches the evaluator
+# ---------------------------------------------------------------------------
+
+
+def test_expired_requests_dropped_before_dispatch():
+    calls = []
+    gate = threading.Event()
+
+    def evaluate(keys):
+        calls.append(list(keys))
+        if keys[0] == "blocker":
+            gate.wait(2.0)
+        return list(keys)
+
+    batcher = DynamicBatcher(
+        evaluate, max_batch_size=4, max_wait_ms=1.0, metrics=MetricsRegistry()
+    )
+    try:
+        blocker = threading.Thread(
+            target=lambda: batcher.submit(["blocker"])
+        )
+        blocker.start()
+        time.sleep(0.05)  # the worker is now inside evaluate()
+
+        # Queued behind the blocker: one request that will expire before
+        # the worker gets to it, one with no deadline.
+        doomed_err = []
+        def doomed():
+            try:
+                batcher.submit(["doomed"], deadline=time.monotonic() + 0.05)
+            except Exception as e:  # noqa: BLE001
+                doomed_err.append(e)
+        survivor_out = []
+        t1 = threading.Thread(target=doomed)
+        t2 = threading.Thread(
+            target=lambda: survivor_out.append(batcher.submit(["survivor"]))
+        )
+        t1.start()
+        t2.start()
+        t1.join(1.0)  # expires while the blocker batch is still running
+        time.sleep(0.05)  # clear margin past the doomed deadline
+        gate.set()
+        t2.join(5.0)
+        blocker.join(5.0)
+    finally:
+        gate.set()
+        batcher.close()
+
+    assert type(doomed_err[0]).__name__ == "DeadlineExceeded"
+    assert survivor_out == [["survivor"]]
+    # The evaluator saw the blocker and the survivor — never the
+    # expired request's key.
+    assert ["doomed"] not in calls
+    assert all("doomed" not in batch for batch in calls)
+    counters = batcher.metrics.export()["counters"]
+    assert counters["batcher.expired_in_batch"] == 1
+
+
+def test_all_dead_batch_skips_dispatch_entirely():
+    calls = []
+    gate = threading.Event()
+
+    def evaluate(keys):
+        calls.append(list(keys))
+        if keys[0] == "blocker":
+            gate.wait(2.0)
+        return list(keys)
+
+    batcher = DynamicBatcher(
+        evaluate, max_batch_size=4, max_wait_ms=1.0, metrics=MetricsRegistry()
+    )
+    try:
+        blocker = threading.Thread(
+            target=lambda: batcher.submit(["blocker"])
+        )
+        blocker.start()
+        time.sleep(0.05)
+        t1 = threading.Thread(
+            target=lambda: pytest.raises(
+                Exception,
+                batcher.submit,
+                ["doomed"],
+                deadline=time.monotonic() + 0.05,
+            )
+        )
+        t1.start()
+        t1.join(1.0)
+        time.sleep(0.1)  # the doomed request is now expired in queue
+        gate.set()
+        blocker.join(5.0)
+        deadline = time.monotonic() + 5.0
+        while (
+            batcher.metrics.export()["counters"].get(
+                "batcher.batches_skipped_dead", 0
+            ) < 1
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+    finally:
+        gate.set()
+        batcher.close()
+
+    counters = batcher.metrics.export()["counters"]
+    assert counters["batcher.batches_skipped_dead"] == 1
+    assert calls == [["blocker"]]
+
+
+# ---------------------------------------------------------------------------
+# Brownout ladder drives the real serving knobs, and fully reverts
+# ---------------------------------------------------------------------------
+
+
+def test_brownout_ladder_moves_serving_knobs_and_reverts():
+    breaching = [True]
+    config = ServingConfig(
+        max_batch_size=4, max_wait_ms=1.0, admission_enabled=True
+    )
+    with PlainSession(DATABASE, config) as session:
+        session.set_tenant("batch", TenantPolicy(priority=0))
+        brownout = session.attach_brownout(
+            BrownoutController(
+                signal=lambda: breaching[0],
+                engage_after_s=0.0,
+                escalate_after_s=0.0,
+                revert_after_s=0.0,
+                metrics=session.metrics,
+            ),
+            batch_cap=2,
+            cheap_tier="streaming",
+        )
+        adm = session.admission
+
+        assert brownout.evaluate() == 1  # shed_low_priority
+        assert adm.min_priority == 1
+        client = DenseDpfPirClient.create(NUM_RECORDS, lambda pt, ci: pt)
+        request = client.create_plain_requests([7])[0]
+        with pytest.raises(Overloaded) as exc_info:
+            session.handle_request(request, tenant="batch")
+        assert exc_info.value.reason == "priority"
+        assert exc_info.value.retry_after_s > 0
+
+        assert brownout.evaluate() == 2  # cap_batches
+        assert session.batcher._batch_cap == 2
+        assert brownout.evaluate() == 3  # force_cheap_tier
+        assert pir_server.tier_floor() == "streaming"
+        assert brownout.evaluate() == 4  # critical_only
+        assert adm.min_priority == 2
+        with pytest.raises(Overloaded):
+            session.handle_request(request)  # default tenant: priority 1
+
+        # Load drops: the ladder walks all the way back down and every
+        # knob returns to its pre-brownout value.
+        breaching[0] = False
+        for want in (3, 2, 1, 0):
+            assert brownout.evaluate() == want
+        assert adm.min_priority == 0
+        assert session.batcher._batch_cap is None
+        assert pir_server.tier_floor() == "materialized"
+
+        # ...and the previously-shed tenant serves again, correctly.
+        response = session.handle_request(request, tenant="batch")
+        oracle = DenseDpfPirServer.create_plain(DATABASE)
+        want = oracle.handle_plain_request(request)
+        assert (
+            response.dpf_pir_response.masked_response
+            == want.dpf_pir_response.masked_response
+        )
+        counters = session.metrics.export()["counters"]
+        assert counters["brownout.engaged{step=critical_only}"] == 1
+        assert counters["brownout.reverted{step=shed_low_priority}"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity: every response served under overload matches the oracle
+# ---------------------------------------------------------------------------
+
+
+def test_overloaded_session_responses_bit_identical_to_oracle():
+    client = DenseDpfPirClient.create(NUM_RECORDS, lambda pt, ci: pt)
+    indices = [3, 17, 42, 77, 99, 101, 5, 64]
+    requests = {i: client.create_plain_requests([i])[0] for i in indices}
+    oracle_server = DenseDpfPirServer.create_plain(DATABASE)
+    oracle = {
+        i: oracle_server.handle_plain_request(
+            requests[i]
+        ).dpf_pir_response.masked_response
+        for i in indices
+    }
+
+    config = ServingConfig(
+        max_batch_size=4, max_wait_ms=2.0, admission_enabled=True
+    )
+    with PlainSession(DATABASE, config) as session:
+        # A tight quota forces real sheds mid-run; clients retry with
+        # the server's hint until served.
+        session.set_tenant(
+            "bursty", TenantPolicy(rate_qps=30.0, burst=2.0)
+        )
+        results = {}
+        errors = []
+        lock = threading.Lock()
+
+        def worker(slot, index):
+            tenant = "bursty" if slot % 2 == 0 else "default"
+            for _ in range(400):
+                try:
+                    response = session.handle_request(
+                        requests[index], tenant=tenant
+                    )
+                    with lock:
+                        results[(slot, index)] = (
+                            response.dpf_pir_response.masked_response
+                        )
+                    return
+                except Overloaded as e:
+                    time.sleep(max(e.retry_after_s, 1e-3))
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(e)
+                    return
+            with lock:
+                errors.append(RuntimeError(f"slot {slot} never served"))
+
+        threads = [
+            threading.Thread(target=worker, args=(slot, index))
+            for slot, index in enumerate(indices * 2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120.0)
+        counters = session.metrics.export()["counters"]
+
+    assert not errors, errors
+    assert len(results) == len(indices) * 2
+    for (_slot, index), masked in results.items():
+        assert masked == oracle[index], f"index {index} corrupted"
+    # The run actually overloaded: the quota shed at least once.
+    assert counters.get("plain.admission.shed{reason=quota}", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# Typed Overloaded/RetryAfter over the wire (Leader <- Helper)
+# ---------------------------------------------------------------------------
+
+
+def test_helper_shed_travels_to_leader_as_typed_overloaded():
+    helper_config = ServingConfig(
+        max_batch_size=4, max_wait_ms=1.0, admission_enabled=True
+    )
+    helper = HelperSession(
+        DATABASE, encrypt_decrypt.decrypt, helper_config
+    )
+    # One token, near-zero refill: the first leader request drains the
+    # helper's quota, the second is shed over the wire.
+    helper.admission.set_tenant(
+        "default", TenantPolicy(rate_qps=0.01, burst=1.0)
+    )
+    leader_config = ServingConfig(
+        max_batch_size=4, max_wait_ms=1.0, helper_retries=2,
+        helper_backoff_ms=1.0, helper_backoff_max_ms=2.0,
+    )
+    client = DenseDpfPirClient.create(NUM_RECORDS, encrypt_decrypt.encrypt)
+    with LeaderSession(
+        DATABASE, InProcessTransport(helper.handle_wire), leader_config
+    ) as leader:
+        request, state = client.create_request([11])
+        response = leader.handle_request(request)
+        plaintexts = client.handle_response(response, state)
+        assert plaintexts == [RECORDS[11]]
+
+        second, _ = client.create_request([23])
+        with pytest.raises(Overloaded) as exc_info:
+            leader.handle_request(second)
+        assert exc_info.value.reason == "helper_overloaded"
+        assert exc_info.value.retry_after_s > 0
+        counters = leader.metrics.export()["counters"]
+        # A typed refusal is not a helper fault: no retries burned, no
+        # breaker failures, and the helper answered in-protocol.
+        assert counters["leader.helper_overloaded"] == 1
+        assert counters.get("leader.helper_retries", 0) == 0
+        assert leader.breaker.state == "closed"
+    helper_counters = helper.metrics.export()["counters"]
+    assert helper_counters["helper.wire_overloads"] == 1
+    assert helper_counters["helper.admission.shed{reason=quota}"] == 1
+    helper.close()
+
+
+# ---------------------------------------------------------------------------
+# Helper-leg retry budget caps retry amplification
+# ---------------------------------------------------------------------------
+
+
+class DeadTransport:
+    """Every round trip times out; the retry ladder alone decides how
+    many attempts the Leader burns."""
+
+    def __init__(self):
+        self.attempts = 0
+
+    def roundtrip(self, data, timeout=None, on_sent=None):
+        self.attempts += 1
+        if on_sent is not None:
+            on_sent()
+        raise TransportTimeout("dead transport")
+
+    def close(self):
+        pass
+
+
+def test_retry_budget_exhaustion_stops_the_ladder():
+    transport = DeadTransport()
+    config = ServingConfig(
+        max_batch_size=4, max_wait_ms=1.0,
+        helper_retries=50,  # the ladder would allow 50 retries...
+        helper_backoff_ms=0.1, helper_backoff_max_ms=0.2,
+        helper_retry_budget_min=3.0,  # ...but the budget allows 3
+        breaker_enabled=False,
+    )
+    client = DenseDpfPirClient.create(
+        NUM_RECORDS, encrypt_decrypt.encrypt
+    )
+    with LeaderSession(DATABASE, transport, config) as leader:
+        request, _ = client.create_request([9])
+        with pytest.raises(HelperUnavailable) as exc_info:
+            leader.handle_request(request)
+        assert "retry budget exhausted" in str(exc_info.value)
+        counters = leader.metrics.export()["counters"]
+        gauges = leader.metrics.export()["gauges"]
+    # 1 initial attempt + 3 budgeted retries, not 51 attempts.
+    assert transport.attempts == 4
+    assert counters["leader.retries_budget_exhausted"] == 1
+    assert counters["leader.helper_retries"] == 3
+    assert gauges["leader.retry_budget_tokens"] == 0.0

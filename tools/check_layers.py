@@ -3,8 +3,9 @@
 
 Three rules over `distributed_point_functions_tpu/`:
 
-1. **Layer DAG** — `heavy_hitters -> serving -> pir -> ops ->
-   observability -> robustness`, never the reverse, with restricted
+1. **Layer DAG** — `heavy_hitters -> serving -> pir -> capacity ->
+   ops -> observability -> robustness`, never the reverse, with
+   restricted
    layers: the serving runtime may only be imported by
    `heavy_hitters/` (the one in-library session kind built on it), and
    `heavy_hitters` itself is application-facing — no library layer
@@ -14,7 +15,11 @@ Three rules over `distributed_point_functions_tpu/`:
    compile/HBM telemetry), but observability — `device.py` and
    `slo.py` included — imports only `utils/`, stdlib, and
    `robustness/` — never pir/ops/serving — so telemetry can never
-   create an upward edge. `robustness` (fault injection, circuit
+   create an upward edge. `capacity` (the shared byte/throughput
+   model plus admission and brownout policy) sits below every
+   workload: pir, serving, and heavy_hitters all consume it, and it
+   may instrument itself via observability but never import a
+   workload back. `robustness` (fault injection, circuit
    breaker, checkpoints) is the true bottom: stdlib-only, so even the
    device dispatch bracket can host a failpoint. Checked over ALL
    imports, including function-level ones, because a reversed
@@ -48,9 +53,10 @@ ROOT = Path(__file__).resolve().parent.parent
 # layers only. Subpackages not listed are unconstrained by rule 1
 # (but still cycle-checked by rule 2).
 LAYERS = {
-    "heavy_hitters": 6,
-    "serving": 5,
-    "pir": 4,
+    "heavy_hitters": 7,
+    "serving": 6,
+    "pir": 5,
+    "capacity": 4,
     "ops": 3,
     "observability": 2,
     "robustness": 1,
@@ -201,8 +207,8 @@ def main() -> int:
                 # their upward edges.
                 violations.append(
                     f"{module}: imports {name} — reverses the "
-                    f"heavy_hitters -> serving -> pir -> ops -> "
-                    f"observability -> robustness layer DAG"
+                    f"heavy_hitters -> serving -> pir -> capacity -> "
+                    f"ops -> observability -> robustness layer DAG"
                 )
         graph[module] = {
             n for imp in top_imports
