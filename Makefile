@@ -23,6 +23,8 @@ test-fast:     ## ~8 min hermetic signal incl. core invariants + tiny Pallas
 	    tests/test_device_observability.py tests/test_slo.py \
 	    tests/test_phase_recorder.py tests/test_transfer_ledger.py \
 	    tests/test_autoprofile.py \
+	    tests/test_events.py tests/test_debug_bundle.py \
+	    tests/test_prober.py \
 	    tests/test_regression_gate.py \
 	    tests/test_robustness.py tests/test_chaos.py \
 	    tests/test_capacity.py tests/test_overload.py \

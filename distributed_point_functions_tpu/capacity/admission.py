@@ -32,6 +32,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..observability import events as events_mod
 from .model import CapacityModel, WorkCost, default_capacity_model
 
 
@@ -354,6 +355,17 @@ class AdmissionController:
         self._shed_by_tenant[tenant] = self._shed_by_tenant.get(tenant, 0) + 1
         if self.metrics is not None:
             self._c_shed[reason].inc()
+        # Coalesced per tenant+reason: a shed storm is one journal line
+        # with a repeat count, not a ring flush.
+        events_mod.emit(
+            "admission.shed",
+            f"{tenant}: {reason.value}",
+            severity="warning",
+            coalesce_key=f"shed:{tenant}:{reason.value}",
+            coalesce_s=5.0,
+            tenant=tenant,
+            reason=reason.value,
+        )
         return AdmissionDecision(
             admitted=False,
             reason=reason,

@@ -35,6 +35,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..observability import events as events_mod
 from ..observability import tracing
 
 BROWNOUT_STEPS: Tuple[str, ...] = (
@@ -205,6 +206,13 @@ class BrownoutController:
             ].inc()
         tracing.add_span(
             f"brownout.{action}", 0.0, step=step,
+            level=record["level_after"],
+        )
+        events_mod.emit(
+            f"brownout.{action}",
+            f"{step} (level {record['level_after']})",
+            severity="warning" if action == "engage" else "info",
+            step=step,
             level=record["level_after"],
         )
 

@@ -70,6 +70,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..observability import events as events_mod
 from ..observability import tracing
 from ..observability import phases as phases_mod
 from ..robustness.checkpoint import CheckpointStore
@@ -493,6 +494,12 @@ class HeavyHittersLeader:
             return None
         sweep = FrontierSweep.restore(config, state)
         self._c_resumes.inc()
+        events_mod.emit(
+            "hh.sweep_resume",
+            f"resumed at round {sweep.round_index}",
+            severity="info",
+            round=sweep.round_index,
+        )
         return sweep
 
     def run(self) -> HeavyHittersResult:

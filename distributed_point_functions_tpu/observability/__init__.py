@@ -1,7 +1,7 @@
 """End-to-end request observability: tracing, device telemetry, SLOs,
-exposition, admin surface.
+events, debug bundles, exposition, admin surface.
 
-Eight pieces, importable from any layer above `utils/` (the layer DAG
+Ten pieces, importable from any layer above `utils/` (the layer DAG
 is serving -> observability -> utils; this package never imports pir/,
 ops/, or serving/ — `device`/`slo` reach JAX lazily and only for
 device facts):
@@ -25,17 +25,33 @@ device facts):
 * `autoprofile` — SLO-triggered profiling: one bounded xprof capture
   per latency-burn transition, with cooldown and a capture ring on
   `/statusz`.
+* `events` — the unified event journal: one process-global bounded
+  ring of typed operational transitions (breaker flips, brownout
+  steps, SLO burns, tier demotions, sweep resumes, sheds, failpoint
+  arming, probe failures) surfaced at `/eventz` and on `/statusz`.
+* `bundle` — incident debug bundles: on an SLO hard breach, breaker
+  open, or probe bit-identity failure, snapshot statusz/metrics/
+  traces/journal/probe history atomically into one directory, with
+  cooldown and bounded retention (`/debugz`).
 * `propagation` — the versioned envelope that carries a trace id on
   the Leader->Helper wire and the Helper's stage timings back
   (old-version peers interop by detection).
 * `exposition` — Prometheus text rendering of the metrics registry,
   including OpenMetrics-style exemplars linking buckets to traces.
 * `admin` — the `/metrics` `/varz` `/healthz` `/statusz` `/tracez`
-  `/profilez` operator HTTP endpoint.
+  `/eventz` `/probez` `/debugz` `/profilez` operator HTTP endpoint.
 """
 
 from .admin import AdminServer
 from .autoprofile import AutoProfiler
+from .bundle import BundleManager
+from .events import (
+    EventJournal,
+    default_journal,
+    emit,
+    set_default_journal,
+    watch_failpoints,
+)
 from .device import (
     CompileTracker,
     DeviceTelemetry,
@@ -82,10 +98,12 @@ from .tracing import (
 __all__ = [
     "AdminServer",
     "AutoProfiler",
+    "BundleManager",
     "CompileTracker",
     "CounterGroup",
     "DeviceTelemetry",
     "EnvelopeError",
+    "EventJournal",
     "FlightRecorder",
     "HbmAccountant",
     "PHASES",
@@ -98,9 +116,11 @@ __all__ = [
     "add_span",
     "current_request",
     "current_trace",
+    "default_journal",
     "default_phase_recorder",
     "default_recorder",
     "default_telemetry",
+    "emit",
     "encode_request",
     "encode_response",
     "install_jax_monitoring_listener",
@@ -109,6 +129,7 @@ __all__ = [
     "render_prometheus",
     "reset_stages",
     "runtime_counters",
+    "set_default_journal",
     "set_default_phase_recorder",
     "set_default_recorder",
     "set_default_telemetry",
@@ -118,4 +139,5 @@ __all__ = [
     "trace_request",
     "try_decode_request",
     "try_decode_response",
+    "watch_failpoints",
 ]

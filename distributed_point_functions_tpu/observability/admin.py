@@ -1,15 +1,20 @@
 """Operator telemetry endpoint: /metrics, /varz, /healthz, /statusz,
-/tracez, /profilez — a stdlib `http.server` surface any session can
-hang off a port.
+/tracez, /profilez, /eventz, /probez, /debugz — a stdlib `http.server`
+surface any session can hang off a port.
 
 The serving runtime's observability state (metrics registry, flight
 recorder, stage aggregates, runtime counters, device telemetry, SLO
-tracker) is in-process; this server is the scrape surface:
+tracker, event journal, blackbox prober, debug bundles) is in-process;
+this server is the scrape surface:
 
     /healthz                 liveness ("ok", 200); with an SLO tracker
                              attached, degrades to 503 while any hard
                              objective is in breach and recovers on
-                             the next probe after the breach clears
+                             the next probe after the breach clears.
+                             With a prober attached the reply is JSON
+                             and gains per-kind probe freshness — a
+                             bit-identity probe that has not passed
+                             within its window also degrades to 503
     /metrics                 Prometheus text exposition of the registry
                              plus the observability runtime counters
     /varz                    the same state as one JSON document
@@ -19,19 +24,32 @@ tracker) is in-process; this server is the scrape surface:
                              transfer ledger, auto-captured profiles,
                              compile counts and cache-hit ratios per
                              dispatch site, HBM watermarks per phase,
-                             SLO burn table; `?format=json` for the
-                             same data machine-readable
+                             SLO burn table, probe summary, and the
+                             event-journal tail; `?format=json` for
+                             the same data machine-readable
     /tracez                  flight-recorder dump (slowest / errored /
                              recent traces, JSON)
+    /eventz                  the unified event journal, newest last
+                             (text; `?format=json`, `?kind=` prefix
+                             filter, `?n=` tail length)
+    /probez                  per-kind blackbox probe history and
+                             freshness (JSON; requires a prober)
+    /debugz                  captured incident debug bundles (JSON;
+                             requires a `BundleManager`)
     /profilez?duration_ms=N  on-demand xprof capture via
                              `utils/profiling.trace` into a fresh
                              directory; returns the trace dir (bounded
                              at 60 s; one capture at a time)
 
 The registry is duck-typed (`.export() -> dict`) so this layer never
-imports `serving/` (check_layers: serving -> observability -> utils).
-Bind is loopback by default — the surface is for operators, not the
-internet.
+imports `serving/` (check_layers: serving -> observability -> utils);
+`prober` is equally duck-typed (`export()`/`freshness()`) because the
+prober lives *above* serving. Bind is loopback by default — the
+surface is for operators, not the internet.
+
+Uptime is computed from a monotonic clock (a wall-clock step — NTP,
+leap smear, manual set — must not bend it); `started_at` keeps the
+wall-clock start for display.
 """
 
 from __future__ import annotations
@@ -48,6 +66,7 @@ from typing import Optional
 
 from ..utils.profiling import trace as xprof_trace
 from . import tracing
+from . import events as events_mod
 from .device import DeviceTelemetry, default_telemetry
 from .phases import PhaseRecorder, default_phase_recorder
 
@@ -82,6 +101,9 @@ class AdminServer:
         breakers=None,
         brownout=None,
         admission=None,
+        journal=None,
+        prober=None,
+        bundles=None,
     ):
         self._registry = registry
         self._recorder = (
@@ -112,10 +134,33 @@ class AdminServer:
         # ladder" needle and a per-tenant admission table when present.
         self._brownout = brownout
         self._admission = admission
+        # journal defaults to the process-global event journal (the
+        # library's emit sites all land there); prober (a
+        # `serving.prober.Prober` or anything with `export()` +
+        # `freshness()`) and bundles (`bundle.BundleManager`) are
+        # opt-in. Handing over a BundleManager auto-registers the
+        # standard snapshot sources on it.
+        self._journal = (
+            journal if journal is not None else events_mod.default_journal()
+        )
+        self._prober = prober
+        self._bundles = bundles
         self._name = name
         self._profile_dir = profile_dir
         self._profile_lock = threading.Lock()
+        # Monotonic for arithmetic, wall for display: an NTP step must
+        # never produce a negative (or century-long) uptime.
+        self._started_mono = time.monotonic()
         self._started_unix = time.time()
+        if bundles is not None:
+            bundles.add_source("statusz", self._status_state)
+            bundles.add_source("metrics", self._merged_export)
+            bundles.add_source("traces", self._recorder.dump)
+            bundles.add_source(
+                "events", lambda: self._journal.export()
+            )
+            if prober is not None:
+                bundles.add_source("probes", prober.export)
         outer = self
 
         class _Handler(http.server.BaseHTTPRequestHandler):
@@ -171,6 +216,9 @@ class AdminServer:
             export["counters"].setdefault(name, value)
         return export
 
+    def _uptime_s(self) -> float:
+        return round(time.monotonic() - self._started_mono, 1)
+
     def _route(self, handler) -> None:
         parsed = urllib.parse.urlsplit(handler.path)
         path = parsed.path.rstrip("/") or "/"
@@ -190,9 +238,8 @@ class AdminServer:
             body = json.dumps(
                 {
                     "name": self._name,
-                    "uptime_s": round(
-                        time.time() - self._started_unix, 1
-                    ),
+                    "uptime_s": self._uptime_s(),
+                    "started_at": self._started_unix,
                     "metrics": self._merged_export(),
                     "stages": tracing.stage_summary(),
                 },
@@ -204,44 +251,155 @@ class AdminServer:
                 self._recorder.dump(), indent=2, default=str
             ).encode()
             self._reply(handler, 200, "application/json", body)
+        elif path == "/eventz":
+            self._eventz(handler, parsed.query)
+        elif path == "/probez":
+            self._probez(handler)
+        elif path == "/debugz":
+            self._debugz(handler)
         elif path == "/profilez":
             self._profilez(handler, parsed.query)
         else:
             self._reply(
                 handler, 404, "text/plain; charset=utf-8",
                 b"unknown endpoint; try /healthz /metrics /varz "
-                b"/statusz /tracez /profilez\n",
+                b"/statusz /tracez /eventz /probez /debugz /profilez\n",
             )
 
     def _healthz(self, handler) -> None:
-        if self._slo is None:
-            self._reply(
-                handler, 200, "text/plain; charset=utf-8", b"ok\n"
-            )
-            return
-        breaches = self._slo.breaches(evaluate=True)
-        if not breaches:
-            self._reply(
-                handler, 200, "text/plain; charset=utf-8", b"ok\n"
-            )
-            return
-        lines = "".join(
-            f"slo breach: {b['name']} ({b['metric']} observed "
-            f"{b['observed']} vs {b['threshold']}, "
-            f"burning {b['burn_s']}s)\n"
-            for b in breaches
+        breaches = (
+            self._slo.breaches(evaluate=True)
+            if self._slo is not None
+            else []
         )
+        if self._prober is None:
+            # Legacy shape: bare liveness text, 503 on hard SLO breach.
+            if not breaches:
+                self._reply(
+                    handler, 200, "text/plain; charset=utf-8", b"ok\n"
+                )
+                return
+            lines = "".join(
+                f"slo breach: {b['name']} ({b['metric']} observed "
+                f"{b['observed']} vs {b['threshold']}, "
+                f"burning {b['burn_s']}s)\n"
+                for b in breaches
+            )
+            self._reply(
+                handler, 503, "text/plain; charset=utf-8",
+                ("unhealthy\n" + lines).encode(),
+            )
+            return
+        # With a prober attached the reply is JSON and gains probe
+        # freshness: a serving process whose bit-identity probes have
+        # not passed within their window may be serving wrong bits with
+        # 200s everywhere else — that is exactly what must drain it.
+        freshness = self._prober.freshness()
+        stale = sorted(
+            k for k, v in freshness.items() if not v.get("fresh", True)
+        )
+        healthy = not breaches and not stale
+        detail = {
+            "status": "ok" if healthy else "unhealthy",
+            "slo_breaches": breaches,
+            "probes": freshness,
+            "stale_probes": stale,
+        }
         self._reply(
-            handler, 503, "text/plain; charset=utf-8",
-            ("unhealthy\n" + lines).encode(),
+            handler,
+            200 if healthy else 503,
+            "application/json",
+            json.dumps(detail, indent=2, default=str).encode(),
         )
+
+    def _eventz(self, handler, query: str) -> None:
+        params = urllib.parse.parse_qs(query)
+        kind = params.get("kind", [None])[0]
+        try:
+            n = int(params.get("n", ["64"])[0])
+        except ValueError:
+            self._reply(
+                handler, 400, "text/plain; charset=utf-8",
+                b"n must be an integer\n",
+            )
+            return
+        events = self._journal.tail(n=n, kind=kind)
+        if params.get("format", [""])[0] == "json":
+            body = json.dumps(
+                {
+                    "journal": {
+                        k: v
+                        for k, v in self._journal.export().items()
+                        if k != "events"
+                    },
+                    "events": events,
+                },
+                indent=2, default=str,
+            ).encode()
+            self._reply(handler, 200, "application/json", body)
+            return
+        lines = [
+            f"# {self._name} event journal "
+            f"(newest last; ?format=json ?kind= ?n=)"
+        ]
+        for e in events:
+            extra = {
+                k: v
+                for k, v in e.items()
+                if k not in (
+                    "seq", "t_wall", "t_mono", "kind", "severity",
+                    "message", "trace_id",
+                )
+            }
+            when = time.strftime(
+                "%H:%M:%S", time.localtime(e["t_wall"])
+            )
+            lines.append(
+                f"{e['seq']:>6} {when} [{e['severity']:>7}] "
+                f"{e['kind']:<24} {e['message']}"
+                + (f"  {extra}" if extra else "")
+                + (
+                    f"  trace={e['trace_id']}"
+                    if e.get("trace_id")
+                    else ""
+                )
+            )
+        self._reply(
+            handler, 200, "text/plain; charset=utf-8",
+            ("\n".join(lines) + "\n").encode(),
+        )
+
+    def _probez(self, handler) -> None:
+        if self._prober is None:
+            self._reply(
+                handler, 404, "text/plain; charset=utf-8",
+                b"no prober attached\n",
+            )
+            return
+        body = json.dumps(
+            self._prober.export(), indent=2, default=str
+        ).encode()
+        self._reply(handler, 200, "application/json", body)
+
+    def _debugz(self, handler) -> None:
+        if self._bundles is None:
+            self._reply(
+                handler, 404, "text/plain; charset=utf-8",
+                b"no bundle manager attached\n",
+            )
+            return
+        body = json.dumps(
+            self._bundles.export(), indent=2, default=str
+        ).encode()
+        self._reply(handler, 200, "application/json", body)
 
     # -- /statusz -----------------------------------------------------------
 
     def _status_state(self) -> dict:
         state = {
             "name": self._name,
-            "uptime_s": round(time.time() - self._started_unix, 1),
+            "uptime_s": self._uptime_s(),
+            "started_at": self._started_unix,
             "device": self._device.export(),
             "slo": self._slo.export() if self._slo is not None else None,
             "phases": self._phases.waterfall(),
@@ -268,6 +426,20 @@ class AdminServer:
                 if self._admission is not None
                 else None
             ),
+            "prober": (
+                self._prober.export()
+                if self._prober is not None
+                else None
+            ),
+            "bundles": (
+                self._bundles.export()
+                if self._bundles is not None
+                else None
+            ),
+            "events": {
+                "kinds": self._journal.kinds(),
+                "tail": self._journal.tail(n=32),
+            },
         }
         return state
 
@@ -545,6 +717,64 @@ def _render_statusz(state: dict) -> str:
             )
         out.append("</table>")
 
+    prober = state.get("prober")
+    if prober is not None:
+        out.append("<h2>Blackbox probes</h2>")
+        out.append(
+            f"<p>cycles: {prober['cycles']}, probes: {prober['probes']}, "
+            f"passes: {prober['passes']}, mismatches: "
+            f"{prober['mismatches']}, errors: {prober['errors']}, "
+            f"degraded: {prober['degraded']}</p>"
+        )
+        out.append(
+            "<table><tr><th>kind</th><th>last status</th>"
+            "<th>last ms</th><th>pass age (s)</th><th>fresh</th>"
+            "<th>detail</th></tr>"
+        )
+        for kind, entry in prober.get("freshness", {}).items():
+            cls = (
+                "ok" if entry.get("last_status") == "pass"
+                else ("nodata" if entry.get("last_status") is None
+                      else "breach")
+            )
+            age = entry.get("last_pass_age_s")
+            out.append(
+                f"<tr class={cls}><td>{esc(kind)}</td>"
+                f"<td>{esc(str(entry.get('last_status')))}</td>"
+                f"<td>{entry.get('last_ms', '-')}</td>"
+                f"<td>{'-' if age is None else age}</td>"
+                f"<td>{entry.get('fresh')}</td>"
+                f"<td>{esc(str(entry.get('detail') or ''))[:120]}</td>"
+                f"</tr>"
+            )
+        out.append("</table>")
+
+    bundles = state.get("bundles")
+    if bundles is not None:
+        out.append("<h2>Debug bundles</h2>")
+        out.append(
+            f"<p>dir: {esc(bundles['directory'])}; fired: "
+            f"{bundles['fired']}, suppressed (cooldown/in-flight): "
+            f"{bundles['suppressed_cooldown']}/"
+            f"{bundles['suppressed_inflight']}, "
+            f"cooldown: {bundles['cooldown_s']} s</p>"
+        )
+        if not bundles["bundles"]:
+            out.append("<p class=nodata>no bundles captured</p>")
+        else:
+            out.append(
+                "<table><tr><th>seq</th><th>when (unix)</th>"
+                "<th>reason</th><th>path</th></tr>"
+            )
+            for b in bundles["bundles"]:
+                where = b.get("path") or b.get("error") or "-"
+                out.append(
+                    f"<tr><td>{b.get('seq')}</td><td>{b.get('ts_unix')}</td>"
+                    f"<td>{esc(str(b.get('reason')))}</td>"
+                    f"<td>{esc(str(where))}</td></tr>"
+                )
+            out.append("</table>")
+
     profiles = state.get("profiles")
     if profiles is not None:
         out.append("<h2>Auto-captured profiles</h2>")
@@ -627,6 +857,33 @@ def _render_statusz(state: dict) -> str:
             out.append(
                 f"<tr><td>{esc(phase)}</td>"
                 f"<td>{_fmt_bytes(watermark)}</td></tr>"
+            )
+        out.append("</table>")
+
+    events = state.get("events") or {}
+    tail = events.get("tail") or []
+    out.append("<h2>Recent events</h2>")
+    if not tail:
+        out.append("<p class=nodata>no events journaled yet</p>")
+    else:
+        out.append(
+            "<table><tr><th>seq</th><th>when</th><th>severity</th>"
+            "<th>kind</th><th>message</th></tr>"
+        )
+        for e in tail:
+            cls = {"error": "breach", "warning": "breach"}.get(
+                e["severity"], "ok"
+            )
+            when = time.strftime(
+                "%H:%M:%S", time.localtime(e["t_wall"])
+            )
+            message = e["message"]
+            if e.get("repeats"):
+                message += f" (x{e['repeats'] + 1})"
+            out.append(
+                f"<tr class={cls}><td>{e['seq']}</td><td>{when}</td>"
+                f"<td>{esc(e['severity'])}</td><td>{esc(e['kind'])}</td>"
+                f"<td>{esc(message)[:160]}</td></tr>"
             )
         out.append("</table>")
     out.append("</body></html>")

@@ -51,6 +51,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from . import events as events_mod
+
 __all__ = ["SloObjective", "SloTracker", "KINDS"]
 
 KINDS = ("p99_ms_max", "rate_min", "counter_max", "gauge_max")
@@ -182,6 +184,7 @@ class SloTracker:
         now = self._clock()
         results = []
         new_burns = []
+        recoveries = []
         with self._lock:
             for objective in self._objectives:
                 observed, state = self._observe(objective, export, now)
@@ -192,7 +195,11 @@ class SloTracker:
                 if state == "breach":
                     self._burning_since.setdefault(objective.name, now)
                 else:
-                    self._burning_since.pop(objective.name, None)
+                    ended = self._burning_since.pop(objective.name, None)
+                    if ended is not None:
+                        recoveries.append(
+                            (objective, round(now - ended, 3))
+                        )
                 burn = self._burning_since.get(objective.name)
                 record = {
                     "name": objective.name,
@@ -212,11 +219,32 @@ class SloTracker:
             self._last_eval = results
             listeners = list(self._burn_listeners)
         for record in new_burns:
+            events_mod.emit(
+                "slo.burn",
+                f"{record['name']} observed {record['observed']} vs "
+                f"{record['threshold']}",
+                severity=(
+                    "error" if record["severity"] == "hard" else "warning"
+                ),
+                objective=record["name"],
+                metric=record["metric"],
+                observed=record["observed"],
+                threshold=record["threshold"],
+            )
             for listener in listeners:
                 try:
                     listener(record)
                 except Exception:  # pragma: no cover - grading completes
                     pass
+        for objective, burned_s in recoveries:
+            events_mod.emit(
+                "slo.recovered",
+                f"{objective.name} after {burned_s}s in breach",
+                severity="info",
+                objective=objective.name,
+                metric=objective.metric,
+                burn_s=burned_s,
+            )
         return results
 
     def healthy(self) -> bool:

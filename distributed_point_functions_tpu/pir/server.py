@@ -30,6 +30,7 @@ import threading
 from typing import Callable, Optional
 
 from ..dpf import DistributedPointFunction, DpfParameters
+from ..observability import events as events_mod
 from ..observability import tracing
 from ..observability import phases as phases_mod
 from ..observability.device import default_telemetry, shape_key
@@ -509,6 +510,14 @@ class DenseDpfPirServer(DpfPirServer):
         tracing.runtime_counters.inc("pir.tier_demotions")
         tracing.runtime_counters.inc(
             f"pir.tier_demote.{plan.mode}_to_{demoted}"
+        )
+        events_mod.emit(
+            "pir.tier_demotion",
+            f"{num_keys} keys: {plan.mode} -> {demoted} after device OOM",
+            severity="warning",
+            num_keys=num_keys,
+            from_tier=plan.mode,
+            to_tier=demoted,
         )
         import warnings
 

@@ -109,6 +109,10 @@ class FailpointRegistry:
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._specs: Dict[str, FailpointSpec] = {}
+        # Arming observers: cb(site, spec_or_None) on arm / disarm /
+        # clear. Plain callables so this module stays stdlib-only; the
+        # event journal (observability/events.py) subscribes downward.
+        self._arm_listeners: list = []
         self.armed = False
         if env:
             spec_env = os.environ.get(_ENV_SPECS, "").strip()
@@ -117,6 +121,23 @@ class FailpointRegistry:
 
     # -- arming -------------------------------------------------------------
 
+    def add_arm_listener(self, listener: Callable) -> None:
+        """Register `listener(site, spec)` to observe arming changes
+        (`spec` is None on disarm). Called outside the registry lock;
+        exceptions are swallowed — fault injection must never be the
+        fault."""
+        with self._lock:
+            self._arm_listeners.append(listener)
+
+    def _notify_arm(self, site: str, spec: Optional[FailpointSpec]) -> None:
+        with self._lock:
+            listeners = list(self._arm_listeners)
+        for listener in listeners:
+            try:
+                listener(site, spec)
+            except Exception:  # noqa: BLE001 - observers must not break arming
+                pass
+
     def arm(self, site: str, action: str = "error", **kwargs) -> FailpointSpec:
         """Arm `site`; returns the live spec (its `hits`/`fired`
         counters update as the schedule plays out)."""
@@ -124,6 +145,7 @@ class FailpointRegistry:
         with self._lock:
             self._specs[site] = spec
             self.armed = True
+        self._notify_arm(site, spec)
         return spec
 
     def arm_from_string(self, text: str) -> None:
@@ -153,13 +175,18 @@ class FailpointRegistry:
 
     def disarm(self, site: str) -> None:
         with self._lock:
-            self._specs.pop(site, None)
+            removed = self._specs.pop(site, None)
             self.armed = bool(self._specs)
+        if removed is not None:
+            self._notify_arm(site, None)
 
     def clear(self) -> None:
         with self._lock:
+            sites = list(self._specs)
             self._specs.clear()
             self.armed = False
+        for site in sites:
+            self._notify_arm(site, None)
 
     def spec(self, site: str) -> Optional[FailpointSpec]:
         with self._lock:

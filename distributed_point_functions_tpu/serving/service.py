@@ -62,6 +62,7 @@ import threading
 from .. import serialization
 from ..capacity.admission import AdmissionController, TenantPolicy
 from ..capacity.brownout import BrownoutController
+from ..observability import events as events_mod
 from ..observability import propagation, tracing
 from ..observability import phases as phases_mod
 from ..observability.device import (
@@ -511,6 +512,13 @@ class LeaderSession(_Session):
 
     def _on_breaker_transition(self, old: str, new: str) -> None:
         self._g_breaker.set(float(self._breaker.state_code()))
+        events_mod.emit(
+            "breaker.transition",
+            f"helper-leg breaker {old} -> {new}",
+            severity="error" if new == "open" else "info",
+            old=old,
+            new=new,
+        )
         if new == "open":
             self._c_breaker_opens.inc()
         if new == "closed" and self._degraded:
@@ -748,6 +756,11 @@ class LeaderSession(_Session):
             if not self._degraded:
                 self._degraded = True
                 self._g_degraded.set(1.0)
+                events_mod.emit(
+                    "service.degraded",
+                    "helper unavailable; serving leader-share-only",
+                    severity="error",
+                )
             token = _DEADLINE.set(deadline)
             try:
                 return self._server._dispatch_plain(

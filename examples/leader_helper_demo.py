@@ -85,13 +85,17 @@ def _serving_config():
 # ---------------------------------------------------------------------------
 
 
-def _maybe_admin(admin_port, registry, name: str, slo_config=None):
+def _maybe_admin(admin_port, registry, name: str, slo_config=None,
+                 prober=None, bundles=None):
     """Start the operator telemetry endpoint when --admin-port is given
     (0 = auto-pick). Serves /metrics, /varz, /statusz, /tracez,
-    /healthz, and /profilez off the role's live registry, flight
-    recorder, and device telemetry. `--slo-config <json>` attaches a
-    declarative SLO tracker: hard breaches degrade /healthz to 503 and
-    /statusz shows the burn table."""
+    /healthz, /eventz, and /profilez off the role's live registry,
+    flight recorder, device telemetry, and event journal. `--slo-config
+    <json>` attaches a declarative SLO tracker: hard breaches degrade
+    /healthz to 503 and /statusz shows the burn table. With `--probe`
+    (leader role) the blackbox prober and its debug bundles surface at
+    /probez and /debugz, and /healthz degrades when a bit-identity
+    probe goes stale."""
     if admin_port is None:
         return None
     from distributed_point_functions_tpu.observability import (
@@ -112,12 +116,19 @@ def _maybe_admin(admin_port, registry, name: str, slo_config=None):
         port=admin_port,
         name=name,
         slo=slo,
+        prober=prober,
+        bundles=bundles,
     )
     admin.start()
+    extras = "".join(
+        [" /probez /debugz" if prober is not None else "",
+         "; SLOs: " + ",".join(o.name for o in slo.objectives)
+         if slo else ""]
+    )
     print(
         f"[{name}] admin endpoint on :{admin.port} "
-        "(/metrics /varz /statusz /tracez /healthz /profilez"
-        f"{'; SLOs: ' + ','.join(o.name for o in slo.objectives) if slo else ''})",
+        "(/metrics /varz /statusz /tracez /eventz /healthz /profilez"
+        f"{extras})",
         flush=True,
     )
     return admin
@@ -139,7 +150,8 @@ def run_helper(port: int, admin_port=None, slo_config=None) -> None:
 
 
 def run_leader(
-    port: int, helper_addr: str, admin_port=None, slo_config=None
+    port: int, helper_addr: str, admin_port=None, slo_config=None,
+    probe: bool = False,
 ) -> None:
     from distributed_point_functions_tpu.serving import (
         FramedTcpServer,
@@ -148,12 +160,37 @@ def run_leader(
         parse_hostport,
     )
 
-    db, _ = build_database()
+    db, records = build_database()
     helper_host, helper_port = parse_hostport(helper_addr)
     session = LeaderSession(
         db, TcpTransport(helper_host, helper_port), _serving_config()
     )
-    _maybe_admin(admin_port, session.metrics, "leader", slo_config)
+    prober = bundles = None
+    if probe:
+        from distributed_point_functions_tpu.observability import (
+            BundleManager,
+        )
+        from distributed_point_functions_tpu.serving.prober import Prober
+        from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+        # Golden queries through the real serving path: the plain-pair
+        # probes cover every planner tier locally, the e2e probe rides
+        # the encrypted helper leg over the real TCP transport. A
+        # bit-identity failure captures a debug bundle.
+        bundles = BundleManager(name="leader")
+        prober = Prober(
+            session, records, encrypter=encrypt_decrypt.encrypt,
+            period_s=10.0,
+        )
+        prober.add_failure_listener(bundles.on_probe_failure)
+        prober.start()
+        print(
+            f"[leader] blackbox prober on ({', '.join(prober.kinds())}); "
+            f"bundles -> {bundles.directory}",
+            flush=True,
+        )
+    _maybe_admin(admin_port, session.metrics, "leader", slo_config,
+                 prober=prober, bundles=bundles)
     server = FramedTcpServer(session.handle_wire, port=port, name="leader")
     print(f"[leader] listening on :{server.port}", flush=True)
     server.serve_forever()
@@ -298,6 +335,13 @@ def main():
                     "docs/DESIGN.md §11); with --admin-port, hard "
                     "breaches degrade /healthz to 503 and /statusz "
                     "shows the burn table")
+    ap.add_argument("--probe", action="store_true",
+                    help="leader role: run the blackbox verification "
+                    "prober (docs/DESIGN.md §15) — golden queries "
+                    "through every planner tier plus the encrypted "
+                    "helper leg, bit-identity asserted every cycle; "
+                    "with --admin-port, history at /probez, incident "
+                    "bundles at /debugz, probe staleness on /healthz")
     ap.add_argument("--demo", action="store_true",
                     help="spawn helper+leader and run a client against them")
     ap.add_argument("--platform", default="",
@@ -320,7 +364,7 @@ def main():
                    slo_config=args.slo_config)
     elif args.role == "leader":
         run_leader(args.port, args.helper, admin_port=args.admin_port,
-                   slo_config=args.slo_config)
+                   slo_config=args.slo_config, probe=args.probe)
     elif args.role == "client":
         indices = [int(x) for x in args.indices.split(",")]
         for i, rec in enumerate(

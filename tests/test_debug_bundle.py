@@ -1,0 +1,162 @@
+"""BundleManager: atomic capture, cooldown, retention ring, trigger
+adapters. All disk I/O goes to pytest tmp_path; the cooldown clock is
+a fake so suppression windows are deterministic.
+"""
+
+import json
+import os
+import threading
+
+from distributed_point_functions_tpu.observability.bundle import BundleManager
+from distributed_point_functions_tpu.observability.events import EventJournal
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, s):
+        self.now += s
+
+
+def make_manager(tmp_path, **kwargs):
+    clock = FakeClock()
+    journal = EventJournal()
+    kwargs.setdefault("cooldown_s", 60.0)
+    mgr = BundleManager(
+        str(tmp_path), clock=clock, journal=journal, **kwargs
+    )
+    return mgr, clock, journal
+
+
+def test_capture_writes_sources_and_manifest(tmp_path):
+    mgr, _, journal = make_manager(tmp_path)
+    mgr.add_source("statusz", lambda: {"healthy": True})
+    mgr.add_source("metrics", lambda: {"counters": {"x": 1}})
+    entry = mgr.trigger("probe_failure", {"kind": "pir_unbatched"})
+    assert entry is not None and "error" not in entry
+    assert os.path.isdir(entry["path"])
+    assert os.path.basename(entry["path"]).startswith("bundle-0001-")
+    with open(os.path.join(entry["path"], "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["reason"] == "probe_failure"
+    assert manifest["context"] == {"kind": "pir_unbatched"}
+    assert manifest["sources"] == {"statusz": "ok", "metrics": "ok"}
+    with open(os.path.join(entry["path"], "statusz.json")) as f:
+        assert json.load(f) == {"healthy": True}
+    # The capture announced itself on the journal.
+    assert [e["kind"] for e in journal.tail()] == ["bundle.captured"]
+
+
+def test_no_partial_bundle_visible_and_source_errors_recorded(tmp_path):
+    mgr, _, _ = make_manager(tmp_path)
+    seen_during_capture = []
+
+    def nosy_source():
+        # Runs mid-capture: only committed (renamed) bundles may be
+        # visible without their dot prefix.
+        seen_during_capture.extend(
+            n for n in os.listdir(tmp_path) if not n.startswith(".")
+        )
+        return {"ok": True}
+
+    def broken_source():
+        raise RuntimeError("snapshot exploded")
+
+    mgr.add_source("nosy", nosy_source)
+    mgr.add_source("broken", broken_source)
+    entry = mgr.trigger("breaker_open")
+    assert seen_during_capture == []
+    assert entry["sources"]["nosy"] == "ok"
+    assert "RuntimeError" in entry["sources"]["broken"]
+    # A failing source never aborts the bundle; the rest landed.
+    assert os.path.exists(os.path.join(entry["path"], "nosy.json"))
+    assert not os.path.exists(os.path.join(entry["path"], "broken.json"))
+    # Nothing un-committed remains.
+    assert all(
+        n.startswith("bundle-") for n in os.listdir(tmp_path)
+    )
+
+
+def test_cooldown_suppresses_then_allows(tmp_path):
+    mgr, clock, _ = make_manager(tmp_path, cooldown_s=60.0)
+    assert mgr.trigger("first") is not None
+    assert mgr.trigger("second") is None
+    clock.advance(61.0)
+    third = mgr.trigger("third")
+    assert third is not None and third["seq"] == 2
+    export = mgr.export()
+    assert export["suppressed_cooldown"] == 1
+    assert export["fired"] == 2
+
+
+def test_retention_ring_deletes_evicted_directories(tmp_path):
+    mgr, clock, _ = make_manager(tmp_path, cooldown_s=0.0, max_bundles=2)
+    paths = []
+    for i in range(4):
+        clock.advance(1.0)
+        paths.append(mgr.trigger(f"r{i}")["path"])
+    kept = mgr.bundles()
+    assert [os.path.basename(b["path"]) for b in kept] == [
+        os.path.basename(paths[2]),
+        os.path.basename(paths[3]),
+    ]
+    assert not os.path.exists(paths[0]) and not os.path.exists(paths[1])
+    assert os.path.isdir(paths[2]) and os.path.isdir(paths[3])
+
+
+def test_concurrent_triggers_yield_exactly_one_bundle(tmp_path):
+    mgr, _, _ = make_manager(tmp_path, cooldown_s=3600.0)
+    barrier = threading.Barrier(8)
+    results = []
+
+    def fire(i):
+        barrier.wait()
+        results.append(mgr.trigger(f"concurrent-{i}"))
+
+    threads = [threading.Thread(target=fire, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wins = [r for r in results if r is not None]
+    assert len(wins) == 1
+    export = mgr.export()
+    assert export["fired"] == 1
+    assert (
+        export["suppressed_cooldown"] + export["suppressed_inflight"] == 7
+    )
+    assert len([n for n in os.listdir(tmp_path) if n.startswith("bundle-")]) == 1
+
+
+def test_trigger_adapters_filter_correctly(tmp_path):
+    mgr, clock, _ = make_manager(tmp_path, cooldown_s=0.0)
+    # Soft burns and non-open transitions must not capture.
+    mgr.on_burn({"severity": "soft", "name": "advisory"})
+    mgr.on_breaker_transition("open", "half_open")
+    assert mgr.export()["fired"] == 0
+    clock.advance(1.0)
+    mgr.on_burn(
+        {"severity": "hard", "name": "lat", "metric": "m",
+         "observed": 99, "threshold": 10}
+    )
+    clock.advance(1.0)
+    mgr.on_breaker_transition("closed", "open")
+    clock.advance(1.0)
+    mgr.on_probe_failure(
+        {"kind": "pir_chunked", "status": "mismatch",
+         "detail": "index 3", "seq": 7}
+    )
+    reasons = [b["reason"] for b in mgr.bundles()]
+    assert reasons == ["slo_hard_breach", "breaker_open", "probe_failure"]
+
+
+def test_reason_is_sanitized_into_path(tmp_path):
+    mgr, _, _ = make_manager(tmp_path)
+    entry = mgr.trigger("weird reason/../with spaces")
+    base = os.path.basename(entry["path"])
+    assert "/" not in base.replace("bundle-", "") and " " not in base
+    assert os.path.isdir(entry["path"])

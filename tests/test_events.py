@@ -1,0 +1,221 @@
+"""EventJournal: ring bound, ordering, coalescing, concurrent emit,
+failpoint watching, and the library emit sites that are cheap to drive
+(SLO burn/recovery).
+
+JAX-free on purpose: the journal is stdlib + tracing, and these tests
+must stay fast enough for `make test-fast`.
+"""
+
+import threading
+
+import pytest
+
+from distributed_point_functions_tpu.observability import tracing
+from distributed_point_functions_tpu.observability.events import (
+    EventJournal,
+    default_journal,
+    emit,
+    set_default_journal,
+    watch_failpoints,
+)
+from distributed_point_functions_tpu.observability.slo import (
+    SloObjective,
+    SloTracker,
+)
+from distributed_point_functions_tpu.robustness.failpoints import (
+    FailpointRegistry,
+)
+from distributed_point_functions_tpu.serving.metrics import MetricsRegistry
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, s):
+        self.now += s
+
+
+# -- core ring semantics ------------------------------------------------------
+
+
+def test_emit_assigns_monotone_seq_and_fields():
+    j = EventJournal(capacity=8)
+    first = j.emit("a.one", "hello", severity="info", extra=42)
+    second = j.emit("a.two", "world", severity="error")
+    assert first["seq"] == 1 and second["seq"] == 2
+    events = j.tail()
+    assert [e["kind"] for e in events] == ["a.one", "a.two"]
+    assert events[0]["extra"] == 42
+    assert events[0]["t_mono"] <= events[1]["t_mono"]
+    assert events[1]["severity"] == "error"
+
+
+def test_ring_bound_evicts_oldest_and_counts_dropped():
+    j = EventJournal(capacity=4)
+    for i in range(10):
+        j.emit("k", str(i))
+    events = j.tail()
+    assert len(events) == 4
+    assert [e["message"] for e in events] == ["6", "7", "8", "9"]
+    # Seq numbers keep counting across eviction: ordering stays provable.
+    assert [e["seq"] for e in events] == [7, 8, 9, 10]
+    export = j.export()
+    assert export["emitted"] == 10
+    assert export["dropped"] == 6
+
+
+def test_bad_severity_and_capacity_rejected():
+    with pytest.raises(ValueError):
+        EventJournal(capacity=0)
+    j = EventJournal()
+    with pytest.raises(ValueError):
+        j.emit("k", severity="fatal")
+
+
+def test_tail_filters_kind_prefix_and_severity():
+    j = EventJournal()
+    j.emit("prober.mismatch", severity="error")
+    j.emit("prober.recovered", severity="info")
+    j.emit("breaker.transition", severity="warning")
+    assert [e["kind"] for e in j.tail(kind="prober")] == [
+        "prober.mismatch",
+        "prober.recovered",
+    ]
+    # Exact match works too, and prefixes do not cross dots.
+    assert len(j.tail(kind="breaker.transition")) == 1
+    assert j.tail(kind="brea") == []
+    errors = j.tail(min_severity="warning")
+    assert [e["kind"] for e in errors] == [
+        "prober.mismatch",
+        "breaker.transition",
+    ]
+    assert len(j.tail(n=1)) == 1
+    assert j.kinds() == {
+        "breaker.transition": 1,
+        "prober.mismatch": 1,
+        "prober.recovered": 1,
+    }
+
+
+def test_coalescing_bumps_repeats_within_window():
+    clock = FakeClock()
+    j = EventJournal(clock=clock)
+    for _ in range(5):
+        j.emit("admission.shed", "t1", coalesce_key="shed:t1", coalesce_s=5.0)
+    events = j.tail()
+    assert len(events) == 1
+    assert events[0]["repeats"] == 4
+    # Past the window the next emit is a fresh event.
+    clock.advance(6.0)
+    j.emit("admission.shed", "t1", coalesce_key="shed:t1", coalesce_s=5.0)
+    assert len(j.tail()) == 2
+    assert j.export()["coalesced"] == 4
+
+
+def test_concurrent_emit_keeps_seq_dense_and_unique():
+    j = EventJournal(capacity=4096)
+    threads = [
+        threading.Thread(
+            target=lambda: [j.emit("race", str(i)) for i in range(100)]
+        )
+        for _ in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = j.tail()
+    seqs = [e["seq"] for e in events]
+    assert len(events) == 800
+    assert seqs == list(range(1, 801))
+
+
+def test_trace_id_attached_when_tracing():
+    j = EventJournal()
+    with tracing.trace_request("evt.test", role="test") as trace:
+        j.emit("traced.kind")
+    j.emit("untraced.kind")
+    traced, untraced = j.tail()
+    assert traced["trace_id"] == trace.trace_id
+    assert untraced["trace_id"] is None
+
+
+def test_clear_keeps_seq_counting():
+    j = EventJournal()
+    j.emit("a")
+    j.clear()
+    assert j.tail() == []
+    assert j.emit("b")["seq"] == 2
+
+
+def test_default_journal_swap_and_module_emit():
+    original = default_journal()
+    mine = EventJournal()
+    try:
+        set_default_journal(mine)
+        emit("swapped.kind", "here")
+        assert [e["kind"] for e in mine.tail()] == ["swapped.kind"]
+        assert original.tail(kind="swapped") == []
+    finally:
+        set_default_journal(original)
+
+
+# -- subscriptions ------------------------------------------------------------
+
+
+def test_watch_failpoints_emits_arm_disarm_and_retroactive():
+    reg = FailpointRegistry(env=False)
+    reg.arm("pre.armed", "delay", delay_ms=0.0)
+    j = EventJournal()
+    watch_failpoints(registry=reg, journal=j)
+    # The already-armed site shows up retroactively.
+    kinds = [e["kind"] for e in j.tail()]
+    assert kinds == ["failpoint.armed"]
+    assert j.tail()[0]["site"] == "pre.armed"
+    reg.arm("transport.response", "corrupt", times=None)
+    reg.disarm("transport.response")
+    reg.clear()
+    kinds = [(e["kind"], e["site"]) for e in j.tail()]
+    assert kinds == [
+        ("failpoint.armed", "pre.armed"),
+        ("failpoint.armed", "transport.response"),
+        ("failpoint.disarmed", "transport.response"),
+        ("failpoint.disarmed", "pre.armed"),
+    ]
+
+
+def test_slo_burn_and_recovery_emit_events():
+    original = default_journal()
+    j = EventJournal()
+    reg = MetricsRegistry()
+    tracker = SloTracker(
+        [
+            SloObjective(
+                name="ceiling",
+                kind="gauge_max",
+                metric="g",
+                threshold=10.0,
+                severity="hard",
+            )
+        ],
+        registry=reg,
+    )
+    try:
+        set_default_journal(j)
+        reg.gauge("g").set(50.0)
+        tracker.evaluate()
+        tracker.evaluate()  # continuing breach: no second burn event
+        reg.gauge("g").set(1.0)
+        tracker.evaluate()
+        kinds = [e["kind"] for e in j.tail()]
+        assert kinds == ["slo.burn", "slo.recovered"]
+        burn, recovered = j.tail()
+        assert burn["severity"] == "error"
+        assert burn["objective"] == "ceiling"
+        assert recovered["objective"] == "ceiling"
+    finally:
+        set_default_journal(original)

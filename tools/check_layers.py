@@ -53,6 +53,7 @@ ROOT = Path(__file__).resolve().parent.parent
 # layers only. Subpackages not listed are unconstrained by rule 1
 # (but still cycle-checked by rule 2).
 LAYERS = {
+    "prober": 8,
     "heavy_hitters": 7,
     "serving": 6,
     "pir": 5,
@@ -62,11 +63,24 @@ LAYERS = {
     "robustness": 1,
 }
 
+# Individual modules promoted out of their directory's layer. The
+# blackbox prober lives in serving/ for discoverability but *drives*
+# both serving and heavy_hitters (it replays golden queries through
+# them), so it gets its own top layer; `serving/__init__.py`
+# deliberately does not export it — that import would be serving ->
+# prober, an upward edge.
+MODULE_LAYERS = {f"{PACKAGE}.serving.prober": "prober"}
+
 # Restricted layers: importable only from the listed source layers
 # (plus themselves). serving stays a near-leaf — its one in-library
 # consumer is the heavy_hitters session; heavy_hitters is a true leaf
-# only applications may import.
-RESTRICTED = {"serving": {"heavy_hitters"}, "heavy_hitters": set()}
+# only applications (and the prober) may import; the prober itself is
+# a true leaf.
+RESTRICTED = {
+    "serving": {"heavy_hitters", "prober"},
+    "heavy_hitters": {"prober"},
+    "prober": set(),
+}
 
 # Application namespaces living outside the package: they may import
 # any layer, but no package module may import them (rule 3). Keeps
@@ -127,6 +141,9 @@ def collect(path: Path):
 
 
 def layer_of(module: str):
+    for name, layer in MODULE_LAYERS.items():
+        if module == name or module.startswith(name + "."):
+            return layer
     parts = module.split(".")
     if len(parts) >= 2 and parts[0] == PACKAGE and parts[1] in LAYERS:
         return parts[1]
