@@ -22,6 +22,7 @@ test-fast:     ## ~8 min hermetic signal incl. core invariants + tiny Pallas
 	    tests/test_serving_service.py tests/test_observability.py \
 	    tests/test_device_observability.py tests/test_slo.py \
 	    tests/test_phase_recorder.py tests/test_transfer_ledger.py \
+	    tests/test_critical_path.py \
 	    tests/test_autoprofile.py \
 	    tests/test_events.py tests/test_debug_bundle.py \
 	    tests/test_prober.py \

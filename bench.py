@@ -266,6 +266,32 @@ def _emit_latency_records(source: str):
                 )
 
 
+def _emit_critical_path_records(source: str):
+    """Append the cross-party critical-path profile accumulated by the
+    process-wide `CriticalPathAnalyzer`: one record per (party, phase)
+    that the merged two-party timelines charged critical time to
+    (direction: lower — the regression gate watches where the p99 goes,
+    e.g. `hh_critical_helper_helper_net_ms` creeping up means the wire
+    leg is eating the budget)."""
+    try:
+        from distributed_point_functions_tpu.observability import (
+            default_analyzer,
+        )
+
+        profile = default_analyzer().export()["profile"]
+    except Exception:  # noqa: BLE001 - observability only
+        return
+    for party, phases in profile.items():
+        for phase, entry in phases.items():
+            if entry["count"]:
+                _append_latency_record(
+                    f"{source}_critical_{party}_{phase}_ms",
+                    entry["p50_ms"],
+                    p99_ms=entry["p99_ms"],
+                    samples=entry["count"],
+                )
+
+
 class _InitTimeout(RuntimeError):
     pass
 
@@ -707,6 +733,7 @@ def main():
                 else "private sweep diverged from the plaintext oracle",
             )
             _emit_latency_records("hh")
+            _emit_critical_path_records("hh")
         except Exception as e:  # noqa: BLE001 - the JSON line must print
             _emit(
                 0.0, 0.0,
@@ -738,6 +765,7 @@ def main():
                 else "batched responses diverged from the unbatched oracle",
             )
             _emit_latency_records("serving")
+            _emit_critical_path_records("serving")
         except Exception as e:  # noqa: BLE001 - the JSON line must print
             _emit(
                 0.0, 0.0,

@@ -15,9 +15,15 @@ upfront by a direct (no serving runtime) `DenseDpfPirServer`, so the
 throughput claim carries an equal-correctness proof in the same run.
 The report includes the batched session's full metrics export — batch
 size histogram, padding waste, and the jit bucket compile/hit counters
-that demonstrate the bounded-compilation property — plus a report-only
-`prober_overhead` point measuring the q/s cost of running the blackbox
-verification prober (`serving/prober.py`) alongside real traffic.
+that demonstrate the bounded-compilation property — plus two
+report-only overhead points: `prober_overhead` measures the q/s cost
+of running the blackbox verification prober (`serving/prober.py`)
+alongside real traffic, and `digest_overhead` measures the q/s cost of
+the v2 envelope's critical-path digest piggyback (Helper phase
+waterfall + recv/send timestamps on every reply; pinned off via
+`ServingConfig(helper_digest=False)`) on the encrypted Leader->Helper
+path. Both ride a <2% budget reviewed from the report, not gated in
+CI.
 
 Run directly (one JSON report on stdout, also written to
 ``benchmarks/results/serving_bench.json``)::
@@ -310,6 +316,80 @@ def run_serving_bench():
         f"{prober_overhead['prober_cycles']} probe cycles)"
     )
 
+    # Digest piggyback overhead: the encrypted Leader->Helper path,
+    # back to back with the critical-path digest pinned off (v1
+    # envelope: no phase waterfall, no recv/send timestamps, no skew
+    # merge on the Leader) and on (the v2 default). Report-only, same
+    # rationale as the prober point: the <2% q/s budget is reviewed
+    # from the report because the delta sits inside CPU-host variance.
+    def digest_overhead_point():
+        from distributed_point_functions_tpu.serving import (
+            HelperSession,
+            InProcessTransport,
+            LeaderSession,
+        )
+        from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+        concurrency = concurrency_levels[-1]
+        e2e_client = DenseDpfPirClient.create(
+            num_records, encrypt_decrypt.encrypt
+        )
+        indices = [
+            int(i) for i in rng.integers(0, num_records, num_requests)
+        ]
+        pool = [e2e_client.create_request([i]) for i in indices]
+
+        def leg(helper_digest):
+            config = ServingConfig(
+                max_batch_size=max_batch,
+                max_wait_ms=2.0,
+                max_queue=max(256, 4 * num_requests),
+                batching=True,
+                helper_digest=helper_digest,
+            )
+            helper = HelperSession(
+                database, encrypt_decrypt.decrypt, config
+            )
+            leader = LeaderSession(
+                database, InProcessTransport(helper.handle_wire), config
+            )
+            with helper, leader:
+                # One warm request outside the timing: the envelope
+                # probe settles and the leader-share jit shapes warm.
+                leader.handle_request(pool[0][0])
+                wall, _, resps = _closed_loop(
+                    leader.handle_request,
+                    [r for r, _ in pool],
+                    concurrency,
+                )
+            bad = 0
+            for (_, state), idx, resp in zip(pool, indices, resps):
+                got = e2e_client.handle_response(resp, state)
+                if got != [record_list[idx]]:
+                    bad += 1
+            return len(pool) / wall, bad
+
+        base_qps, base_bad = leg(helper_digest=False)
+        digest_qps, digest_bad = leg(helper_digest=True)
+        return {
+            "concurrency": concurrency,
+            "requests_per_leg": len(pool),
+            "baseline_qps": round(base_qps, 2),
+            "digest_qps": round(digest_qps, 2),
+            "overhead_pct": round(
+                100.0 * (base_qps - digest_qps) / base_qps, 2
+            ),
+            "mismatches": base_bad + digest_bad,
+        }
+
+    digest_overhead = digest_overhead_point()
+    _log(
+        f"digest overhead c={digest_overhead['concurrency']}: "
+        f"{digest_overhead['baseline_qps']:.1f} -> "
+        f"{digest_overhead['digest_qps']:.1f} q/s "
+        f"({digest_overhead['overhead_pct']:+.1f}%)"
+    )
+
     best_batched = max(p["qps"] for p in batched_points)
     best_unbatched = max(p["qps"] for p in unbatched_points)
     correctness_ok = (
@@ -318,6 +398,7 @@ def run_serving_bench():
             for p in batched_points + unbatched_points
         )
         and prober_overhead["mismatches"] == 0
+        and digest_overhead["mismatches"] == 0
     )
     compiles = batched_metrics["counters"].get(
         "plain.batcher.jit_bucket_compiles", 0
@@ -339,6 +420,7 @@ def run_serving_bench():
         else None,
         "correctness_ok": correctness_ok,
         "prober_overhead": prober_overhead,
+        "digest_overhead": digest_overhead,
         "jit_bucket_compiles": compiles,
         "batched_metrics": batched_metrics,
         # Per-stage span summary (queue wait / batch assembly / device

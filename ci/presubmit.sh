@@ -19,19 +19,27 @@
 #                    live AdminServer, check a hard SLO breach degrades
 #                    /healthz to 503, and check a synthetic latency-SLO
 #                    burn produces exactly one auto-capture entry
-#   8. chaos-smoke — one scripted fault schedule through the real
+#   8. critical-smoke — cross-party critical path over a real TCP
+#                    Leader/Helper pair: the skew-corrected
+#                    decomposition on /criticalz must account for the
+#                    measured helper rtt (helper_net + helper_queue +
+#                    helper_compute == exchange rtt exactly, within
+#                    own-share overlap + stated uncertainty of the raw
+#                    rtt), and the merged two-party timeline on
+#                    /tracez must be monotone per party
+#   9. chaos-smoke — one scripted fault schedule through the real
 #                    stack: a permanently-failing helper leg must open
 #                    the Leader's circuit breaker (fast-fail, /statusz
 #                    row), and a heavy-hitters sweep killed mid-run
 #                    must resume from its checkpoint to the plaintext
 #                    answer
-#   9. overload-smoke — synthetic burst against cost-aware admission:
+#  10. overload-smoke — synthetic burst against cost-aware admission:
 #                    a tiny tenant quota must shed at admission with a
 #                    typed RetryAfter hint (never reaching the batcher),
 #                    and a breaching SLO signal must walk the brownout
 #                    ladder to critical_only (visible on /statusz) and
 #                    fully auto-revert when the signal clears
-#  10. prober-smoke — blackbox-verification chaos drill: a `corrupt`
+#  11. prober-smoke — blackbox-verification chaos drill: a `corrupt`
 #                    failpoint armed on the helper-leg response wire
 #                    (via DPF_TPU_FAILPOINTS, so the event journal
 #                    shows the arming) must be flagged by the prober
@@ -40,10 +48,10 @@
 #                    the timeline, degrade /healthz once the e2e probe
 #                    goes stale, and fully recover (probez passing,
 #                    /healthz 200) after the failpoint clears
-#  11. perf-gate   — benchmarks/regression_gate.py --check-only against
+#  12. perf-gate   — benchmarks/regression_gate.py --check-only against
 #                    the committed history fixture (CPU-safe: judges
 #                    records, runs no bench)
-#  12. dryrun      — 8-virtual-device multichip compile+step
+#  13. dryrun      — 8-virtual-device multichip compile+step
 # Benchmarks are excluded exactly as the reference excludes
 # `--test_tag_filters=-benchmark`. `FULL=1` appends the whole suite.
 set -u -o pipefail
@@ -163,6 +171,90 @@ assert len(prof.captures()) == 1, prof.export()  # still exactly one
 print("admin-smoke: OK (/metrics incl. exemplars, /statusz incl. phase "
       "waterfall + transfer ledger + auto-captures, /tracez, /healthz "
       "incl. SLO degrade+recover, one capture per burn)")
+'
+
+stage critical-smoke env JAX_PLATFORMS=cpu python -c '
+import json, urllib.request
+import numpy as np
+from distributed_point_functions_tpu.observability import AdminServer
+from distributed_point_functions_tpu.pir import (
+    DenseDpfPirClient, DenseDpfPirDatabase,
+)
+from distributed_point_functions_tpu.serving import (
+    FramedTcpServer, HelperSession, LeaderSession, ServingConfig,
+    TcpTransport,
+)
+from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+rng = np.random.default_rng(7)
+records = [bytes(rng.integers(0, 256, 16, dtype=np.uint8))
+           for _ in range(64)]
+builder = DenseDpfPirDatabase.Builder()
+for r in records:
+    builder.insert(r)
+database = builder.build()
+config = ServingConfig(max_batch_size=4, max_wait_ms=2.0)
+helper = HelperSession(database, encrypt_decrypt.decrypt, config)
+server = FramedTcpServer(
+    helper.handle_wire, port=0, name="critical-helper"
+).start()
+transport = TcpTransport("localhost", server.port)
+leader = LeaderSession(database, transport, config)
+client = DenseDpfPirClient.create(64, encrypt_decrypt.encrypt)
+try:
+    with helper, leader:
+        for idx in (3, 17, 41):
+            request, state = client.create_request([idx])
+            response = leader.handle_request(request)
+            assert client.handle_response(response, state) == [
+                records[idx]
+            ], idx
+finally:
+    transport.close()
+    server.stop()
+with AdminServer(registry=leader.metrics) as admin:
+    base = f"http://127.0.0.1:{admin.port}"
+    crit = json.load(
+        urllib.request.urlopen(base + "/criticalz?format=json")
+    )
+    assert crit["requests"] == 3, crit
+    assert crit["skew_invalid"] == 0, crit
+    last = crit["last"]["leader"]
+    assert last["skew_valid"] is True, last
+    total = (last["helper_net_ms"] + last["helper_queue_ms"]
+             + last["helper_compute_ms"])
+    # Identity: the split accounts for the exchange rtt exactly.
+    assert abs(total - last["exchange_ms"]) < 1e-2, last
+    # ... and for the raw measured rtt within the honest tolerance:
+    # the own-share overlap that provably ran serially inside the
+    # bracket (bounded by own_ms and, when the concurrency cap
+    # engages, equal to 2x the stated uncertainty) plus codec slop.
+    assert abs(total - last["rtt_ms"]) <= (
+        last["own_ms"] + 2.0 * last["uncertainty_ms"] + 1.0
+    ), last
+    prof = crit["profile"]
+    assert prof and all(
+        "p99_ms" in cell
+        for party in prof.values() for cell in party.values()
+    ), prof
+    sz = json.load(urllib.request.urlopen(base + "/statusz?format=json"))
+    assert sz["critical"]["requests"] == 3, sz["critical"]
+    tracez = json.load(urllib.request.urlopen(base + "/tracez"))
+    traces = tracez["slowest"] + tracez["recent"]
+    merged = next(
+        t for t in traces if t["name"] == "leader.request"
+    )["attrs"]["critical_path"]
+    assert merged["critical_leg"] in ("helper", "local"), merged
+    timeline = merged["timeline"]
+    assert timeline and any(s["critical"] for s in timeline), merged
+    for party in {s["party"] for s in timeline}:
+        starts = [s["start_ms"] for s in timeline if s["party"] == party]
+        assert starts == sorted(starts), (party, starts)
+    assert all(s["start_ms"] >= 0.0 and s["duration_ms"] >= 0.0
+               for s in timeline), timeline
+print("critical-smoke: OK (/criticalz net+queue+compute == exchange "
+      "rtt over real TCP, ~ raw rtt within overlap+uncertainty; "
+      "/tracez merged timeline monotone per party)")
 '
 
 stage chaos-smoke env JAX_PLATFORMS=cpu python -c '

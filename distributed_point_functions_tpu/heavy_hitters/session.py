@@ -13,10 +13,12 @@ Wire format (versioned, fixed-width little-endian; rides inside the
 
     request  = MAGIC "DPHH" | u8 version | u8 kind=1 | u32 round
              | u32 num_prefixes | [v2+: 8-byte trace id]
-             | [v3: u32 crc32] | num_prefixes * u64 frontier
+             | [v3+: u32 crc32] | num_prefixes * u64 frontier
     response = MAGIC "DPHH" | u8 version | u8 kind=2 | u32 round
              | u32 num_prefixes | [v2+: f64 helper_ms]
-             | [v3: u64 epoch | u32 crc32] | num_prefixes * u32 shares
+             | [v3+: u64 epoch] | [v4: f64 recv_ms | f64 send_ms
+             | f64 compute_ms] | [v3+: u32 crc32]
+             | num_prefixes * u32 shares
     reset    = MAGIC "DPHH" | u8 version | u8 kind=3   (reply: kind=4)
 
 Version 2 adds observability: the Leader's trace id rides in the
@@ -36,6 +38,11 @@ its first round, steps its wire version down one, and re-sends the
 round — the own-share overlap hook is idempotent, so the resend costs
 only the wire leg. `IntegrityError` and `TransportTimeout` never
 downgrade: a damaged frame or a slow Helper is not an old Helper.
+Version 4 adds the critical-path digest: the Helper's recv/send
+perf_counter timestamps and device-compute ms ride each response, so
+the Leader skew-corrects the two clocks NTP-style and splits every
+round's helper leg into helper_net / helper_queue / helper_compute
+(`observability/critical_path.py`, surfaced at `/criticalz`).
 
 Fault recovery (`robustness/`): the Leader optionally persists the
 sweep frontier after every completed round into a `CheckpointStore`
@@ -70,6 +77,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..observability import critical_path
 from ..observability import events as events_mod
 from ..observability import tracing
 from ..observability import phases as phases_mod
@@ -86,8 +94,8 @@ from .protocol import (
 )
 
 _MAGIC = b"DPHH"
-_VERSION = 3
-_SUPPORTED_VERSIONS = (1, 2, 3)
+_VERSION = 4
+_SUPPORTED_VERSIONS = (1, 2, 3, 4)
 _KIND_EVAL_REQUEST = 1
 _KIND_EVAL_RESPONSE = 2
 _KIND_RESET_REQUEST = 3
@@ -100,10 +108,15 @@ _EVAL_HEADER = struct.Struct("<4sBBII")
 _REQ_EXTS = {
     2: struct.Struct("<8s"),    # raw trace id (zeros = none)
     3: struct.Struct("<8sI"),   # + u32 crc32 of the whole message
+    4: struct.Struct("<8sI"),   # unchanged from v3 (crc stays last)
 }
 _RESP_EXTS = {
     2: struct.Struct("<d"),     # helper-side eval ms
     3: struct.Struct("<dQI"),   # + u64 helper session epoch + u32 crc32
+    # v4: + the Helper's perf_counter-domain recv/send timestamps and
+    # device-compute ms (the critical-path digest); crc stays LAST so
+    # `_decode_eval`'s crc_offset = ext end - 4 keeps holding.
+    4: struct.Struct("<dQdddI"),
 }
 
 
@@ -155,11 +168,19 @@ def encode_eval_response(
     version: int = _VERSION,
     helper_ms: float = 0.0,
     epoch: int = 0,
+    recv_ms: float = 0.0,
+    send_ms: float = 0.0,
+    compute_ms: float = 0.0,
 ) -> bytes:
     if version not in _SUPPORTED_VERSIONS:
         raise ValueError(f"unsupported wire version {version}")
     shares = np.ascontiguousarray(shares, dtype="<u4")
-    if version >= 3:
+    if version >= 4:
+        ext = _RESP_EXTS[4].pack(
+            float(helper_ms), int(epoch), float(recv_ms),
+            float(send_ms), float(compute_ms), 0,
+        )
+    elif version == 3:
         ext = _RESP_EXTS[3].pack(float(helper_ms), int(epoch), 0)
     elif version == 2:
         ext = _RESP_EXTS[2].pack(float(helper_ms))
@@ -174,7 +195,8 @@ def encode_eval_response(
         + shares.tobytes()
     )
     if version >= 3:
-        msg = _patch_crc(msg, _EVAL_HEADER.size + _RESP_EXTS[3].size - 4)
+        ext_size = _RESP_EXTS[min(version, max(_RESP_EXTS))].size
+        msg = _patch_crc(msg, _EVAL_HEADER.size + ext_size - 4)
     return msg
 
 
@@ -245,16 +267,26 @@ def decode_eval_request_full(payload: bytes):
 
 def decode_eval_response_full(payload: bytes):
     """-> (round_index, shares uint32[num_prefixes], version,
-    helper_ms float or None, helper epoch int or None). The epoch is
-    a random u64 the Helper draws at construction: constant across
-    rounds within one process, different after a restart — the
-    Leader's restart detector."""
+    helper_ms float or None, helper epoch int or None, timing dict or
+    None). The epoch is a random u64 the Helper draws at construction:
+    constant across rounds within one process, different after a
+    restart — the Leader's restart detector. `timing` (v4+) carries
+    the Helper's critical-path digest: `recv_ms`/`send_ms`
+    (perf_counter-domain wire timestamps) and `compute_ms` (device
+    evaluation time), the inputs to NTP-style skew estimation."""
     round_index, shares, version, ext = _decode_eval(
         payload, _KIND_EVAL_RESPONSE, 4, "<u4", _RESP_EXTS
     )
     helper_ms = ext[0] if ext is not None else None
     epoch = ext[1] if ext is not None and version >= 3 else None
-    return round_index, shares, version, helper_ms, epoch
+    timing = None
+    if ext is not None and version >= 4:
+        timing = {
+            "recv_ms": float(ext[2]),
+            "send_ms": float(ext[3]),
+            "compute_ms": float(ext[4]),
+        }
+    return round_index, shares, version, helper_ms, epoch, timing
 
 
 def decode_eval_request(payload: bytes):
@@ -305,6 +337,7 @@ class HeavyHittersHelper:
         return self._epoch
 
     def handle_wire(self, payload: bytes) -> bytes:
+        recv_pc_ms = time.perf_counter() * 1e3
         if len(payload) >= _HEADER.size:
             _, _, kind = _HEADER.unpack_from(payload)
             if kind == _KIND_RESET_REQUEST:
@@ -338,16 +371,21 @@ class HeavyHittersHelper:
             with phases_mod.default_phase_recorder().request(
                 "hh-helper", fresh=True
             ):
+                c0 = time.perf_counter()
                 with tracing.span(
                     "helper_evaluate", frontier_width=int(frontier.shape[0])
                 ), phases_mod.phase("device_compute"):
                     shares = self._server.evaluate_round(
                         round_index, frontier.tolist()
                     )
+                compute_ms = (time.perf_counter() - c0) * 1e3
         helper_ms = (time.perf_counter() - t0) * 1e3
+        # v4 piggybacks the critical-path digest; older requesters get
+        # their own version back, byte-compatible with before.
         return encode_eval_response(
             round_index, shares, version=version, helper_ms=helper_ms,
-            epoch=self._epoch,
+            epoch=self._epoch, recv_ms=recv_pc_ms,
+            send_ms=time.perf_counter() * 1e3, compute_ms=compute_ms,
         )
 
 
@@ -410,6 +448,7 @@ class HeavyHittersLeader:
         self._c_corrupt = self._metrics.counter("hh.corrupt_frames")
         self._c_restarts = self._metrics.counter("hh.helper_restarts")
         self._c_resumes = self._metrics.counter("hh.sweep_resumes")
+        critical_path.install(registry=self._metrics)
 
     @property
     def metrics(self) -> MetricsRegistry:
@@ -468,20 +507,33 @@ class HeavyHittersLeader:
 
     def _round_trip(self, r, frontier, on_sent, trace):
         """One wire exchange at the current version. Returns
-        (payload, reply, helper_round, helper_share, helper_ms)."""
+        (payload, reply, helper_round, helper_share, helper_ms,
+        timing), where `timing` (v4 peers only) adds this side's
+        send/recv perf_counter timestamps to the Helper's digest —
+        the four stamps of one NTP exchange."""
         version = self._wire_version
         trace_id = trace.trace_id if version >= 2 else None
         payload = encode_eval_request(
             r, frontier, version=version, trace_id=trace_id
         )
+        t_send_ms = time.perf_counter() * 1e3
         reply = self._transport.roundtrip(
             payload, timeout=self._timeout, on_sent=on_sent
         )
-        helper_round, helper_share, _, helper_ms, epoch = (
+        t_recv_ms = time.perf_counter() * 1e3
+        helper_round, helper_share, _, helper_ms, epoch, timing = (
             decode_eval_response_full(reply)
         )
         self._observe_epoch(epoch)
-        return payload, reply, helper_round, helper_share, helper_ms
+        if timing is not None:
+            timing = {
+                "send_ms": t_send_ms,
+                "recv_ms": t_recv_ms,
+                "helper_recv_ms": timing["recv_ms"],
+                "helper_send_ms": timing["send_ms"],
+                "helper_compute_ms": timing["compute_ms"],
+            }
+        return payload, reply, helper_round, helper_share, helper_ms, timing
 
     def _restore_sweep(self, config) -> Optional[FrontierSweep]:
         """A sweep resumed from the checkpoint store, or None to start
@@ -517,24 +569,29 @@ class HeavyHittersLeader:
                 r = sweep.round_index
                 frontier = sweep.frontier
                 own_share: list = []
+                own_window: list = []
 
                 def compute_own_share():
                     # on_sent may fire twice on a transparent reconnect
                     # (and again on a wire-version downgrade or fault
                     # resend); the share must only be computed once.
                     if not own_share:
+                        s0 = time.perf_counter()
                         with tracing.span("leader_own_share", round=r), \
                                 phases_mod.phase("device_compute"):
                             own_share.append(
                                 self._server.evaluate_round(r, frontier)
                             )
+                        own_window.append(
+                            (s0 * 1e3, time.perf_counter() * 1e3)
+                        )
 
                 t0 = time.perf_counter()
                 attempt = 0
                 while True:
                     try:
                         payload, reply, helper_round, helper_share, \
-                            helper_ms = self._round_trip(
+                            helper_ms, timing = self._round_trip(
                                 r, frontier, compute_own_share, trace
                             )
                         break
@@ -579,14 +636,60 @@ class HeavyHittersLeader:
                 stats.wall_ms = round_ms
                 stats.bytes_sent = len(payload)
                 stats.bytes_received = len(reply)
+                skew = None
+                if timing is not None:
+                    # v4 digest: skew-estimate this round's exchange and
+                    # split its helper leg, excluding whatever part of
+                    # the own-share window ran inside the round trip.
+                    win = own_window[0] if own_window else None
+                    overlap_ms = (
+                        max(0.0, min(win[1], timing["recv_ms"])
+                            - max(win[0], timing["send_ms"]))
+                        if win is not None else 0.0
+                    )
+                    skew = critical_path.estimate_skew(
+                        timing["send_ms"], timing["recv_ms"],
+                        timing["helper_recv_ms"],
+                        timing["helper_send_ms"],
+                        overlap_ms=overlap_ms,
+                    )
+                    decomp = critical_path.decompose_helper_leg(
+                        skew,
+                        {"device_compute": timing["helper_compute_ms"]},
+                    )
+                    if decomp is not None:
+                        phases_mod.record(
+                            "helper_net", decomp["helper_net_ms"]
+                        )
+                        phases_mod.record(
+                            "helper_queue", decomp["helper_queue_ms"]
+                        )
+                        phases_mod.record(
+                            "helper_compute",
+                            decomp["helper_compute_ms"],
+                        )
+                    critical_path.default_analyzer().observe_round(
+                        "hh-leader",
+                        own_ms=(win[1] - win[0]) if win is not None
+                        else 0.0,
+                        rtt_ms=timing["recv_ms"] - timing["send_ms"],
+                        decomp=decomp,
+                        skew=skew,
+                    )
                 if helper_ms is not None:
                     network_ms = max(0.0, round_ms - helper_ms)
                     m.histogram("hh.helper_remote_ms").observe(helper_ms)
                     m.histogram("hh.helper_network_ms").observe(network_ms)
+                    extra = {}
+                    if skew is not None:
+                        extra["offset_ms_est"] = round(skew.offset_ms, 3)
+                        extra["offset_uncertainty_ms"] = round(
+                            skew.uncertainty_ms, 3
+                        )
                     trace.add_span(
                         "helper_leg", round_ms, round=r,
                         remote_ms=round(helper_ms, 3),
-                        network_ms=round(network_ms, 3),
+                        network_ms=round(network_ms, 3), **extra,
                     )
                 else:
                     trace.add_span("helper_leg", round_ms, round=r)

@@ -1,7 +1,7 @@
 """End-to-end request observability: tracing, device telemetry, SLOs,
 events, debug bundles, exposition, admin surface.
 
-Ten pieces, importable from any layer above `utils/` (the layer DAG
+Eleven pieces, importable from any layer above `utils/` (the layer DAG
 is serving -> observability -> utils; this package never imports pir/,
 ops/, or serving/ — `device`/`slo` reach JAX lazily and only for
 device facts):
@@ -35,11 +35,18 @@ device facts):
   cooldown and bounded retention (`/debugz`).
 * `propagation` — the versioned envelope that carries a trace id on
   the Leader->Helper wire and the Helper's stage timings back
-  (old-version peers interop by detection).
+  (old-version peers interop by detection); v2 piggybacks the
+  Helper's per-request phase digest and recv/send timestamps.
+* `critical_path` — the cross-party merge: NTP-style clock-skew
+  estimation from each exchange, helper_rtt decomposed into
+  helper_net / helper_queue / helper_compute, the two-party DAG
+  walked to mark the critical leg, aggregated into the `/criticalz`
+  per-(phase, party) profile.
 * `exposition` — Prometheus text rendering of the metrics registry,
   including OpenMetrics-style exemplars linking buckets to traces.
 * `admin` — the `/metrics` `/varz` `/healthz` `/statusz` `/tracez`
-  `/eventz` `/probez` `/debugz` `/profilez` operator HTTP endpoint.
+  `/eventz` `/probez` `/debugz` `/profilez` `/criticalz` operator
+  HTTP endpoint.
 """
 
 from .admin import AdminServer
@@ -62,6 +69,14 @@ from .device import (
     set_default_telemetry,
     shape_key,
 )
+from .critical_path import (
+    CriticalPathAnalyzer,
+    SkewEstimate,
+    decompose_helper_leg,
+    default_analyzer,
+    estimate_skew,
+    set_default_analyzer,
+)
 from .phases import (
     PHASES,
     PhaseRecorder,
@@ -77,6 +92,7 @@ from .propagation import (
     encode_request,
     encode_response,
     try_decode_request,
+    try_decode_request_full,
     try_decode_response,
 )
 from .tracing import (
@@ -101,6 +117,7 @@ __all__ = [
     "BundleManager",
     "CompileTracker",
     "CounterGroup",
+    "CriticalPathAnalyzer",
     "DeviceTelemetry",
     "EnvelopeError",
     "EventJournal",
@@ -109,6 +126,7 @@ __all__ = [
     "PHASES",
     "PhaseRecorder",
     "RequestPhases",
+    "SkewEstimate",
     "SloObjective",
     "SloTracker",
     "Trace",
@@ -116,6 +134,8 @@ __all__ = [
     "add_span",
     "current_request",
     "current_trace",
+    "decompose_helper_leg",
+    "default_analyzer",
     "default_journal",
     "default_phase_recorder",
     "default_recorder",
@@ -123,12 +143,14 @@ __all__ = [
     "emit",
     "encode_request",
     "encode_response",
+    "estimate_skew",
     "install_jax_monitoring_listener",
     "new_trace_id",
     "parse_labeled_name",
     "render_prometheus",
     "reset_stages",
     "runtime_counters",
+    "set_default_analyzer",
     "set_default_journal",
     "set_default_phase_recorder",
     "set_default_recorder",
@@ -138,6 +160,7 @@ __all__ = [
     "stage_summary",
     "trace_request",
     "try_decode_request",
+    "try_decode_request_full",
     "try_decode_response",
     "watch_failpoints",
 ]

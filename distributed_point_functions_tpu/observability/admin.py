@@ -1,6 +1,6 @@
 """Operator telemetry endpoint: /metrics, /varz, /healthz, /statusz,
-/tracez, /profilez, /eventz, /probez, /debugz — a stdlib `http.server`
-surface any session can hang off a port.
+/tracez, /profilez, /eventz, /probez, /debugz, /criticalz — a stdlib
+`http.server` surface any session can hang off a port.
 
 The serving runtime's observability state (metrics registry, flight
 recorder, stage aggregates, runtime counters, device telemetry, SLO
@@ -36,6 +36,13 @@ this server is the scrape surface:
                              freshness (JSON; requires a prober)
     /debugz                  captured incident debug bundles (JSON;
                              requires a `BundleManager`)
+    /criticalz               cross-party critical-path profile: which
+                             (party, phase) pairs the merged two-party
+                             timelines charge critical time to, with
+                             p50/p95/p99 per pair, the last merged
+                             request's skew-corrected helper_rtt
+                             decomposition, and skew-estimate health
+                             (text; `?format=json`)
     /profilez?duration_ms=N  on-demand xprof capture via
                              `utils/profiling.trace` into a fresh
                              directory; returns the trace dir (bounded
@@ -66,6 +73,7 @@ from typing import Optional
 
 from ..utils.profiling import trace as xprof_trace
 from . import tracing
+from . import critical_path as critical_path_mod
 from . import events as events_mod
 from .device import DeviceTelemetry, default_telemetry
 from .phases import PhaseRecorder, default_phase_recorder
@@ -104,6 +112,7 @@ class AdminServer:
         journal=None,
         prober=None,
         bundles=None,
+        critical=None,
     ):
         self._registry = registry
         self._recorder = (
@@ -145,6 +154,13 @@ class AdminServer:
         )
         self._prober = prober
         self._bundles = bundles
+        # critical defaults to the process-wide cross-party analyzer the
+        # Leader paths report into (`critical_path.install`).
+        self._critical = (
+            critical
+            if critical is not None
+            else critical_path_mod.default_analyzer()
+        )
         self._name = name
         self._profile_dir = profile_dir
         self._profile_lock = threading.Lock()
@@ -257,13 +273,16 @@ class AdminServer:
             self._probez(handler)
         elif path == "/debugz":
             self._debugz(handler)
+        elif path == "/criticalz":
+            self._criticalz(handler, parsed.query)
         elif path == "/profilez":
             self._profilez(handler, parsed.query)
         else:
             self._reply(
                 handler, 404, "text/plain; charset=utf-8",
                 b"unknown endpoint; try /healthz /metrics /varz "
-                b"/statusz /tracez /eventz /probez /debugz /profilez\n",
+                b"/statusz /tracez /eventz /probez /debugz /criticalz "
+                b"/profilez\n",
             )
 
     def _healthz(self, handler) -> None:
@@ -393,6 +412,76 @@ class AdminServer:
         ).encode()
         self._reply(handler, 200, "application/json", body)
 
+    def _criticalz(self, handler, query: str) -> None:
+        params = urllib.parse.parse_qs(query)
+        state = self._critical.export()
+        if params.get("format", [""])[0] == "json":
+            body = json.dumps(state, indent=2, default=str).encode()
+            self._reply(handler, 200, "application/json", body)
+            return
+        lines = [
+            f"# {self._name} cross-party critical path "
+            f"(?format=json for machine-readable)",
+            f"merged requests: {state['requests']}  "
+            f"invalid skew estimates: {state['skew_invalid']}",
+        ]
+        legs = state.get("legs") or {}
+        if legs:
+            total = sum(legs.values()) or 1
+            lines.append(
+                "critical leg: "
+                + "  ".join(
+                    f"{leg}={n} ({n / total * 100:.1f}%)"
+                    for leg, n in sorted(legs.items())
+                )
+            )
+        last = state.get("last") or {}
+        for role, s in last.items():
+            lines.append(f"last merged request [{role}]:")
+            if "helper_net_ms" in s:
+                lines.append(
+                    f"  critical leg {s['critical_leg']}; rtt "
+                    f"{s['rtt_ms']} ms (exchange "
+                    f"{s.get('exchange_ms', '-')} ms) = net "
+                    f"{s['helper_net_ms']} + queue "
+                    f"{s['helper_queue_ms']} + compute "
+                    f"{s['helper_compute_ms']}; own-share "
+                    f"{s['own_ms']} ms"
+                )
+            else:
+                lines.append(
+                    f"  critical leg {s['critical_leg']}; rtt "
+                    f"{s['rtt_ms']} ms; own-share {s['own_ms']} ms; "
+                    f"no valid decomposition (skew invalid or v1 peer)"
+                )
+            if "offset_ms" in s:
+                lines.append(
+                    f"  clock offset {s['offset_ms']} ms "
+                    f"+/- {s.get('uncertainty_ms', '-')} ms "
+                    f"(valid={s.get('skew_valid')}, "
+                    f"uncertain={s.get('uncertain', False)})"
+                )
+        profile = state.get("profile") or {}
+        if not profile:
+            lines.append("no critical time attributed yet")
+        for party, phases in profile.items():
+            lines.append(f"critical time by phase [{party}]:")
+            lines.append(
+                f"  {'phase':<16}{'count':>7}{'p50 ms':>10}"
+                f"{'p95 ms':>10}{'p99 ms':>10}{'share':>8}"
+            )
+            for phase, entry in phases.items():
+                lines.append(
+                    f"  {phase:<16}{entry['count']:>7}"
+                    f"{entry['p50_ms']:>10}{entry['p95_ms']:>10}"
+                    f"{entry['p99_ms']:>10}"
+                    f"{entry['share'] * 100:>7.1f}%"
+                )
+        self._reply(
+            handler, 200, "text/plain; charset=utf-8",
+            ("\n".join(lines) + "\n").encode(),
+        )
+
     # -- /statusz -----------------------------------------------------------
 
     def _status_state(self) -> dict:
@@ -426,6 +515,7 @@ class AdminServer:
                 if self._admission is not None
                 else None
             ),
+            "critical": self._critical.export(),
             "prober": (
                 self._prober.export()
                 if self._prober is not None
@@ -688,6 +778,42 @@ def _render_statusz(state: dict) -> str:
                 f"<td>{entry['share'] * 100:.1f}%</td></tr>"
             )
         out.append("</table>")
+
+    critical = state.get("critical")
+    if critical is not None:
+        out.append("<h2>Critical path (cross-party)</h2>")
+        if not critical.get("requests"):
+            out.append(
+                "<p class=nodata>no merged two-party timelines yet</p>"
+            )
+        else:
+            legs = critical.get("legs") or {}
+            total_legs = sum(legs.values()) or 1
+            leg_txt = ", ".join(
+                f"{esc(leg)}: {n} ({n / total_legs * 100:.1f}%)"
+                for leg, n in sorted(legs.items())
+            )
+            out.append(
+                f"<p>merged requests: {critical['requests']}; "
+                f"critical leg — {leg_txt}; invalid skew estimates: "
+                f"{critical['skew_invalid']}</p>"
+            )
+            out.append(
+                "<table><tr><th>party</th><th>phase</th><th>count</th>"
+                "<th>p50 ms</th><th>p95 ms</th><th>p99 ms</th>"
+                "<th>share of critical time</th></tr>"
+            )
+            for party, phases_ in (critical.get("profile") or {}).items():
+                for phase, entry in phases_.items():
+                    out.append(
+                        f"<tr><td>{esc(party)}</td><td>{esc(phase)}</td>"
+                        f"<td>{entry['count']}</td>"
+                        f"<td>{entry['p50_ms']}</td>"
+                        f"<td>{entry['p95_ms']}</td>"
+                        f"<td>{entry['p99_ms']}</td>"
+                        f"<td>{entry['share'] * 100:.1f}%</td></tr>"
+                    )
+            out.append("</table>")
 
     transfers = state["device"].get("transfers") or {}
     out.append("<h2>Host&#8596;device transfers</h2>")

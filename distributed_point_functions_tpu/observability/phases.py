@@ -22,6 +22,11 @@ milliseconds into a fixed phase taxonomy
     helper_rtt     the Leader's helper-leg round trip (overlaps
                    device_compute when own-share compute runs in the
                    transport's on_sent window)
+    helper_net     decomposition of helper_rtt (critical_path.py):
+    helper_queue   wire time / Helper non-compute overhead / Helper
+    helper_compute device compute — these three re-slice helper_rtt
+                   using the Helper's v2 envelope digest, they are not
+                   additional wall time
     respond        wire decode/encode and share reconstruction
     other          the unattributed remainder (computed at request end,
                    so attributed phases + other ~= end-to-end)
@@ -84,8 +89,18 @@ PHASES = (
     "dispatch",
     "device_compute",
     "helper_rtt",
+    "helper_net",
+    "helper_queue",
+    "helper_compute",
     "respond",
     "other",
+)
+
+# Phases that re-slice helper_rtt (already attributed) rather than
+# adding wall time; excluded from the `other` remainder so the split
+# is not double-counted against end-to-end.
+_OVERLAY_PHASES = frozenset(
+    ("helper_net", "helper_queue", "helper_compute")
 )
 
 
@@ -96,7 +111,9 @@ class RequestPhases:
     after a deadline-abandoned submitter are dropped, not misfiled
     into the next aggregate window."""
 
-    __slots__ = ("role", "_t0", "_phases", "_stack", "_closed", "_lock")
+    __slots__ = (
+        "role", "_t0", "_phases", "_stack", "_closed", "_lock", "_meta",
+    )
 
     def __init__(self, role: str):
         self.role = role
@@ -106,6 +123,18 @@ class RequestPhases:
         self._stack: list = []
         self._closed = False
         self._lock = threading.Lock()
+        # Out-of-band attachments (e.g. the helper-leg skew/decomposition
+        # stashed by the Leader for the critical-path close listener).
+        self._meta: Dict[str, object] = {}
+
+    def set_meta(self, key: str, value) -> None:
+        """Attach out-of-band data to this record (survives close)."""
+        with self._lock:
+            self._meta[key] = value
+
+    def get_meta(self, key: str, default=None):
+        with self._lock:
+            return self._meta.get(key, default)
 
     def add(self, name: str, ms: float) -> None:
         """Attribute `ms` milliseconds to phase `name` (additive)."""
@@ -208,6 +237,18 @@ class PhaseRecorder:
         self._agg: Dict[str, Dict[str, list]] = {}
         # role -> [count, total_ms, deque] for end-to-end latency
         self._e2e: Dict[str, list] = {}
+        # Fired at request close, while the trace is still current:
+        # fn(role, phases, total_ms, request). The critical-path
+        # analyzer hooks here to merge the two-party timeline.
+        self._close_listeners: list = []
+
+    def add_close_listener(self, fn) -> None:
+        """Register `fn(role, phases, total_ms, request)` to run when a
+        request record closes (inside the still-active trace context).
+        Idempotent per function object; listeners must not raise."""
+        with self._lock:
+            if fn not in self._close_listeners:
+                self._close_listeners.append(fn)
 
     def bind_registry(self, registry) -> None:
         """Mirror per-request phase totals into `registry` (duck-typed
@@ -234,7 +275,10 @@ class PhaseRecorder:
             _ACTIVE.reset(token)
             total_ms = req.elapsed_ms()
             phases = req.close()
-            attributed = sum(phases.values())
+            attributed = sum(
+                ms for name, ms in phases.items()
+                if name not in _OVERLAY_PHASES
+            )
             if total_ms > attributed:
                 phases["other"] = total_ms - attributed
             self._observe(role, phases, total_ms)
@@ -244,6 +288,13 @@ class PhaseRecorder:
                     k: round(v, 3) for k, v in sorted(phases.items())
                 }
                 trace.attrs["phase_total_ms"] = round(total_ms, 3)
+            with self._lock:
+                listeners = list(self._close_listeners)
+            for fn in listeners:
+                try:
+                    fn(role, phases, total_ms, req)
+                except Exception:  # pragma: no cover - never raises
+                    pass
 
     @contextlib.contextmanager
     def collect(self):

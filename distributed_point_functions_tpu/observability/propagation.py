@@ -12,7 +12,9 @@ Wire layout (all integers big-endian):
     request  = MAGIC(4) | u8 version | u8 kind=1 | 8-byte trace id
              | u32 inner_len | inner proto bytes
     response = MAGIC(4) | u8 version | u8 kind=2 | u32 meta_len
-             | meta JSON (trace_id, server_ms, spans)
+             | meta JSON (trace_id, server_ms, spans; v2 adds the
+               Helper's per-request phase digest `phases` plus
+               `recv_ms`/`send_ms` monotonic timestamps)
              | u32 inner_len | inner proto bytes
     error    = MAGIC(4) | u8 version | u8 kind=3 | u32 meta_len
              | meta JSON (trace_id, error_type, message, retry_after_s)
@@ -24,6 +26,18 @@ can distinguish "Helper is shedding load, back off this much" from
 "Helper is dead". `try_decode_response` raises it as
 `WireErrorResponse`; peers that never send envelopes never see it.
 
+**Version 2 carries the Helper's critical-path digest.** A v2 response
+meta adds `phases` (the Helper's `RequestPhases` waterfall for this
+request), `recv_ms` and `send_ms` (the Helper's `perf_counter`-domain
+receive/send timestamps, ms), and per-span `offset_ms` so the Leader
+can reassemble a skew-corrected merged timeline
+(`observability/critical_path.py`). A Helper always answers in the
+request's version, so a v1 Leader never sees v2 fields; a v2 Leader
+talking to a v1-only Helper faults once on the v2 probe, steps down to
+v1 (keeping spans and `server_ms`, losing only the digest), and only a
+second fault drops it to bare proto — the same sticky probe ladder the
+kind-3 error envelope rode in on.
+
 **Old-peer interop is by construction + detection, not negotiation.**
 MAGIC starts with byte 0xFF: as a protobuf tag that is field 31 with
 wire type 7, which does not exist, so an old Helper fed an enveloped
@@ -33,6 +47,12 @@ inside its existing retry budget). Conversely `try_decode_request`
 returns the payload untouched when the magic is absent, so a new
 Helper serves old bare-proto Leaders unchanged — and replies bare, so
 old Leaders never see an envelope.
+
+Response span lists are bounded at `MAX_RESPONSE_SPANS`: a deep trace
+must not inflate every reply frame, so the encoder keeps the first N
+(chronological) spans and counts the rest in the
+`propagation.spans_dropped` runtime counter — truncation is visible,
+never silent.
 """
 
 from __future__ import annotations
@@ -41,23 +61,31 @@ import json
 import struct
 from typing import Optional, Tuple
 
+from . import tracing
+
 __all__ = [
     "EnvelopeError",
+    "MAX_RESPONSE_SPANS",
     "PROPAGATION_VERSION",
     "WireErrorResponse",
     "encode_error",
     "encode_request",
     "try_decode_request",
+    "try_decode_request_full",
     "encode_response",
     "try_decode_response",
 ]
 
 # 0xFF first => guaranteed-invalid protobuf, so old peers fail fast.
 _MAGIC = b"\xffDPT"
-PROPAGATION_VERSION = 1
+PROPAGATION_VERSION = 2
+_SUPPORTED_VERSIONS = (1, 2)
 _KIND_REQUEST = 1
 _KIND_RESPONSE = 2
 _KIND_ERROR = 3
+
+# Cap on spans per response envelope (satellite: no unbounded frames).
+MAX_RESPONSE_SPANS = 64
 
 
 _HEAD = struct.Struct(">4sBB")
@@ -86,25 +114,33 @@ class WireErrorResponse(RuntimeError):
         self.trace_id = trace_id
 
 
-def encode_request(trace_id: str, inner: bytes) -> bytes:
+def encode_request(
+    trace_id: str, inner: bytes, version: int = PROPAGATION_VERSION
+) -> bytes:
+    if version not in _SUPPORTED_VERSIONS:
+        raise EnvelopeError(f"unsupported envelope version {version}")
     tid = bytes.fromhex(trace_id)[:8].ljust(8, b"\0")
     return (
-        _HEAD.pack(_MAGIC, PROPAGATION_VERSION, _KIND_REQUEST)
+        _HEAD.pack(_MAGIC, version, _KIND_REQUEST)
         + tid
         + _LEN.pack(len(inner))
         + inner
     )
 
 
-def try_decode_request(payload: bytes) -> Tuple[Optional[str], bytes]:
-    """-> (trace_id | None, inner bytes). No magic: the payload is a
-    bare old-version proto and comes back untouched."""
+def try_decode_request_full(
+    payload: bytes,
+) -> Tuple[Optional[str], bytes, int]:
+    """-> (trace_id | None, inner bytes, envelope version). No magic:
+    the payload is a bare old-version proto and comes back untouched
+    (reported as version 0). A server answers in the request's version
+    so old Leaders never see fields they cannot decode."""
     if not payload.startswith(_MAGIC):
-        return None, payload
+        return None, payload, 0
     if len(payload) < _HEAD.size + 8 + _LEN.size:
         raise EnvelopeError("truncated envelope header")
     _, version, kind = _HEAD.unpack_from(payload)
-    if version != PROPAGATION_VERSION:
+    if version not in _SUPPORTED_VERSIONS:
         raise EnvelopeError(f"unsupported envelope version {version}")
     if kind != _KIND_REQUEST:
         raise EnvelopeError(f"unexpected envelope kind {kind}")
@@ -115,7 +151,14 @@ def try_decode_request(payload: bytes) -> Tuple[Optional[str], bytes]:
         raise EnvelopeError(
             f"envelope body is {len(inner)} bytes, expected {inner_len}"
         )
-    return tid.hex(), inner
+    return tid.hex(), inner, version
+
+
+def try_decode_request(payload: bytes) -> Tuple[Optional[str], bytes]:
+    """-> (trace_id | None, inner bytes). No magic: the payload is a
+    bare old-version proto and comes back untouched."""
+    trace_id, inner, _ = try_decode_request_full(payload)
+    return trace_id, inner
 
 
 def encode_response(
@@ -123,25 +166,51 @@ def encode_response(
     trace_id: str,
     server_ms: float,
     spans: Optional[list] = None,
+    version: int = PROPAGATION_VERSION,
+    phases: Optional[dict] = None,
+    recv_ms: Optional[float] = None,
+    send_ms: Optional[float] = None,
 ) -> bytes:
-    meta = json.dumps(
-        {
-            "trace_id": trace_id,
-            "server_ms": round(float(server_ms), 3),
-            "spans": [
-                {
-                    "name": str(s.get("name", "?")),
-                    "duration_ms": float(s.get("duration_ms", 0.0)),
-                }
-                for s in (spans or [])
-            ],
-        },
-        separators=(",", ":"),
-    ).encode()
+    """`phases`/`recv_ms`/`send_ms` (the Helper's critical-path digest)
+    ride only on version >= 2 — a v1 reply is byte-compatible with the
+    old encoder, so downgrading drops the digest and nothing else."""
+    if version not in _SUPPORTED_VERSIONS:
+        raise EnvelopeError(f"unsupported envelope version {version}")
+    span_list = list(spans or [])
+    if len(span_list) > MAX_RESPONSE_SPANS:
+        tracing.runtime_counters.inc(
+            "propagation.spans_dropped",
+            len(span_list) - MAX_RESPONSE_SPANS,
+        )
+        span_list = span_list[:MAX_RESPONSE_SPANS]
+    encoded_spans = []
+    for s in span_list:
+        entry = {
+            "name": str(s.get("name", "?")),
+            "duration_ms": float(s.get("duration_ms", 0.0)),
+        }
+        if version >= 2 and "offset_ms" in s:
+            entry["offset_ms"] = float(s["offset_ms"])
+        encoded_spans.append(entry)
+    meta = {
+        "trace_id": trace_id,
+        "server_ms": round(float(server_ms), 3),
+        "spans": encoded_spans,
+    }
+    if version >= 2:
+        if phases:
+            meta["phases"] = {
+                str(k): round(float(v), 3) for k, v in phases.items()
+            }
+        if recv_ms is not None:
+            meta["recv_ms"] = round(float(recv_ms), 3)
+        if send_ms is not None:
+            meta["send_ms"] = round(float(send_ms), 3)
+    meta_bytes = json.dumps(meta, separators=(",", ":")).encode()
     return (
-        _HEAD.pack(_MAGIC, PROPAGATION_VERSION, _KIND_RESPONSE)
-        + _LEN.pack(len(meta))
-        + meta
+        _HEAD.pack(_MAGIC, version, _KIND_RESPONSE)
+        + _LEN.pack(len(meta_bytes))
+        + meta_bytes
         + _LEN.pack(len(inner))
         + inner
     )
@@ -152,9 +221,14 @@ def encode_error(
     message: str = "",
     retry_after_s: float = 0.0,
     trace_id: Optional[str] = None,
+    version: int = 1,
 ) -> bytes:
     """Typed refusal reply (kind 3): the peer decodes it back into a
-    `WireErrorResponse` via `try_decode_response`."""
+    `WireErrorResponse` via `try_decode_response`. Defaults to version
+    1 — the error meta gained no v2 fields, and v1 is decodable by
+    every enveloped peer."""
+    if version not in _SUPPORTED_VERSIONS:
+        raise EnvelopeError(f"unsupported envelope version {version}")
     meta = json.dumps(
         {
             "trace_id": trace_id,
@@ -165,7 +239,7 @@ def encode_error(
         separators=(",", ":"),
     ).encode()
     return (
-        _HEAD.pack(_MAGIC, PROPAGATION_VERSION, _KIND_ERROR)
+        _HEAD.pack(_MAGIC, version, _KIND_ERROR)
         + _LEN.pack(len(meta))
         + meta
     )
@@ -180,7 +254,7 @@ def try_decode_response(payload: bytes) -> Tuple[Optional[dict], bytes]:
     if len(payload) < _HEAD.size + _LEN.size:
         raise EnvelopeError("truncated envelope header")
     _, version, kind = _HEAD.unpack_from(payload)
-    if version != PROPAGATION_VERSION:
+    if version not in _SUPPORTED_VERSIONS:
         raise EnvelopeError(f"unsupported envelope version {version}")
     if kind == _KIND_ERROR:
         (meta_len,) = _LEN.unpack_from(payload, _HEAD.size)

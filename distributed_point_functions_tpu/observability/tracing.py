@@ -98,33 +98,51 @@ class Trace:
         offset_ms: Optional[float] = None,
         **attrs,
     ) -> None:
+        if offset_ms is None:
+            offset_ms = self.elapsed_ms() - duration_ms
+        # A span recorded from another thread after the trace advanced
+        # (or a skew-corrected remote span) can compute a negative
+        # offset; clamp so merged timelines stay monotone, but keep the
+        # evidence in a `clamped` attr.
+        clamped = offset_ms < 0.0
         entry = {
             "name": name,
-            "offset_ms": round(
-                self.elapsed_ms() - duration_ms
-                if offset_ms is None else offset_ms,
-                3,
-            ),
+            "offset_ms": round(max(0.0, offset_ms), 3),
             "duration_ms": round(duration_ms, 3),
         }
+        if clamped:
+            entry["clamped"] = True
         if attrs:
             entry.update(attrs)
         with self._lock:
             self.spans.append(entry)
 
     def add_remote_spans(
-        self, spans: List[dict], prefix: str = "remote."
+        self,
+        spans: List[dict],
+        prefix: str = "remote.",
+        base_offset_ms: Optional[float] = None,
     ) -> None:
         """Graft a peer's server-side spans (from the response envelope)
-        onto this trace. Remote offsets are in the peer's clock domain,
-        so only durations are kept."""
+        onto this trace. Remote offsets are in the peer's clock domain:
+        without a skew estimate only durations are kept; with
+        `base_offset_ms` (the peer's trace start mapped into THIS
+        trace's timeline via the NTP-style offset from
+        `critical_path.estimate_skew`) each remote span lands at its
+        skew-corrected position, clamped at 0 like `add_span`."""
         with self._lock:
             for s in spans:
-                self.spans.append({
+                entry = {
                     "name": prefix + str(s.get("name", "?")),
                     "duration_ms": float(s.get("duration_ms", 0.0)),
                     "remote": True,
-                })
+                }
+                if base_offset_ms is not None and "offset_ms" in s:
+                    off = base_offset_ms + float(s["offset_ms"])
+                    if off < 0.0:
+                        entry["clamped"] = True
+                    entry["offset_ms"] = round(max(0.0, off), 3)
+                self.spans.append(entry)
 
     def span_list(self) -> List[dict]:
         """Snapshot of the spans so far (for response envelopes taken

@@ -31,7 +31,11 @@ one probe of each enabled kind:
                        `encrypter` is provided); a session answering
                        in degraded (leader-share-only) mode is flagged
                        `degraded`, not failed — the answer is *known*
-                       to be unreconstructable then
+                       to be unreconstructable then; each result also
+                       carries the request's merged critical-path
+                       summary (`critical_path` key: the skew-corrected
+                       helper_net / helper_queue / helper_compute
+                       split) so /probez shows where probe latency went
     hh_sweep           a miniature heavy-hitters sweep over two
                        in-memory servers built from golden reports,
                        checked against `plaintext_heavy_hitters`
@@ -82,6 +86,7 @@ from ..heavy_hitters.protocol import (
     plaintext_heavy_hitters,
     run_protocol,
 )
+from ..observability import critical_path
 from ..observability import events as events_mod
 from ..observability.slo import SloObjective
 from ..pir.client import DenseDpfPirClient
@@ -167,6 +172,9 @@ class Prober:
         self._last_status: Dict[str, str] = {}
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # The last leader_e2e probe's merged critical-path summary
+        # (None until one runs against a critical-path-aware session).
+        self._last_critical: Optional[dict] = None
 
         n = len(records)
         if indices is None:
@@ -312,6 +320,12 @@ class Prober:
     def _probe_leader_e2e(self) -> Optional[str]:
         request, state, client = self._e2e
         response = self._session.handle_request(request)
+        # The probe just rode the real two-party path, so the analyzer's
+        # freshest Leader summary IS this request's critical path; stash
+        # it for `_run_one` to attach to the /probez result.
+        self._last_critical = critical_path.default_analyzer().last(
+            "leader"
+        )
         got = client.handle_response(response, state)
         return self._check_records(got)
 
@@ -357,7 +371,7 @@ class Prober:
         with self._lock:
             self._seq += 1
             seq = self._seq
-        return {
+        result = {
             "kind": kind,
             "status": status,
             "ms": ms,
@@ -366,6 +380,11 @@ class Prober:
             "t_wall": round(time.time(), 3),
             "t_mono": round(self._clock(), 3),
         }
+        if kind == "leader_e2e" and self._last_critical is not None:
+            # Where the probe's own latency went: the skew-corrected
+            # helper-leg decomposition for this request (/probez).
+            result["critical_path"] = self._last_critical
+        return result
 
     def _record(self, result: dict) -> None:
         kind, status = result["kind"], result["status"]

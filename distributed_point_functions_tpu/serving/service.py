@@ -63,7 +63,7 @@ from .. import serialization
 from ..capacity.admission import AdmissionController, TenantPolicy
 from ..capacity.brownout import BrownoutController
 from ..observability import events as events_mod
-from ..observability import propagation, tracing
+from ..observability import critical_path, propagation, tracing
 from ..observability import phases as phases_mod
 from ..observability.device import (
     default_telemetry,
@@ -147,6 +147,10 @@ class ServingConfig:
     admission_queue_budget_ms: float = 250.0
     helper_retry_budget_ratio: float = 0.1
     helper_retry_budget_min: float = 10.0
+    # False pins the Leader's envelope probe at v1: no Helper phase
+    # digest, no skew estimate, no critical-path decomposition — the
+    # knob the digest-piggyback overhead benchmark flips.
+    helper_digest: bool = True
 
 
 # The deadline travels from handle_request into the server's plain
@@ -337,10 +341,20 @@ class _Session:
         hint; a bare-proto peer sees the exception propagate to the
         transport exactly as before (old peers could not parse the
         envelope anyway).
+
+        The reply always uses the *request's* envelope version, so a v1
+        Leader never sees v2 fields. A v2 request gets the critical-path
+        digest piggybacked on the reply: this side's phase waterfall
+        plus the perf_counter-domain receive/send timestamps the Leader
+        needs for NTP-style skew estimation.
         """
         from ..protos import private_information_retrieval_pb2 as pir_pb2
 
-        trace_id, inner = propagation.try_decode_request(data)
+        recv_ms = time.perf_counter() * 1e3
+        trace_id, inner, req_version = propagation.try_decode_request_full(
+            data
+        )
+        resp_version = min(req_version, propagation.PROPAGATION_VERSION)
         t0 = time.perf_counter()
         with tracing.trace_request(
             f"{self._name}.request",
@@ -380,11 +394,17 @@ class _Session:
                     ).SerializeToString()
             if trace_id is None:
                 return out
+            # The phases context has closed: trace.attrs["phases"] is
+            # this request's final waterfall (the v2 digest).
             return propagation.encode_response(
                 out,
                 trace.trace_id,
                 server_ms=(time.perf_counter() - t0) * 1e3,
                 spans=trace.span_list(),
+                version=resp_version,
+                phases=trace.attrs.get("phases"),
+                recv_ms=recv_ms,
+                send_ms=time.perf_counter() * 1e3,
             )
 
     def close(self) -> None:
@@ -464,6 +484,17 @@ class LeaderSession(_Session):
         # False = peer rejected it once (bare proto from then on);
         # True = peer answered an envelope.
         self._peer_envelope: Optional[bool] = None
+        # Envelope version ladder: probe at v2 (the critical-path
+        # digest), step to v1 on the first non-timeout fault, to bare
+        # proto on the second — each step sticky and retry-neutral, so
+        # a v1-only Helper costs exactly one probe and keeps its spans.
+        self._peer_wire_version = (
+            propagation.PROPAGATION_VERSION
+            if self._config.helper_digest else 1
+        )
+        # Critical-path analysis rides the phase recorder's close hook;
+        # install is idempotent and binds critical.* to this registry.
+        critical_path.install(registry=m)
         # Degraded mode is now *state*, not just a per-response counter:
         # entered when a request falls back to its Leader-only share,
         # exited the moment the breaker's half-open probe closes it.
@@ -560,9 +591,10 @@ class LeaderSession(_Session):
 
         The request goes out wrapped in a trace-context envelope until
         the peer proves it is old-version: a non-timeout fault on an
-        envelope probe (an old Helper fails proto-parsing the envelope
-        and drops the connection) downgrades this transport to bare
-        proto before the normal retry policy resumes. Timeouts do NOT
+        envelope probe (an old Helper fails parsing the envelope and
+        drops the connection) steps the version ladder — v2 to v1
+        (losing only the critical-path digest), then v1 to bare proto —
+        before the normal retry policy resumes. Timeouts do NOT
         downgrade — a slow Helper is not an old one.
         """
         breaker = self._breaker
@@ -579,12 +611,22 @@ class LeaderSession(_Session):
         ).SerializeToString()
         cfg = self._config
         called = [False]
+        # The own-share window (perf_counter ms): the skew estimator
+        # subtracts whatever part of it ran serially inside the
+        # round-trip bracket, so own-share compute is never booked as
+        # wire time (the in-process transport runs it inline).
+        share_window = [None]
 
         def leader_share_once():
             if not called[0]:
                 called[0] = True
-                with tracing.span("leader_own_share"):
-                    while_waiting()
+                s0 = time.perf_counter()
+                try:
+                    with tracing.span("leader_own_share"):
+                        while_waiting()
+                finally:
+                    share_window[0] = (s0 * 1e3,
+                                       time.perf_counter() * 1e3)
 
         timeout = (
             None if cfg.helper_timeout_ms is None
@@ -601,6 +643,7 @@ class LeaderSession(_Session):
                     trace.trace_id if trace is not None
                     else tracing.new_trace_id(),
                     wire,
+                    version=self._peer_wire_version,
                 )
                 if enveloped
                 else wire
@@ -629,13 +672,17 @@ class LeaderSession(_Session):
                     and not isinstance(e, TransportTimeout)
                 ):
                     # Probe fault: plausibly an old peer choking on the
-                    # envelope. Downgrade this transport to bare proto
-                    # and re-send immediately — the probe does not
-                    # consume a retry attempt (downgrading is sticky,
-                    # so this branch runs at most once per transport),
-                    # and does not feed the breaker: a version mismatch
-                    # is not a dead Helper.
-                    self._peer_envelope = False
+                    # envelope. Step down the version ladder — v2 to v1
+                    # first (a v1 Helper keeps its spans, loses only
+                    # the digest), then v1 to bare proto — and re-send
+                    # immediately. Neither step consumes a retry
+                    # attempt (each is sticky, so the ladder runs at
+                    # most twice per transport) or feeds the breaker: a
+                    # version mismatch is not a dead Helper.
+                    if self._peer_wire_version > 1:
+                        self._peer_wire_version = 1
+                    else:
+                        self._peer_envelope = False
                     self._c_downgrades.inc()
                     last = e
                     continue
@@ -713,13 +760,77 @@ class LeaderSession(_Session):
             self.metrics.histogram("leader.helper_network_ms").observe(
                 network_ms
             )
+            # v2 digest: NTP-style skew estimate from this exchange's
+            # four timestamps, then the helper_net / helper_queue /
+            # helper_compute split. The own-share window is subtracted
+            # from the exchange rtt where it overlapped the bracket.
+            skew = None
+            decomp = None
+            t0_ms, t3_ms = t0 * 1e3, t0 * 1e3 + rtt_ms
+            if meta.get("recv_ms") is not None and (
+                meta.get("send_ms") is not None
+            ):
+                win = share_window[0]
+                overlap_ms = (
+                    max(0.0, min(win[1], t3_ms) - max(win[0], t0_ms))
+                    if win is not None else 0.0
+                )
+                skew = critical_path.estimate_skew(
+                    t0_ms, t3_ms,
+                    float(meta["recv_ms"]), float(meta["send_ms"]),
+                    overlap_ms=overlap_ms,
+                )
+                decomp = critical_path.decompose_helper_leg(
+                    skew, meta.get("phases")
+                )
+                if decomp is not None:
+                    phases_mod.record(
+                        "helper_net", decomp["helper_net_ms"]
+                    )
+                    phases_mod.record(
+                        "helper_queue", decomp["helper_queue_ms"]
+                    )
+                    phases_mod.record(
+                        "helper_compute", decomp["helper_compute_ms"]
+                    )
+                req = phases_mod.current_request()
+                if req is not None:
+                    req.set_meta("helper_leg", {
+                        "rtt_ms": rtt_ms,
+                        "own_ms": (
+                            win[1] - win[0] if win is not None else 0.0
+                        ),
+                        "skew": skew.as_dict(),
+                        "decomp": decomp,
+                        "helper_phases": meta.get("phases") or {},
+                    })
             if trace is not None:
+                extra = {}
+                if skew is not None:
+                    extra["offset_ms_est"] = round(skew.offset_ms, 3)
+                    extra["offset_uncertainty_ms"] = round(
+                        skew.uncertainty_ms, 3
+                    )
                 trace.add_span(
                     "helper_leg", rtt_ms, remote_ms=round(remote_ms, 3),
-                    network_ms=round(network_ms, 3),
+                    network_ms=round(network_ms, 3), **extra,
                 )
+                # With a skew estimate, remote spans land at their
+                # corrected position on THIS trace's timeline: the
+                # Helper's recv_ms maps into the Leader clock via the
+                # offset, then rebases against the trace start.
+                base_offset_ms = None
+                if skew is not None:
+                    trace_start_ms = (
+                        time.perf_counter() * 1e3 - trace.elapsed_ms()
+                    )
+                    base_offset_ms = (
+                        float(meta["recv_ms"]) - skew.offset_ms
+                        - trace_start_ms
+                    )
                 trace.add_remote_spans(
-                    meta.get("spans", []), prefix="helper."
+                    meta.get("spans", []), prefix="helper.",
+                    base_offset_ms=base_offset_ms,
                 )
                 trace.add_span("helper_network", network_ms)
         elif trace is not None:

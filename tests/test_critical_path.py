@@ -1,0 +1,403 @@
+"""Cross-party critical-path tests: NTP-style skew estimation, the
+helper_rtt decomposition, the two-party timeline DAG, the analyzer /
+`/criticalz` surface, and the in-process acceptance criterion (on
+`InProcessTransport` the decomposition must attribute helper_net ~ 0
+and helper_queue + helper_compute ~ the exchange rtt, within the
+estimator's own stated uncertainty)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.observability import (
+    AdminServer,
+    critical_path as cp,
+    phases as phases_mod,
+    tracing,
+)
+from distributed_point_functions_tpu.pir import (
+    DenseDpfPirClient,
+    DenseDpfPirDatabase,
+)
+from distributed_point_functions_tpu.serving import (
+    HelperSession,
+    InProcessTransport,
+    LeaderSession,
+    ServingConfig,
+)
+from distributed_point_functions_tpu.serving.metrics import MetricsRegistry
+from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+# ---------------------------------------------------------------------------
+# Skew estimation
+# ---------------------------------------------------------------------------
+
+# One synthetic exchange: the Helper clock runs 100 ms ahead, each wire
+# leg takes 2 ms, the Helper holds the request for 6 ms.
+#   t0=0 (send), t1=102 (helper recv), t2=108 (helper send), t3=10.
+_T0, _T1, _T2, _T3 = 0.0, 102.0, 108.0, 10.0
+
+
+def test_estimate_skew_recovers_offset_and_uncertainty():
+    skew = cp.estimate_skew(_T0, _T3, _T1, _T2)
+    assert skew.valid
+    assert skew.offset_ms == pytest.approx(100.0)
+    assert skew.rtt_ms == pytest.approx(10.0)
+    assert skew.exchange_ms == pytest.approx(10.0)
+    assert skew.helper_service_ms == pytest.approx(6.0)
+    # Exact bound: the unseen quantity is the outbound/return split of
+    # the 4 ms of non-service time, so the offset error is within 2 ms.
+    assert skew.uncertainty_ms == pytest.approx(2.0)
+
+
+def test_estimate_skew_negative_offset():
+    # Helper clock 50 ms BEHIND the Leader's: t1=-48, t2=-42.
+    skew = cp.estimate_skew(0.0, 10.0, -48.0, -42.0)
+    assert skew.valid
+    assert skew.offset_ms == pytest.approx(-50.0)
+    assert skew.uncertainty_ms == pytest.approx(2.0)
+    decomp = cp.decompose_helper_leg(skew, {"device_compute": 6.0})
+    assert decomp is not None
+    assert decomp["helper_net_ms"] == pytest.approx(4.0)
+
+
+def test_estimate_skew_subtracts_own_share_overlap():
+    # 4 ms of the bracket was the Leader's own-share compute running
+    # inline (InProcessTransport): the exchange rtt excludes it, so the
+    # wire estimate tightens to exactly the service time.
+    skew = cp.estimate_skew(_T0, _T3, _T1, _T2, overlap_ms=4.0)
+    assert skew.valid
+    assert skew.exchange_ms == pytest.approx(6.0)
+    assert skew.uncertainty_ms == pytest.approx(0.0)
+    decomp = cp.decompose_helper_leg(skew, {"device_compute": 6.0})
+    assert decomp["helper_net_ms"] == pytest.approx(0.0)
+
+
+def test_concurrent_overlap_is_capped_not_refused():
+    # Threaded transport (real TCP): the own share runs CONCURRENTLY
+    # with the Helper's 6 ms service, so the claimed 8 ms overlap
+    # cannot all have been serial — raw subtraction would push the
+    # exchange below the service floor and refuse every split. The
+    # serial part is capped at rtt - service (wire time cannot be
+    # negative); the 4 ms concurrent remainder widens the uncertainty
+    # instead of vanishing.
+    skew = cp.estimate_skew(_T0, _T3, _T1, _T2, overlap_ms=8.0)
+    assert skew.valid
+    assert skew.exchange_ms == pytest.approx(6.0)  # clamped to service
+    # (exchange - service)/2 = 0 plus min(hidden=4, rtt-exchange=4)/2.
+    assert skew.uncertainty_ms == pytest.approx(2.0)
+    decomp = cp.decompose_helper_leg(skew, {"device_compute": 6.0})
+    assert decomp is not None
+    assert decomp["helper_net_ms"] == pytest.approx(0.0)
+    assert decomp["helper_queue_ms"] + decomp["helper_compute_ms"] == (
+        pytest.approx(skew.exchange_ms)
+    )
+
+
+def test_decompose_identity_and_queue_split():
+    skew = cp.estimate_skew(_T0, _T3, _T1, _T2)
+    decomp = cp.decompose_helper_leg(
+        skew, {"device_compute": 3.0, "dispatch": 1.0, "respond": 9.0}
+    )
+    # respond is not a compute phase; compute = 3 + 1, queue the rest.
+    assert decomp["helper_compute_ms"] == pytest.approx(4.0)
+    assert decomp["helper_queue_ms"] == pytest.approx(2.0)
+    assert decomp["helper_net_ms"] == pytest.approx(4.0)
+    total = (
+        decomp["helper_net_ms"]
+        + decomp["helper_queue_ms"]
+        + decomp["helper_compute_ms"]
+    )
+    assert total == pytest.approx(skew.exchange_ms)
+    assert decomp["uncertain"] is False
+    # An over-reported digest is capped at the service time.
+    capped = cp.decompose_helper_leg(skew, {"device_compute": 50.0})
+    assert capped["helper_compute_ms"] == pytest.approx(6.0)
+    assert capped["helper_queue_ms"] == pytest.approx(0.0)
+
+
+def test_jitter_dominating_service_is_flagged_not_bogus():
+    # rtt 10 ms around a 0.1 ms service: the estimate is still valid
+    # (the split exists) but the uncertainty (4.95 ms) dwarfs the
+    # service time being split — `uncertain` must say so.
+    skew = cp.estimate_skew(0.0, 10.0, 100.0, 100.1)
+    assert skew.valid
+    decomp = cp.decompose_helper_leg(skew, {"device_compute": 0.1})
+    assert decomp is not None
+    assert decomp["uncertain"] is True
+    assert decomp["uncertainty_ms"] == pytest.approx(4.95)
+
+
+def test_service_exceeding_exchange_refuses_to_split():
+    # Clock-granularity jitter: the Helper claims more service time
+    # than the whole exchange. No clamped-but-confident split.
+    skew = cp.estimate_skew(0.0, 5.0, 100.0, 110.0)
+    assert not skew.valid
+    assert cp.decompose_helper_leg(skew, {"device_compute": 9.0}) is None
+    # Negative rtt (caller bug / non-monotonic inputs): same refusal.
+    assert not cp.estimate_skew(10.0, 0.0, 100.0, 101.0).valid
+    assert cp.decompose_helper_leg(None, {}) is None
+
+
+# ---------------------------------------------------------------------------
+# Timeline DAG
+# ---------------------------------------------------------------------------
+
+
+def _leg(rtt=8.0, own=3.0, net=2.0, queue=2.0, compute=4.0):
+    return {
+        "rtt_ms": rtt,
+        "own_ms": own,
+        "decomp": {
+            "helper_net_ms": net,
+            "helper_queue_ms": queue,
+            "helper_compute_ms": compute,
+            "uncertainty_ms": 0.1,
+            "uncertain": False,
+        },
+        "skew": {"exchange_ms": net + queue + compute, "valid": True},
+    }
+
+
+def test_build_timeline_marks_the_longer_leg_critical():
+    phases = {
+        "queue": 1.0,
+        "batch": 1.0,
+        "device_compute": 3.0,
+        "respond": 1.0,
+    }
+    segments, leg = cp.build_timeline(phases, _leg())
+    assert leg == "helper"
+    by_phase = {(s["party"], s["phase"]): s for s in segments}
+    # Serial head and tail are always critical.
+    assert by_phase[("leader", "queue")]["critical"]
+    assert by_phase[("leader", "batch")]["critical"]
+    assert by_phase[("leader", "respond")]["critical"]
+    # Parallel section: helper leg (8 ms) beats own-share (3 ms).
+    assert not by_phase[("leader", "device_compute")]["critical"]
+    assert by_phase[("helper", "helper_queue")]["critical"]
+    assert by_phase[("helper", "helper_compute")]["critical"]
+    # helper_net splits into symmetric half-legs around the service.
+    nets = [s for s in segments if s["phase"] == "helper_net"]
+    assert [n["duration_ms"] for n in nets] == [1.0, 1.0]
+    assert nets[0]["start_ms"] == pytest.approx(2.0)
+    assert nets[1]["start_ms"] == pytest.approx(9.0)
+    # The tail starts after the slower leg joins.
+    assert by_phase[("leader", "respond")]["start_ms"] == pytest.approx(
+        2.0 + 8.0
+    )
+    # Per-party starts are monotone and every segment is in-range.
+    for party in {s["party"] for s in segments}:
+        starts = [s["start_ms"] for s in segments if s["party"] == party]
+        assert starts == sorted(starts)
+    assert all(s["start_ms"] >= 0.0 for s in segments)
+
+
+def test_build_timeline_local_critical_and_fallback():
+    phases = {"queue": 1.0, "device_compute": 20.0, "respond": 1.0}
+    segments, leg = cp.build_timeline(phases, _leg(own=20.0))
+    assert leg == "local"
+    own = next(s for s in segments if s["phase"] == "device_compute")
+    assert own["critical"]
+    assert not any(
+        s["critical"] for s in segments if s["phase"] == "helper_queue"
+    )
+    # No decomposition (invalid skew / v1 peer): one opaque rtt block.
+    segments, leg = cp.build_timeline(
+        {}, {"rtt_ms": 8.0, "own_ms": 1.0, "decomp": None, "skew": {}}
+    )
+    assert leg == "helper"
+    assert [s["phase"] for s in segments] == ["helper_rtt"]
+
+
+# ---------------------------------------------------------------------------
+# Analyzer aggregation + admin surface
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def analyzer():
+    prev = cp.default_analyzer()
+    fresh = cp.set_default_analyzer(cp.CriticalPathAnalyzer())
+    yield fresh
+    cp.set_default_analyzer(prev)
+
+
+@pytest.fixture
+def recorder():
+    prev = tracing.default_recorder()
+    rec = tracing.set_default_recorder(tracing.FlightRecorder())
+    yield rec
+    tracing.set_default_recorder(prev)
+
+
+def test_analyzer_observe_round_profile_and_metrics(analyzer):
+    reg = MetricsRegistry()
+    analyzer.bind_registry(reg)
+    skew = cp.estimate_skew(_T0, _T3, _T1, _T2)
+    decomp = cp.decompose_helper_leg(skew, {"device_compute": 6.0})
+    for _ in range(3):
+        analyzer.observe_round(
+            "hh-leader", own_ms=1.0, rtt_ms=10.0, decomp=decomp, skew=skew
+        )
+    state = analyzer.export()
+    assert state["requests"] == 3
+    assert state["legs"]["helper"] == 3
+    assert state["skew_invalid"] == 0
+    profile = state["profile"]
+    assert profile["helper"]["helper_compute"]["count"] == 3
+    assert profile["helper"]["helper_compute"]["p50_ms"] == pytest.approx(
+        6.0
+    )
+    # Shares sum to 1 over all critical cells.
+    total_share = sum(
+        entry["share"] for phases in profile.values()
+        for entry in phases.values()
+    )
+    assert total_share == pytest.approx(1.0, abs=0.01)
+    last = analyzer.last("hh-leader")
+    assert last["critical_leg"] == "helper"
+    assert last["helper_net_ms"] == pytest.approx(4.0)
+    snap = reg.export()
+    assert snap["counters"]["critical.legs{leg=helper}"] == 3
+    assert snap["gauges"]["critical.helper_compute_ms"] == pytest.approx(
+        6.0
+    )
+    hist = snap["histograms"][
+        "critical.path_ms{party=helper,phase=helper_compute}"
+    ]
+    assert hist["count"] == 3
+    # An invalid estimate counts, never splits.
+    analyzer.observe_round(
+        "hh-leader", own_ms=1.0, rtt_ms=5.0, decomp=None,
+        skew=cp.estimate_skew(0.0, 5.0, 100.0, 110.0),
+    )
+    assert analyzer.export()["skew_invalid"] == 1
+    assert reg.export()["counters"]["critical.skew_invalid"] == 1
+
+
+def test_criticalz_endpoint_text_json_and_statusz(analyzer):
+    skew = cp.estimate_skew(_T0, _T3, _T1, _T2)
+    decomp = cp.decompose_helper_leg(skew, {"device_compute": 6.0})
+    analyzer.observe_round(
+        "leader", own_ms=1.0, rtt_ms=10.0, decomp=decomp, skew=skew
+    )
+    with AdminServer() as admin:  # defaults to the default analyzer
+        base = f"http://127.0.0.1:{admin.port}"
+        text = urllib.request.urlopen(base + "/criticalz").read().decode()
+        assert "critical path" in text
+        assert "helper_compute" in text
+        assert "last merged request [leader]" in text
+        state = json.loads(
+            urllib.request.urlopen(
+                base + "/criticalz?format=json"
+            ).read()
+        )
+        assert state["requests"] == 1
+        assert state["last"]["leader"]["helper_net_ms"] == pytest.approx(
+            4.0
+        )
+        assert (
+            state["profile"]["helper"]["helper_compute"]["count"] == 1
+        )
+        statusz = json.loads(
+            urllib.request.urlopen(
+                base + "/statusz?format=json"
+            ).read()
+        )
+        assert statusz["critical"]["requests"] == 1
+        html = urllib.request.urlopen(base + "/statusz").read().decode()
+        assert "Critical path (cross-party)" in html
+        # The 404 help lists the endpoint.
+        try:
+            urllib.request.urlopen(base + "/nope")
+        except urllib.error.HTTPError as e:
+            assert "/criticalz" in e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# In-process acceptance: the decomposition is honest end to end
+# ---------------------------------------------------------------------------
+
+NUM_RECORDS = 64
+RECORD_BYTES = 16
+RNG = np.random.default_rng(77)
+
+
+def _build_database():
+    records = [
+        bytes(RNG.integers(0, 256, RECORD_BYTES, dtype=np.uint8))
+        for _ in range(NUM_RECORDS)
+    ]
+    builder = DenseDpfPirDatabase.Builder()
+    for r in records:
+        builder.insert(r)
+    return builder.build(), records
+
+
+def test_in_process_decomposition_attributes_rtt(analyzer, recorder):
+    """ISSUE acceptance: on InProcessTransport the helper leg is all
+    service (the Helper runs inline), so helper_net ~ 0 and
+    helper_queue + helper_compute ~ exchange rtt, within the
+    estimator's own stated uncertainty — checked from the same numbers
+    an operator would read off /criticalz."""
+    database, records = _build_database()
+    config = ServingConfig(max_batch_size=4, max_wait_ms=2.0)
+    helper = HelperSession(database, encrypt_decrypt.decrypt, config)
+    leader = LeaderSession(
+        database, InProcessTransport(helper.handle_wire), config
+    )
+    client = DenseDpfPirClient.create(NUM_RECORDS, encrypt_decrypt.encrypt)
+    with helper, leader:
+        for idx in (3, 17, 41):
+            request, state = client.create_request([idx])
+            response = leader.handle_request(request)
+            assert client.handle_response(response, state) == [
+                records[idx]
+            ]
+    last = analyzer.last("leader")
+    assert last is not None, "no merged timeline reached the analyzer"
+    assert last["skew_valid"] is True
+    net = last["helper_net_ms"]
+    queue = last["helper_queue_ms"]
+    compute = last["helper_compute_ms"]
+    exchange = last["exchange_ms"]
+    uncertainty = last["uncertainty_ms"]
+    # helper_net ~ 0: within the estimator's stated uncertainty plus
+    # envelope-codec slop, and small in absolute terms.
+    assert net <= 2.0 * uncertainty + 0.5
+    assert net < 5.0
+    # The split accounts for the exchange rtt to the same tolerance.
+    assert queue + compute == pytest.approx(
+        exchange, abs=2.0 * uncertainty + 0.5
+    )
+    state = analyzer.export()
+    assert state["requests"] == 3
+    assert state["profile"], "no critical time attributed"
+    # The merged timeline rode the flight-recorder trace (/tracez).
+    dump = recorder.dump()
+    traces = dump["slowest"] + dump["recent"]
+    leader_trace = next(
+        t for t in traces if t["name"] == "leader.request"
+    )
+    merged = leader_trace["attrs"]["critical_path"]
+    assert merged["critical_leg"] in ("helper", "local")
+    timeline = merged["timeline"]
+    assert timeline
+    for party in {s["party"] for s in timeline}:
+        starts = [
+            s["start_ms"] for s in timeline if s["party"] == party
+        ]
+        assert starts == sorted(starts)
+    assert all(
+        s["start_ms"] >= 0.0 and s["duration_ms"] >= 0.0
+        for s in timeline
+    )
+    # The waterfall gained the overlay phases for this role.
+    waterfall = phases_mod.default_phase_recorder().waterfall()
+    leader_phases = waterfall["leader"]["phases"]
+    assert leader_phases.get("helper_net", {}).get("count", 0) >= 1
+    assert leader_phases.get("helper_compute", {}).get("count", 0) >= 1

@@ -229,13 +229,32 @@ def test_wire_v3_checksum_epoch_and_downgrade_compat():
     frontier = np.array([0, 5, 1 << 40], dtype=np.uint64)
     shares = np.array([7, 0, 0xFFFFFFFF], dtype=np.uint32)
 
-    # v3 round trip carries the helper epoch and a frame checksum.
-    resp = hh.encode_eval_response(2, shares, helper_ms=1.5, epoch=42)
-    r, decoded, version, helper_ms, epoch = hh.decode_eval_response_full(
-        resp
+    # v4 round trip carries the helper epoch, a frame checksum, and the
+    # critical-path digest (recv/send timestamps + compute ms).
+    resp = hh.encode_eval_response(
+        2,
+        shares,
+        helper_ms=1.5,
+        epoch=42,
+        recv_ms=100.25,
+        send_ms=101.75,
+        compute_ms=1.125,
     )
-    assert (r, version, epoch) == (2, 3, 42)
+    (
+        r,
+        decoded,
+        version,
+        helper_ms,
+        epoch,
+        timing,
+    ) = hh.decode_eval_response_full(resp)
+    assert (r, version, epoch) == (2, 4, 42)
     assert helper_ms == pytest.approx(1.5)
+    assert timing == {
+        "recv_ms": pytest.approx(100.25),
+        "send_ms": pytest.approx(101.75),
+        "compute_ms": pytest.approx(1.125),
+    }
     np.testing.assert_array_equal(decoded, shares)
 
     # A flipped byte in the body fails the checksum as a typed
@@ -249,19 +268,27 @@ def test_wire_v3_checksum_epoch_and_downgrade_compat():
 
     req = hh.encode_eval_request(1, frontier, trace_id="ab" * 8)
     r, decoded, version, trace_id = hh.decode_eval_request_full(req)
-    assert (r, version, trace_id) == (1, 3, "ab" * 8)
+    assert (r, version, trace_id) == (1, 4, "ab" * 8)
     corrupt = bytearray(req)
     corrupt[len(corrupt) // 2] ^= 0x01
     with pytest.raises(hh.IntegrityError, match="checksum"):
         hh.decode_eval_request_full(bytes(corrupt))
 
-    # Older wire versions still decode (no checksum to verify).
-    for old in (1, 2):
+    # Older wire versions still decode (no checksum to verify on
+    # v1/v2, no critical-path digest below v4).
+    for old in (1, 2, 3):
         old_resp = hh.encode_eval_response(2, shares, version=old)
-        r, decoded, version, _, epoch = hh.decode_eval_response_full(
-            old_resp
-        )
-        assert (r, version, epoch) == (2, old, None)
+        (
+            r,
+            decoded,
+            version,
+            _,
+            epoch,
+            timing,
+        ) = hh.decode_eval_response_full(old_resp)
+        assert version == old
+        assert r == 2
+        assert timing is None
         np.testing.assert_array_equal(decoded, shares)
 
 

@@ -381,6 +381,80 @@ def test_envelope_response_roundtrip():
     assert propagation.try_decode_response(b"bare") == (None, b"bare")
 
 
+def test_envelope_v2_digest_rides_only_v2():
+    """The critical-path digest (phases + recv/send timestamps +
+    per-span offsets) is a v2-only extension: the identical encode call
+    at v1 is byte-equal to the pre-digest encoder, so the downgrade
+    ladder drops the digest and nothing else."""
+    tid = tracing.new_trace_id()
+    spans = [
+        {"name": "device_compute", "duration_ms": 1.0, "offset_ms": 0.5}
+    ]
+    digest = dict(
+        phases={"device_compute": 1.0, "respond": 0.25},
+        recv_ms=10.0,
+        send_ms=12.0,
+    )
+    meta, inner = propagation.try_decode_response(
+        propagation.encode_response(
+            b"r", tid, server_ms=2.0, spans=spans, **digest
+        )
+    )
+    assert inner == b"r"
+    assert meta["phases"] == {"device_compute": 1.0, "respond": 0.25}
+    assert (meta["recv_ms"], meta["send_ms"]) == (10.0, 12.0)
+    assert meta["spans"][0]["offset_ms"] == 0.5
+    v1 = propagation.encode_response(
+        b"r", tid, server_ms=2.0, spans=spans, version=1, **digest
+    )
+    assert v1 == propagation.encode_response(
+        b"r", tid, server_ms=2.0, spans=spans, version=1
+    )
+    meta1, inner1 = propagation.try_decode_response(v1)
+    assert inner1 == b"r"  # the inner share is never the casualty
+    assert "phases" not in meta1 and "recv_ms" not in meta1
+    assert meta1["server_ms"] == 2.0
+    assert meta1["spans"] == [
+        {"name": "device_compute", "duration_ms": 1.0}
+    ]
+
+
+def test_envelope_response_span_list_is_bounded():
+    tid = tracing.new_trace_id()
+    cap = propagation.MAX_RESPONSE_SPANS
+    spans = [
+        {"name": f"s{i}", "duration_ms": 1.0} for i in range(cap + 9)
+    ]
+    before = tracing.runtime_counters.export().get(
+        "propagation.spans_dropped", 0
+    )
+    meta, _ = propagation.try_decode_response(
+        propagation.encode_response(b"x", tid, server_ms=0.0, spans=spans)
+    )
+    assert len(meta["spans"]) == cap
+    assert meta["spans"][0]["name"] == "s0"  # chronological head kept
+    after = tracing.runtime_counters.export()["propagation.spans_dropped"]
+    assert after - before == 9
+
+
+def test_add_span_clamps_negative_offset(recorder):
+    with tracing.trace_request("t.clamp") as trace:
+        trace.add_span("rewound", 1.0, offset_ms=-5.0)
+        trace.add_span("normal", 1.0, offset_ms=2.0)
+        trace.add_remote_spans(
+            [{"name": "early", "duration_ms": 0.5, "offset_ms": 1.0}],
+            prefix="helper.",
+            base_offset_ms=-3.0,
+        )
+        spans = trace.span_list()
+    rewound = next(s for s in spans if s["name"] == "rewound")
+    assert rewound["offset_ms"] == 0.0 and rewound["clamped"] is True
+    normal = next(s for s in spans if s["name"] == "normal")
+    assert normal["offset_ms"] == 2.0 and "clamped" not in normal
+    early = next(s for s in spans if s["name"] == "helper.early")
+    assert early["offset_ms"] == 0.0 and early["clamped"] is True
+
+
 # ---------------------------------------------------------------------------
 # Admin endpoint
 # ---------------------------------------------------------------------------
@@ -513,10 +587,86 @@ def test_old_helper_downgrades_leader_to_bare_proto(recorder):
     assert got == [RECORDS[5], RECORDS[64]]
     assert got2 == [RECORDS[6]]
     assert leader._peer_envelope is False
-    assert counters["leader.wire_downgrades"] == 1
-    # The probe fault did not consume a retry attempt.
+    # Stepwise ladder: v2 -> v1 -> bare, one downgrade per fault.
+    assert counters["leader.wire_downgrades"] == 2
+    # The probe faults did not consume a retry attempt.
     assert counters["leader.helper_retries"] == 0
     assert counters["leader.helper_failures"] == 0
+
+
+def _v1_envelope_only(handler):
+    """Wrap a Helper handler as a v1-envelope-era peer: v2 requests are
+    rejected the way an old build would (envelope magic known, version
+    byte not), bare and v1 traffic passes through."""
+
+    def guard(payload):
+        if payload.startswith(b"\xffDPT") and payload[4] != 1:
+            raise propagation.EnvelopeError(
+                f"unsupported envelope version {payload[4]}"
+            )
+        return handler(payload)
+
+    return guard
+
+
+def test_new_leader_steps_down_to_v1_helper_keeping_spans(recorder):
+    """Decode matrix, new Leader x old (v1-envelope) Helper: exactly one
+    ladder step, and the downgrade drops only the digest — the inner
+    share, server_ms split, and remote spans all survive at v1."""
+    helper = HelperSession(DATABASE, encrypt_decrypt.decrypt, make_config())
+    leader = LeaderSession(
+        DATABASE,
+        InProcessTransport(_v1_envelope_only(helper.handle_wire)),
+        make_config(),
+    )
+    with helper, leader:
+        got = run_query(leader, [5, 64])
+        got2 = run_query(leader, [6])
+        counters = leader.metrics.export()["counters"]
+    assert got == [RECORDS[5], RECORDS[64]]
+    assert got2 == [RECORDS[6]]
+    assert leader._peer_envelope is True  # still an enveloped peer
+    assert leader._peer_wire_version == 1  # ...pinned at v1, sticky
+    assert counters["leader.wire_downgrades"] == 1
+    assert counters["leader.helper_retries"] == 0
+    # v1 keeps server_ms + spans, so the remote/network split and the
+    # grafted helper.* spans are intact.
+    leader_trace = _assert_leader_trace_decomposed(recorder.dump())
+    helper_leg = next(
+        s for s in leader_trace["spans"] if s["name"] == "helper_leg"
+    )
+    # ...but the digest is gone: no skew estimate on the leg.
+    assert "offset_ms_est" not in helper_leg
+
+
+def test_new_helper_answers_v1_requests_in_v1(recorder):
+    """Decode matrix, old (v1) Leader x new Helper: the Helper answers
+    in the request's version, so a v1 peer never sees v2 fields."""
+    helper = HelperSession(DATABASE, encrypt_decrypt.decrypt, make_config())
+    replies = []
+
+    def capture(payload):
+        out = helper.handle_wire(payload)
+        replies.append(out)
+        return out
+
+    # helper_digest=False pins this Leader's envelope at v1 — from the
+    # Helper's side it is indistinguishable from an old build.
+    leader = LeaderSession(
+        DATABASE,
+        InProcessTransport(capture),
+        make_config(helper_digest=False),
+    )
+    with helper, leader:
+        got = run_query(leader, [8])
+    assert got == [RECORDS[8]]
+    assert leader.metrics.export()["counters"]["leader.wire_downgrades"] == 0
+    assert replies and replies[-1][4] == 1  # version byte: answered v1
+    meta, inner = propagation.try_decode_response(replies[-1])
+    assert inner  # the share rode along
+    assert meta["server_ms"] >= 0.0 and meta["spans"]
+    assert "phases" not in meta
+    assert "recv_ms" not in meta and "send_ms" not in meta
 
 
 def test_new_leader_serves_old_bare_proto_clients(recorder):
@@ -567,10 +717,15 @@ def test_hh_wire_v2_codec_roundtrip():
 
     shares = np.array([7, 0, 0xFFFFFFFF], dtype=np.uint32)
     resp = hh.encode_eval_response(3, shares, helper_ms=12.5, version=2)
-    r, decoded, version, helper_ms, epoch = hh.decode_eval_response_full(
-        resp
-    )
-    assert (r, version, helper_ms, epoch) == (3, 2, 12.5, None)
+    (
+        r,
+        decoded,
+        version,
+        helper_ms,
+        epoch,
+        timing,
+    ) = hh.decode_eval_response_full(resp)
+    assert (r, version, helper_ms, epoch, timing) == (3, 2, 12.5, None, None)
     np.testing.assert_array_equal(decoded, shares)
 
     # The 2-tuple decoders keep working for every version.
@@ -587,7 +742,7 @@ def test_hh_wire_v2_codec_roundtrip():
     with pytest.raises(hh.ProtocolError, match="v2 extension"):
         hh.decode_eval_request_full(req[:20])
     with pytest.raises(ValueError, match="wire version"):
-        hh.encode_eval_request(0, frontier, version=4)
+        hh.encode_eval_request(0, frontier, version=5)
 
 
 def _hh_oracle():
@@ -603,7 +758,7 @@ def test_hh_v2_sweep_propagates_trace_and_helper_timing(recorder, hh_keys):
     )
     result = leader.run()
     assert result.as_dict() == _hh_oracle()
-    assert leader.wire_version == 3
+    assert leader.wire_version == 4
     snap = leader.metrics.export()
     assert snap["counters"]["hh.wire_downgrades"] == 0
     rounds = snap["counters"]["hh.rounds"]
@@ -650,8 +805,8 @@ def test_hh_leader_downgrades_for_v1_helper_in_process(hh_keys):
     result = leader.run()
     assert result.as_dict() == _hh_oracle()
     assert leader.wire_version == 1
-    # Stepwise: v3 -> v2 -> v1, one downgrade per rejected probe.
-    assert leader.metrics.export()["counters"]["hh.wire_downgrades"] == 2
+    # Stepwise: v4 -> v3 -> v2 -> v1, one downgrade per rejected probe.
+    assert leader.metrics.export()["counters"]["hh.wire_downgrades"] == 3
     # v1 responses carry no helper timing, so no remote/network split.
     assert "hh.helper_remote_ms" not in leader.metrics.export()["histograms"]
 
@@ -675,7 +830,7 @@ def test_hh_leader_downgrades_for_v1_helper_over_tcp(hh_keys):
         server.stop()
     assert result.as_dict() == _hh_oracle()
     assert leader.wire_version == 1
-    assert leader.metrics.export()["counters"]["hh.wire_downgrades"] == 2
+    assert leader.metrics.export()["counters"]["hh.wire_downgrades"] == 3
 
 
 def test_hh_helper_answers_v1_leaders_in_v1(hh_keys):
@@ -686,10 +841,15 @@ def test_hh_helper_answers_v1_leaders_in_v1(hh_keys):
         hh.encode_eval_request(0, frontier, version=1)
     )
     assert reply[4] == 1  # version byte: the Helper answered in v1
-    r, shares, version, helper_ms, epoch = hh.decode_eval_response_full(
-        reply
-    )
-    assert (r, version, helper_ms, epoch) == (0, 1, None, None)
+    (
+        r,
+        shares,
+        version,
+        helper_ms,
+        epoch,
+        timing,
+    ) = hh.decode_eval_response_full(reply)
+    assert (r, version, helper_ms, epoch, timing) == (0, 1, None, None, None)
     assert shares.shape == (16,)
 
 

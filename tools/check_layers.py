@@ -12,8 +12,8 @@ Three rules over `distributed_point_functions_tpu/`:
    imports it (applications — examples/, bench.py, benchmarks/ — may
    import anything). `observability` sits near the bottom on purpose:
    every layer may instrument itself (spans, runtime counters,
-   compile/HBM telemetry), but observability — `device.py` and
-   `slo.py` included — imports only `utils/`, stdlib, and
+   compile/HBM telemetry), but observability — `device.py`, `slo.py`,
+   and `critical_path.py` included — imports only `utils/`, stdlib, and
    `robustness/` — never pir/ops/serving — so telemetry can never
    create an upward edge. `capacity` (the shared byte/throughput
    model plus admission and brownout policy) sits below every
