@@ -32,7 +32,14 @@ or through the headline harness (one bench-style JSON line)::
 
 Environment knobs: HH_BENCH_CLIENTS (default 48), HH_BENCH_DOMAIN_BITS
 ("16"), HH_BENCH_LEVEL_BITS (4), HH_BENCH_THRESHOLDS ("2,4"),
-HH_BENCH_OUT (report path; empty string disables the file).
+HH_BENCH_OUT (report path; empty string disables the file),
+BENCH_HISTORY ("0" skips the history.jsonl residual append).
+
+The report also carries `cost_model_residual_p50` for the "hh"
+workload — the cost ledger's samples-weighted |residual_p50| over the
+measured sweeps' folded levels, appended to history.jsonl with
+direction "lower" (report-only; same shape as serving_bench's pir
+aggregate).
 """
 
 from __future__ import annotations
@@ -220,6 +227,27 @@ def run_heavy_hitters_bench():
                 f"correct={'ok' if point['correctness_ok'] else 'FAILED'}"
             )
 
+    # Cost-model accuracy: every folded level in the measured sweeps
+    # joined its admission-time frontier price against the measured
+    # fold in the default cost ledger. Same aggregate (samples-weighted
+    # mean |residual_p50|) and history metric shape as serving_bench's
+    # pir workload — report-only, direction "lower".
+    from benchmarks.serving_bench import workload_residual_summary
+    from distributed_point_functions_tpu.observability import (
+        costmodel as costmodel_mod,
+    )
+
+    cost_model_residual = workload_residual_summary(
+        costmodel_mod.default_cost_ledger().export(), "hh"
+    )
+    if cost_model_residual["cells"]:
+        _log(
+            f"cost-model residual (hh): "
+            f"|p50| {cost_model_residual['residual_p50_abs']:.3f} over "
+            f"{cost_model_residual['samples']} folded levels in "
+            f"{len(cost_model_residual['cells'])} cells"
+        )
+
     best = max(p["lanes_per_sec"] for p in points)
     speedups = [p["resume_speedup"] for p in points if p["resume_speedup"]]
     report = {
@@ -235,6 +263,7 @@ def run_heavy_hitters_bench():
         if speedups
         else None,
         "correctness_ok": correctness_ok,
+        "cost_model_residual_p50": cost_model_residual,
         # Sweep-wide span summary (helper_evaluate / leader_own_share /
         # reconstruct / round percentiles) and the final measured
         # point's metrics snapshot.
@@ -256,6 +285,12 @@ def run_heavy_hitters_bench():
 def main():
     report = run_heavy_hitters_bench()
     print(json.dumps(report, indent=2))
+    if os.environ.get("BENCH_HISTORY", "1") != "0":
+        from benchmarks.serving_bench import append_residual_history
+
+        append_residual_history(
+            report["cost_model_residual_p50"], bench="heavy_hitters_bench"
+        )
     if not report["correctness_ok"]:
         raise SystemExit("heavy-hitters bench FAILED correctness")
 

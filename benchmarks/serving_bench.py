@@ -10,6 +10,15 @@ one returns — at several concurrency levels, in two modes:
   request pays its own `handle_plain_request` device step — the
   one-request-at-a-time baseline.
 
+The batched sweep also reads the cost-model accuracy ledger
+(`observability/costmodel.py`) the session populated while serving: a
+report-only `cost_model_residual_p50` per workload (abs signed-ratio
+error of the capacity model's device-ms price, lower is better) that
+`main()` appends to `benchmarks/results/history.jsonl`, and a
+`ledger_overhead` point measuring the q/s cost of the per-batch
+predicted-vs-actual join against a short-circuited ledger (same <2%
+review budget as the prober and digest points).
+
 Every response is compared bit-for-bit against an oracle computed
 upfront by a direct (no serving runtime) `DenseDpfPirServer`, so the
 throughput claim carries an equal-correctness proof in the same run.
@@ -39,7 +48,10 @@ SERVING_BENCH_RECORD_BYTES (32), SERVING_BENCH_CONCURRENCY ("1,4,16"),
 SERVING_BENCH_REQUESTS (total closed-loop requests per sweep point,
 default 64), SERVING_BENCH_MAX_BATCH (16), SERVING_BENCH_PROBER_PERIOD_S
 (cadence for the overhead point, default 5.0 — the prober default),
-SERVING_BENCH_OUT (report path; empty string disables the file).
+SERVING_BENCH_OUT (report path; empty string disables the file),
+BENCH_HISTORY ("0" skips the history.jsonl residual append),
+BENCH_HISTORY_PATH (append target, default
+benchmarks/results/history.jsonl).
 """
 
 from __future__ import annotations
@@ -61,6 +73,79 @@ def _percentile(sorted_vals, q):
         return None
     idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
     return sorted_vals[idx]
+
+
+def workload_residual_summary(ledger_export, workload):
+    """Collapse one workload's cost-ledger cells into a single
+    report-line number: the samples-weighted mean of per-cell signed
+    `residual_p50` and of its absolute value (the history metric —
+    0 means the capacity model priced the work exactly).
+
+    Shared with `heavy_hitters_bench` so both workloads report the
+    same aggregate.
+    """
+    prefix = f"{workload}/"
+    cells = {
+        name: cell
+        for name, cell in ledger_export.get("cells", {}).items()
+        if name.startswith(prefix) and cell.get("residual_p50") is not None
+    }
+    total = sum(c["samples"] for c in cells.values())
+    if not total:
+        return {"workload": workload, "samples": 0, "cells": {},
+                "residual_p50": None, "residual_p50_abs": None}
+    signed = sum(
+        c["residual_p50"] * c["samples"] for c in cells.values()
+    ) / total
+    absolute = sum(
+        abs(c["residual_p50"]) * c["samples"] for c in cells.values()
+    ) / total
+    return {
+        "workload": workload,
+        "samples": total,
+        "residual_p50": round(signed, 4),
+        "residual_p50_abs": round(absolute, 4),
+        "cells": {
+            name: {
+                "samples": c["samples"],
+                "residual_p50": c["residual_p50"],
+            }
+            for name, c in cells.items()
+        },
+    }
+
+
+def append_residual_history(summary, bench):
+    """Best-effort: append the |residual_p50| aggregate for one
+    workload to `benchmarks/results/history.jsonl` as metric
+    `cost_model_residual_p50_<workload>` with explicit
+    ``direction: "lower"`` — report-only in spirit (the regression
+    gate needs 2 clean priors before it judges, and the record is
+    plainly labeled), never fatal to the bench."""
+    if summary["samples"] == 0 or summary["residual_p50_abs"] is None:
+        return
+    try:
+        from benchmarks.regression_gate import append_record, git_rev
+
+        append_record(
+            {
+                "metric": f"cost_model_residual_p50_{summary['workload']}",
+                "value": float(summary["residual_p50_abs"]),
+                "unit": "abs_ratio_error",
+                "direction": "lower",
+                "status": "ok",
+                "vs_baseline": None,
+                "git_rev": git_rev(),
+                "device": os.environ.get("BENCH_PLATFORM", "cpu"),
+                "bench": bench,
+                "samples": summary["samples"],
+            },
+            path=os.environ.get(
+                "BENCH_HISTORY_PATH", "benchmarks/results/history.jsonl"
+            ),
+        )
+    except Exception as e:  # noqa: BLE001 - accounting never fails a bench
+        _log(f"history append skipped: {e}")
 
 
 def _closed_loop(handle, requests, concurrency):
@@ -390,6 +475,90 @@ def run_serving_bench():
         f"({digest_overhead['overhead_pct']:+.1f}%)"
     )
 
+    # Cost-ledger overhead: the same batched point at the highest
+    # concurrency, back to back on two fresh sessions — one bound to a
+    # ledger whose `observe` is short-circuited (the join never runs),
+    # one bound to a real `CostLedger` — so the delta is exactly the
+    # per-batch predicted-vs-actual join. Report-only, same <2% q/s
+    # budget and CPU-variance rationale as the prober/digest points.
+    def ledger_overhead_point():
+        from distributed_point_functions_tpu.observability import (
+            costmodel as costmodel_mod,
+        )
+
+        class _NullLedger(costmodel_mod.CostLedger):
+            def observe(self, *args, **kwargs):  # noqa: D401 - no-op
+                return None
+
+        concurrency = concurrency_levels[-1]
+        config = ServingConfig(
+            max_batch_size=max_batch,
+            max_wait_ms=2.0,
+            max_queue=max(256, 4 * num_requests),
+            batching=True,
+        )
+        prev = costmodel_mod.default_cost_ledger()
+
+        def leg(ledger):
+            costmodel_mod.set_default_cost_ledger(ledger)
+            with PlainSession(database, config) as session:
+                wall, _, resps = _closed_loop(
+                    session.handle_request, requests, concurrency
+                )
+            bad = sum(
+                1
+                for got, want in zip(resps, oracle)
+                if got.dpf_pir_response.masked_response != want
+            )
+            return len(requests) / wall, bad
+
+        try:
+            base_qps, base_bad = leg(_NullLedger())
+            measured = costmodel_mod.CostLedger()
+            ledger_qps, ledger_bad = leg(measured)
+        finally:
+            costmodel_mod.set_default_cost_ledger(prev)
+        return {
+            "concurrency": concurrency,
+            "requests_per_leg": len(requests),
+            "baseline_qps": round(base_qps, 2),
+            "ledger_qps": round(ledger_qps, 2),
+            "overhead_pct": round(
+                100.0 * (base_qps - ledger_qps) / base_qps, 2
+            ),
+            "ledger_samples": measured.export()["total_samples"],
+            "mismatches": base_bad + ledger_bad,
+        }
+
+    ledger_overhead = ledger_overhead_point()
+    _log(
+        f"ledger overhead c={ledger_overhead['concurrency']}: "
+        f"{ledger_overhead['baseline_qps']:.1f} -> "
+        f"{ledger_overhead['ledger_qps']:.1f} q/s "
+        f"({ledger_overhead['overhead_pct']:+.1f}%, "
+        f"{ledger_overhead['ledger_samples']} joined batches)"
+    )
+
+    # Cost-model accuracy: the default ledger joined every terminal
+    # batch the sweeps served against its admission-time price. The
+    # aggregate is the samples-weighted mean of per-cell |residual_p50|
+    # (signed ratio error, 0 = perfectly priced) — report-only, and
+    # appended to history.jsonl by main() with direction "lower".
+    from distributed_point_functions_tpu.observability import (
+        costmodel as costmodel_mod,
+    )
+
+    cost_model_residual = workload_residual_summary(
+        costmodel_mod.default_cost_ledger().export(), "pir"
+    )
+    if cost_model_residual["cells"]:
+        _log(
+            f"cost-model residual (pir): "
+            f"|p50| {cost_model_residual['residual_p50_abs']:.3f} over "
+            f"{cost_model_residual['samples']} batches in "
+            f"{len(cost_model_residual['cells'])} cells"
+        )
+
     best_batched = max(p["qps"] for p in batched_points)
     best_unbatched = max(p["qps"] for p in unbatched_points)
     correctness_ok = (
@@ -399,6 +568,7 @@ def run_serving_bench():
         )
         and prober_overhead["mismatches"] == 0
         and digest_overhead["mismatches"] == 0
+        and ledger_overhead["mismatches"] == 0
     )
     compiles = batched_metrics["counters"].get(
         "plain.batcher.jit_bucket_compiles", 0
@@ -421,6 +591,8 @@ def run_serving_bench():
         "correctness_ok": correctness_ok,
         "prober_overhead": prober_overhead,
         "digest_overhead": digest_overhead,
+        "ledger_overhead": ledger_overhead,
+        "cost_model_residual_p50": cost_model_residual,
         "jit_bucket_compiles": compiles,
         "batched_metrics": batched_metrics,
         # Per-stage span summary (queue wait / batch assembly / device
@@ -450,6 +622,10 @@ def run_serving_bench():
 def main():
     report = run_serving_bench()
     print(json.dumps(report, indent=2))
+    if os.environ.get("BENCH_HISTORY", "1") != "0":
+        append_residual_history(
+            report["cost_model_residual_p50"], bench="serving_bench"
+        )
     if not report["correctness_ok"]:
         raise SystemExit("serving bench FAILED correctness")
 

@@ -48,10 +48,20 @@
 #                    the timeline, degrade /healthz once the e2e probe
 #                    goes stale, and fully recover (probez passing,
 #                    /healthz 200) after the failpoint clears
-#  12. perf-gate   — benchmarks/regression_gate.py --check-only against
+#  12. capacity-accuracy-smoke — the cost-model accuracy loop closed
+#                    on live traffic: a deliberately mispriced pir
+#                    workload (DPF_TPU_COSTMODEL_MISPRICE) served
+#                    through a real PlainSession must populate
+#                    /capacityz with finite residuals, journal a
+#                    capacity.drift event, burn the drift SLO gauge,
+#                    apply a clamped (<= 2x) correction to subsequent
+#                    admission prices with bit-identical responses,
+#                    and fully revert under the recalibration kill
+#                    switch
+#  13. perf-gate   — benchmarks/regression_gate.py --check-only against
 #                    the committed history fixture (CPU-safe: judges
 #                    records, runs no bench)
-#  13. dryrun      — 8-virtual-device multichip compile+step
+#  14. dryrun      — 8-virtual-device multichip compile+step
 # Benchmarks are excluded exactly as the reference excludes
 # `--test_tag_filters=-benchmark`. `FULL=1` appends the whole suite.
 set -u -o pipefail
@@ -561,6 +571,115 @@ with helper, leader, AdminServer(
 print("prober-smoke: OK (corruption flagged in cycle "
       f"{flagged_cycle}, one bundle with correlated timeline, "
       "healthz degraded on stale e2e probe and recovered after clear)")
+'
+
+stage capacity-accuracy-smoke env JAX_PLATFORMS=cpu \
+    DPF_TPU_COSTMODEL_WINDOW=4 \
+    DPF_TPU_COSTMODEL_DRIFT_WINDOWS=1 \
+    DPF_TPU_COSTMODEL_MIN_SAMPLES=4 \
+    DPF_TPU_COSTMODEL_MISPRICE=pir=3.0 \
+    python -c '
+import json, os, tempfile, threading, urllib.request
+import numpy as np
+from distributed_point_functions_tpu.capacity import (
+    KILL_SWITCH_ENV, CapacityModel, ThroughputCalibration,
+    set_default_capacity_model,
+)
+from distributed_point_functions_tpu.observability import (
+    AdminServer, CostLedger, set_default_cost_ledger,
+)
+from distributed_point_functions_tpu.observability.costmodel import (
+    DRIFT_GAUGE,
+)
+from distributed_point_functions_tpu.observability.events import (
+    default_journal,
+)
+from distributed_point_functions_tpu.pir import DenseDpfPirDatabase
+from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
+from distributed_point_functions_tpu.pir.server import DenseDpfPirServer
+from distributed_point_functions_tpu.serving import (
+    PlainSession, ServingConfig,
+)
+
+records = [(b"cap-%02d:" % i).ljust(16, b".")[:16] for i in range(32)]
+builder = DenseDpfPirDatabase.Builder()
+for r in records:
+    builder.insert(r)
+database = builder.build()
+
+# Pinned absurdly-fast calibration: every measured batch then looks
+# enormously more expensive than priced on any host, so the mispriced
+# workload drifts deterministically.
+cal = tempfile.NamedTemporaryFile("w", suffix=".jsonl", delete=False)
+cal.write(json.dumps({"metric": "serving_closed_loop_queries_per_sec",
+                      "value": 1e9}) + "\n")
+cal.write(json.dumps({"metric": "heavy_hitters_sweep_lanes_per_sec",
+                      "value": 1e9}) + "\n")
+cal.close()
+model = CapacityModel(device_memory_bytes=16 << 30,
+                      calibration=ThroughputCalibration(cal.name))
+set_default_capacity_model(model)
+set_default_cost_ledger(CostLedger())
+raw_1key_ms = 3.0 * 1e3 / 1e9  # misprice only, no correction
+
+client = DenseDpfPirClient.create(len(records), lambda pt, ci: pt)
+reqs = [client.create_plain_requests([i])[0] for i in range(8)]
+oracle_server = DenseDpfPirServer.create_plain(database)
+oracle = [oracle_server.handle_plain_request(r)
+          .dpf_pir_response.masked_response for r in reqs]
+
+journal = default_journal()
+config = ServingConfig(max_batch_size=1, max_wait_ms=1.0)
+with PlainSession(database, config) as session:
+    results = [None] * len(reqs)
+
+    def worker(i):
+        results[i] = session.handle_request(reqs[i])
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(reqs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for got, want in zip(results, oracle):
+        assert got.dpf_pir_response.masked_response == want, \
+            "responses changed under mispricing"
+    with AdminServer(registry=session.metrics,
+                     capacity=session.capacity_accuracy) as admin:
+        base = "http://127.0.0.1:%d" % admin.port
+        state = json.load(
+            urllib.request.urlopen(base + "/capacityz?format=json"))
+        pir_cells = {k: v for k, v in state["ledger"]["cells"].items()
+                     if k.startswith("pir/")}
+        assert pir_cells, state["ledger"]["cells"]
+        for c in pir_cells.values():
+            assert c["samples"] >= 1, c
+            assert np.isfinite(c["residual_p50"]), c
+    drifts = [e for e in journal.tail(n=64, kind="capacity.drift")
+              if e.get("workload") == "pir"]
+    assert drifts and drifts[0]["state"] == "drifting", drifts
+    gauge = session.metrics.export()["gauges"][DRIFT_GAUGE]
+    assert gauge >= 1.0, gauge
+    rec = session.capacity_accuracy.recalibrator
+    factor = rec.factor("pir")
+    assert 1.0 < factor <= 2.0, factor
+    priced = model.price_pir_keys(1).device_ms
+    assert abs(priced - factor * raw_1key_ms) < 1e-12, (priced, factor)
+    os.environ[KILL_SWITCH_ENV] = "0"
+    try:
+        reverted = model.price_pir_keys(1).device_ms
+        assert abs(reverted - raw_1key_ms) < 1e-12, reverted
+        assert journal.tail(kind="capacity.correction_reverted"), \
+            "no revert event"
+    finally:
+        del os.environ[KILL_SWITCH_ENV]
+    resumed = model.price_pir_keys(1).device_ms
+    assert abs(resumed - factor * raw_1key_ms) < 1e-12, resumed
+os.unlink(cal.name)
+print("capacity-accuracy-smoke: OK (%d pir cells, drift journaled, "
+      "gauge %.0f, correction clamped at %.2fx, kill switch "
+      "reverted and resumed)" % (len(pir_cells), gauge, factor))
 '
 
 stage perf-gate python -m benchmarks.regression_gate --check-only \

@@ -23,6 +23,13 @@ package is the single serving brain:
   steps driven by SLO burn states (shed low-priority tenants -> cap
   batch sizes -> force cheaper serving tiers -> reject non-critical
   traffic), every transition exported for `/statusz` and traced.
+* `recalibrate` — guarded online recalibration: a `Recalibrator`
+  subscribes to the cost ledger's drift windows
+  (`observability/costmodel.py`) and serves clamped per-workload EWMA
+  correction factors back into `CapacityModel.price_*`, with a live
+  kill switch (`DPF_TPU_COSTMODEL_RECALIBRATE=0`) and every material
+  factor change journaled. `CapacityAccuracy` bundles ledger + model +
+  recalibrator exports for `/capacityz`.
 
 Layering (`tools/check_layers.py`): capacity sits *below* pir, serving,
 and heavy_hitters (all three consume it) and *above* ops/observability/
@@ -44,7 +51,16 @@ from .model import (
     ThroughputCalibration,
     WorkCost,
     default_capacity_model,
+    misprice_factor,
     set_default_capacity_model,
+)
+from .recalibrate import (
+    KILL_SWITCH_ENV,
+    CapacityAccuracy,
+    Recalibrator,
+    default_recalibrator,
+    recalibration_enabled,
+    set_default_recalibrator,
 )
 
 __all__ = [
@@ -52,8 +68,11 @@ __all__ = [
     "AdmissionDecision",
     "BROWNOUT_STEPS",
     "BrownoutController",
+    "CapacityAccuracy",
     "CapacityModel",
+    "KILL_SWITCH_ENV",
     "LevelChunking",
+    "Recalibrator",
     "ShedReason",
     "TenantPolicy",
     "ThroughputCalibration",
@@ -61,5 +80,9 @@ __all__ = [
     "WeightedFairQueue",
     "WorkCost",
     "default_capacity_model",
+    "default_recalibrator",
+    "misprice_factor",
+    "recalibration_enabled",
     "set_default_capacity_model",
+    "set_default_recalibrator",
 ]

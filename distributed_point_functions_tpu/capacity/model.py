@@ -22,11 +22,23 @@ history exists) prices work in estimated device-milliseconds — the
 quantity serving admission reasons about (estimated queue drain time
 vs. request deadline, see `capacity/admission.py`).
 
+Since the cost-model accuracy ledger (`observability/costmodel.py`)
+landed, the time model is *validated and corrected*: an installed
+correction provider (see `capacity/recalibrate.py`) multiplies each
+priced device-ms by a clamped per-(workload, shape-bucket) EWMA
+factor learned from measured residuals, and a `DPF_TPU_COSTMODEL_MISPRICE`
+override (failpoint-style, e.g. ``pir=3.0``) deliberately misprices a
+workload so drift detection and recalibration can be drilled end to
+end without touching real throughput numbers.
+
 Environment knobs: ``DPF_TPU_SELECTION_BYTES_BUDGET``,
 ``DPF_TPU_HH_BYTES_BUDGET`` (byte budgets, unchanged semantics),
 ``DPF_TPU_DEVICE_MEMORY_BYTES`` (pins the device memory the budgets
 derive from when no explicit budget is set),
-``DPF_TPU_CAPACITY_HISTORY`` (alternate history.jsonl path).
+``DPF_TPU_CAPACITY_HISTORY`` (alternate history.jsonl path),
+``DPF_TPU_CALIBRATION_STALE_S`` (newest-clean-record age beyond which
+calibration reports itself stale), ``DPF_TPU_COSTMODEL_MISPRICE``
+(per-workload synthetic estimate multiplier for accuracy drills).
 """
 
 from __future__ import annotations
@@ -36,7 +48,8 @@ import json
 import math
 import os
 import threading
-from typing import Dict, Optional
+import time
+from typing import Callable, Dict, Optional
 
 _SELECTION_BLOCK_BYTES = 16
 _HH_BLOCK_BYTES = 16
@@ -62,6 +75,40 @@ _FALLBACK_THROUGHPUT = {
 # History metrics that calibrate each unit of work.
 _SERVING_QPS_METRIC = "serving_closed_loop_queries_per_sec"
 _HH_LANES_METRIC = "heavy_hitters_sweep_lanes_per_sec"
+
+# Calibration staleness: the bench history is appended per perf-gated
+# PR, so a newest clean record older than this is a process pricing
+# work off a stale machine state.
+_DEFAULT_STALE_AFTER_S = 30 * 86400.0
+_STALE_ENV = "DPF_TPU_CALIBRATION_STALE_S"
+
+# Failpoint-style synthetic mispricing: "workload=factor[,workload=
+# factor]" multiplies the device-ms estimate for that workload. Parsed
+# lazily and cached per env value so the disarmed path is one dict hit.
+_MISPRICE_ENV = "DPF_TPU_COSTMODEL_MISPRICE"
+_misprice_cache: tuple = ("", {})
+
+
+def misprice_factor(workload: str) -> float:
+    """The armed synthetic estimate multiplier for `workload` (1.0
+    disarmed). Read live so tests and the presubmit drill can toggle
+    the env without rebuilding the model."""
+    global _misprice_cache
+    raw = os.environ.get(_MISPRICE_ENV, "").strip()
+    cached_raw, table = _misprice_cache
+    if raw != cached_raw:
+        table = {}
+        for item in raw.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            key, _, value = item.partition("=")
+            try:
+                table[key.strip()] = float(value)
+            except ValueError:
+                continue
+        _misprice_cache = (raw, table)
+    return table.get(workload, 1.0)
 
 
 def _env_int(name: str) -> Optional[int]:
@@ -91,16 +138,38 @@ class ThroughputCalibration:
 
     Reads `history.jsonl` once, lazily, keeping the newest *clean*
     (`status == "ok"`, finite value) record per metric — the same
-    cleanliness rule the regression gate applies. Missing file,
-    malformed lines, and absent metrics all degrade to the built-in
-    conservative fallbacks; calibration must never take serving down.
+    cleanliness rule the regression gate applies, so `infra_error` and
+    `last_good` echoes (the BENCH_r02–r05 tunnel-outage shape) never
+    calibrate anything; they are counted per status instead. Missing
+    file, malformed lines, and absent metrics all degrade to the
+    built-in conservative fallbacks — journaled once per metric as
+    `capacity.calibration_fallback`, because an uncalibrated process
+    over-sheds; calibration must never take serving down.
+
+    The winning record's `ts_unix` is retained so `/statusz` and
+    `/capacityz` can show record age and an explicit `stale` flag when
+    the newest clean record is older than `stale_after_s`.
     """
 
-    def __init__(self, history_path: Optional[str] = None):
+    def __init__(
+        self,
+        history_path: Optional[str] = None,
+        stale_after_s: Optional[float] = None,
+    ):
         self._path = history_path or default_history_path()
+        if stale_after_s is None:
+            raw = os.environ.get(_STALE_ENV, "").strip()
+            try:
+                stale_after_s = float(raw) if raw else _DEFAULT_STALE_AFTER_S
+            except ValueError:
+                stale_after_s = _DEFAULT_STALE_AFTER_S
+        self.stale_after_s = stale_after_s
         self._lock = threading.Lock()
         self._loaded = False
         self._newest: Dict[str, float] = {}
+        self._ts: Dict[str, float] = {}
+        self._skipped: Dict[str, int] = {}
+        self._fallback_noted: set = set()
 
     def _load(self) -> None:
         with self._lock:
@@ -123,14 +192,26 @@ class ThroughputCalibration:
                 if not isinstance(rec, dict):
                     continue
                 value = rec.get("value")
+                status = str(rec.get("status", "ok"))
                 if (
-                    rec.get("status", "ok") == "ok"
+                    status == "ok"
                     and isinstance(value, (int, float))
                     and math.isfinite(float(value))
                     and float(value) > 0
                 ):
-                    # File order is append order: last clean wins.
-                    self._newest[str(rec.get("metric"))] = float(value)
+                    # File order is append order: last clean wins,
+                    # whatever device/topology/git_rev stamp it carries.
+                    metric = str(rec.get("metric"))
+                    self._newest[metric] = float(value)
+                    ts = rec.get("ts_unix")
+                    if isinstance(ts, (int, float)) and math.isfinite(
+                        float(ts)
+                    ):
+                        self._ts[metric] = float(ts)
+                    else:
+                        self._ts.pop(metric, None)
+                else:
+                    self._skipped[status] = self._skipped.get(status, 0) + 1
 
     def lookup(self, metric: str) -> Optional[float]:
         """Newest clean measurement for `metric`, or None."""
@@ -139,13 +220,81 @@ class ThroughputCalibration:
 
     def throughput(self, metric: str, fallback: float) -> float:
         value = self.lookup(metric)
-        return value if value is not None else fallback
+        if value is not None:
+            return value
+        self._note_fallback(metric, fallback)
+        return fallback
+
+    def _note_fallback(self, metric: str, fallback: float) -> None:
+        """Journal the first fall-back to the conservative built-in for
+        each metric — the operator-visible sign that admission is
+        pricing work off a guess, not a measurement."""
+        with self._lock:
+            if metric in self._fallback_noted:
+                return
+            self._fallback_noted.add(metric)
+        from ..observability import events as events_mod
+
+        events_mod.emit(
+            "capacity.calibration_fallback",
+            message=(
+                f"no clean history record for {metric}; pricing with "
+                f"conservative fallback {fallback:g}"
+            ),
+            severity="warning",
+            metric=metric,
+            fallback=fallback,
+            history_path=self._path,
+        )
+
+    def record_age_s(self, metric: str) -> Optional[float]:
+        """Age of the winning clean record, or None without one (or
+        when the record carried no timestamp)."""
+        self._load()
+        with self._lock:
+            ts = self._ts.get(metric)
+        return None if ts is None else max(0.0, time.time() - ts)
+
+    def stale(self, metric: str) -> bool:
+        """True when `metric` has no clean record at all, or its newest
+        clean record is older than `stale_after_s`."""
+        self._load()
+        with self._lock:
+            if metric not in self._newest:
+                return True
+            ts = self._ts.get(metric)
+        if ts is None:
+            # A clean record without a timestamp cannot be aged; treat
+            # it as fresh rather than permanently stale.
+            return False
+        return (time.time() - ts) > self.stale_after_s
 
     def export(self) -> dict:
         self._load()
+        now = time.time()
+        with self._lock:
+            newest = dict(sorted(self._newest.items()))
+            ts = dict(self._ts)
+            skipped = dict(sorted(self._skipped.items()))
+        metrics = {}
+        any_stale = False
+        for metric, value in newest.items():
+            age = None if metric not in ts else max(0.0, now - ts[metric])
+            is_stale = age is not None and age > self.stale_after_s
+            any_stale = any_stale or is_stale
+            metrics[metric] = {
+                "value": value,
+                "ts_unix": ts.get(metric),
+                "age_s": None if age is None else round(age, 1),
+                "stale": is_stale,
+            }
         return {
             "history_path": self._path,
-            "calibrated_metrics": dict(sorted(self._newest.items())),
+            "calibrated_metrics": newest,
+            "metrics": metrics,
+            "skipped_records": skipped,
+            "stale_after_s": self.stale_after_s,
+            "stale": any_stale,
         }
 
 
@@ -201,6 +350,30 @@ class CapacityModel:
         self.calibration = (
             calibration if calibration is not None else ThroughputCalibration()
         )
+        # Optional `fn(workload, quantity) -> factor` multiplying each
+        # priced device-ms (see capacity/recalibrate.py). None (the
+        # default, and the kill-switch end state) prices raw.
+        self._correction: Optional[Callable[[str, int], float]] = None
+
+    def set_correction_provider(
+        self, provider: Optional[Callable[[str, int], float]]
+    ) -> None:
+        """Install (or, with None, remove) the recalibration correction
+        provider. Providers must be cheap and must not raise; a raising
+        provider is ignored for that price."""
+        self._correction = provider
+
+    def _corrected(self, workload: str, quantity: int, device_ms: float):
+        """Apply the synthetic misprice override and any installed
+        correction factor to a raw device-ms estimate."""
+        device_ms *= misprice_factor(workload)
+        provider = self._correction
+        if provider is not None:
+            try:
+                device_ms *= float(provider(workload, quantity))
+            except Exception:  # noqa: BLE001 - pricing must never raise
+                pass
+        return device_ms
 
     # -- budgets -------------------------------------------------------------
 
@@ -373,7 +546,7 @@ class CapacityModel:
                 if num_blocks
                 else 0
             ),
-            device_ms=num_keys * 1e3 / qps,
+            device_ms=self._corrected("pir", num_keys, num_keys * 1e3 / qps),
             quantity=num_keys,
             unit="pir_keys",
         )
@@ -394,7 +567,7 @@ class CapacityModel:
         lps = max(1e-6, self.hh_lanes_per_sec())
         return WorkCost(
             bytes_peak=chunking.bytes_peak,
-            device_ms=lanes * 1e3 / lps,
+            device_ms=self._corrected("hh", lanes, lanes * 1e3 / lps),
             quantity=lanes,
             unit="hh_lanes",
         )

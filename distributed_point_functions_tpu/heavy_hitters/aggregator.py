@@ -27,6 +27,7 @@ walk feeding it) shards over keys via
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import List, Optional, Sequence
 
 import jax
@@ -35,6 +36,7 @@ import numpy as np
 
 from ..capacity.model import default_capacity_model
 from ..dpf import BatchCutState, DistributedPointFunction
+from ..observability import costmodel as costmodel_mod
 from ..observability.device import default_telemetry, shape_key
 from ..value_types import IntType
 
@@ -42,6 +44,16 @@ from ..value_types import IntType
 def frontier_budget_bytes() -> int:
     """Byte budget for one fused level evaluation (capacity model)."""
     return default_capacity_model().frontier_budget_bytes()
+
+
+def _watermark_bytes(telemetry) -> int:
+    """The HBM accountant's high-water mark (0 when unavailable);
+    deltas across a level give the cost ledger its peak-bytes truth
+    whenever the level set a new peak."""
+    try:
+        return int(telemetry.hbm.export()["watermark_bytes"])
+    except Exception:  # noqa: BLE001 - accounting never breaks the sweep
+        return 0
 
 
 def lane_bytes(walk_levels: int, value_blocks: int) -> int:
@@ -192,6 +204,8 @@ class LevelAggregator:
             self._metrics.counter("hh.level_chunks").inc(plan.num_chunks)
 
         telemetry = default_telemetry()
+        hbm_before = _watermark_bytes(telemetry)
+        t_level = time.perf_counter()
         shares: List[np.ndarray] = []
         cut_parts: List[BatchCutState] = []
         for c in range(plan.num_chunks):
@@ -234,5 +248,24 @@ class LevelAggregator:
                 )
             self._cuts = merged
         self._prev_level = hierarchy_level
+        # Terminal folded level: join the capacity model's lane price
+        # with the measured wall time (np.asarray above forced the
+        # device sync, so the clock is honest) into the cost ledger.
+        level_ms = (time.perf_counter() - t_level) * 1e3
+        predicted = default_capacity_model().price_hh_level(
+            self._staged.n,
+            len(prefixes),
+            stop - start,
+            self._dpf._blocks_needed[hierarchy_level],
+        )
+        costmodel_mod.default_cost_ledger().observe(
+            "hh",
+            "resume" if resume else "root",
+            costmodel_mod.shape_bucket(predicted.quantity),
+            predicted_device_ms=predicted.device_ms,
+            actual_device_ms=level_ms,
+            predicted_bytes=plan.bytes_peak,
+            actual_bytes=max(0, _watermark_bytes(telemetry) - hbm_before),
+        )
         out = np.concatenate(shares).astype(np.uint64) & self._mask
         return out.astype(np.uint32)

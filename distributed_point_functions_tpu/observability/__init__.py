@@ -1,7 +1,7 @@
 """End-to-end request observability: tracing, device telemetry, SLOs,
 events, debug bundles, exposition, admin surface.
 
-Eleven pieces, importable from any layer above `utils/` (the layer DAG
+Twelve pieces, importable from any layer above `utils/` (the layer DAG
 is serving -> observability -> utils; this package never imports pir/,
 ops/, or serving/ — `device`/`slo` reach JAX lazily and only for
 device facts):
@@ -42,11 +42,17 @@ device facts):
   helper_net / helper_queue / helper_compute, the two-party DAG
   walked to mark the critical leg, aggregated into the `/criticalz`
   per-(phase, party) profile.
+* `costmodel` — the cost-model accuracy ledger: joins admission-time
+  capacity prices (device-ms, peak bytes) with measured truth at every
+  terminal batch outcome and folded sweep level into per-(workload,
+  tier, shape-bucket) residual reservoirs, detects sustained drift
+  (journal event + SLO gauge), and feeds the guarded recalibration
+  loop in `capacity/recalibrate.py`.
 * `exposition` — Prometheus text rendering of the metrics registry,
   including OpenMetrics-style exemplars linking buckets to traces.
 * `admin` — the `/metrics` `/varz` `/healthz` `/statusz` `/tracez`
-  `/eventz` `/probez` `/debugz` `/profilez` `/criticalz` operator
-  HTTP endpoint.
+  `/eventz` `/probez` `/debugz` `/profilez` `/criticalz` `/capacityz`
+  operator HTTP endpoint.
 """
 
 from .admin import AdminServer
@@ -68,6 +74,13 @@ from .device import (
     install_jax_monitoring_listener,
     set_default_telemetry,
     shape_key,
+)
+from .costmodel import (
+    CostLedger,
+    default_cost_ledger,
+    drift_objective,
+    set_default_cost_ledger,
+    shape_bucket,
 )
 from .critical_path import (
     CriticalPathAnalyzer,
@@ -116,6 +129,7 @@ __all__ = [
     "AutoProfiler",
     "BundleManager",
     "CompileTracker",
+    "CostLedger",
     "CounterGroup",
     "CriticalPathAnalyzer",
     "DeviceTelemetry",
@@ -136,10 +150,12 @@ __all__ = [
     "current_trace",
     "decompose_helper_leg",
     "default_analyzer",
+    "default_cost_ledger",
     "default_journal",
     "default_phase_recorder",
     "default_recorder",
     "default_telemetry",
+    "drift_objective",
     "emit",
     "encode_request",
     "encode_response",
@@ -151,10 +167,12 @@ __all__ = [
     "reset_stages",
     "runtime_counters",
     "set_default_analyzer",
+    "set_default_cost_ledger",
     "set_default_journal",
     "set_default_phase_recorder",
     "set_default_recorder",
     "set_default_telemetry",
+    "shape_bucket",
     "shape_key",
     "span",
     "stage_summary",

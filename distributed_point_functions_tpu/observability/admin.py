@@ -1,6 +1,6 @@
 """Operator telemetry endpoint: /metrics, /varz, /healthz, /statusz,
-/tracez, /profilez, /eventz, /probez, /debugz, /criticalz — a stdlib
-`http.server` surface any session can hang off a port.
+/tracez, /profilez, /eventz, /probez, /debugz, /criticalz, /capacityz —
+a stdlib `http.server` surface any session can hang off a port.
 
 The serving runtime's observability state (metrics registry, flight
 recorder, stage aggregates, runtime counters, device telemetry, SLO
@@ -43,6 +43,13 @@ this server is the scrape surface:
                              request's skew-corrected helper_rtt
                              decomposition, and skew-estimate health
                              (text; `?format=json`)
+    /capacityz               cost-model accuracy ledger: per-(workload,
+                             tier, shape-bucket) predicted-vs-actual
+                             device-ms residual percentiles, drift
+                             state, learned correction factors, and
+                             throughput-calibration staleness (text;
+                             `?format=json`; requires a capacity
+                             accuracy export)
     /profilez?duration_ms=N  on-demand xprof capture via
                              `utils/profiling.trace` into a fresh
                              directory; returns the trace dir (bounded
@@ -113,6 +120,7 @@ class AdminServer:
         prober=None,
         bundles=None,
         critical=None,
+        capacity=None,
     ):
         self._registry = registry
         self._recorder = (
@@ -161,6 +169,11 @@ class AdminServer:
             if critical is not None
             else critical_path_mod.default_analyzer()
         )
+        # capacity (`capacity.recalibrate.CapacityAccuracy`) is
+        # duck-typed (`export() -> dict` with "ledger"/"model"/
+        # "recalibration" keys) and opt-in; it backs /capacityz and a
+        # "Cost-model accuracy" section on /statusz.
+        self._capacity = capacity
         self._name = name
         self._profile_dir = profile_dir
         self._profile_lock = threading.Lock()
@@ -177,6 +190,8 @@ class AdminServer:
             )
             if prober is not None:
                 bundles.add_source("probes", prober.export)
+            if capacity is not None:
+                bundles.add_source("capacity", capacity.export)
         outer = self
 
         class _Handler(http.server.BaseHTTPRequestHandler):
@@ -275,6 +290,8 @@ class AdminServer:
             self._debugz(handler)
         elif path == "/criticalz":
             self._criticalz(handler, parsed.query)
+        elif path == "/capacityz":
+            self._capacityz(handler, parsed.query)
         elif path == "/profilez":
             self._profilez(handler, parsed.query)
         else:
@@ -282,7 +299,7 @@ class AdminServer:
                 handler, 404, "text/plain; charset=utf-8",
                 b"unknown endpoint; try /healthz /metrics /varz "
                 b"/statusz /tracez /eventz /probez /debugz /criticalz "
-                b"/profilez\n",
+                b"/capacityz /profilez\n",
             )
 
     def _healthz(self, handler) -> None:
@@ -482,6 +499,113 @@ class AdminServer:
             ("\n".join(lines) + "\n").encode(),
         )
 
+    def _capacityz(self, handler, query: str) -> None:
+        if self._capacity is None:
+            self._reply(
+                handler, 404, "text/plain; charset=utf-8",
+                b"no capacity accuracy export attached\n",
+            )
+            return
+        params = urllib.parse.parse_qs(query)
+        state = self._capacity.export()
+        if params.get("format", [""])[0] == "json":
+            body = json.dumps(state, indent=2, default=str).encode()
+            self._reply(handler, 200, "application/json", body)
+            return
+        ledger = state.get("ledger") or {}
+        cells = ledger.get("cells") or {}
+        drifting = ledger.get("drifting") or []
+        lines = [
+            f"# {self._name} cost-model accuracy "
+            f"(?format=json for machine-readable)",
+            f"samples: {ledger.get('total_samples', 0)}  "
+            f"unpriced: {ledger.get('total_unpriced', 0)}  "
+            f"window: {ledger.get('window_size')}  "
+            f"drift band: +/-{ledger.get('drift_band')} "
+            f"for {ledger.get('drift_windows')} windows",
+        ]
+        if drifting:
+            lines.append(
+                "DRIFTING: " + "  ".join(sorted(drifting))
+            )
+        if not cells:
+            lines.append("no priced batches observed yet")
+        else:
+            lines.append(
+                f"{'cell':<28}{'n':>6}{'p50':>9}{'p95':>9}{'p99':>9}"
+                f"{'pred ms':>10}{'actual ms':>11}{'win p50':>9}"
+                f"{'drift':>7}"
+            )
+            for key in sorted(cells):
+                c = cells[key]
+                win = c.get("last_window_p50")
+                lines.append(
+                    f"{key:<28}{c['samples']:>6}"
+                    f"{c['residual_p50']:>+9.3f}"
+                    f"{c['residual_p95']:>+9.3f}"
+                    f"{c['residual_p99']:>+9.3f}"
+                    f"{c['mean_predicted_ms']:>10.3f}"
+                    f"{c['mean_actual_ms']:>11.3f}"
+                    f"{'-' if win is None else f'{win:+.3f}':>9}"
+                    f"{'YES' if c.get('drifting') else '-':>7}"
+                )
+                worst = c.get("worst")
+                if worst and worst.get("trace_id"):
+                    lines.append(
+                        f"  worst residual {worst['residual']:+.3f} "
+                        f"trace={worst['trace_id']}"
+                    )
+        recal = state.get("recalibration")
+        if recal is not None:
+            status = "enabled" if recal.get("enabled") else (
+                f"DISABLED via {recal.get('kill_switch_env')} "
+                f"(pricing raw)"
+            )
+            factors = recal.get("factors") or {}
+            factor_txt = (
+                "  ".join(
+                    f"{k}=x{v}" for k, v in sorted(factors.items())
+                )
+                or "none learned"
+            )
+            lines.append(
+                f"recalibration: {status}; alpha {recal.get('alpha')}, "
+                f"clamp {recal.get('clamp')}, min samples "
+                f"{recal.get('min_samples')}"
+            )
+            lines.append(f"correction factors: {factor_txt}")
+        model = state.get("model") or {}
+        calib = model.get("calibration") or {}
+        metrics = calib.get("metrics") or {}
+        lines.append(
+            f"throughput calibration ({calib.get('history_path')}): "
+            + ("STALE" if calib.get("stale") else "fresh")
+        )
+        for metric, entry in sorted(metrics.items()):
+            age = entry.get("age_s")
+            lines.append(
+                f"  {metric} = {entry.get('value')} "
+                f"(age {'-' if age is None else f'{age:.0f} s'}, "
+                f"{'STALE' if entry.get('stale') else 'fresh'})"
+            )
+        if not metrics:
+            lines.append(
+                "  no calibrated records; pricing from conservative "
+                "defaults"
+            )
+        skipped = calib.get("skipped_records") or {}
+        if skipped:
+            lines.append(
+                "  skipped records: "
+                + "  ".join(
+                    f"{status}={n}" for status, n in sorted(skipped.items())
+                )
+            )
+        self._reply(
+            handler, 200, "text/plain; charset=utf-8",
+            ("\n".join(lines) + "\n").encode(),
+        )
+
     # -- /statusz -----------------------------------------------------------
 
     def _status_state(self) -> dict:
@@ -516,6 +640,11 @@ class AdminServer:
                 else None
             ),
             "critical": self._critical.export(),
+            "capacity": (
+                self._capacity.export()
+                if self._capacity is not None
+                else None
+            ),
             "prober": (
                 self._prober.export()
                 if self._prober is not None
@@ -754,6 +883,74 @@ def _render_statusz(state: dict) -> str:
         else:
             out.append("<p class=nodata>no tenants seen yet</p>")
 
+    capacity = state.get("capacity")
+    if capacity is not None:
+        ledger = capacity.get("ledger") or {}
+        cells = ledger.get("cells") or {}
+        drifting = ledger.get("drifting") or []
+        out.append("<h2>Cost-model accuracy</h2>")
+        cls = "breach" if drifting else "ok"
+        out.append(
+            f"<p class={cls}>samples: {ledger.get('total_samples', 0)}, "
+            f"unpriced: {ledger.get('total_unpriced', 0)}, drifting "
+            f"cells: {len(drifting)} "
+            f"(band &plusmn;{ledger.get('drift_band')} for "
+            f"{ledger.get('drift_windows')} windows of "
+            f"{ledger.get('window_size')})</p>"
+        )
+        if not cells:
+            out.append("<p class=nodata>no priced batches observed yet</p>")
+        else:
+            out.append(
+                "<table><tr><th>workload/tier/bucket</th><th>samples</th>"
+                "<th>residual p50</th><th>p95</th><th>p99</th>"
+                "<th>mean predicted ms</th><th>mean actual ms</th>"
+                "<th>drifting</th></tr>"
+            )
+            for key in sorted(cells):
+                c = cells[key]
+                row_cls = "breach" if c.get("drifting") else "ok"
+                out.append(
+                    f"<tr class={row_cls}><td>{esc(key)}</td>"
+                    f"<td>{c['samples']}</td>"
+                    f"<td>{c['residual_p50']:+.3f}</td>"
+                    f"<td>{c['residual_p95']:+.3f}</td>"
+                    f"<td>{c['residual_p99']:+.3f}</td>"
+                    f"<td>{c['mean_predicted_ms']:.3f}</td>"
+                    f"<td>{c['mean_actual_ms']:.3f}</td>"
+                    f"<td>{'YES' if c.get('drifting') else '-'}</td></tr>"
+                )
+            out.append("</table>")
+        recal = capacity.get("recalibration")
+        if recal is not None:
+            factors = recal.get("factors") or {}
+            factor_txt = ", ".join(
+                f"{esc(k)}=x{v}" for k, v in sorted(factors.items())
+            ) or "none learned"
+            if recal.get("enabled"):
+                out.append(
+                    f"<p class=ok>recalibration enabled; factors: "
+                    f"{factor_txt}</p>"
+                )
+            else:
+                out.append(
+                    f"<p class=breach>recalibration DISABLED via "
+                    f"{esc(str(recal.get('kill_switch_env')))} (pricing "
+                    f"raw); learned factors bypassed: {factor_txt}</p>"
+                )
+        calib = (capacity.get("model") or {}).get("calibration") or {}
+        stale_cls = "breach" if calib.get("stale") else "ok"
+        out.append(
+            f"<p class={stale_cls}>throughput calibration: "
+            + ("STALE" if calib.get("stale") else "fresh")
+            + "".join(
+                f"; {esc(m)}={e.get('value')} "
+                f"(age {e.get('age_s', '-')} s)"
+                for m, e in sorted((calib.get("metrics") or {}).items())
+            )
+            + "</p>"
+        )
+
     waterfall = state.get("phases") or {}
     out.append("<h2>Phase waterfall</h2>")
     if not waterfall:
@@ -936,6 +1133,22 @@ def _render_statusz(state: dict) -> str:
     out.append(
         f"<h2>Compilations (total: {compile_state['total_compiles']})</h2>"
     )
+    compile_cache = state["device"].get("compile_cache")
+    if compile_cache is not None:
+        if "error" in compile_cache:
+            out.append(
+                f"<p class=breach>persistent compile cache failed: "
+                f"{esc(str(compile_cache['error']))}</p>"
+            )
+        else:
+            out.append(
+                f"<p>persistent compile cache: "
+                f"{esc(str(compile_cache.get('dir')))} — warm entries at "
+                f"start: {compile_cache.get('warm_entries_at_start')}, "
+                f"entries now: {compile_cache.get('entries')}, persisted "
+                f"this process: "
+                f"{compile_cache.get('persisted_this_process')}</p>"
+            )
     if not compile_state["sites"]:
         out.append("<p class=nodata>no tracked dispatches yet</p>")
     else:

@@ -643,18 +643,36 @@ class DeviceTelemetry:
         self.compile_tracker = CompileTracker(registry)
         self.hbm = HbmAccountant(registry)
         self.transfers = TransferLedger(registry)
+        # Optional persistent-compilation-cache info: a dict, or a
+        # zero-arg callable returning one (re-counted per scrape).
+        # Installed by the serving startup when
+        # DPF_TPU_COMPILE_CACHE_DIR wires the JAX cache, so /statusz's
+        # compile table can show cold-vs-warm counts.
+        self._compile_cache_info = None
 
     def bind_registry(self, registry) -> None:
         self.compile_tracker.bind_registry(registry)
         self.hbm.bind_registry(registry)
         self.transfers.bind_registry(registry)
 
+    def set_compile_cache_info(self, provider) -> None:
+        self._compile_cache_info = provider
+
     def export(self) -> dict:
-        return {
+        out = {
             "compile": self.compile_tracker.export(),
             "hbm": self.hbm.export(),
             "transfers": self.transfers.export(),
         }
+        info = self._compile_cache_info
+        if callable(info):
+            try:
+                info = info()
+            except Exception:  # noqa: BLE001 - telemetry must not raise
+                info = None
+        if info:
+            out["compile_cache"] = info
+        return out
 
     def reset(self) -> None:
         self.compile_tracker.reset()

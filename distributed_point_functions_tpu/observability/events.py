@@ -29,6 +29,14 @@ Event kinds emitted by the library (the taxonomy; see DESIGN.md §15):
     prober.error           a probe raised instead of answering
     prober.recovered       a failing probe kind passed again
     bundle.captured        a debug bundle was written
+    capacity.drift         a cost-model cell's residuals left (or
+                           re-entered) the configured drift band
+    capacity.correction_applied   recalibration moved a cell's price
+                           correction factor (coalesced per cell)
+    capacity.correction_reverted  the recalibration kill switch
+                           bypassed the learned factors
+    capacity.calibration_fallback throughput calibration fell back to
+                           the conservative built-in for a metric
 
 Emitters call the module-level `emit(...)` (the process-global
 journal, mirroring `tracing.runtime_counters`); sessions that want an

@@ -418,6 +418,16 @@ class DenseDpfPirServer(DpfPirServer):
                     raise
 
     def _execute_plan(self, plan, keys, bitrev, impl, telemetry, seen):
+        # Stamp the executed planner tier onto the enclosing phase
+        # record (the batcher's batch-scoped record during batched
+        # serving): the cost-ledger join reads `serving_plan` back to
+        # key its predicted-vs-actual residual cell by tier.
+        record = phases_mod.current_request()
+        if record is not None:
+            record.set_meta(
+                "serving_plan",
+                {"mode": plan.mode, "num_keys": plan.num_keys},
+            )
         if plan.mode == "streaming":
             key = shape_key(
                 ("m", f"streaming-{plan.ip}"),

@@ -46,6 +46,8 @@ from collections import deque
 from typing import Callable, List, Optional, Sequence
 
 from ..capacity.admission import AdmissionController, WeightedFairQueue
+from ..capacity.model import default_capacity_model
+from ..observability import costmodel as costmodel_mod
 from ..observability import tracing
 from ..observability import phases as phases_mod
 from ..observability.device import default_telemetry, shape_key
@@ -79,6 +81,16 @@ def bucket_size(num_keys: int) -> int:
     if num_keys <= 0:
         raise ValueError("num_keys must be positive")
     return 1 << (num_keys - 1).bit_length()
+
+
+def _h2d_bytes(telemetry) -> int:
+    """Cumulative host->device bytes from the transfer ledger (0 when
+    unavailable); deltas across a batch attribute its staging traffic
+    to the cost ledger."""
+    try:
+        return int(telemetry.transfers.export()["totals"]["h2d_bytes"])
+    except Exception:  # noqa: BLE001 - accounting never breaks serving
+        return 0
 
 
 class _Pending:
@@ -358,8 +370,10 @@ class DynamicBatcher:
                 # Chaos site: a worker-side fault here must fan out to
                 # every live request and leave the worker serving.
                 failpoints.fire("batcher.evaluate")
+                telemetry = default_telemetry()
+                h2d_before = _h2d_bytes(telemetry)
                 t_eval = time.perf_counter()
-                tracker = default_telemetry().compile_tracker
+                tracker = telemetry.compile_tracker
                 recorder = phases_mod.default_phase_recorder()
                 with self.metrics.timed(f"{self._name}.evaluate_ms"), \
                         tracker.dispatch(
@@ -426,6 +440,52 @@ class DynamicBatcher:
                     p.phases.add("dispatch", dispatch_ms)
                 self._release(p)
                 p.event.set()
+            # Terminal batch outcome: join the capacity-model estimate
+            # for the executed bucket with the measured device truth
+            # (after every waiter is released, so accounting adds no
+            # request latency).
+            self._observe_cost(
+                bucket, live, collected, eval_ms, batch_phases,
+                telemetry, h2d_before,
+            )
+
+    def _observe_cost(
+        self, bucket, live, collected, eval_ms, batch_phases,
+        telemetry, h2d_before,
+    ) -> None:
+        """Feed the cost ledger one (estimate, truth) pair for this
+        batch. The estimate is what the capacity model would charge for
+        the executed padded bucket (corrections included, so the
+        recalibration loop is closed); the truth is the exclusive
+        `device_compute` phase from the batch-scoped record, falling
+        back to wall time minus compile when the evaluation path has no
+        phase brackets (stub evaluators in tests). Never raises."""
+        try:
+            plan_meta = (
+                batch_phases.get_meta("serving_plan")
+                if batch_phases is not None else None
+            ) or {}
+            tier = str(plan_meta.get("mode", "unplanned"))
+            actual_ms = collected.get("device_compute", 0.0)
+            if actual_ms <= 0.0:
+                actual_ms = max(
+                    0.0, eval_ms - collected.get("compile", 0.0)
+                )
+            predicted = default_capacity_model().price_pir_keys(bucket)
+            trace = next(
+                (p.trace for p in live if p.trace is not None), None
+            )
+            costmodel_mod.default_cost_ledger().observe(
+                "pir", tier, str(bucket),
+                predicted_device_ms=predicted.device_ms,
+                actual_device_ms=actual_ms,
+                transfer_bytes=max(
+                    0, _h2d_bytes(telemetry) - h2d_before
+                ),
+                trace=trace,
+            )
+        except Exception:  # noqa: BLE001 - accounting never breaks serving
+            pass
 
     # -- lifecycle ----------------------------------------------------------
 

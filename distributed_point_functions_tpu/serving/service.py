@@ -54,6 +54,7 @@ from __future__ import annotations
 
 import contextvars
 import dataclasses
+import os
 import time
 from typing import Optional
 
@@ -62,6 +63,8 @@ import threading
 from .. import serialization
 from ..capacity.admission import AdmissionController, TenantPolicy
 from ..capacity.brownout import BrownoutController
+from ..capacity.recalibrate import CapacityAccuracy, default_recalibrator
+from ..observability import costmodel as costmodel_mod
 from ..observability import events as events_mod
 from ..observability import critical_path, propagation, tracing
 from ..observability import phases as phases_mod
@@ -167,6 +170,78 @@ _TENANT: contextvars.ContextVar = contextvars.ContextVar(
 )
 
 
+# ---------------------------------------------------------------------------
+# Persistent JAX compilation cache (opt-in, process-wide)
+# ---------------------------------------------------------------------------
+
+_COMPILE_CACHE_ENV = "DPF_TPU_COMPILE_CACHE_DIR"
+_compile_cache_state: Optional[dict] = None
+_compile_cache_lock = threading.Lock()
+
+
+def _cache_entries(path: str) -> int:
+    try:
+        return sum(1 for n in os.listdir(path) if not n.startswith("."))
+    except OSError:
+        return 0
+
+
+def install_compile_cache() -> Optional[dict]:
+    """Opt-in persistent JAX compilation cache: when
+    `DPF_TPU_COMPILE_CACHE_DIR` is set, point
+    `jax_compilation_cache_dir` at it so a restarted process deserializes
+    yesterday's XLA programs instead of recompiling them on the first
+    request. Idempotent and process-wide (the cache is a JAX global);
+    returns the state dict (None when the env is unset). The state —
+    cache dir, entries present at startup (warm), and entries persisted
+    by this process (cold compiles now cached for the next restart) —
+    is pushed into the device telemetry so `/statusz`'s compile table
+    shows it next to the per-site compile counts."""
+    global _compile_cache_state
+    with _compile_cache_lock:
+        if _compile_cache_state is not None:
+            return _compile_cache_state
+        path = os.environ.get(_COMPILE_CACHE_ENV, "").strip()
+        if not path:
+            return None
+        try:
+            os.makedirs(path, exist_ok=True)
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", path)
+            # Persist every program: serving's jit shapes are few and
+            # bucketed, and the cold first request is exactly what the
+            # cache exists to kill. Older jaxlibs lack the thresholds.
+            for knob, value in (
+                ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                ("jax_persistent_cache_min_entry_size_bytes", -1),
+            ):
+                try:
+                    jax.config.update(knob, value)
+                except Exception:  # noqa: BLE001 - knob absent is fine
+                    pass
+            state = {
+                "dir": path,
+                "warm_entries_at_start": _cache_entries(path),
+            }
+        except Exception as e:  # noqa: BLE001 - cache is an optimization
+            state = {"dir": path, "error": f"{type(e).__name__}: {e}"}
+        _compile_cache_state = state
+
+    def _info() -> dict:
+        out = dict(state)
+        if "error" not in out:
+            current = _cache_entries(path)
+            out["entries"] = current
+            out["persisted_this_process"] = max(
+                0, current - state["warm_entries_at_start"]
+            )
+        return out
+
+    default_telemetry().set_compile_cache_info(_info)
+    return state
+
+
 class _Session:
     """Shared session mechanics: batcher wiring, deadlines, wire codec."""
 
@@ -181,6 +256,10 @@ class _Session:
         self._config = config if config is not None else ServingConfig()
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._name = name
+        # Opt-in persistent compilation cache first: it must be wired
+        # before the session's first jit dispatch to serve warm
+        # programs (no-op without DPF_TPU_COMPILE_CACHE_DIR).
+        install_compile_cache()
         # Device telemetry rides the session's registry: compile events
         # and HBM watermarks from the dispatch sites below show up on
         # this session's /metrics and /statusz. The jax.monitoring
@@ -188,6 +267,16 @@ class _Session:
         default_telemetry().bind_registry(self.metrics)
         install_jax_monitoring_listener(default_telemetry().compile_tracker)
         phases_mod.default_phase_recorder().bind_registry(self.metrics)
+        # Cost-model accuracy: the process-wide ledger mirrors residual
+        # histograms + the drift gauge into this session's registry,
+        # and the shared recalibrator closes the loop on the default
+        # capacity model's prices. `capacity_accuracy` is the read
+        # model /capacityz and the /statusz section render.
+        ledger = costmodel_mod.default_cost_ledger()
+        ledger.bind_registry(self.metrics)
+        self.capacity_accuracy = CapacityAccuracy(
+            ledger=ledger, recalibrator=default_recalibrator()
+        )
         self.admission: Optional[AdmissionController] = None
         if self._config.admission_enabled:
             self.admission = AdmissionController(

@@ -7,6 +7,7 @@ with no JAX and no wall clock.
 """
 
 import json
+import time
 
 import pytest
 
@@ -186,6 +187,136 @@ def test_calibration_missing_file_degrades_to_fallback(tmp_path):
     # The built-in fallbacks are the derated v5e captures.
     assert m.serving_queries_per_sec() == 1300.0
     assert m.hh_lanes_per_sec() == 950_000.0
+
+
+def _bench_record(metric, value, status="ok", ts=None, **extra):
+    """One history.jsonl record in the real writer's shape (stack
+    stamps and all) — the BENCH_r02–r05 tunnel-outage episodes mix ok,
+    infra_error, and last_good rows exactly like this."""
+    rec = {
+        "device": extra.pop("device", "v5e-1"),
+        "git_rev": extra.pop("git_rev", "abc1234"),
+        "metric": metric,
+        "status": status,
+        "topology": extra.pop("topology", "1x1"),
+        "ts_unix": time.time() if ts is None else ts,
+        "unit": extra.pop("unit", "per_sec"),
+        "value": value,
+        "vs_baseline": extra.pop("vs_baseline", None),
+    }
+    rec.update(extra)
+    return rec
+
+
+def test_calibration_skips_infra_error_and_last_good(tmp_path):
+    """The tunnel-outage shape: an infra_error record echoing the last
+    good value, then explicit last_good echoes, must never calibrate —
+    only genuinely clean rows do, and the skips are counted."""
+    path = tmp_path / "h.jsonl"
+    rows = [
+        _bench_record("serving_closed_loop_queries_per_sec", 2600.0),
+        _bench_record(
+            "serving_closed_loop_queries_per_sec", 2590.0,
+            status="infra_error", error="ssh tunnel reset",
+            last_good=2600.0,
+        ),
+        _bench_record(
+            "serving_closed_loop_queries_per_sec", 2600.0,
+            status="last_good",
+        ),
+        _bench_record(
+            "heavy_hitters_sweep_lanes_per_sec", 1.9e6,
+            status="infra_error", error="tpu preempted",
+        ),
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    cal = ThroughputCalibration(str(path))
+    assert cal.lookup("serving_closed_loop_queries_per_sec") == 2600.0
+    # The hh metric never had a clean row: fallback, not the echo.
+    assert cal.lookup("heavy_hitters_sweep_lanes_per_sec") is None
+    assert cal.throughput(
+        "heavy_hitters_sweep_lanes_per_sec", 950_000.0
+    ) == 950_000.0
+    export = cal.export()
+    assert export["skipped_records"] == {"infra_error": 2, "last_good": 1}
+
+
+def test_calibration_mixed_stack_stamps_last_clean_wins(tmp_path):
+    """Append order is time order whatever the (device, topology,
+    git_rev) stamp: a newer clean record from a different stack stamp
+    replaces the older one, per metric independently."""
+    path = tmp_path / "h.jsonl"
+    rows = [
+        _bench_record(
+            "serving_closed_loop_queries_per_sec", 1000.0,
+            device="v5e-1", topology="1x1", git_rev="old1111",
+        ),
+        _bench_record(
+            "heavy_hitters_sweep_lanes_per_sec", 2.0e6,
+            device="v5e-1", topology="1x1",
+        ),
+        _bench_record(
+            "serving_closed_loop_queries_per_sec", 2600.0,
+            device="v5p-8", topology="2x4", git_rev="new2222",
+        ),
+        _bench_record(
+            "serving_closed_loop_queries_per_sec", 0.0,  # dirty value
+            device="v5p-8", topology="2x4",
+        ),
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    cal = ThroughputCalibration(str(path))
+    assert cal.lookup("serving_closed_loop_queries_per_sec") == 2600.0
+    assert cal.lookup("heavy_hitters_sweep_lanes_per_sec") == 2.0e6
+
+
+def test_calibration_staleness_and_record_age(tmp_path):
+    path = tmp_path / "h.jsonl"
+    now = time.time()
+    rows = [
+        _bench_record("fresh_metric", 100.0, ts=now - 10.0),
+        _bench_record("old_metric", 200.0, ts=now - 500.0),
+        {"metric": "untimed_metric", "value": 300.0, "status": "ok"},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    cal = ThroughputCalibration(str(path), stale_after_s=60.0)
+    assert cal.record_age_s("fresh_metric") == pytest.approx(10.0, abs=5.0)
+    assert not cal.stale("fresh_metric")
+    assert cal.stale("old_metric")
+    # A clean record without a timestamp can't be aged: fresh, not
+    # permanently stale.
+    assert cal.record_age_s("untimed_metric") is None
+    assert not cal.stale("untimed_metric")
+    # No record at all IS stale (pricing runs on fallbacks).
+    assert cal.stale("absent_metric")
+    export = cal.export()
+    assert export["stale"] is True  # old_metric drags the summary flag
+    assert export["metrics"]["fresh_metric"]["stale"] is False
+    assert export["metrics"]["old_metric"]["stale"] is True
+    assert export["metrics"]["old_metric"]["age_s"] == pytest.approx(
+        500.0, abs=5.0
+    )
+    assert export["stale_after_s"] == 60.0
+
+
+def test_calibration_fallback_journals_once_per_metric(tmp_path):
+    from distributed_point_functions_tpu.observability.events import (
+        default_journal,
+    )
+
+    journal = default_journal()
+    seq0 = max((e["seq"] for e in journal.tail(n=1)), default=0)
+    cal = ThroughputCalibration(str(tmp_path / "missing.jsonl"))
+    for _ in range(3):
+        assert cal.throughput("test_only_fallback_metric", 7.0) == 7.0
+    events = [
+        e
+        for e in journal.tail(n=32, kind="capacity.calibration_fallback")
+        if e["seq"] > seq0
+        and e.get("metric") == "test_only_fallback_metric"
+    ]
+    assert len(events) == 1
+    assert events[0]["fallback"] == 7.0
 
 
 def test_price_pir_keys_device_ms(tmp_path):
