@@ -1,0 +1,365 @@
+"""Rotation benchmark: snapshot staleness and q/s dip under live traffic.
+
+Drives a two-party Leader/Helper pair (in-process transport, each side
+with its own `SnapshotManager`) with closed-loop client threads, then
+rotates the database through the `RotationCoordinator` several times
+while the traffic keeps flowing. Two headline numbers come out:
+
+- ``rotation_staleness_ms`` — the Helper-first/Leader-last flip window
+  measured by the coordinator (worst rotation of the run). During this
+  window the Leader refuses cross-generation pairs with a typed
+  `SnapshotMismatch` and retries, so it is the interval in which
+  queries can pay a retry, never the interval in which they can be
+  wrong.
+- ``rotation_qps_dip_pct`` — completed-query throughput in the window
+  around the worst rotation, relative to the steady-state baseline.
+
+Every completed response is compared bit-for-bit against the oracle of
+*some single* generation (each generation's records differ from every
+other generation at every byte, so a cross-generation XOR can match
+nothing): the run fails if any response mixes generations.
+
+Run directly (one JSON report on stdout, also written to
+``benchmarks/results/rotation_bench.json``; appends the two records
+above — both ``direction: lower`` — to the regression-gate history)::
+
+    JAX_PLATFORMS=cpu python -m benchmarks.rotation_bench
+
+Environment knobs: ROTATION_BENCH_RECORDS (default 512),
+ROTATION_BENCH_RECORD_BYTES (32), ROTATION_BENCH_THREADS (4),
+ROTATION_BENCH_ROTATIONS (3), ROTATION_BENCH_BASELINE_S (steady-state
+measurement window, 1.5), ROTATION_BENCH_SETTLE_S (gap between
+rotations, 0.5), ROTATION_BENCH_FLIP_DELAY_MS (arm a
+``snapshot.flip`` delay failpoint to stretch the window, 0 = off),
+ROTATION_BENCH_OUT (report path; empty string disables the file).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+
+def _log(msg: str) -> None:
+    print(f"[rotation-bench {time.strftime('%H:%M:%S')}] {msg}",
+          file=sys.stderr, flush=True)
+
+
+# Per-generation XOR masks: any two differ, so any two generations'
+# records differ at every byte and a torn (cross-generation) XOR can
+# never equal either oracle.
+_GEN_MASKS = [0x00, 0xA5, 0x3C, 0x5A, 0xC3, 0x69, 0x96, 0x0F, 0xF0]
+
+
+def _records_for_generation(base, gen):
+    mask = _GEN_MASKS[gen % len(_GEN_MASKS)]
+    if mask == 0:
+        return list(base)
+    return [bytes(b ^ mask for b in r) for r in base]
+
+
+def run_rotation_bench():
+    """Build the two-party pair, run closed-loop traffic across several
+    rotations, return the report dict (also written to
+    ROTATION_BENCH_OUT unless empty)."""
+    import numpy as np
+
+    from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
+    from distributed_point_functions_tpu.pir.database import (
+        DenseDpfPirDatabase,
+    )
+    from distributed_point_functions_tpu.robustness import failpoints
+    from distributed_point_functions_tpu.serving import (
+        HelperSession,
+        InProcessTransport,
+        LeaderSession,
+        RotationCoordinator,
+        ServingConfig,
+        SnapshotManager,
+        SnapshotMismatch,
+    )
+    from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+    num_records = int(os.environ.get("ROTATION_BENCH_RECORDS", 512))
+    record_bytes = int(os.environ.get("ROTATION_BENCH_RECORD_BYTES", 32))
+    num_threads = int(os.environ.get("ROTATION_BENCH_THREADS", 4))
+    num_rotations = int(os.environ.get("ROTATION_BENCH_ROTATIONS", 3))
+    baseline_s = float(os.environ.get("ROTATION_BENCH_BASELINE_S", 1.5))
+    settle_s = float(os.environ.get("ROTATION_BENCH_SETTLE_S", 0.5))
+    flip_delay_ms = float(
+        os.environ.get("ROTATION_BENCH_FLIP_DELAY_MS", 0.0)
+    )
+
+    _log(
+        f"database: {num_records} x {record_bytes}B, {num_threads} "
+        f"closed-loop threads, {num_rotations} rotations, baseline "
+        f"{baseline_s}s, settle {settle_s}s, flip delay "
+        f"{flip_delay_ms:.0f} ms"
+    )
+
+    rng = np.random.default_rng(12)
+    base_records = [
+        bytes(rng.integers(0, 256, record_bytes, dtype=np.uint8))
+        for _ in range(num_records)
+    ]
+    oracles = {0: _records_for_generation(base_records, 0)}
+
+    def build_full(records):
+        builder = DenseDpfPirDatabase.Builder()
+        for r in records:
+            builder.insert(r)
+        return builder.build()
+
+    # Warm every jit bucket up front (sizes 1..max_batch). A cold XLA
+    # compile mid-run would otherwise hold a batch in flight for longer
+    # than the flip timeout and turn the rotation into a false failure.
+    from distributed_point_functions_tpu.pir import messages
+    from distributed_point_functions_tpu.pir.server import DenseDpfPirServer
+
+    max_batch = 8
+    _log("warming jit buckets")
+    t0 = time.perf_counter()
+    warm_server = DenseDpfPirServer.create_plain(build_full(oracles[0]))
+    warm_keys = list(
+        DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+        .create_plain_requests([0])[0]
+        .plain_request.dpf_keys
+    )
+    b = 1
+    while b <= max_batch:
+        warm_server.handle_plain_request(
+            messages.PirRequest(
+                plain_request=messages.PlainRequest(dpf_keys=warm_keys * b)
+            )
+        )
+        b *= 2
+    _log(f"warmup done in {time.perf_counter() - t0:.1f}s")
+
+    config = ServingConfig(max_batch_size=max_batch, max_wait_ms=2.0)
+    helper = HelperSession(
+        build_full(oracles[0]), encrypt_decrypt.decrypt, config
+    )
+    leader = LeaderSession(
+        build_full(oracles[0]), InProcessTransport(helper.handle_wire),
+        config,
+    )
+    leader_mgr = SnapshotManager(leader)
+    helper_mgr = SnapshotManager(helper)
+    coordinator = RotationCoordinator(leader_mgr, helper_mgr)
+
+    client = DenseDpfPirClient.create(num_records, encrypt_decrypt.encrypt)
+    probe_indices = [int(i) for i in rng.integers(0, num_records, 16)]
+
+    lock = threading.Lock()
+    stats = {"completed": 0, "torn": 0, "refusals": 0, "other_errors": 0}
+    completion_times = []
+    stop = threading.Event()
+
+    def worker(tid):
+        i = tid
+        while not stop.is_set():
+            idx = probe_indices[i % len(probe_indices)]
+            i += num_threads
+            try:
+                request, state = client.create_request([idx])
+                response = leader.handle_request(request)
+                got = client.handle_response(response, state)[0]
+                now = time.monotonic()
+                with lock:
+                    ok = any(
+                        got == recs[idx] for recs in oracles.values()
+                    )
+                    stats["completed"] += 1
+                    if not ok:
+                        stats["torn"] += 1
+                    completion_times.append(now)
+            except SnapshotMismatch:
+                # Typed refusal that out-lasted the leader's own retry
+                # budget: counted, re-issued by the closed loop.
+                with lock:
+                    stats["refusals"] += 1
+            except Exception:  # noqa: BLE001 - counted, bench continues
+                with lock:
+                    stats["other_errors"] += 1
+
+    threads = [
+        threading.Thread(target=worker, args=(t,), name=f"load-{t}")
+        for t in range(num_threads)
+    ]
+    for t in threads:
+        t.start()
+
+    # Steady-state baseline window before the first rotation.
+    t_base0 = time.monotonic()
+    time.sleep(baseline_s)
+    t_base1 = time.monotonic()
+
+    if flip_delay_ms > 0:
+        failpoints.default_failpoints().arm(
+            "snapshot.flip", "delay",
+            times=2 * num_rotations, delay_ms=flip_delay_ms,
+        )
+
+    rotations = []
+    try:
+        for _ in range(num_rotations):
+            prev = leader.server.database
+            next_gen = prev.generation + 1
+            next_records = _records_for_generation(base_records, next_gen)
+            with lock:
+                oracles[next_gen] = next_records
+            delta = DenseDpfPirDatabase.Builder()
+            for i, r in enumerate(next_records):
+                delta.update(i, r)
+            leader_db = delta.build_from(prev)
+            helper_delta = DenseDpfPirDatabase.Builder()
+            for i, r in enumerate(next_records):
+                helper_delta.update(i, r)
+            helper_db = helper_delta.build_from(helper.server.database)
+
+            t_rot0 = time.monotonic()
+            report = coordinator.rotate(leader_db, helper_db)
+            t_rot1 = time.monotonic()
+            rotations.append({
+                "to_generation": report["to_generation"],
+                "staleness_ms": report["staleness_ms"],
+                "rotate_wall_ms": round((t_rot1 - t_rot0) * 1e3, 3),
+                "window": (t_rot0, t_rot1),
+            })
+            _log(
+                f"rotation -> generation {report['to_generation']}: "
+                f"staleness {report['staleness_ms']:.2f} ms, wall "
+                f"{(t_rot1 - t_rot0) * 1e3:.2f} ms"
+            )
+            # Older generations can no longer answer; keeping only the
+            # two live oracles keeps the torn-check meaningful.
+            with lock:
+                for g in list(oracles):
+                    if g < next_gen - 1:
+                        del oracles[g]
+            time.sleep(settle_s)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+        failpoints.default_failpoints().clear()
+
+    def qps_in(t0, t1):
+        with lock:
+            n = sum(1 for t in completion_times if t0 <= t < t1)
+        return n / max(t1 - t0, 1e-9)
+
+    baseline_qps = qps_in(t_base0, t_base1)
+    # Measure each rotation over a window at least as long as one
+    # baseline-granularity slice so a handful of fast flips doesn't
+    # produce a noisy zero-sample dip.
+    dips = []
+    for rot in rotations:
+        t0, t1 = rot.pop("window")
+        span = max(t1 - t0, 0.25)
+        rot_qps = qps_in(t0, t0 + span)
+        dip = max(0.0, (baseline_qps - rot_qps) / baseline_qps * 100.0) \
+            if baseline_qps > 0 else 0.0
+        rot["window_qps"] = round(rot_qps, 2)
+        rot["qps_dip_pct"] = round(dip, 2)
+        dips.append(dip)
+
+    worst_staleness = max(
+        (r["staleness_ms"] for r in rotations), default=0.0
+    )
+    worst_dip = max(dips, default=0.0)
+    correctness_ok = (
+        stats["torn"] == 0 and stats["other_errors"] == 0
+        and len(rotations) == num_rotations
+    )
+    counters = leader.metrics.export()["counters"]
+    report = {
+        "config": {
+            "num_records": num_records,
+            "record_bytes": record_bytes,
+            "threads": num_threads,
+            "rotations": num_rotations,
+            "baseline_s": baseline_s,
+            "flip_delay_ms": flip_delay_ms,
+        },
+        "baseline_qps": round(baseline_qps, 2),
+        "rotations": rotations,
+        "rotation_staleness_ms": round(worst_staleness, 3),
+        "rotation_qps_dip_pct": round(worst_dip, 2),
+        "traffic": dict(stats),
+        "correctness_ok": correctness_ok,
+        "handshake_counters": {
+            k: v for k, v in counters.items() if "snapshot" in k
+        },
+        "snapshots": leader_mgr.export(),
+    }
+    _log(
+        f"baseline {baseline_qps:.1f} q/s; worst staleness "
+        f"{worst_staleness:.2f} ms, worst dip {worst_dip:.1f}%; "
+        f"{stats['completed']} completed, {stats['refusals']} refusals, "
+        f"{stats['torn']} torn, correctness "
+        f"{'ok' if correctness_ok else 'FAILED'}"
+    )
+
+    out = os.environ.get(
+        "ROTATION_BENCH_OUT", "benchmarks/results/rotation_bench.json"
+    )
+    if out:
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2)
+        _log(f"report written to {out}")
+    return report
+
+
+def _append_history_records(report):
+    """Two records for the regression gate — staleness and q/s dip,
+    both explicit `direction: lower`. Best-effort like every history
+    append."""
+    try:
+        from benchmarks.regression_gate import append_record, git_rev
+
+        path = os.environ.get(
+            "BENCH_HISTORY_PATH", "benchmarks/results/history.jsonl"
+        )
+        status = "ok" if report["correctness_ok"] else "error"
+        rev = git_rev()
+        device = os.environ.get("BENCH_PLATFORM", "cpu")
+        append_record({
+            "metric": "rotation_staleness_ms",
+            "value": report["rotation_staleness_ms"],
+            "unit": "ms",
+            "direction": "lower",
+            "vs_baseline": None,
+            "status": status,
+            "git_rev": rev,
+            "device": device,
+        }, path=path)
+        append_record({
+            "metric": "rotation_qps_dip_pct",
+            "value": report["rotation_qps_dip_pct"],
+            "unit": "percent",
+            "direction": "lower",
+            "vs_baseline": None,
+            "status": status,
+            "git_rev": rev,
+            "device": device,
+        }, path=path)
+    except Exception as e:  # noqa: BLE001 - history must not break a bench
+        _log(f"history append failed (non-fatal): {e}")
+
+
+def main():
+    report = run_rotation_bench()
+    if os.environ.get("BENCH_HISTORY", "1") != "0":
+        _append_history_records(report)
+    print(json.dumps(report, indent=2))
+    if not report["correctness_ok"]:
+        raise SystemExit("rotation bench FAILED correctness")
+
+
+if __name__ == "__main__":
+    main()

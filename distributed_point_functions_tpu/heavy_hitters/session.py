@@ -336,6 +336,21 @@ class HeavyHittersHelper:
     def epoch(self) -> int:
         return self._epoch
 
+    def set_data_generation(self, generation: int) -> int:
+        """The Helper's report set rotated to a new database snapshot
+        generation (`serving/snapshots.py`): redraw the session epoch,
+        deterministically derived from the old epoch and the
+        generation. Any sweep in flight sees the epoch change on its
+        next round and replays from the root (`EpochChanged` -> the
+        Leader's from-root replay), exactly as if the Helper had
+        restarted — cut-state accumulated against the old data never
+        mixes into counts over the new. Returns the new epoch."""
+        self._epoch = (
+            (self._epoch * 1_000_003 + int(generation) + 1)
+            % (1 << 64)
+        ) or 1
+        return self._epoch
+
     def handle_wire(self, payload: bytes) -> bytes:
         recv_pc_ms = time.perf_counter() * 1e3
         if len(payload) >= _HEADER.size:

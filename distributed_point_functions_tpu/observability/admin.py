@@ -121,6 +121,7 @@ class AdminServer:
         bundles=None,
         critical=None,
         capacity=None,
+        snapshots=None,
     ):
         self._registry = registry
         self._recorder = (
@@ -174,6 +175,11 @@ class AdminServer:
         # "recalibration" keys) and opt-in; it backs /capacityz and a
         # "Cost-model accuracy" section on /statusz.
         self._capacity = capacity
+        # snapshots (`serving.snapshots.SnapshotManager`) is duck-typed
+        # (`export() -> dict` with serving/staging generations, drain
+        # refcounts and flip history) and opt-in; /statusz grows a
+        # "Snapshots" section when present.
+        self._snapshots = snapshots
         self._name = name
         self._profile_dir = profile_dir
         self._profile_lock = threading.Lock()
@@ -192,6 +198,8 @@ class AdminServer:
                 bundles.add_source("probes", prober.export)
             if capacity is not None:
                 bundles.add_source("capacity", capacity.export)
+            if snapshots is not None:
+                bundles.add_source("snapshots", snapshots.export)
         outer = self
 
         class _Handler(http.server.BaseHTTPRequestHandler):
@@ -645,6 +653,11 @@ class AdminServer:
                 if self._capacity is not None
                 else None
             ),
+            "snapshots": (
+                self._snapshots.export()
+                if self._snapshots is not None
+                else None
+            ),
             "prober": (
                 self._prober.export()
                 if self._prober is not None
@@ -950,6 +963,55 @@ def _render_statusz(state: dict) -> str:
             )
             + "</p>"
         )
+
+    snapshots = state.get("snapshots")
+    if snapshots is not None:
+        out.append("<h2>Snapshots</h2>")
+        staging = snapshots.get("staging_generation")
+        mismatches = snapshots.get("mismatches", 0)
+        cls = "breach" if mismatches else "ok"
+        out.append(
+            f"<p class={cls}>serving generation "
+            f"{snapshots.get('serving_generation')}"
+            + (
+                f", staging {staging}" if staging is not None
+                else ", nothing staged"
+            )
+            + f"; flips: {snapshots.get('flips', 0)}, aborts: "
+            f"{snapshots.get('aborts', 0)}, mismatches: {mismatches}, "
+            f"pins: {snapshots.get('pins', 0)}</p>"
+        )
+        inflight = snapshots.get("inflight") or {}
+        retired = snapshots.get("retired_awaiting_drain") or []
+        if inflight or retired:
+            out.append(
+                "<p>in-flight per generation: "
+                + (", ".join(
+                    f"{esc(g)}={n}" for g, n in sorted(inflight.items())
+                ) or "none")
+                + "; retired awaiting drain: "
+                + (", ".join(str(g) for g in retired) or "none")
+                + "</p>"
+            )
+        history = snapshots.get("history") or []
+        if history:
+            out.append(
+                "<table><tr><th>from</th><th>to</th>"
+                "<th>staleness ms</th><th>in-flight at flip</th>"
+                "<th>old staging</th></tr>"
+            )
+            for r in history[-16:]:
+                staleness = r.get("staleness_ms")
+                out.append(
+                    f"<tr class=ok><td>{r.get('from_generation')}</td>"
+                    f"<td>{r.get('to_generation')}</td>"
+                    f"<td>{'-' if staleness is None else staleness}</td>"
+                    f"<td>{r.get('inflight_old')}</td>"
+                    f"<td>{esc(str(r.get('old_freed')))}</td></tr>"
+                )
+            out.append("</table>")
+        else:
+            out.append("<p class=nodata>no rotations yet</p>")
 
     waterfall = state.get("phases") or {}
     out.append("<h2>Phase waterfall</h2>")

@@ -37,6 +37,16 @@ Event kinds emitted by the library (the taxonomy; see DESIGN.md §15):
                            bypassed the learned factors
     capacity.calibration_fallback throughput calibration fell back to
                            the conservative built-in for a metric
+    snapshot.flip          a database generation flip was applied
+    snapshot.mismatch      Leader refused a cross-generation Helper
+                           answer (typed SnapshotMismatch, retried)
+    snapshot.abort         a rotation was aborted before its flip
+    snapshot.check_disabled a pre-v3 peer answers with generation
+                           checking disabled (coalesced per peer)
+    snapshot.drained       a retired generation's stagings were freed
+                           after its last in-flight batch landed
+    prober.goldens_rotated the prober re-keyed its golden pairs to a
+                           new database generation
 
 Emitters call the module-level `emit(...)` (the process-global
 journal, mirroring `tracing.runtime_counters`); sessions that want an

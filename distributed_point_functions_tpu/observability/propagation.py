@@ -10,6 +10,7 @@ Helper-reported compute.
 Wire layout (all integers big-endian):
 
     request  = MAGIC(4) | u8 version | u8 kind=1 | 8-byte trace id
+             | [v3+: u64 generation+1, 0 = unbound]
              | u32 inner_len | inner proto bytes
     response = MAGIC(4) | u8 version | u8 kind=2 | u32 meta_len
              | meta JSON (trace_id, server_ms, spans; v2 adds the
@@ -37,6 +38,19 @@ talking to a v1-only Helper faults once on the v2 probe, steps down to
 v1 (keeping spans and `server_ms`, losing only the digest), and only a
 second fault drops it to bare proto — the same sticky probe ladder the
 kind-3 error envelope rode in on.
+
+**Version 3 carries the database snapshot generation.** A v3 request
+appends a u64 generation field after the trace id (encoded as
+`generation + 1`; 0 means "leader did not bind a generation"), and a
+v3 response meta adds `"generation"`: the generation the Helper's
+share was actually evaluated against. The Leader compares the echo
+against the generation its *own* share was computed from and raises a
+typed `SnapshotMismatch` (`serving/snapshots.py`) on disagreement —
+in the CGKS two-server model, shares from different database
+generations XOR to well-formed garbage, so the mismatch must be
+refused, never combined. The probe ladder extends one more step:
+v3 -> v2 -> v1 -> bare, each step one counted downgrade. Peers below
+v3 interoperate with generation checking disabled-but-journaled.
 
 **Old-peer interop is by construction + detection, not negotiation.**
 MAGIC starts with byte 0xFF: as a protobuf tag that is field 31 with
@@ -71,6 +85,7 @@ __all__ = [
     "encode_error",
     "encode_request",
     "try_decode_request",
+    "try_decode_request_ext",
     "try_decode_request_full",
     "encode_response",
     "try_decode_response",
@@ -78,8 +93,8 @@ __all__ = [
 
 # 0xFF first => guaranteed-invalid protobuf, so old peers fail fast.
 _MAGIC = b"\xffDPT"
-PROPAGATION_VERSION = 2
-_SUPPORTED_VERSIONS = (1, 2)
+PROPAGATION_VERSION = 3
+_SUPPORTED_VERSIONS = (1, 2, 3)
 _KIND_REQUEST = 1
 _KIND_RESPONSE = 2
 _KIND_ERROR = 3
@@ -90,6 +105,8 @@ MAX_RESPONSE_SPANS = 64
 
 _HEAD = struct.Struct(">4sBB")
 _LEN = struct.Struct(">I")
+# v3 request generation field: u64 `generation + 1`, 0 = unbound.
+_GEN = struct.Struct(">Q")
 
 
 class EnvelopeError(ValueError):
@@ -115,28 +132,43 @@ class WireErrorResponse(RuntimeError):
 
 
 def encode_request(
-    trace_id: str, inner: bytes, version: int = PROPAGATION_VERSION
+    trace_id: str,
+    inner: bytes,
+    version: int = PROPAGATION_VERSION,
+    generation: Optional[int] = None,
 ) -> bytes:
+    """`generation` (the snapshot generation the Leader's own share is
+    bound to) rides only on version >= 3; passing one with a lower
+    version silently drops it — the downgrade ladder already journals
+    that checking is disabled for that peer."""
     if version not in _SUPPORTED_VERSIONS:
         raise EnvelopeError(f"unsupported envelope version {version}")
     tid = bytes.fromhex(trace_id)[:8].ljust(8, b"\0")
+    gen_field = b""
+    if version >= 3:
+        gen_field = _GEN.pack(
+            0 if generation is None else int(generation) + 1
+        )
     return (
         _HEAD.pack(_MAGIC, version, _KIND_REQUEST)
         + tid
+        + gen_field
         + _LEN.pack(len(inner))
         + inner
     )
 
 
-def try_decode_request_full(
+def try_decode_request_ext(
     payload: bytes,
-) -> Tuple[Optional[str], bytes, int]:
-    """-> (trace_id | None, inner bytes, envelope version). No magic:
-    the payload is a bare old-version proto and comes back untouched
-    (reported as version 0). A server answers in the request's version
-    so old Leaders never see fields they cannot decode."""
+) -> Tuple[Optional[str], bytes, int, Optional[int]]:
+    """-> (trace_id | None, inner bytes, envelope version,
+    generation | None). No magic: the payload is a bare old-version
+    proto and comes back untouched (reported as version 0). A server
+    answers in the request's version so old Leaders never see fields
+    they cannot decode. `generation` is None below v3 and for v3
+    requests whose Leader did not bind one."""
     if not payload.startswith(_MAGIC):
-        return None, payload, 0
+        return None, payload, 0, None
     if len(payload) < _HEAD.size + 8 + _LEN.size:
         raise EnvelopeError("truncated envelope header")
     _, version, kind = _HEAD.unpack_from(payload)
@@ -145,19 +177,36 @@ def try_decode_request_full(
     if kind != _KIND_REQUEST:
         raise EnvelopeError(f"unexpected envelope kind {kind}")
     tid = payload[_HEAD.size:_HEAD.size + 8]
-    (inner_len,) = _LEN.unpack_from(payload, _HEAD.size + 8)
-    inner = payload[_HEAD.size + 8 + _LEN.size:]
+    body = _HEAD.size + 8
+    generation = None
+    if version >= 3:
+        if len(payload) < body + _GEN.size + _LEN.size:
+            raise EnvelopeError("truncated envelope header")
+        (gen_field,) = _GEN.unpack_from(payload, body)
+        if gen_field > 0:
+            generation = gen_field - 1
+        body += _GEN.size
+    (inner_len,) = _LEN.unpack_from(payload, body)
+    inner = payload[body + _LEN.size:]
     if len(inner) != inner_len:
         raise EnvelopeError(
             f"envelope body is {len(inner)} bytes, expected {inner_len}"
         )
-    return tid.hex(), inner, version
+    return tid.hex(), inner, version, generation
+
+
+def try_decode_request_full(
+    payload: bytes,
+) -> Tuple[Optional[str], bytes, int]:
+    """-> (trace_id | None, inner bytes, envelope version)."""
+    trace_id, inner, version, _ = try_decode_request_ext(payload)
+    return trace_id, inner, version
 
 
 def try_decode_request(payload: bytes) -> Tuple[Optional[str], bytes]:
     """-> (trace_id | None, inner bytes). No magic: the payload is a
     bare old-version proto and comes back untouched."""
-    trace_id, inner, _ = try_decode_request_full(payload)
+    trace_id, inner, _, _ = try_decode_request_ext(payload)
     return trace_id, inner
 
 
@@ -170,10 +219,13 @@ def encode_response(
     phases: Optional[dict] = None,
     recv_ms: Optional[float] = None,
     send_ms: Optional[float] = None,
+    generation: Optional[int] = None,
 ) -> bytes:
     """`phases`/`recv_ms`/`send_ms` (the Helper's critical-path digest)
     ride only on version >= 2 — a v1 reply is byte-compatible with the
-    old encoder, so downgrading drops the digest and nothing else."""
+    old encoder, so downgrading drops the digest and nothing else.
+    `generation` (the snapshot generation the share was evaluated
+    against) rides only on version >= 3."""
     if version not in _SUPPORTED_VERSIONS:
         raise EnvelopeError(f"unsupported envelope version {version}")
     span_list = list(spans or [])
@@ -206,6 +258,8 @@ def encode_response(
             meta["recv_ms"] = round(float(recv_ms), 3)
         if send_ms is not None:
             meta["send_ms"] = round(float(send_ms), 3)
+    if version >= 3 and generation is not None:
+        meta["generation"] = int(generation)
     meta_bytes = json.dumps(meta, separators=(",", ":")).encode()
     return (
         _HEAD.pack(_MAGIC, version, _KIND_RESPONSE)

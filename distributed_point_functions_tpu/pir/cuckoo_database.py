@@ -38,9 +38,18 @@ class CuckooHashedDpfPirDatabase:
         def __init__(self):
             self._records: Dict[bytes, bytes] = {}
             self._params: Optional[CuckooHashingParams] = None
+            self._generation = 0
 
         def set_params(self, params: CuckooHashingParams):
             self._params = params
+            return self
+
+        def set_generation(self, generation: int):
+            """Snapshot generation tag stamped on the built database and
+            both parallel dense databases — sparse PIR adopts the
+            serving-side rotation machinery (`serving/snapshots.py`)
+            unchanged because the tag travels the same way."""
+            self._generation = int(generation)
             return self
 
         def insert(self, key_value: Tuple[bytes, bytes]):
@@ -54,6 +63,7 @@ class CuckooHashedDpfPirDatabase:
             b = CuckooHashedDpfPirDatabase.Builder()
             b._records = dict(self._records)
             b._params = self._params
+            b._generation = self._generation
             return b
 
         def build(self) -> "CuckooHashedDpfPirDatabase":
@@ -68,20 +78,25 @@ class CuckooHashedDpfPirDatabase:
                 if not key:
                     raise ValueError("key cannot be empty")
             slots = self._build_slots(params)
-            key_builder = DenseDpfPirDatabase.Builder()
-            value_builder = DenseDpfPirDatabase.Builder()
+            key_records: List[bytes] = []
+            value_records: List[bytes] = []
             for slot in slots:
                 if slot is not None:
-                    key_builder.insert(slot)
-                    value_builder.insert(self._records[slot])
+                    key_records.append(slot)
+                    value_records.append(self._records[slot])
                 else:
-                    key_builder.insert(b"")
-                    value_builder.insert(b"")
+                    key_records.append(b"")
+                    value_records.append(b"")
             return CuckooHashedDpfPirDatabase(
-                key_builder.build(),
-                value_builder.build(),
+                DenseDpfPirDatabase(
+                    key_records, generation=self._generation
+                ),
+                DenseDpfPirDatabase(
+                    value_records, generation=self._generation
+                ),
                 size=len(self._records),
                 num_buckets=params.num_buckets,
+                generation=self._generation,
             )
 
         def _build_slots(self, params):
@@ -150,16 +165,24 @@ class CuckooHashedDpfPirDatabase:
         value_database: DenseDpfPirDatabase,
         size: int,
         num_buckets: int,
+        generation: int = 0,
     ):
         self._key_database = key_database
         self._value_database = value_database
         self._size = size
         self._num_buckets = num_buckets
+        self._generation = int(generation)
 
     @property
     def size(self) -> int:
         """Number of real (non-dummy) records."""
         return self._size
+
+    @property
+    def generation(self) -> int:
+        """Snapshot generation tag (0 = untagged), shared with both
+        parallel dense databases."""
+        return self._generation
 
     @property
     def num_buckets(self) -> int:

@@ -88,11 +88,22 @@ def words_to_record_bytes(
 
 
 class DenseDpfPirDatabase:
-    """Immutable dense database; construct via `DenseDpfPirDatabase.Builder`."""
+    """Immutable dense database; construct via `DenseDpfPirDatabase.Builder`.
+
+    Every database carries a **generation** tag (`generation`, default
+    0): a monotonically increasing snapshot version the serving runtime
+    (`serving/snapshots.py`) binds batches and wire envelopes to, so a
+    rotated deployment can prove both parties answered one query from
+    the same data. `Builder.build_from(prev)` derives generation N+1
+    from N host-side — staged `update(i, value)` rows are repacked in
+    place of a full re-insert when they fit the previous layout.
+    """
 
     class Builder:
         def __init__(self):
             self._records: List[bytes] = []
+            # index -> staged replacement, applied by build()/build_from()
+            self._updates: dict = {}
 
         def insert(self, value: bytes) -> "DenseDpfPirDatabase.Builder":
             if isinstance(value, str):
@@ -100,16 +111,70 @@ class DenseDpfPirDatabase:
             self._records.append(bytes(value))
             return self
 
+        def update(self, i: int, value: bytes) -> "DenseDpfPirDatabase.Builder":
+            """Stage an in-place replacement of record `i`. Under
+            `build()` the index refers to this builder's inserted
+            records; under `build_from(prev)` it refers to `prev`'s
+            records — the delta path that makes generation N+1 cheap."""
+            if isinstance(value, str):
+                value = value.encode()
+            i = int(i)
+            if i < 0:
+                raise IndexError(f"update index {i} must be >= 0")
+            self._updates[i] = bytes(value)
+            return self
+
         def clone(self) -> "DenseDpfPirDatabase.Builder":
             b = DenseDpfPirDatabase.Builder()
             b._records = list(self._records)
+            b._updates = dict(self._updates)
             return b
 
         def build(self) -> "DenseDpfPirDatabase":
-            return DenseDpfPirDatabase(self._records)
+            records = list(self._records)
+            for i, value in self._updates.items():
+                if i >= len(records):
+                    raise IndexError(
+                        f"update index {i} out of bounds for "
+                        f"{len(records)} inserted records"
+                    )
+                records[i] = value
+            return DenseDpfPirDatabase(records)
 
-    def __init__(self, records: Sequence[bytes]):
+        def build_from(
+            self, prev: "DenseDpfPirDatabase"
+        ) -> "DenseDpfPirDatabase":
+            """Derive generation N+1 from database `prev` host-side:
+            `prev`'s records with this builder's staged `update`s
+            applied (and any `insert`ed records appended), tagged
+            `prev.generation + 1`. When nothing is appended and every
+            updated value fits `prev`'s packed row layout, the packed
+            host buffer is copied and only the updated rows repacked —
+            no per-record re-insert at directory scale."""
+            for i in self._updates:
+                if i >= prev.size:
+                    raise IndexError(
+                        f"update index {i} out of bounds for previous "
+                        f"generation of {prev.size} records"
+                    )
+            generation = prev.generation + 1
+            fits_in_place = not self._records and all(
+                len(v) <= prev._max_value_size
+                for v in self._updates.values()
+            )
+            if fits_in_place:
+                return DenseDpfPirDatabase._from_delta(
+                    prev, self._updates, generation
+                )
+            records = list(prev._records)
+            for i, value in self._updates.items():
+                records[i] = value
+            records.extend(self._records)
+            return DenseDpfPirDatabase(records, generation=generation)
+
+    def __init__(self, records: Sequence[bytes], generation: int = 0):
         self._records = [bytes(r) for r in records]
+        self._generation = int(generation)
         self._max_value_size = max((len(r) for r in self._records), default=0)
         num_records = len(self._records)
         self._num_padded = max(128, ((num_records + 127) // 128) * 128)
@@ -139,6 +204,11 @@ class DenseDpfPirDatabase:
         self._host_words = np.ascontiguousarray(buf).view("<u4").astype(
             np.uint32
         )
+        self._init_runtime()
+
+    def _init_runtime(self) -> None:
+        """Device-staging slots and tier-fallback memory (fresh per
+        instance — a delta build shares host bytes, never stagings)."""
         self._db_words = None  # row-major device copy (jnp fallback path)
         self._db_perm = None  # bit-major layout, staged on first pallas use
         # Bitrev-block staging (the v2 gather-free serving exit): same
@@ -159,10 +229,44 @@ class DenseDpfPirDatabase:
         self._failed_tiers: set = set()
         self._failed_knobs: set = set()  # v2 knob combos that crashed
 
+    @classmethod
+    def _from_delta(
+        cls,
+        prev: "DenseDpfPirDatabase",
+        updates: dict,
+        generation: int,
+    ) -> "DenseDpfPirDatabase":
+        """Generation N+1 from N without re-inserting: copy the packed
+        host buffer and repack only the updated rows. Caller guarantees
+        every update index is in range and every value fits `prev`'s
+        record width (`build_from` checks and falls back otherwise)."""
+        db = cls.__new__(cls)
+        records = list(prev._records)
+        for i, value in updates.items():
+            records[i] = value
+        db._records = records
+        db._generation = int(generation)
+        db._max_value_size = prev._max_value_size
+        db._num_padded = prev._num_padded
+        host = prev._host_words.copy()
+        record_bytes = host.shape[1] * 4
+        for i, value in updates.items():
+            row = np.zeros(record_bytes, dtype=np.uint8)
+            row[: len(value)] = np.frombuffer(value, dtype=np.uint8)
+            host[i] = row.view("<u4").astype(np.uint32)
+        db._host_words = host
+        db._init_runtime()
+        return db
+
     @property
     def size(self) -> int:
         """Number of records."""
         return len(self._records)
+
+    @property
+    def generation(self) -> int:
+        """Snapshot generation tag (0 = untagged / initial build)."""
+        return self._generation
 
     @property
     def max_value_size(self) -> int:
@@ -194,6 +298,46 @@ class DenseDpfPirDatabase:
 
     def record(self, i: int) -> bytes:
         return self._records[i]
+
+    def prestage(self) -> int:
+        """Eagerly stage the row-major device buffer (the double-buffer
+        half of a snapshot rotation: generation N+1 moves into HBM while
+        N keeps serving, so the flip itself transfers nothing). Layout
+        variants (bit-major, bitrev, streaming) still stage lazily on
+        first use. Returns the bytes staged by this call (0 if the
+        buffer was already resident)."""
+        with self._stage_lock:
+            if self._db_words is not None:
+                return 0
+            _ = self.db_words
+            return int(self._host_words.nbytes)
+
+    def release_stagings(self) -> int:
+        """Drop every device staging (row-major, bit-major, bitrev,
+        streaming) so a retired generation's HBM is reclaimable the
+        moment its last in-flight batch drains. The host buffer stays —
+        re-staging is possible but a retired snapshot normally never
+        serves again. Returns the number of device buffers dropped."""
+        with self._stage_lock:
+            dropped = 0
+            for attr in (
+                "_db_words", "_db_perm", "_db_words_rev", "_db_perm_rev",
+            ):
+                if getattr(self, attr) is not None:
+                    setattr(self, attr, None)
+                    dropped += 1
+            if self._streaming_stage is not None:
+                self._streaming_stage = None
+                dropped += 1
+            self._host_rev = None
+        # One HBM sample after the drop so the db_staging watermark and
+        # live-bytes gauge reflect the reclaim without waiting for the
+        # next staging to bracket a phase.
+        try:
+            default_telemetry().hbm.sample()
+        except Exception:  # noqa: BLE001 - telemetry never raises
+            pass
+        return dropped
 
     def bitrev_block_count(self) -> int:
         """Block count of the bitrev staging: the padded power of two a

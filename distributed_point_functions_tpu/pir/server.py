@@ -340,6 +340,55 @@ class DenseDpfPirServer(DpfPirServer):
     def database(self) -> DenseDpfPirDatabase:
         return self._database
 
+    def swap_database(
+        self, database: DenseDpfPirDatabase
+    ) -> DenseDpfPirDatabase:
+        """Atomically replace the served database with a new generation.
+
+        Only safe at a batch boundary: `handle_plain_request` reads
+        `self._database` several times per call, so the caller
+        (`serving/snapshots.py`) must guarantee no evaluation is in
+        flight. Geometry must match — the DPF parameters, expand/walk
+        split, and sharded/chunked plans are all derived from the
+        original database and are kept; same-geometry replacements only
+        need the staged-buffer caches dropped.
+
+        Returns the old database (still staged; the caller drains and
+        frees it via `release_stagings()`).
+        """
+        if database is None:
+            raise ValueError("database cannot be None")
+        old = self._database
+        if database.size != old.size:
+            raise ValueError(
+                f"swap_database size mismatch: {database.size} != {old.size}"
+            )
+        if database.num_selection_blocks != old.num_selection_blocks:
+            raise ValueError(
+                "swap_database selection-block mismatch: "
+                f"{database.num_selection_blocks} != "
+                f"{old.num_selection_blocks}"
+            )
+        if database.max_value_size != old.max_value_size:
+            raise ValueError(
+                "swap_database max_value_size mismatch: "
+                f"{database.max_value_size} != {old.max_value_size}"
+            )
+        self._database = database
+        with self._chunked_db_lock:
+            self._chunked_db = None
+        # The sharded step (a compiled function of the geometry) is
+        # reusable; only the placed database must restage.
+        self._sharded_db = None
+        if self._sharded_step is not None:
+            from ..parallel.sharded import pad_rows_to_mesh, shard_database
+
+            ndev = self._mesh.devices.size
+            self._sharded_db = shard_database(
+                self._mesh, pad_rows_to_mesh(database.db_words, ndev)
+            )
+        return old
+
     def _parse_helper_request(self, data: bytes) -> "messages.HelperRequest":
         return messages.parse_helper_request(self._dpf, data)
 

@@ -31,6 +31,11 @@ from .service import (
     ServingConfig,
     TenantPolicy,
 )
+from .snapshots import (
+    RotationCoordinator,
+    SnapshotManager,
+    SnapshotMismatch,
+)
 from .transport import (
     FramedTcpServer,
     InProcessTransport,
@@ -57,7 +62,10 @@ __all__ = [
     "MetricsRegistry",
     "Overloaded",
     "PlainSession",
+    "RotationCoordinator",
     "ServingConfig",
+    "SnapshotManager",
+    "SnapshotMismatch",
     "TcpTransport",
     "TenantPolicy",
     "Transport",

@@ -96,7 +96,7 @@ def _h2d_bytes(telemetry) -> int:
 class _Pending:
     __slots__ = (
         "keys", "deadline", "event", "result", "error", "t0", "abandoned",
-        "trace", "phases", "tenant", "cost",
+        "trace", "phases", "tenant", "cost", "generation",
     )
 
     def __init__(self, keys, deadline, tenant="default", cost=None):
@@ -109,6 +109,10 @@ class _Pending:
         self.error: Optional[BaseException] = None
         self.t0 = time.monotonic()
         self.abandoned = False
+        # Snapshot generation the batch actually evaluated against
+        # (None without a generation source); stamped by the worker at
+        # the batch boundary, read back through `submit_ex`.
+        self.generation: Optional[int] = None
         # The submitting request's trace: the worker thread appends the
         # queue-wait / device-compute spans onto it by reference. Same
         # deal for the phase record — the worker attributes
@@ -172,6 +176,11 @@ class DynamicBatcher:
         # single tenant, so either way one-tenant order is arrival
         # order).
         self._queue = WeightedFairQueue() if admission is not None else deque()
+        # Snapshot rotation hook (`serving/snapshots.py`): the worker
+        # calls begin_batch()/end_batch() around every evaluation so
+        # flips land only at batch boundaries and in-flight batches
+        # pin their generation's stagings.
+        self._generation_source = None
         self._seen_buckets: set = set()
         self._closed = False
         self._worker = threading.Thread(
@@ -191,6 +200,20 @@ class DynamicBatcher:
         result per key, in order. `deadline` is absolute
         `time.monotonic()` seconds; `tenant` keys the QoS policy when
         cost-aware admission is attached (ignored otherwise)."""
+        results, _ = self.submit_ex(keys, deadline, tenant)
+        return results
+
+    def submit_ex(
+        self,
+        keys: Sequence,
+        deadline: Optional[float] = None,
+        tenant: str = "default",
+    ):
+        """`submit`, but returns `(results, generation)` where
+        `generation` is the snapshot generation the batch evaluated
+        against (None without an attached generation source) — the
+        Leader binds its own share to it and refuses a Helper echo
+        from any other generation."""
         keys = list(keys)
         if not keys:
             raise ValueError("keys must not be empty")
@@ -251,7 +274,28 @@ class DynamicBatcher:
                 )
         if pending.error is not None:
             raise pending.error
-        return pending.result
+        return pending.result, pending.generation
+
+    # -- snapshot rotation hook ---------------------------------------------
+
+    def set_generation_source(self, source) -> None:
+        """Attach a `SnapshotManager` (duck-typed: `begin_batch()`
+        returning the bound generation, `end_batch(generation)` when
+        the batch retires). Flips then land only between batches, so a
+        batch never evaluates half against generation N and half
+        against N+1."""
+        with self._cond:
+            self._generation_source = source
+
+    def _end_batch(self, generation) -> None:
+        if generation is None:
+            return
+        source = self._generation_source
+        if source is not None:
+            try:
+                source.end_batch(generation)
+            except Exception:  # noqa: BLE001 - bookkeeping never kills the worker
+                pass
 
     # -- brownout hook ------------------------------------------------------
 
@@ -366,6 +410,18 @@ class DynamicBatcher:
             self._c_pad.inc(bucket - len(flat))
             self._h_batch.observe(len(flat))
             self._h_pad_waste.observe(pad_waste)
+            # Batch boundary: a pending snapshot flip applies HERE (or
+            # not at all until the next batch), then the whole bucket
+            # evaluates and binds against one generation.
+            generation = None
+            source = self._generation_source
+            if source is not None:
+                try:
+                    generation = source.begin_batch()
+                except Exception:  # noqa: BLE001 - rotation never kills serving
+                    generation = None
+            for p in live:
+                p.generation = generation
             try:
                 # Chaos site: a worker-side fault here must fan out to
                 # every live request and leave the worker serving.
@@ -404,6 +460,7 @@ class DynamicBatcher:
                     self._release(p)
                     p.error = e
                     p.event.set()
+                self._end_batch(generation)
                 continue
             # Batch-level stage aggregates (once per batch) ...
             tracing.add_span(
@@ -440,6 +497,10 @@ class DynamicBatcher:
                     p.phases.add("dispatch", dispatch_ms)
                 self._release(p)
                 p.event.set()
+            # The batch has fully retired against its generation: let a
+            # waiting flip proceed (and the old generation's stagings
+            # drop once its last batch lands here).
+            self._end_batch(generation)
             # Terminal batch outcome: join the capacity-model estimate
             # for the executed bucket with the measured device truth
             # (after every waiter is released, so accounting adds no

@@ -74,6 +74,7 @@ deliberately NOT exported from `serving/__init__.py` — import it as
 from __future__ import annotations
 
 import collections
+import contextlib
 import random
 import threading
 import time
@@ -193,11 +194,22 @@ class Prober:
         client = DenseDpfPirClient(
             n, encrypter if encrypter is not None else (lambda pt, info: pt)
         )
+        self._client = client
+        self._db_size = n
         self._plain_pair = client.create_plain_requests(indices)
         self._e2e = None
         if encrypter is not None:
             request, state = client.create_request(indices)
             self._e2e = (request, state, client)
+        # Snapshot rotation: the database generation the golden pairs
+        # are keyed to, plus the SnapshotManagers to pin during each
+        # probe so a probe's two shares never straddle a flip (see
+        # `bind_snapshots` / `rotate_goldens`).
+        self._generation = getattr(
+            getattr(session, "server", None), "database", None
+        )
+        self._generation = getattr(self._generation, "generation", 0)
+        self._snapshot_pins: List = []
 
         self._hh = None
         if hh_values:
@@ -241,6 +253,93 @@ class Prober:
         *known* state, not a new incident). Exceptions are swallowed."""
         with self._lock:
             self._failure_listeners.append(listener)
+
+    def rotate_goldens(
+        self,
+        records: Sequence[bytes],
+        *,
+        indices: Optional[Sequence[int]] = None,
+        generation: Optional[int] = None,
+    ) -> None:
+        """Re-key the golden (index, plaintext) pairs to a rotated
+        database generation. DPF keys select by *index*, so unchanged
+        golden indices keep their precomputed requests — only the
+        oracle plaintexts swap; passing new `indices` regenerates the
+        requests too. Rotation preserves the database size
+        (`swap_database` enforces it), so `records` must match."""
+        if len(records) != self._db_size:
+            raise ValueError(
+                f"rotated records count {len(records)} != database size "
+                f"{self._db_size} (rotation preserves geometry)"
+            )
+        with self._lock:
+            if indices is not None:
+                indices = [int(i) for i in indices]
+                for i in indices:
+                    if not 0 <= i < self._db_size:
+                        raise ValueError(
+                            f"golden index {i} out of bounds for "
+                            f"{self._db_size}"
+                        )
+                if indices != self._indices:
+                    self._indices = indices
+                    self._plain_pair = (
+                        self._client.create_plain_requests(indices)
+                    )
+                    if self._e2e is not None:
+                        request, state = self._client.create_request(
+                            indices
+                        )
+                        self._e2e = (request, state, self._client)
+            self._expected = [
+                bytes(records[i]) for i in self._indices
+            ]
+            if generation is not None:
+                self._generation = int(generation)
+            generation_now = self._generation
+        journal = (
+            self._journal
+            if self._journal is not None
+            else events_mod.default_journal()
+        )
+        journal.emit(
+            "prober.goldens_rotated",
+            f"golden pairs re-keyed to generation {generation_now}",
+            severity="info",
+            generation=generation_now,
+        )
+
+    def bind_snapshots(self, manager, records_provider=None):
+        """Track a `SnapshotManager` through rotations: every probe
+        pins it (a probe's two shares must evaluate against ONE
+        generation — the pin holds a pending flip off until the probe
+        lands), and, when `records_provider(to_generation)` is given,
+        every applied flip rotates the goldens to the new generation's
+        plaintexts within the same flip callback — i.e. before the
+        next probe cycle can run against stale oracles. Bind BOTH
+        parties' managers in a two-party deployment so neither side
+        flips mid-probe. Returns `manager` for chaining."""
+        with self._lock:
+            if manager not in self._snapshot_pins:
+                self._snapshot_pins.append(manager)
+        if records_provider is not None:
+            def on_flip(record):
+                records = records_provider(record["to_generation"])
+                if records:
+                    self.rotate_goldens(
+                        records, generation=record["to_generation"]
+                    )
+
+            manager.add_flip_listener(on_flip)
+        return manager
+
+    def _pinned_managers(self) -> List:
+        with self._lock:
+            managers = list(self._snapshot_pins)
+        session_manager = getattr(self._session, "snapshots", None)
+        if session_manager is not None and session_manager not in managers:
+            managers.append(session_manager)
+        return managers
 
     def rate_floor_objective(
         self, threshold: Optional[float] = None
@@ -343,20 +442,28 @@ class Prober:
         status = "pass"
         detail = None
         try:
-            if kind == "pir_materialized":
-                detail = self._probe_tier(None)
-            elif kind == "pir_streaming":
-                detail = self._probe_tier("streaming")
-            elif kind == "pir_chunked":
-                detail = self._probe_tier("chunked")
-            elif kind == "pir_unbatched":
-                detail = self._probe_unbatched()
-            elif kind == "leader_e2e":
-                detail = self._probe_leader_e2e()
-            elif kind == "hh_sweep":
-                detail = self._probe_hh_sweep()
-            else:  # pragma: no cover - kinds() is the source of truth
-                raise ValueError(f"unknown probe kind {kind}")
+            # Pin every bound SnapshotManager for the probe's duration:
+            # the two shares of a golden pair (and the oracle they are
+            # checked against) must all belong to ONE generation, so a
+            # pending rotation flip waits out the probe instead of
+            # landing between its submissions.
+            with contextlib.ExitStack() as stack:
+                for manager in self._pinned_managers():
+                    stack.enter_context(manager.pin())
+                if kind == "pir_materialized":
+                    detail = self._probe_tier(None)
+                elif kind == "pir_streaming":
+                    detail = self._probe_tier("streaming")
+                elif kind == "pir_chunked":
+                    detail = self._probe_tier("chunked")
+                elif kind == "pir_unbatched":
+                    detail = self._probe_unbatched()
+                elif kind == "leader_e2e":
+                    detail = self._probe_leader_e2e()
+                elif kind == "hh_sweep":
+                    detail = self._probe_hh_sweep()
+                else:  # pragma: no cover - kinds() is the source of truth
+                    raise ValueError(f"unknown probe kind {kind}")
             if detail is not None:
                 status = "mismatch"
         except Exception as e:  # noqa: BLE001 - a probe must not kill the loop
@@ -504,8 +611,11 @@ class Prober:
             for r in history:
                 probes += 1
                 counts[r["status"]] = counts.get(r["status"], 0) + 1
+        with self._lock:
+            generation = self._generation
         return {
             "name": self._name,
+            "generation": generation,
             "period_s": self._period_s,
             "max_duty_cycle": self._max_duty_cycle,
             "freshness_window_s": self._freshness_window_s,

@@ -79,11 +79,13 @@ from ..robustness import failpoints
 from ..robustness.breaker import CircuitBreaker
 from .batcher import DeadlineExceeded, DynamicBatcher, Overloaded
 from .metrics import MetricsRegistry
+from .snapshots import SnapshotMismatch
 from .transport import Transport, TransportError, TransportTimeout
 
 __all__ = [
     "ServingConfig",
     "HelperUnavailable",
+    "SnapshotMismatch",
     "PlainSession",
     "LeaderSession",
     "HelperSession",
@@ -154,6 +156,13 @@ class ServingConfig:
     # digest, no skew estimate, no critical-path decomposition — the
     # knob the digest-piggyback overhead benchmark flips.
     helper_digest: bool = True
+    # How many times the Leader re-runs a request whose Helper answer
+    # came from a different database generation (typed
+    # SnapshotMismatch, never a cross-generation XOR). Retries
+    # converge because the Leader's own pending flip applies at the
+    # next batch boundary; the window is the coordinator's bounded
+    # Helper-first/Leader-last flip gap.
+    snapshot_retries: int = 3
 
 
 # The deadline travels from handle_request into the server's plain
@@ -167,6 +176,15 @@ _DEADLINE: contextvars.ContextVar = contextvars.ContextVar(
 # read where the plain handler submits to the batcher.
 _TENANT: contextvars.ContextVar = contextvars.ContextVar(
     "serving_tenant", default="default"
+)
+# The snapshot generation the most recent batched evaluation on this
+# context bound to (set by _batched_plain_handler from the batcher's
+# batch-boundary stamp). The Helper's handle_wire echoes it in the v3
+# reply; the Leader's _send_to_helper compares it against the Helper's
+# echo. Reset to None at each entry point so a stale value from a
+# previous request on the same thread can never bleed into the check.
+_EVAL_GENERATION: contextvars.ContextVar = contextvars.ContextVar(
+    "serving_eval_generation", default=None
 )
 
 
@@ -277,6 +295,9 @@ class _Session:
         self.capacity_accuracy = CapacityAccuracy(
             ledger=ledger, recalibrator=default_recalibrator()
         )
+        # Snapshot rotation (serving/snapshots.py): a SnapshotManager
+        # wires itself in via attach_snapshots at construction.
+        self.snapshots = None
         self.admission: Optional[AdmissionController] = None
         if self._config.admission_enabled:
             self.admission = AdmissionController(
@@ -308,6 +329,15 @@ class _Session:
     @property
     def batcher(self) -> Optional[DynamicBatcher]:
         return self._batcher
+
+    def attach_snapshots(self, manager):
+        """Wire a `SnapshotManager` into the session: generation flips
+        then land only at this session's batch boundaries, and the
+        wire entry points carry/check the generation field."""
+        self.snapshots = manager
+        if self._batcher is not None:
+            self._batcher.set_generation_source(manager)
+        return manager
 
     # -- QoS / brownout -----------------------------------------------------
 
@@ -372,11 +402,17 @@ class _Session:
         return response.dpf_pir_response.masked_response
 
     def _batched_plain_handler(self, request):
-        out = self._batcher.submit(
+        out, generation = self._batcher.submit_ex(
             request.plain_request.dpf_keys,
             deadline=_DEADLINE.get(),
             tenant=_TENANT.get(),
         )
+        if generation is not None:
+            # Published for the enclosing entry point (the Helper's
+            # echo / the Leader's own-share generation): deliberately
+            # un-scoped — the reader is up-stack on this same context
+            # and each entry point resets it to None first.
+            _EVAL_GENERATION.set(generation)
         return messages.PirResponse(
             dpf_pir_response=messages.DpfPirResponse(
                 masked_response=list(out)
@@ -435,15 +471,22 @@ class _Session:
         Leader never sees v2 fields. A v2 request gets the critical-path
         digest piggybacked on the reply: this side's phase waterfall
         plus the perf_counter-domain receive/send timestamps the Leader
-        needs for NTP-style skew estimation.
+        needs for NTP-style skew estimation. A v3 request gets the
+        snapshot generation this side's share was evaluated against
+        echoed in the reply meta — the Leader's cross-generation check
+        depends on that echo being the *evaluated* generation, not
+        whatever is serving by reply time.
         """
         from ..protos import private_information_retrieval_pb2 as pir_pb2
 
         recv_ms = time.perf_counter() * 1e3
-        trace_id, inner, req_version = propagation.try_decode_request_full(
-            data
+        trace_id, inner, req_version, req_generation = (
+            propagation.try_decode_request_ext(data)
         )
         resp_version = min(req_version, propagation.PROPAGATION_VERSION)
+        # Fresh per request: a stale generation from a previous request
+        # on this thread must never be echoed as this one's.
+        _EVAL_GENERATION.set(None)
         t0 = time.perf_counter()
         with tracing.trace_request(
             f"{self._name}.request",
@@ -483,6 +526,36 @@ class _Session:
                     ).SerializeToString()
             if trace_id is None:
                 return out
+            # The generation this request's share actually evaluated
+            # against (stamped at the batch boundary); falls back to
+            # the serving generation for unbatched sessions.
+            served_generation = _EVAL_GENERATION.get()
+            if served_generation is None and self.snapshots is not None:
+                served_generation = self.snapshots.serving_generation()
+            if (
+                req_generation is not None
+                and served_generation is not None
+                and req_generation != served_generation
+            ):
+                # The peer believed a different generation was current
+                # when it sent. Harmless here — the Leader's echo check
+                # is the enforcement point — but worth a (coalesced)
+                # line on the timeline while the rotation window is
+                # open.
+                events_mod.emit(
+                    "snapshot.mismatch",
+                    f"request bound generation {req_generation}, "
+                    f"evaluated against {served_generation}",
+                    severity="warning",
+                    party=self._name,
+                    request_generation=req_generation,
+                    served_generation=served_generation,
+                    coalesce_key=(
+                        f"snapshot.skew:{self._name}:"
+                        f"{req_generation}:{served_generation}"
+                    ),
+                    coalesce_s=1.0,
+                )
             # The phases context has closed: trace.attrs["phases"] is
             # this request's final waterfall (the v2 digest).
             return propagation.encode_response(
@@ -494,6 +567,7 @@ class _Session:
                 phases=trace.attrs.get("phases"),
                 recv_ms=recv_ms,
                 send_ms=time.perf_counter() * 1e3,
+                generation=served_generation,
             )
 
     def close(self) -> None:
@@ -569,14 +643,19 @@ class LeaderSession(_Session):
         self._c_failures = m.counter("leader.helper_failures")
         self._c_degraded = m.counter("leader.degraded_responses")
         self._c_downgrades = m.counter("leader.wire_downgrades")
+        self._c_mismatches = m.counter("leader.snapshot_mismatches")
+        self._c_snapshot_retries = m.counter("leader.snapshot_retries")
         # None = envelope support unknown (probe with an envelope);
         # False = peer rejected it once (bare proto from then on);
         # True = peer answered an envelope.
         self._peer_envelope: Optional[bool] = None
-        # Envelope version ladder: probe at v2 (the critical-path
-        # digest), step to v1 on the first non-timeout fault, to bare
-        # proto on the second — each step sticky and retry-neutral, so
-        # a v1-only Helper costs exactly one probe and keeps its spans.
+        # Envelope version ladder: probe at v3 (generation handshake +
+        # critical-path digest), step one version down per non-timeout
+        # fault — v3 -> v2 (losing only the generation echo; checking
+        # goes disabled-but-journaled) -> v1 (losing the digest) ->
+        # bare proto. Each step is sticky, retry-neutral, and counted
+        # once in leader.wire_downgrades, so an old Helper costs
+        # exactly (3 - its version) probes.
         self._peer_wire_version = (
             propagation.PROPAGATION_VERSION
             if self._config.helper_digest else 1
@@ -686,6 +765,10 @@ class LeaderSession(_Session):
         before the normal retry policy resumes. Timeouts do NOT
         downgrade — a slow Helper is not an old one.
         """
+        # Fresh per leg: the own-share evaluation below stamps the
+        # generation it bound to; a stale stamp from a previous request
+        # on this thread must never satisfy the echo check.
+        _EVAL_GENERATION.set(None)
         breaker = self._breaker
         if breaker is not None and not breaker.allow():
             # Open breaker: fail in microseconds — no serialization, no
@@ -705,6 +788,13 @@ class LeaderSession(_Session):
         # round-trip bracket, so own-share compute is never booked as
         # wire time (the in-process transport runs it inline).
         share_window = [None]
+        # The generation the own share bound to, captured the moment
+        # the share returns. It must NOT be read from _EVAL_GENERATION
+        # after the round-trip: an in-process Helper runs handle_wire
+        # on this same thread/context and would overwrite it with the
+        # HELPER's generation — turning the mismatch check into
+        # helper-vs-helper, which can never fire.
+        own_gen_box = [None]
 
         def leader_share_once():
             if not called[0]:
@@ -716,6 +806,7 @@ class LeaderSession(_Session):
                 finally:
                     share_window[0] = (s0 * 1e3,
                                        time.perf_counter() * 1e3)
+                    own_gen_box[0] = _EVAL_GENERATION.get()
 
         timeout = (
             None if cfg.helper_timeout_ms is None
@@ -733,6 +824,15 @@ class LeaderSession(_Session):
                     else tracing.new_trace_id(),
                     wire,
                     version=self._peer_wire_version,
+                    # Advisory: the serving generation at send time
+                    # (the own share has not evaluated yet — it runs
+                    # overlapped with this round-trip). The Helper
+                    # journals skew against it; the authoritative
+                    # check below compares echo vs. own-share binding.
+                    generation=(
+                        self.snapshots.serving_generation()
+                        if self.snapshots is not None else None
+                    ),
                 )
                 if enveloped
                 else wire
@@ -761,15 +861,17 @@ class LeaderSession(_Session):
                     and not isinstance(e, TransportTimeout)
                 ):
                     # Probe fault: plausibly an old peer choking on the
-                    # envelope. Step down the version ladder — v2 to v1
-                    # first (a v1 Helper keeps its spans, loses only
-                    # the digest), then v1 to bare proto — and re-send
-                    # immediately. Neither step consumes a retry
-                    # attempt (each is sticky, so the ladder runs at
-                    # most twice per transport) or feeds the breaker: a
-                    # version mismatch is not a dead Helper.
+                    # envelope. Step ONE version down the ladder — v3
+                    # to v2 (losing the generation echo; checking goes
+                    # disabled-but-journaled), v2 to v1 (losing the
+                    # digest), then v1 to bare proto — and re-send
+                    # immediately. No step consumes a retry attempt
+                    # (each is sticky, so the ladder runs at most
+                    # PROPAGATION_VERSION times per transport) or feeds
+                    # the breaker: a version mismatch is not a dead
+                    # Helper.
                     if self._peer_wire_version > 1:
-                        self._peer_wire_version = 1
+                        self._peer_wire_version -= 1
                     else:
                         self._peer_envelope = False
                     self._c_downgrades.inc()
@@ -836,6 +938,40 @@ class LeaderSession(_Session):
             raise
         if enveloped:
             self._peer_envelope = meta is not None
+        if self.snapshots is not None:
+            # The generation handshake. own_generation is what this
+            # Leader's share actually evaluated against (stamped at
+            # the batch boundary by _batched_plain_handler, captured
+            # at share return — see own_gen_box above);
+            # helper_generation is the Helper's echo of the same for
+            # its share. Disagreement means the XOR would be
+            # well-formed garbage — refuse typed, never combine.
+            own_generation = own_gen_box[0]
+            helper_generation = (
+                meta.get("generation") if meta is not None else None
+            )
+            if helper_generation is None:
+                if own_generation is not None:
+                    # Pre-v3 peer (or a Helper without rotation
+                    # machinery): checking is disabled for this peer,
+                    # journaled so the gap is visible.
+                    self.snapshots.note_unchecked(
+                        self._peer_wire_version
+                        if self._peer_envelope else 0
+                    )
+            elif (
+                own_generation is not None
+                and own_generation != helper_generation
+            ):
+                self._c_mismatches.inc()
+                self.snapshots.record_mismatch(
+                    own_generation,
+                    helper_generation,
+                    trace_id=(
+                        trace.trace_id if trace is not None else None
+                    ),
+                )
+                raise SnapshotMismatch(own_generation, helper_generation)
         if meta is not None:
             # Decompose the helper leg: the Helper reports its own
             # server time, the rest of the RTT is the network (plus
@@ -936,6 +1072,28 @@ class LeaderSession(_Session):
     def handle_request(self, request, deadline=None):
         if deadline is None:
             deadline = self._default_deadline()
+        retries = max(0, self._config.snapshot_retries)
+        attempt = 0
+        while True:
+            try:
+                return self._handle_request_once(request, deadline)
+            except SnapshotMismatch:
+                # Typed cross-generation refusal from the handshake:
+                # retry the WHOLE request — the own share re-evaluates
+                # (binding to the post-flip generation once the
+                # pending flip lands at a batch boundary) and the
+                # Helper leg re-runs. Bounded: the coordinator flips
+                # Helper-first/Leader-last, so the window closes as
+                # soon as this party's flip applies.
+                if attempt >= retries:
+                    raise
+                attempt += 1
+                self._c_snapshot_retries.inc()
+                # A breath per attempt: the flip this retry is waiting
+                # on applies on the batcher worker, not this thread.
+                time.sleep(0.002 * attempt)
+
+    def _handle_request_once(self, request, deadline):
         try:
             return super().handle_request(request, deadline)
         except HelperUnavailable:

@@ -587,8 +587,8 @@ def test_old_helper_downgrades_leader_to_bare_proto(recorder):
     assert got == [RECORDS[5], RECORDS[64]]
     assert got2 == [RECORDS[6]]
     assert leader._peer_envelope is False
-    # Stepwise ladder: v2 -> v1 -> bare, one downgrade per fault.
-    assert counters["leader.wire_downgrades"] == 2
+    # Stepwise ladder: v3 -> v2 -> v1 -> bare, one downgrade per fault.
+    assert counters["leader.wire_downgrades"] == 3
     # The probe faults did not consume a retry attempt.
     assert counters["leader.helper_retries"] == 0
     assert counters["leader.helper_failures"] == 0
@@ -610,9 +610,10 @@ def _v1_envelope_only(handler):
 
 
 def test_new_leader_steps_down_to_v1_helper_keeping_spans(recorder):
-    """Decode matrix, new Leader x old (v1-envelope) Helper: exactly one
-    ladder step, and the downgrade drops only the digest — the inner
-    share, server_ms split, and remote spans all survive at v1."""
+    """Decode matrix, new Leader x old (v1-envelope) Helper: two ladder
+    steps (v3 -> v2 -> v1), and the downgrade drops only the generation
+    echo and the digest — the inner share, server_ms split, and remote
+    spans all survive at v1."""
     helper = HelperSession(DATABASE, encrypt_decrypt.decrypt, make_config())
     leader = LeaderSession(
         DATABASE,
@@ -627,7 +628,7 @@ def test_new_leader_steps_down_to_v1_helper_keeping_spans(recorder):
     assert got2 == [RECORDS[6]]
     assert leader._peer_envelope is True  # still an enveloped peer
     assert leader._peer_wire_version == 1  # ...pinned at v1, sticky
-    assert counters["leader.wire_downgrades"] == 1
+    assert counters["leader.wire_downgrades"] == 2
     assert counters["leader.helper_retries"] == 0
     # v1 keeps server_ms + spans, so the remote/network split and the
     # grafted helper.* spans are intact.

@@ -1,0 +1,759 @@
+"""Snapshot rotation tests: versioned builds, the v3 wire generation
+field, the SnapshotManager lifecycle (stage -> flip -> drain-then-free),
+the two-party generation handshake, chaos rotation faults, and the
+flip-atomicity race against concurrent batcher submissions.
+
+The invariant under test everywhere: a response is either computed
+entirely against one database generation, or it is a typed refusal
+(`SnapshotMismatch`) — never a cross-generation XOR, which in the CGKS
+two-server model is well-formed garbage no latency metric would flag.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.observability import (
+    AdminServer,
+    propagation,
+    tracing,
+)
+from distributed_point_functions_tpu.observability.bundle import (
+    BundleManager,
+)
+from distributed_point_functions_tpu.observability.device import (
+    default_telemetry,
+)
+from distributed_point_functions_tpu.observability.events import EventJournal
+from distributed_point_functions_tpu.pir import (
+    DenseDpfPirClient,
+    DenseDpfPirDatabase,
+)
+from distributed_point_functions_tpu.pir.cuckoo_database import (
+    CuckooHashedDpfPirDatabase,
+)
+from distributed_point_functions_tpu.pir.sparse_server import (
+    CuckooHashingSparseDpfPirServer,
+)
+from distributed_point_functions_tpu.prng import xor_bytes
+from distributed_point_functions_tpu.robustness import failpoints
+from distributed_point_functions_tpu.serving import (
+    HelperSession,
+    InProcessTransport,
+    LeaderSession,
+    PlainSession,
+    RotationCoordinator,
+    ServingConfig,
+    SnapshotManager,
+    SnapshotMismatch,
+)
+from distributed_point_functions_tpu.serving.prober import Prober
+from distributed_point_functions_tpu.pir import messages
+from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+NUM_RECORDS = 128
+RECORD_BYTES = 16
+RNG = np.random.default_rng(777)
+
+RECORDS0 = [
+    bytes(RNG.integers(0, 256, RECORD_BYTES, dtype=np.uint8))
+    for _ in range(NUM_RECORDS)
+]
+# Generation 1 differs from generation 0 at EVERY index, so a
+# cross-generation XOR can never accidentally equal either oracle.
+RECORDS1 = [bytes(b ^ 0xA5 for b in r) for r in RECORDS0]
+
+
+def build_db(records):
+    builder = DenseDpfPirDatabase.Builder()
+    for r in records:
+        builder.insert(r)
+    return builder.build()
+
+
+def make_config(**overrides):
+    base = dict(
+        max_batch_size=8,
+        max_wait_ms=2.0,
+        helper_timeout_ms=None,
+        helper_retries=2,
+        helper_backoff_ms=1.0,
+        helper_backoff_max_ms=2.0,
+    )
+    base.update(overrides)
+    return ServingConfig(**base)
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    reg = failpoints.default_failpoints()
+    reg.clear()
+    yield reg
+    reg.clear()
+
+
+def two_party(leader_config=None, helper_config=None):
+    """Leader+Helper sessions over distinct (identical-record) database
+    objects, each with its own SnapshotManager, plus a coordinator."""
+    helper = HelperSession(
+        build_db(RECORDS0),
+        encrypt_decrypt.decrypt,
+        helper_config if helper_config is not None else make_config(),
+    )
+    leader = LeaderSession(
+        build_db(RECORDS0),
+        InProcessTransport(helper.handle_wire),
+        leader_config if leader_config is not None else make_config(),
+    )
+    leader_mgr = SnapshotManager(leader, journal=EventJournal())
+    helper_mgr = SnapshotManager(helper, journal=EventJournal())
+    coordinator = RotationCoordinator(leader_mgr, helper_mgr)
+    return leader, helper, leader_mgr, helper_mgr, coordinator
+
+
+def run_query(leader, indices):
+    client = DenseDpfPirClient.create(NUM_RECORDS, encrypt_decrypt.encrypt)
+    request, state = client.create_request(indices)
+    response = leader.handle_request(request)
+    return client.handle_response(response, state)
+
+
+# ---------------------------------------------------------------------------
+# Builder delta path and generation tags
+# ---------------------------------------------------------------------------
+
+
+def test_build_from_delta_bumps_generation_and_applies_updates():
+    db0 = build_db(RECORDS0)
+    assert db0.generation == 0
+    new3 = bytes(16)
+    db1 = DenseDpfPirDatabase.Builder().update(3, new3).build_from(db0)
+    assert db1.generation == 1
+    assert db1.size == db0.size
+    assert db1.max_value_size == db0.max_value_size
+    # The delta applied; untouched records shared; prev untouched.
+    assert db1.record(3) == new3
+    assert db1.record(5) == RECORDS0[5]
+    assert db0.record(3) == RECORDS0[3]
+    # A second delta chains the tag.
+    db2 = DenseDpfPirDatabase.Builder().update(0, new3).build_from(db1)
+    assert db2.generation == 2 and db2.record(3) == new3
+
+
+def test_build_from_rejects_out_of_bounds_update():
+    db0 = build_db(RECORDS0)
+    with pytest.raises(IndexError, match="out of bounds"):
+        DenseDpfPirDatabase.Builder().update(
+            NUM_RECORDS, b"x"
+        ).build_from(db0)
+
+
+def test_build_from_shares_no_device_stagings():
+    db0 = build_db(RECORDS0)
+    _ = db0.db_words  # stage generation 0
+    db1 = DenseDpfPirDatabase.Builder().update(1, b"y" * 16).build_from(db0)
+    # A delta build copies host bytes but never inherits HBM stagings.
+    assert db1._db_words is None and db1._db_perm is None
+
+
+def test_cuckoo_builder_carries_generation_tag():
+    pairs = [(f"key{i}".encode(), f"value{i}".encode()) for i in range(16)]
+    params = CuckooHashingSparseDpfPirServer.generate_params(
+        len(pairs), seed=b"0123456789abcdef"
+    )
+    builder = CuckooHashedDpfPirDatabase.Builder().set_params(params)
+    for kv in pairs:
+        builder.insert(kv)
+    db = builder.set_generation(7).build()
+    assert db.generation == 7
+    # ...and both backing dense stores wear the same tag (a clone
+    # keeps it for the two-party twin build).
+    assert db.key_database.generation == 7
+    assert db.value_database.generation == 7
+    assert builder.clone().build().generation == 7
+
+
+# ---------------------------------------------------------------------------
+# Wire v3: the generation field
+# ---------------------------------------------------------------------------
+
+
+def test_wire_v3_request_carries_generation():
+    tid = tracing.new_trace_id()
+    wrapped = propagation.encode_request(tid, b"inner", generation=4)
+    got_tid, inner, version, generation = (
+        propagation.try_decode_request_ext(wrapped)
+    )
+    assert (got_tid, inner, version, generation) == (tid, b"inner", 3, 4)
+    # generation 0 and unbound are distinct on the wire (u64 gen+1).
+    _, _, _, g0 = propagation.try_decode_request_ext(
+        propagation.encode_request(tid, b"i", generation=0)
+    )
+    assert g0 == 0
+    _, _, _, unbound = propagation.try_decode_request_ext(
+        propagation.encode_request(tid, b"i", generation=None)
+    )
+    assert unbound is None
+
+
+def test_wire_pre_v3_and_bare_have_no_generation():
+    tid = tracing.new_trace_id()
+    v2 = propagation.encode_request(tid, b"inner", version=2)
+    got_tid, inner, version, generation = (
+        propagation.try_decode_request_ext(v2)
+    )
+    assert (got_tid, inner, version, generation) == (tid, b"inner", 2, None)
+    assert propagation.try_decode_request_ext(b"\x0abare") == (
+        None, b"\x0abare", 0, None,
+    )
+
+
+def test_wire_v3_response_echoes_generation_v2_does_not():
+    tid = tracing.new_trace_id()
+    spans = [{"name": "s", "duration_ms": 1.0}]
+    meta, inner = propagation.try_decode_response(
+        propagation.encode_response(
+            b"r", tid, server_ms=1.0, spans=spans, generation=7
+        )
+    )
+    assert inner == b"r" and meta["generation"] == 7
+    meta2, _ = propagation.try_decode_response(
+        propagation.encode_response(
+            b"r", tid, server_ms=1.0, spans=spans, version=2, generation=7
+        )
+    )
+    assert "generation" not in meta2
+
+
+# ---------------------------------------------------------------------------
+# SnapshotManager lifecycle (single party)
+# ---------------------------------------------------------------------------
+
+
+def test_stage_flip_and_immediate_free():
+    journal = EventJournal()
+    with PlainSession(build_db(RECORDS0), make_config()) as session:
+        manager = SnapshotManager(session, journal=journal)
+        assert session.snapshots is manager
+        assert run_query_plain(session, [3]) == [RECORDS0[3]]
+        db1 = delta_db(session.server.database, RECORDS1)
+        ledger = default_telemetry().transfers
+        h2d_before = ledger.bytes_h2d("db_staging")
+        staged = manager.stage(db1)
+        # Double-buffered: N+1 moved into HBM while N serves.
+        assert staged == int(db1._host_words.nbytes)
+        assert ledger.bytes_h2d("db_staging") - h2d_before >= staged
+        assert manager.staging_generation() == 1
+        record = manager.flip()
+        assert record["to_generation"] == 1
+        assert record["old_freed"] == "immediate"
+        assert manager.serving_generation() == 1
+        assert manager.staging_generation() is None
+        # The flipped-in generation answers; the old stagings are gone.
+        assert run_query_plain(session, [3]) == [RECORDS1[3]]
+        export = manager.export()
+        assert export["flips"] == 1
+        assert export["retired_awaiting_drain"] == []
+        kinds = [e["kind"] for e in journal.export()["events"]]
+        assert "snapshot.flip" in kinds and "snapshot.drained" in kinds
+
+
+def run_query_plain(session, indices):
+    client = DenseDpfPirClient(NUM_RECORDS, lambda pt, info: pt)
+    req0, req1 = client.create_plain_requests(indices)
+    resp0 = session.handle_request(req0)
+    resp1 = session.handle_request(req1)
+    return [
+        xor_bytes(a, b)
+        for a, b in zip(
+            resp0.dpf_pir_response.masked_response,
+            resp1.dpf_pir_response.masked_response,
+        )
+    ]
+
+
+def delta_db(prev, records):
+    builder = DenseDpfPirDatabase.Builder()
+    for i, r in enumerate(records):
+        builder.update(i, r)
+    return builder.build_from(prev)
+
+
+def test_stage_rejects_geometry_mismatch():
+    with PlainSession(build_db(RECORDS0), make_config()) as session:
+        manager = SnapshotManager(session, journal=EventJournal())
+        with pytest.raises(ValueError, match="size"):
+            manager.stage(build_db(RECORDS0[: NUM_RECORDS // 2]))
+
+
+def test_flip_without_staging_raises():
+    with PlainSession(build_db(RECORDS0), make_config()) as session:
+        manager = SnapshotManager(session, journal=EventJournal())
+        with pytest.raises(RuntimeError, match="no staged generation"):
+            manager.flip()
+
+
+def test_pin_holds_flip_off_then_flip_lands():
+    with PlainSession(build_db(RECORDS0), make_config()) as session:
+        manager = SnapshotManager(session, journal=EventJournal())
+        manager.stage(delta_db(session.server.database, RECORDS1))
+        with manager.pin() as gen:
+            assert gen == 0
+            with pytest.raises(TimeoutError):
+                manager.flip(timeout=0.05)
+            # The staged candidate survives a timed-out flip.
+            assert manager.staging_generation() == 1
+            assert manager.serving_generation() == 0
+        manager.flip()
+        assert manager.serving_generation() == 1
+
+
+def test_deferred_free_waits_for_inflight_drain():
+    journal = EventJournal()
+    with PlainSession(build_db(RECORDS0), make_config()) as session:
+        manager = SnapshotManager(session, journal=journal)
+        old_db = session.server.database
+        _ = old_db.db_words  # generation 0 staged and serving
+        manager.stage(delta_db(old_db, RECORDS1))
+        # A batch is in flight against generation 0...
+        gen = manager.begin_batch()
+        assert gen == 0
+        flipped = []
+        t = threading.Thread(
+            target=lambda: flipped.append(manager.flip(timeout=5.0))
+        )
+        t.start()
+        # ...the flip still applies at the next batch boundary (the
+        # old generation is parked, NOT freed — its batch is live)...
+        deadline = 50
+        while manager.serving_generation() != 1 and deadline:
+            manager_gen = manager.begin_batch()
+            manager.end_batch(manager_gen)
+            deadline -= 1
+        assert manager.serving_generation() == 1
+        assert 0 in manager.export()["retired_awaiting_drain"]
+        assert old_db._db_words is not None  # still pinned by the batch
+        # ...and only the last in-flight batch retiring frees it.
+        manager.end_batch(0)
+        t.join(timeout=5.0)
+        assert flipped and flipped[0]["old_freed"] == "deferred"
+        assert manager.export()["retired_awaiting_drain"] == []
+        assert old_db._db_words is None
+        assert manager.export()["flips"] == 1
+        kinds = [e["kind"] for e in journal.export()["events"]]
+        assert "snapshot.drained" in kinds
+
+
+def test_abort_drops_staging_and_keeps_serving():
+    journal = EventJournal()
+    with PlainSession(build_db(RECORDS0), make_config()) as session:
+        manager = SnapshotManager(session, journal=journal)
+        db1 = delta_db(session.server.database, RECORDS1)
+        manager.stage(db1)
+        manager.abort("operator change of heart")
+        assert manager.staging_generation() is None
+        assert manager.serving_generation() == 0
+        assert db1._db_words is None  # staged HBM dropped
+        assert manager.export()["aborts"] == 1
+        kinds = [e["kind"] for e in journal.export()["events"]]
+        assert "snapshot.abort" in kinds
+        assert run_query_plain(session, [9]) == [RECORDS0[9]]
+
+
+# ---------------------------------------------------------------------------
+# Two-party handshake
+# ---------------------------------------------------------------------------
+
+
+def test_rotation_handshake_end_to_end():
+    leader, helper, leader_mgr, helper_mgr, coordinator = two_party()
+    with helper, leader:
+        assert run_query(leader, [3, 99]) == [RECORDS0[3], RECORDS0[99]]
+        report = coordinator.rotate(
+            delta_db(leader.server.database, RECORDS1),
+            delta_db(helper.server.database, RECORDS1),
+        )
+        assert report["to_generation"] == 1
+        assert report["staleness_ms"] >= 0.0
+        assert report["leader_staged_bytes"] > 0
+        assert report["helper_staged_bytes"] > 0
+        assert leader_mgr.serving_generation() == 1
+        assert helper_mgr.serving_generation() == 1
+        # Post-rotation answers are the NEW generation's bits.
+        assert run_query(leader, [3, 99]) == [RECORDS1[3], RECORDS1[99]]
+        # The measured flip window landed on the leader's flip record.
+        assert leader_mgr.export()["history"][-1]["staleness_ms"] is not None
+
+
+def test_cross_generation_answer_is_typed_refusal_never_wrong_xor(tmp_path):
+    bundles = BundleManager(directory=str(tmp_path), cooldown_s=0.0)
+    helper = HelperSession(
+        build_db(RECORDS0), encrypt_decrypt.decrypt, make_config()
+    )
+    leader = LeaderSession(
+        build_db(RECORDS0),
+        InProcessTransport(helper.handle_wire),
+        make_config(snapshot_retries=1),
+    )
+    leader_mgr = SnapshotManager(
+        leader, journal=EventJournal(), bundles=bundles
+    )
+    helper_mgr = SnapshotManager(helper, journal=EventJournal())
+    with helper, leader:
+        # Split-brain: ONLY the helper rotates. The leader must refuse
+        # the echo — a combined answer here would be well-formed
+        # garbage.
+        helper_mgr.stage(delta_db(helper.server.database, RECORDS1))
+        helper_mgr.flip()
+        with pytest.raises(SnapshotMismatch) as excinfo:
+            run_query(leader, [5])
+        assert excinfo.value.leader_generation == 0
+        assert excinfo.value.helper_generation == 1
+        counters = leader.metrics.export()["counters"]
+        # initial attempt + snapshot_retries re-runs, each refused.
+        assert counters["leader.snapshot_mismatches"] == 2
+        assert counters["leader.snapshot_retries"] == 1
+        assert leader_mgr.export()["mismatches"] == 2
+        # The mismatch froze a debug bundle.
+        assert bundles.export()["fired"] >= 1
+
+
+def test_handshake_window_converges_via_retries():
+    leader, helper, leader_mgr, helper_mgr, coordinator = two_party(
+        leader_config=make_config(snapshot_retries=20)
+    )
+    with helper, leader:
+        leader_mgr.stage(delta_db(leader.server.database, RECORDS1))
+        helper_mgr.stage(delta_db(helper.server.database, RECORDS1))
+        helper_mgr.flip()
+        # Hold the leader's flip off (a pin) while a query runs: the
+        # query sees leader@0/helper@1, refuses typed, and retries
+        # until the pin lifts and the leader's armed flip lands at a
+        # batch boundary — the bounded mismatch window, in miniature.
+        pin = leader_mgr.pin()
+        pin.__enter__()
+        flip_thread = threading.Thread(
+            target=lambda: leader_mgr.flip(timeout=10.0)
+        )
+        flip_thread.start()
+        got = []
+        query_thread = threading.Thread(
+            target=lambda: got.append(run_query(leader, [7]))
+        )
+        query_thread.start()
+        import time as _time
+
+        _time.sleep(0.05)
+        pin.__exit__(None, None, None)
+        query_thread.join(timeout=30.0)
+        flip_thread.join(timeout=10.0)
+        assert got == [[RECORDS1[7]]]
+        counters = leader.metrics.export()["counters"]
+        assert counters["leader.snapshot_retries"] >= 1
+        assert leader_mgr.serving_generation() == 1
+
+
+# ---------------------------------------------------------------------------
+# Envelope downgrade matrix (pre-generation peers)
+# ---------------------------------------------------------------------------
+
+
+def _version_capped(handler, max_version):
+    """Wrap a Helper handler as a pre-v3 build: envelopes newer than
+    `max_version` are rejected the way an old peer would."""
+
+    def guard(payload):
+        if payload.startswith(b"\xffDPT") and payload[4] > max_version:
+            raise propagation.EnvelopeError(
+                f"unsupported envelope version {payload[4]}"
+            )
+        return handler(payload)
+
+    return guard
+
+
+def test_v2_peer_costs_one_downgrade_and_journals_check_disabled():
+    helper = HelperSession(
+        build_db(RECORDS0), encrypt_decrypt.decrypt, make_config()
+    )
+    leader = LeaderSession(
+        build_db(RECORDS0),
+        InProcessTransport(_version_capped(helper.handle_wire, 2)),
+        make_config(),
+    )
+    journal = EventJournal()
+    SnapshotManager(leader, journal=journal)
+    with helper, leader:
+        got = run_query(leader, [5, 64])
+        got2 = run_query(leader, [6])
+        counters = leader.metrics.export()["counters"]
+    assert got == [RECORDS0[5], RECORDS0[64]]
+    assert got2 == [RECORDS0[6]]
+    # Exactly ONE counted downgrade (v3 -> v2), sticky.
+    assert counters["leader.wire_downgrades"] == 1
+    assert leader._peer_wire_version == 2
+    assert leader._peer_envelope is True
+    # No generation echo at v2: checking is disabled-but-journaled,
+    # and never raises.
+    assert counters.get("leader.snapshot_mismatches", 0) == 0
+    kinds = [e["kind"] for e in journal.export()["events"]]
+    assert "snapshot.check_disabled" in kinds
+
+
+def test_pre_generation_leader_interops_with_v3_helper():
+    # helper_digest=False pins the Leader at v1 — indistinguishable
+    # from an old build. The rotation-aware Helper must answer it in
+    # v1, generation-free, with zero downgrades.
+    helper = HelperSession(
+        build_db(RECORDS0), encrypt_decrypt.decrypt, make_config()
+    )
+    SnapshotManager(helper, journal=EventJournal())
+    replies = []
+
+    def capture(payload):
+        out = helper.handle_wire(payload)
+        replies.append(out)
+        return out
+
+    leader = LeaderSession(
+        build_db(RECORDS0),
+        InProcessTransport(capture),
+        make_config(helper_digest=False),
+    )
+    with helper, leader:
+        got = run_query(leader, [8])
+    assert got == [RECORDS0[8]]
+    assert leader.metrics.export()["counters"]["leader.wire_downgrades"] == 0
+    assert replies and replies[-1][4] == 1  # answered v1
+    meta, inner = propagation.try_decode_response(replies[-1])
+    assert inner and "generation" not in meta
+
+
+# ---------------------------------------------------------------------------
+# Chaos: rotation faults are crash-safe (N keeps serving, bit-identical)
+# ---------------------------------------------------------------------------
+
+
+def _assert_both_on_generation_zero(leader, leader_mgr, helper_mgr):
+    assert leader_mgr.serving_generation() == 0
+    assert helper_mgr.serving_generation() == 0
+    assert leader_mgr.staging_generation() is None
+    assert helper_mgr.staging_generation() is None
+    assert run_query(leader, [11]) == [RECORDS0[11]]
+
+
+def test_stage_fault_aborts_rotation(clean_failpoints):
+    leader, helper, leader_mgr, helper_mgr, coordinator = two_party()
+    clean_failpoints.arm("snapshot.stage", "error", times=1)
+    with helper, leader:
+        with pytest.raises(failpoints.FailpointError):
+            coordinator.rotate(
+                delta_db(leader.server.database, RECORDS1),
+                delta_db(helper.server.database, RECORDS1),
+            )
+        _assert_both_on_generation_zero(leader, leader_mgr, helper_mgr)
+        assert leader_mgr.export()["aborts"] == 1
+
+
+def test_helper_ack_fault_drops_both_stagings(clean_failpoints):
+    leader, helper, leader_mgr, helper_mgr, coordinator = two_party()
+    clean_failpoints.arm("snapshot.helper_ack", "error", times=1)
+    db1_l = delta_db(leader.server.database, RECORDS1)
+    db1_h = delta_db(helper.server.database, RECORDS1)
+    with helper, leader:
+        with pytest.raises(failpoints.FailpointError):
+            coordinator.rotate(db1_l, db1_h)
+        _assert_both_on_generation_zero(leader, leader_mgr, helper_mgr)
+        # Both staged HBM buffers were dropped by the abort.
+        assert db1_l._db_words is None and db1_h._db_words is None
+        # A second, un-faulted rotation succeeds from the clean state.
+        report = coordinator.rotate(
+            delta_db(leader.server.database, RECORDS1),
+            delta_db(helper.server.database, RECORDS1),
+        )
+        assert report["to_generation"] == 1
+        assert run_query(leader, [11]) == [RECORDS1[11]]
+
+
+def test_flip_fault_before_any_commit_is_crash_safe(clean_failpoints):
+    # The first flip() call in rotate() is the HELPER's (helper-first
+    # order): a fault there must leave BOTH parties on N.
+    leader, helper, leader_mgr, helper_mgr, coordinator = two_party()
+    clean_failpoints.arm("snapshot.flip", "error", times=1)
+    with helper, leader:
+        with pytest.raises(failpoints.FailpointError):
+            coordinator.rotate(
+                delta_db(leader.server.database, RECORDS1),
+                delta_db(helper.server.database, RECORDS1),
+            )
+        _assert_both_on_generation_zero(leader, leader_mgr, helper_mgr)
+
+
+def test_flip_delay_fault_only_stretches_the_window(clean_failpoints):
+    leader, helper, leader_mgr, helper_mgr, coordinator = two_party()
+    clean_failpoints.arm("snapshot.flip", "delay", times=1, delay_ms=30)
+    with helper, leader:
+        report = coordinator.rotate(
+            delta_db(leader.server.database, RECORDS1),
+            delta_db(helper.server.database, RECORDS1),
+        )
+        # The injected delay landed inside the measured window.
+        assert report["staleness_ms"] >= 0.0
+        assert run_query(leader, [2]) == [RECORDS1[2]]
+
+
+# ---------------------------------------------------------------------------
+# Flip atomicity: rotation racing concurrent batcher submissions
+# ---------------------------------------------------------------------------
+
+
+def test_flip_never_tears_under_concurrent_submissions():
+    indices = [1, 7]
+    client = DenseDpfPirClient(NUM_RECORDS, lambda pt, info: pt)
+    req0, req1 = client.create_plain_requests(indices)
+    combined = messages.PirRequest(
+        plain_request=messages.PlainRequest(
+            dpf_keys=list(req0.plain_request.dpf_keys)
+            + list(req1.plain_request.dpf_keys)
+        )
+    )
+    oracle = {
+        0: [RECORDS0[i] for i in indices],
+        1: [RECORDS1[i] for i in indices],
+    }
+    with PlainSession(build_db(RECORDS0), make_config()) as session:
+        manager = SnapshotManager(session, journal=EventJournal())
+        # Warm the serving path before racing it.
+        session.handle_request(combined)
+        tears = []
+        generations_seen = set()
+        stop = threading.Event()
+
+        def worker():
+            while not stop.is_set():
+                resp = session.handle_request(combined)
+                masked = resp.dpf_pir_response.masked_response
+                k = len(indices)
+                got = [
+                    xor_bytes(masked[i], masked[k + i]) for i in range(k)
+                ]
+                matches = [g for g, want in oracle.items() if got == want]
+                if len(matches) != 1:
+                    tears.append(got)
+                    return
+                generations_seen.add(matches[0])
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        old_db = session.server.database
+        db1 = delta_db(old_db, RECORDS1)
+        ledger = default_telemetry().transfers
+        h2d_before = ledger.bytes_h2d("db_staging")
+        staged = manager.stage(db1)
+        assert staged > 0
+        assert ledger.bytes_h2d("db_staging") - h2d_before >= staged
+        manager.flip(timeout=10.0)
+        # Let post-flip traffic run, then quiesce.
+        import time as _time
+
+        _time.sleep(0.1)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+        # Every response was bit-identical to exactly ONE generation's
+        # oracle — no batch ever evaluated half-and-half.
+        assert tears == []
+        assert 1 in generations_seen  # post-flip answers observed
+        # The last in-flight batch's end_batch runs just after its
+        # waiters release; give the drain a moment to land.
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline:
+            export = manager.export()
+            if (
+                export["inflight"] == {}
+                and export["retired_awaiting_drain"] == []
+            ):
+                break
+            _time.sleep(0.01)
+        # Drain counters back to zero, the old generation fully freed.
+        assert export["inflight"] == {}
+        assert export["retired_awaiting_drain"] == []
+        assert old_db._db_words is None
+        hbm = default_telemetry().hbm.export()
+        assert "db_staging" in hbm["watermark_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# Prober golden rotation
+# ---------------------------------------------------------------------------
+
+
+def test_prober_rotates_goldens_with_the_flip():
+    journal = EventJournal()
+    with PlainSession(build_db(RECORDS0), make_config()) as session:
+        manager = SnapshotManager(session, journal=journal)
+        prober = Prober(
+            session,
+            RECORDS0,
+            indices=[0, 64, 127],
+            journal=journal,
+            period_s=60.0,
+        )
+        prober.bind_snapshots(
+            manager, records_provider=lambda gen: RECORDS1
+        )
+        for result in prober.run_cycle():
+            assert result["status"] == "pass", result
+        manager.stage(delta_db(session.server.database, RECORDS1))
+        manager.flip()
+        # The flip listener re-keyed the goldens to generation 1: the
+        # next cycle still proves bit-identity (against the NEW bits).
+        for result in prober.run_cycle():
+            assert result["status"] == "pass", result
+        assert prober.export()["generation"] == 1
+        kinds = [e["kind"] for e in journal.export()["events"]]
+        assert "prober.goldens_rotated" in kinds
+
+
+def test_prober_rejects_wrong_size_golden_rotation():
+    with PlainSession(build_db(RECORDS0), make_config()) as session:
+        SnapshotManager(session, journal=EventJournal())
+        prober = Prober(session, RECORDS0, period_s=60.0)
+        with pytest.raises(ValueError, match="database size"):
+            prober.rotate_goldens(RECORDS1[:10])
+
+
+# ---------------------------------------------------------------------------
+# /statusz surface
+# ---------------------------------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_statusz_snapshot_section():
+    with PlainSession(build_db(RECORDS0), make_config()) as session:
+        manager = SnapshotManager(session, journal=EventJournal())
+        manager.stage(delta_db(session.server.database, RECORDS1))
+        manager.flip()
+        with AdminServer(
+            registry=session.metrics, snapshots=manager
+        ) as admin:
+            base = f"http://127.0.0.1:{admin.port}"
+            status, body = _get(f"{base}/statusz?format=json")
+            assert status == 200
+            state = json.loads(body)
+            snap = state["snapshots"]
+            assert snap["serving_generation"] == 1
+            assert snap["flips"] == 1
+            assert snap["history"][-1]["to_generation"] == 1
+            status, html = _get(f"{base}/statusz")
+            assert status == 200
+            assert "<h2>Snapshots</h2>" in html
+            assert "serving generation 1" in html
