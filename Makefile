@@ -31,6 +31,7 @@ test-fast:     ## ~8 min hermetic signal incl. core invariants + tiny Pallas
 	    tests/test_snapshots.py \
 	    tests/test_capacity.py tests/test_overload.py \
 	    tests/test_heavy_hitters.py tests/test_incremental_reuse.py \
+	    tests/test_mesh_serving.py \
 	    tests/test_pallas_fast.py tests/test_bench_ladder.py -q
 
 protos:        ## regenerate *_pb2.py from protos/*.proto

@@ -58,10 +58,16 @@
 #                    admission prices with bit-identical responses,
 #                    and fully revert under the recalibration kill
 #                    switch
-#  13. perf-gate   — benchmarks/regression_gate.py --check-only against
+#  13. shard-smoke — pod-scale mesh serving end to end on 8 forced
+#                    host devices: closed-loop traffic against one
+#                    logical server spread over a 2-D (shard x key)
+#                    mesh, one snapshot rotation mid-traffic, zero
+#                    prober failures, no cross-generation reads, and
+#                    the per-shard staging visible in mesh_export
+#  14. perf-gate   — benchmarks/regression_gate.py --check-only against
 #                    the committed history fixture (CPU-safe: judges
 #                    records, runs no bench)
-#  14. dryrun      — 8-virtual-device multichip compile+step
+#  15. dryrun      — 8-virtual-device multichip compile+step
 # Benchmarks are excluded exactly as the reference excludes
 # `--test_tag_filters=-benchmark`. `FULL=1` appends the whole suite.
 set -u -o pipefail
@@ -827,6 +833,123 @@ print("rotation-smoke: OK (2 rotations under load: staleness "
       f"armed, dip {dip_pct:.0f}% of {base_qps:.0f} q/s baseline, "
       f"recovery {rec_qps:.0f} q/s, {completed} completed, 0 torn, "
       "prober bit-identical on generation 2)")
+'
+
+# --- shard-smoke: one logical Leader/Helper party served from a 2-D
+# device mesh (4 database shards x 2 key lanes over 8 forced host
+# devices), closed-loop traffic, one snapshot rotation at a batch
+# boundary mid-traffic. Proves the PR 13 contract: every response is
+# bit-identical to one generation's oracle (the 0xA5 mask makes a
+# cross-generation mix match neither), the blackbox prober stays green
+# through the flip with goldens rotating, and the flipped-to staging
+# is fully sharded (all shards generation N+1, never a partial flip).
+stage shard-smoke env JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 python -c '
+import threading, time
+import numpy as np
+import jax
+from distributed_point_functions_tpu.parallel.sharded import make_mesh2d
+from distributed_point_functions_tpu.pir import (
+    DenseDpfPirClient, DenseDpfPirDatabase,
+)
+from distributed_point_functions_tpu.prng import xor_bytes
+from distributed_point_functions_tpu.serving import (
+    PlainSession, ServingConfig, SnapshotManager,
+)
+from distributed_point_functions_tpu.serving.prober import Prober
+
+assert len(jax.devices()) == 8, jax.devices()
+NUM, NBYTES = 512, 16
+rng = np.random.default_rng(13)
+base = [bytes(rng.integers(0, 256, NBYTES, dtype=np.uint8))
+        for _ in range(NUM)]
+recs = {0: base, 1: [bytes(b ^ 0xA5 for b in r) for r in base]}
+
+def build(records):
+    b = DenseDpfPirDatabase.Builder()
+    for r in records:
+        b.insert(r)
+    return b.build()
+
+def delta(prev, records):
+    b = DenseDpfPirDatabase.Builder()
+    for i, r in enumerate(records):
+        b.update(i, r)
+    return b.build_from(prev)
+
+mesh = make_mesh2d(4, 2)
+config = ServingConfig(max_batch_size=4, max_wait_ms=1.0)
+client = DenseDpfPirClient(NUM, lambda pt, info: pt)
+lock = threading.Lock()
+stats = {"completed": 0, "torn": 0}
+stop = threading.Event()
+
+with PlainSession(build(recs[0]), config, mesh=mesh) as session:
+    mgr = SnapshotManager(session)
+    prober = Prober(session, recs[0], period_s=0.1, indices=[0, 7, 501])
+    prober.bind_snapshots(mgr, records_provider=lambda g: recs[g])
+
+    def query(indices):
+        r0, r1 = client.create_plain_requests(indices)
+        a = session.handle_request(r0).dpf_pir_response.masked_response
+        b = session.handle_request(r1).dpf_pir_response.masked_response
+        return [xor_bytes(x, y) for x, y in zip(a, b)]
+
+    # Warm every mesh jit bucket traffic and probes can form, so the
+    # flip below lands at a fast steady-state batch boundary instead of
+    # queueing behind a cold multi-device compile.
+    assert query([3])[0] == recs[0][3]
+    query([3, 500])
+    query([3, 500, 7, 101])
+    assert session.server._mesh_plan is not None, \
+        "mesh server fell back to single-device"
+    assert all(r["status"] == "pass" for r in prober.run_cycle())
+
+    def worker(tid):
+        i = tid
+        while not stop.is_set():
+            idx = (7 * i) % NUM
+            i += 2
+            got = query([idx])[0]
+            with lock:
+                stats["completed"] += 1
+                if not any(got == r[idx] for r in recs.values()):
+                    stats["torn"] += 1
+            stop.wait(0.01)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(2)]
+    for t in threads:
+        t.start()
+    with prober:
+        time.sleep(0.5)
+        staged = mgr.stage(delta(session.server.database, recs[1]))
+        assert staged > 0, "mesh staging transferred nothing"
+        mgr.flip(timeout=60.0)
+        time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30.0)
+    # Goldens rotated with the flip: the prober stays green on gen 1.
+    results = prober.run_cycle()
+    assert all(r["status"] == "pass" for r in results), results
+    export = prober.export()
+    assert export["mismatches"] == 0 and export["errors"] == 0, export
+    snap = mgr.export()
+    assert snap["serving_generation"] == 1 and snap["flips"] == 1, snap
+    assert stats["torn"] == 0 and stats["completed"] > 0, stats
+    assert query([3])[0] == recs[1][3]
+    info = session.server.mesh_export()
+    assert info["staging"]["generation"] == 1, info["staging"]
+    # One row per device: 4 chunk shards x 2 key-axis replicas.
+    per_dev = info["staging"]["shards"]
+    assert len(per_dev) == 8, info["staging"]
+    assert len({(s["chunk_start"], s["chunk_stop"]) for s in per_dev}) == 4
+    assert session.server._mesh_plan is not None, "fell back post-flip"
+    completed = stats["completed"]
+print("shard-smoke: OK (mesh 4x2 over 8 forced devices, 1 rotation "
+      f"under load, {completed} completed, 0 torn, prober green on "
+      "generation 1, staging sharded 4-ways)")
 '
 
 stage perf-gate python -m benchmarks.regression_gate --check-only \

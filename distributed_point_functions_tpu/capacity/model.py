@@ -354,6 +354,80 @@ class CapacityModel:
         # priced device-ms (see capacity/recalibrate.py). None (the
         # default, and the kill-switch end state) prices raw.
         self._correction: Optional[Callable[[str, int], float]] = None
+        # Serving-mesh shape (shard_devices, key_devices), or None for
+        # single-device pricing. serving/ configures this when a session
+        # binds a 2-D mesh so admission and brownout price per-shard
+        # work and per-mesh throughput without any of their own changes.
+        self._mesh_shape: Optional[tuple] = None
+
+    # -- serving mesh --------------------------------------------------------
+
+    def configure_mesh(
+        self,
+        shard_devices: Optional[int],
+        key_devices: Optional[int] = None,
+    ) -> None:
+        """Declare the serving mesh shape (None clears it). With a mesh
+        configured, byte prices are per shard — each device holds only
+        its chunk span of the cut state — and throughput prefers the
+        calibrated multi-device `serving_qps_{ndev}dev` metric."""
+        if shard_devices is None:
+            self._mesh_shape = None
+            return
+        self._mesh_shape = (int(shard_devices), int(key_devices or 1))
+
+    @property
+    def mesh_shape(self) -> Optional[tuple]:
+        return self._mesh_shape
+
+    def mesh_device_count(self) -> int:
+        if self._mesh_shape is None:
+            return 1
+        return self._mesh_shape[0] * self._mesh_shape[1]
+
+    def mesh_selection_budget_bytes(self) -> int:
+        """Per-mesh selection budget: every device brings its own HBM
+        slice, so the mesh-wide budget is the per-device budget times
+        the device count (per-shard peaks are still gated against the
+        per-device `selection_budget_bytes`)."""
+        return self.selection_budget_bytes() * self.mesh_device_count()
+
+    def streaming_selection_bytes_per_shard(
+        self, num_keys: int, cut_levels: int, chunk_levels: int
+    ) -> int:
+        """Per-device streaming peak on the mesh: each device expands
+        its key slice down to the cut, keeps only its span of cut-state
+        lanes, and double-buffers one chunk's selections."""
+        if self._mesh_shape is None:
+            return self.streaming_selection_bytes(
+                num_keys, cut_levels, chunk_levels
+            )
+        shards, key_devices = self._mesh_shape
+        local_keys = -(-num_keys // max(1, key_devices))
+        local_lanes = max(1, (1 << cut_levels) // max(1, shards))
+        return local_keys * _SELECTION_BLOCK_BYTES * (
+            local_lanes + 2 * (1 << chunk_levels)
+        )
+
+    def mesh_pir_bytes_per_shard(
+        self, num_keys: int, num_blocks: int
+    ) -> int:
+        """Modeled per-device HBM peak for one mesh-served PIR batch
+        (streaming split re-derived the way the serving plan derives
+        it: cut covers at least the shard axis)."""
+        if self._mesh_shape is None:
+            return self.materialized_selection_bytes(num_keys, num_blocks)
+        shards, key_devices = self._mesh_shape
+        expand = max(0, (num_blocks - 1).bit_length())
+        s_levels = max(0, (shards - 1).bit_length())
+        local_keys = -(-num_keys // max(1, key_devices))
+        chunk = min(
+            self.pick_streaming_split(local_keys, expand),
+            max(0, expand - s_levels),
+        )
+        return self.streaming_selection_bytes_per_shard(
+            num_keys, expand - chunk, chunk
+        )
 
     def set_correction_provider(
         self, provider: Optional[Callable[[str, int], float]]
@@ -521,7 +595,22 @@ class CapacityModel:
 
     def serving_queries_per_sec(self) -> float:
         """Calibrated end-to-end serving throughput (queries/s) — the
-        denominator of admission's queue-drain estimate."""
+        denominator of admission's queue-drain estimate.
+
+        With a mesh configured: the calibrated
+        `serving_qps_{ndev}dev` record wins when one exists; otherwise
+        the single-device calibration scales by the device count (the
+        near-linear-scaling prior, replaced the first time the
+        multi-device bench stage lands a record)."""
+        ndev = self.mesh_device_count()
+        if ndev > 1:
+            value = self.calibration.lookup(f"serving_qps_{ndev}dev")
+            if value is not None:
+                return value
+            return ndev * self.calibration.throughput(
+                _SERVING_QPS_METRIC,
+                _FALLBACK_THROUGHPUT[_SERVING_QPS_METRIC],
+            )
         return self.calibration.throughput(
             _SERVING_QPS_METRIC,
             _FALLBACK_THROUGHPUT[_SERVING_QPS_METRIC],
@@ -540,12 +629,16 @@ class CapacityModel:
         is known (the most HBM-hungry tier the planner could pick);
         device-ms comes from calibrated serving throughput."""
         qps = max(1e-6, self.serving_queries_per_sec())
+        if not num_blocks:
+            bytes_peak = 0
+        elif self._mesh_shape is not None:
+            bytes_peak = self.mesh_pir_bytes_per_shard(num_keys, num_blocks)
+        else:
+            bytes_peak = self.materialized_selection_bytes(
+                num_keys, num_blocks
+            )
         return WorkCost(
-            bytes_peak=(
-                self.materialized_selection_bytes(num_keys, num_blocks)
-                if num_blocks
-                else 0
-            ),
+            bytes_peak=bytes_peak,
             device_ms=self._corrected("pir", num_keys, num_keys * 1e3 / qps),
             quantity=num_keys,
             unit="pir_keys",
@@ -574,7 +667,7 @@ class CapacityModel:
 
     def export(self) -> dict:
         """The /statusz view of the model."""
-        return {
+        out = {
             "device_memory_bytes": self._device_memory,
             "selection_budget_bytes": self.selection_budget_bytes(),
             "frontier_budget_bytes": self.frontier_budget_bytes(),
@@ -584,6 +677,16 @@ class CapacityModel:
             "hh_lanes_per_sec": round(self.hh_lanes_per_sec(), 2),
             "calibration": self.calibration.export(),
         }
+        if self._mesh_shape is not None:
+            out["mesh"] = {
+                "shard_devices": self._mesh_shape[0],
+                "key_devices": self._mesh_shape[1],
+                "devices": self.mesh_device_count(),
+                "mesh_selection_budget_bytes": (
+                    self.mesh_selection_budget_bytes()
+                ),
+            }
+        return out
 
 
 _default_model: Optional[CapacityModel] = None

@@ -122,6 +122,7 @@ class AdminServer:
         critical=None,
         capacity=None,
         snapshots=None,
+        mesh=None,
     ):
         self._registry = registry
         self._recorder = (
@@ -180,6 +181,12 @@ class AdminServer:
         # refcounts and flip history) and opt-in; /statusz grows a
         # "Snapshots" section when present.
         self._snapshots = snapshots
+        # mesh is the pod-scale serving export: a zero-arg callable (a
+        # `DenseDpfPirServer.mesh_export` bound method) or anything
+        # with `export() -> dict` — mesh shape, plan, per-shard staging
+        # bytes/copies and HBM watermarks. Opt-in; /statusz grows a
+        # "Mesh" section when present.
+        self._mesh = mesh
         self._name = name
         self._profile_dir = profile_dir
         self._profile_lock = threading.Lock()
@@ -200,6 +207,8 @@ class AdminServer:
                 bundles.add_source("capacity", capacity.export)
             if snapshots is not None:
                 bundles.add_source("snapshots", snapshots.export)
+            if mesh is not None:
+                bundles.add_source("mesh", self._mesh_state)
         outer = self
 
         class _Handler(http.server.BaseHTTPRequestHandler):
@@ -257,6 +266,12 @@ class AdminServer:
 
     def _uptime_s(self) -> float:
         return round(time.monotonic() - self._started_mono, 1)
+
+    def _mesh_state(self) -> Optional[dict]:
+        if self._mesh is None:
+            return None
+        source = getattr(self._mesh, "export", self._mesh)
+        return source() if callable(source) else None
 
     def _route(self, handler) -> None:
         parsed = urllib.parse.urlsplit(handler.path)
@@ -658,6 +673,7 @@ class AdminServer:
                 if self._snapshots is not None
                 else None
             ),
+            "mesh": self._mesh_state(),
             "prober": (
                 self._prober.export()
                 if self._prober is not None
@@ -1012,6 +1028,74 @@ def _render_statusz(state: dict) -> str:
             out.append("</table>")
         else:
             out.append("<p class=nodata>no rotations yet</p>")
+
+    mesh = state.get("mesh")
+    if mesh is not None:
+        out.append("<h2>Mesh</h2>")
+        if not mesh.get("configured"):
+            out.append("<p class=nodata>no device mesh configured</p>")
+        else:
+            shape = mesh.get("shape") or {}
+            shape_txt = " &times; ".join(
+                f"{esc(str(axis))}={n}" for axis, n in shape.items()
+            ) or "-"
+            fallback = mesh.get("fallback_error")
+            cls = "breach" if fallback else "ok"
+            out.append(
+                f"<p class={cls}>{mesh.get('devices')} devices, "
+                f"{shape_txt}"
+                + (
+                    f"; FALLBACK to single-device: {esc(str(fallback))}"
+                    if fallback
+                    else ""
+                )
+                + "</p>"
+            )
+            plan = mesh.get("plan")
+            if plan is not None:
+                scratch = plan.get("scratch") or {}
+                out.append(
+                    f"<p>plan: walk {plan.get('walk_levels')} + cut "
+                    f"{plan.get('cut_levels')} + chunk "
+                    f"{plan.get('chunk_levels')} levels, ip "
+                    f"{esc(str(plan.get('ip')))}; requests: "
+                    f"{plan.get('requests')}; donated scratch: "
+                    f"{'on' if plan.get('donate') else 'OFF'} "
+                    f"(staged {scratch.get('staged_copies')}, reused "
+                    f"{scratch.get('reuses')})</p>"
+                )
+            staging = mesh.get("staging")
+            if staging is None:
+                out.append("<p class=nodata>no mesh-staged database</p>")
+            else:
+                out.append(
+                    f"<p>generation {staging.get('generation')}: "
+                    f"{staging.get('num_chunks')} chunks over "
+                    f"{staging.get('num_shards')} shards, "
+                    f"{_fmt_bytes(staging.get('total_bytes', 0))} in "
+                    f"{staging.get('copies')} staging copies</p>"
+                )
+                out.append(
+                    "<table><tr><th>device</th><th>chunks</th>"
+                    "<th>staged bytes</th><th>staging copies</th>"
+                    "<th>HBM watermark</th></tr>"
+                )
+                for shard in staging.get("shards", ()):
+                    watermark = shard.get("hbm_watermark_bytes")
+                    out.append(
+                        f"<tr><td>{shard.get('device')}</td>"
+                        f"<td>[{shard.get('chunk_start')}, "
+                        f"{shard.get('chunk_stop')})</td>"
+                        f"<td>{_fmt_bytes(shard.get('bytes', 0))}</td>"
+                        f"<td>{shard.get('copies')}</td>"
+                        f"<td>"
+                        + (
+                            "-" if watermark is None
+                            else _fmt_bytes(watermark)
+                        )
+                        + "</td></tr>"
+                    )
+                out.append("</table>")
 
     waterfall = state.get("phases") or {}
     out.append("<h2>Phase waterfall</h2>")

@@ -1,13 +1,17 @@
 """Multi-chip scale-out over a `jax.sharding.Mesh` (ICI/DCN collectives)."""
 
 from .sharded import (
+    ShardedServingPlan,
     make_mesh,
+    make_mesh2d,
     sharded_dense_pir_step,
     sharded_inner_product,
 )
 
 __all__ = [
+    "ShardedServingPlan",
     "make_mesh",
+    "make_mesh2d",
     "sharded_dense_pir_step",
     "sharded_inner_product",
 ]

@@ -25,7 +25,9 @@ the sharding checker (`check_vma`) at its default (on).
 
 from __future__ import annotations
 
-
+import os
+import threading
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -623,3 +625,308 @@ def sharded_dense_pir_step_streaming(
         return _xor_combine(partials, mesh)
 
     return run
+
+
+def make_mesh2d(
+    shard_devices: int | None = None,
+    key_devices: int | None = None,
+    *,
+    axis_names: tuple[str, str] = ("shard", "key"),
+) -> Mesh:
+    """2-D serving mesh: database-shard axis x key-batch axis.
+
+    Row-major over `jax.devices()`, so the key axis is the fast
+    (ICI-near) one. Leaving one count `None` derives it from the device
+    count; leaving both defaults to all devices on the shard axis.
+    """
+    devices = jax.devices()
+    n = len(devices)
+    if shard_devices is None and key_devices is None:
+        shard_devices, key_devices = n, 1
+    elif shard_devices is None:
+        _check_divisible("device count", n, key_devices)
+        shard_devices = n // key_devices
+    elif key_devices is None:
+        _check_divisible("device count", n, shard_devices)
+        key_devices = n // shard_devices
+    if shard_devices < 1 or key_devices < 1:
+        raise ValueError("mesh axes must be >= 1 device each")
+    need = shard_devices * key_devices
+    if need > n:
+        raise ValueError(
+            f"mesh {shard_devices}x{key_devices} needs {need} devices, "
+            f"have {n}"
+        )
+    grid = np.array(devices[:need]).reshape(shard_devices, key_devices)
+    return Mesh(grid, tuple(axis_names))
+
+
+class ScratchPool:
+    """Device-resident selection-scratch buffers recycled across requests.
+
+    The serving entry point takes a zeroed scratch accumulator as its
+    donated argument and returns a re-zeroed buffer alongside the result;
+    the pool hands the returned buffer to the next same-shape request, so
+    steady-state serving stages the scratch exactly once per shape
+    instead of once per request. `DPF_TPU_DONATE=0` (or enabled=False)
+    disables recycling — every request stages a fresh copy — which is the
+    control arm the donation test and bench history measure against.
+    """
+
+    def __init__(self, mesh: Mesh, enabled: bool = True):
+        self._mesh = mesh
+        self._enabled = bool(enabled)
+        self._cache: dict[tuple, jnp.ndarray] = {}
+        self._lock = threading.Lock()
+        self.staged_copies = 0
+        self.reuses = 0
+
+    def take(self, shape) -> jnp.ndarray:
+        key = tuple(int(s) for s in shape)
+        if self._enabled:
+            with self._lock:
+                buf = self._cache.pop(key, None)
+            if buf is not None:
+                self.reuses += 1
+                return buf
+        from ..observability.device import default_telemetry
+
+        buf = default_telemetry().transfers.device_put(
+            np.zeros(key, np.uint32),
+            NamedSharding(self._mesh, P()),
+            phase="selection_scratch",
+        )
+        self.staged_copies += 1
+        return buf
+
+    def put(self, buf) -> None:
+        if not self._enabled:
+            return
+        key = tuple(int(s) for s in buf.shape)
+        with self._lock:
+            self._cache[key] = buf
+
+    def export(self) -> dict:
+        with self._lock:
+            shapes = sorted(self._cache)
+        return {
+            "enabled": self._enabled,
+            "staged_copies": int(self.staged_copies),
+            "reuses": int(self.reuses),
+            "cached_shapes": [list(s) for s in shapes],
+        }
+
+
+class ShardedServingPlan:
+    """One jitted serving step over a 2-D mesh (shard axis x key axis).
+
+    The streaming expand->inner-product scan runs per device on its
+    (chunk-span x key-slice) tile: keys arrive pre-partitioned over the
+    key axis (`stage_keys`), database chunks pre-partitioned over the
+    shard axis (`DenseDpfPirDatabase.streaming_chunks(mesh=...)`), so
+    dispatch never relayouts on the host. Per-device partials XOR-combine
+    to a replicated result inside the jit.
+
+    The selection scratch is donated (`donate_argnums`): the entry takes
+    a zeroed accumulator, folds it into the combine, and returns a
+    re-zeroed buffer that the `ScratchPool` recycles into the next
+    request — ROADMAP 3a's donation win, measurable as
+    `selection_scratch` copies in the TransferLedger.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        *,
+        walk_levels: int,
+        cut_levels: int,
+        chunk_levels: int,
+        ip: str = "jnp",
+        interpret: bool = False,
+        donate: bool | None = None,
+    ):
+        axis_names = tuple(mesh.axis_names)
+        if len(axis_names) != 2:
+            raise ValueError(
+                f"ShardedServingPlan needs a 2-D mesh, got axes "
+                f"{axis_names}"
+            )
+        self.mesh = mesh
+        self.shard_axis, self.key_axis = axis_names
+        self.num_shards = int(mesh.shape[self.shard_axis])
+        self.num_key_devices = int(mesh.shape[self.key_axis])
+        self.walk_levels = int(walk_levels)
+        self.cut_levels = int(cut_levels)
+        self.chunk_levels = int(chunk_levels)
+        self.num_chunks = 1 << self.cut_levels
+        _check_divisible("num_chunks", self.num_chunks, self.num_shards)
+        self.ip = ip
+        self.bitmajor = ip == "pallas2"
+        if donate is None:
+            donate = os.environ.get("DPF_TPU_DONATE", "1") != "0"
+        self.donate = bool(donate)
+        self.scratch = ScratchPool(mesh, enabled=self.donate)
+        self.requests = 0
+        self._levels = self.walk_levels + self.cut_levels + self.chunk_levels
+        # CPU/XLA may decline the replicated-scratch alias; the pool's
+        # recycling still holds, so the warning is noise.
+        warnings.filterwarnings(
+            "ignore", message=".*donated buffers were not usable.*"
+        )
+        self._entry = self._build(interpret)
+
+    def _build(self, interpret: bool):
+        from ..pir.dense_eval_planes_v2 import (
+            _packed_levels,
+            _pad_keys32,
+            pack_key_planes_kg,
+            streaming_cut_state,
+            streaming_scan_accumulate,
+        )
+
+        mesh = self.mesh
+        sa, ka = self.shard_axis, self.key_axis
+        nc_local = self.num_chunks // self.num_shards
+        walk_levels, cut_levels = self.walk_levels, self.cut_levels
+        levels, ip = self._levels, self.ip
+
+        def step(seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
+                 db_chunks_shard):
+            nk = seeds0.shape[0]  # local key-slice size
+            seeds0, control0, cw_seeds, cw_left, cw_right, last_vc = (
+                _pad_keys32(
+                    seeds0, control0, cw_seeds, cw_left, cw_right, last_vc
+                )
+            )
+            state, ctrl = streaming_cut_state(
+                seeds0,
+                control0,
+                cw_seeds,
+                cw_left,
+                cw_right,
+                walk_levels=walk_levels,
+                cut_levels=cut_levels,
+            )
+            idx = lax.axis_index(sa)
+            state = lax.dynamic_slice_in_dim(
+                state, idx * nc_local, nc_local, axis=-1
+            )
+            ctrl = lax.dynamic_slice_in_dim(
+                ctrl, idx * nc_local, nc_local, axis=-1
+            )
+            tail_cwp, tail_cwl, tail_cwr = _packed_levels(
+                cw_seeds, cw_left, cw_right, walk_levels + cut_levels,
+                levels,
+            )
+            acc = streaming_scan_accumulate(
+                state,
+                ctrl,
+                db_chunks_shard,
+                tail_cwp,
+                tail_cwl,
+                tail_cwr,
+                pack_key_planes_kg(last_vc),
+                ip=ip,
+                interpret=interpret,
+                vma=(sa, ka),
+            )
+            return acc[None, :nk]
+
+        shard_mapped = shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(
+                P(ka),
+                P(ka),
+                P(None, ka),
+                P(None, ka),
+                P(None, ka),
+                P(ka),
+                P(sa),
+            ),
+            out_specs=P(sa, ka),
+        )
+
+        def entry(scratch, seeds0, control0, cw_seeds, cw_left, cw_right,
+                  last_vc, db_chunks):
+            partials = shard_mapped(
+                seeds0, control0, cw_seeds, cw_left, cw_right, last_vc,
+                db_chunks,
+            )
+            combined = _xor_combine(partials, mesh) ^ scratch
+            return combined, jnp.zeros_like(scratch)
+
+        return jax.jit(entry, donate_argnums=(0,))
+
+    def stage_keys(self, staged_host):
+        """Place a host-side key staging tuple onto the mesh,
+        pre-partitioned over the key axis.
+
+        Zero-pads the query axis to a multiple of the key-axis size
+        (zero keys are inert; callers slice the result back). The six
+        blocks go up in one batched `device_put`, counted as a single
+        `key_staging` h2d copy — the same one-copy-per-batch convention
+        as the single-device `stage_keys`.
+        """
+        staged = pad_staged_queries(staged_host, self.num_key_devices)
+        ka = self.key_axis
+        specs = (P(ka), P(ka), P(None, ka), P(None, ka), P(None, ka),
+                 P(ka))
+        arrays = tuple(
+            np.ascontiguousarray(np.asarray(a)) for a in staged
+        )
+        shardings = tuple(NamedSharding(self.mesh, s) for s in specs)
+        dev = jax.device_put(arrays, shardings)
+        from ..observability.device import default_telemetry
+
+        default_telemetry().transfers.record_h2d(
+            sum(int(a.nbytes) for a in arrays),
+            phase="key_staging",
+            copies=1,
+        )
+        return dev
+
+    def run(self, staged_dev, db_chunks):
+        """Execute on pre-placed inputs.
+
+        Returns replicated uint32[nq_padded, W]; callers slice back to
+        the real key count.
+        """
+        if staged_dev[2].shape[0] != self._levels:
+            raise ValueError(
+                f"key has {staged_dev[2].shape[0]} correction levels; "
+                f"plan was built for walk {self.walk_levels} + cut "
+                f"{self.cut_levels} + chunk {self.chunk_levels}"
+            )
+        if db_chunks.shape[0] != self.num_chunks:
+            raise ValueError(
+                f"expected {self.num_chunks} database chunks, got "
+                f"{db_chunks.shape[0]}"
+            )
+        nk = int(staged_dev[0].shape[0])
+        w = int(db_chunks.shape[-1])
+        scratch = self.scratch.take((nk, w))
+        out, fresh = self._entry(scratch, *staged_dev, db_chunks)
+        self.scratch.put(fresh)
+        self.requests += 1
+        return out
+
+    def export(self) -> dict:
+        return {
+            "axes": {
+                "shard": {"name": self.shard_axis,
+                          "size": self.num_shards},
+                "key": {"name": self.key_axis,
+                        "size": self.num_key_devices},
+            },
+            "devices": int(self.mesh.devices.size),
+            "walk_levels": self.walk_levels,
+            "cut_levels": self.cut_levels,
+            "chunk_levels": self.chunk_levels,
+            "num_chunks": self.num_chunks,
+            "ip": self.ip,
+            "bitmajor": self.bitmajor,
+            "donate": self.donate,
+            "requests": int(self.requests),
+            "scratch": self.scratch.export(),
+        }

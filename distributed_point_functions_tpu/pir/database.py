@@ -219,8 +219,12 @@ class DenseDpfPirDatabase:
         self._db_words_rev = None
         self._db_perm_rev = None
         # Streaming staging (blocked-bitrev chunk spans), one plan at a
-        # time: ((cut_levels, bitmajor), uint32[nc, ...] device array).
+        # time: ((cut_levels, bitmajor[, mesh fingerprint]),
+        # uint32[nc, ...] device array — mesh-sharded when staged with
+        # `mesh=`).
         self._streaming_stage = None
+        # Per-shard detail of the last mesh staging (statusz/bundles).
+        self._mesh_staging_info = None
         # All lazy stagings build under this lock: concurrent first
         # requests must not stage the database twice (each staging is a
         # full HBM copy). Reentrant because _staged_perm -> _row_words
@@ -299,13 +303,46 @@ class DenseDpfPirDatabase:
     def record(self, i: int) -> bytes:
         return self._records[i]
 
-    def prestage(self) -> int:
-        """Eagerly stage the row-major device buffer (the double-buffer
+    def prestage(
+        self,
+        mesh=None,
+        *,
+        cut_levels: int | None = None,
+        bitmajor: bool = False,
+        shard_axis: str = "shard",
+    ) -> int:
+        """Eagerly stage the serving device buffer (the double-buffer
         half of a snapshot rotation: generation N+1 moves into HBM while
-        N keeps serving, so the flip itself transfers nothing). Layout
-        variants (bit-major, bitrev, streaming) still stage lazily on
-        first use. Returns the bytes staged by this call (0 if the
-        buffer was already resident)."""
+        N keeps serving, so the flip itself transfers nothing).
+
+        Without `mesh`: stages the row-major single-device buffer;
+        layout variants (bit-major, bitrev, streaming) still stage
+        lazily on first use. With `mesh` (+ the serving plan's
+        `cut_levels`/`bitmajor`): stages the streaming chunk spans
+        pre-partitioned over the mesh's shard axis, each record shard
+        placed directly on its device — the flip is then a cache hit.
+        Returns the bytes staged by this call (0 if already resident).
+        """
+        if mesh is not None:
+            if cut_levels is None:
+                raise ValueError("prestage(mesh=...) needs cut_levels")
+            with self._stage_lock:
+                key = self._streaming_key(
+                    cut_levels, bitmajor, mesh, shard_axis
+                )
+                if (
+                    self._streaming_stage is not None
+                    and self._streaming_stage[0] == key
+                ):
+                    return 0
+                self.streaming_chunks(
+                    cut_levels=cut_levels,
+                    bitmajor=bitmajor,
+                    mesh=mesh,
+                    shard_axis=shard_axis,
+                )
+                info = self._mesh_staging_info or {}
+                return int(info.get("total_bytes", 0))
         with self._stage_lock:
             if self._db_words is not None:
                 return 0
@@ -329,6 +366,7 @@ class DenseDpfPirDatabase:
             if self._streaming_stage is not None:
                 self._streaming_stage = None
                 dropped += 1
+            self._mesh_staging_info = None
             self._host_rev = None
         # One HBM sample after the drop so the db_staging watermark and
         # live-bytes gauge reflect the reclaim without waiting for the
@@ -420,8 +458,35 @@ class DenseDpfPirDatabase:
                     )
             return self._db_perm
 
+    @staticmethod
+    def _streaming_key(cut_levels, bitmajor, mesh, shard_axis):
+        """Cache key for one streaming staging. Mesh stagings key on the
+        device assignment + shard axis so a mesh change restages."""
+        base = (int(cut_levels), bool(bitmajor))
+        if mesh is None:
+            return base
+        fingerprint = (
+            str(shard_axis),
+            tuple(mesh.axis_names),
+            tuple(int(s) for s in mesh.devices.shape),
+            tuple(d.id for d in mesh.devices.flat),
+        )
+        return base + (fingerprint,)
+
+    def mesh_staging_info(self) -> dict | None:
+        """Per-shard detail of the live mesh staging (device id, chunk
+        span, bytes, copies), or None when not mesh-staged."""
+        with self._stage_lock:
+            info = self._mesh_staging_info
+            return dict(info) if info is not None else None
+
     def streaming_chunks(
-        self, *, cut_levels: int, bitmajor: bool
+        self,
+        *,
+        cut_levels: int,
+        bitmajor: bool,
+        mesh=None,
+        shard_axis: str = "shard",
     ) -> jnp.ndarray:
         """Device staging for the streaming serving plan: records in
         streaming (blocked bit-reversed) block order, split into
@@ -433,10 +498,18 @@ class DenseDpfPirDatabase:
         the plan split — a batch-size change that moves the planner's
         cut restages (the covering padded row count is plan-invariant,
         only the chunk boundaries move).
+
+        With `mesh`, the chunk axis is sharded over `shard_axis`: each
+        device's span of chunk spans is `jax.device_put` directly from
+        the host slice to that device (no single-device detour, no
+        cross-device reshard), assembled into one global array under a
+        `NamedSharding`. Each per-device upload is counted in the
+        TransferLedger under `db_staging`, and per-shard HBM watermarks
+        land under `db_staging/dev<N>`.
         """
         from .dense_eval_planes_v2 import streaming_block_permute_records
 
-        key = (int(cut_levels), bool(bitmajor))
+        key = self._streaming_key(cut_levels, bitmajor, mesh, shard_axis)
         with self._stage_lock:
             if (
                 self._streaming_stage is not None
@@ -447,6 +520,13 @@ class DenseDpfPirDatabase:
                 self._host_words_padded(), cut_levels
             )
             nc = 1 << cut_levels
+            if mesh is not None:
+                arr = self._stage_chunks_mesh(
+                    host, nc, mesh, shard_axis, bitmajor
+                )
+                self._streaming_stage = (key, arr)
+                return arr
+            self._mesh_staging_info = None
             ledger = default_telemetry().transfers
             with default_telemetry().hbm.phase("db_staging"):
                 if bitmajor:
@@ -471,6 +551,87 @@ class DenseDpfPirDatabase:
                     )
             self._streaming_stage = (key, arr)
             return arr
+
+    def _stage_chunks_mesh(self, host, nc, mesh, shard_axis, bitmajor):
+        """Place chunk spans pre-partitioned over the mesh's shard axis.
+
+        Row-major chunks [nc, chunk_records, W] go up directly. The
+        bit-major layout needs the on-device permute
+        (`stage_db_chunks_bitmajor`), so the row-major sharded upload is
+        followed by a jitted shard-local transform constrained to the
+        same shard-axis sharding — records still never cross devices.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if nc % int(mesh.shape[shard_axis]):
+            raise ValueError(
+                f"{nc} chunks not divisible by the {shard_axis} axis "
+                f"({mesh.shape[shard_axis]} devices)"
+            )
+        telemetry = default_telemetry()
+        ledger = telemetry.transfers
+        chunks = host.reshape(nc, -1, host.shape[1])
+        spec = PartitionSpec(shard_axis, None, None)
+        sharding = NamedSharding(mesh, spec)
+        idx_map = sharding.addressable_devices_indices_map(chunks.shape)
+        pieces = []
+        shards = []
+        total = 0
+        for dev, index in sorted(
+            idx_map.items(), key=lambda kv: kv[0].id
+        ):
+            piece = np.ascontiguousarray(chunks[index])
+            with telemetry.hbm.phase(f"db_staging/dev{dev.id}"):
+                darr = jax.device_put(piece, dev)
+                darr.block_until_ready()
+            ledger.record_h2d(int(piece.nbytes), phase="db_staging")
+            span = index[0]
+            shards.append({
+                "device": int(dev.id),
+                "chunk_start": int(span.start or 0),
+                "chunk_stop": int(
+                    span.stop if span.stop is not None else nc
+                ),
+                "bytes": int(piece.nbytes),
+                "copies": 1,
+            })
+            total += int(piece.nbytes)
+            pieces.append(darr)
+        arr = jax.make_array_from_single_device_arrays(
+            chunks.shape, sharding, pieces
+        )
+        if bitmajor:
+            from ..ops.inner_product_pallas import (
+                stage_db_chunks_bitmajor,
+            )
+
+            rows = jax.jit(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x.reshape(-1, x.shape[-1]),
+                    NamedSharding(mesh, PartitionSpec(shard_axis, None)),
+                )
+            )(arr)
+            arr = jax.jit(
+                lambda x: jax.lax.with_sharding_constraint(
+                    stage_db_chunks_bitmajor(x, nc),
+                    NamedSharding(
+                        mesh,
+                        PartitionSpec(shard_axis, None, None, None),
+                    ),
+                )
+            )(rows)
+            arr = ledger.block_until_ready(arr, phase="db_staging")
+        self._mesh_staging_info = {
+            "shard_axis": str(shard_axis),
+            "num_shards": int(mesh.shape[shard_axis]),
+            "num_chunks": int(nc),
+            "bitmajor": bool(bitmajor),
+            "total_bytes": total,
+            "copies": len(shards),
+            "generation": int(self._generation),
+            "shards": shards,
+        }
+        return arr
 
     def _tier_chain(self):
         """(tiers-to-try, forced): the inner-product fallback chain.

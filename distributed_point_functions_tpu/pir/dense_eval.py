@@ -312,15 +312,14 @@ def _walk_zeros_host(seeds0, control0, cw_seeds, cw_left, cw_right, levels):
     return seeds, control
 
 
-def stage_keys(keys: Sequence[DpfKey], host_walk_levels: int = 0):
-    """Stack a batch of dense-PIR DPF keys into device-ready arrays.
+def stage_keys_host(keys: Sequence[DpfKey], host_walk_levels: int = 0):
+    """Host half of `stage_keys`: stack a batch of dense-PIR DPF keys
+    into six numpy arrays without placing them on any device.
 
-    All keys must have the same number of correction words and a single
-    128-bit last-level value correction. With `host_walk_levels > 0` the
-    shared all-zeros prefix is walked on the host during staging (see
-    `_walk_zeros_host`): the returned seeds/control sit at that depth and
-    the correction-word arrays drop the walked levels, so the device step
-    runs with `walk_levels=0`.
+    Callers that serve from a mesh use this directly and do the
+    placement themselves with a `NamedSharding` matching the step's
+    in_specs (`ShardedServingPlan.stage_keys`), so keys never take a
+    single-device detour before being resharded at dispatch.
     """
     nk = len(keys)
     num_levels = len(keys[0].correction_words)
@@ -356,6 +355,22 @@ def stage_keys(keys: Sequence[DpfKey], host_walk_levels: int = 0):
         cw_seeds = cw_seeds[host_walk_levels:]
         cw_left = cw_left[host_walk_levels:]
         cw_right = cw_right[host_walk_levels:]
+    return seeds0, control0, cw_seeds, cw_left, cw_right, last_vc
+
+
+def stage_keys(keys: Sequence[DpfKey], host_walk_levels: int = 0):
+    """Stack a batch of dense-PIR DPF keys into device-ready arrays.
+
+    All keys must have the same number of correction words and a single
+    128-bit last-level value correction. With `host_walk_levels > 0` the
+    shared all-zeros prefix is walked on the host during staging (see
+    `_walk_zeros_host`): the returned seeds/control sit at that depth and
+    the correction-word arrays drop the walked levels, so the device step
+    runs with `walk_levels=0`.
+    """
+    seeds0, control0, cw_seeds, cw_left, cw_right, last_vc = (
+        stage_keys_host(keys, host_walk_levels)
+    )
     # One device_put for the whole staging: all six blocks are uint32,
     # so they pack into a single flat transfer and slice back apart on
     # device (value_types.host_const's batching note, applied). Six

@@ -41,9 +41,15 @@ from .database import DenseDpfPirDatabase, words_to_record_bytes
 from .dense_eval import (
     serving_expansion,
     stage_keys,
+    stage_keys_host,
     stage_keys_walked,
 )
-from .planner import ServingPlan, plan_dense_serving, selection_budget_bytes
+from .planner import (
+    ServingPlan,
+    plan_dense_serving,
+    selection_budget_bytes,
+    streaming_ip,
+)
 
 # sender(helper_request: PirRequest, while_waiting: Callable[[], None])
 #   -> PirResponse
@@ -266,6 +272,20 @@ class DenseDpfPirServer(DpfPirServer):
         self._mesh = mesh
         self._sharded_step = None
         self._sharded_db = None
+        # 2-D mesh serving plan (shard axis x key axis): built lazily on
+        # the first request; a build failure or device OOM parks the
+        # error here and the tier-demotion chain falls back to
+        # single-device for the rest of the process.
+        self._mesh_plan = None
+        self._mesh_db = None
+        self._mesh_plan_error = None
+        self._mesh_lock = threading.Lock()
+        # Only one shard_map program may be in flight on the device
+        # set at a time: the entry's cross-shard psum rendezvous
+        # deadlocks if a second program (e.g. an unbatched prober
+        # probe racing the batcher worker) interleaves its collectives
+        # on the same devices.
+        self._mesh_exec_lock = threading.Lock()
         self._chunked_db = None
         self._chunked_db_lock = threading.Lock()
         self._streaming_ip_failed = False
@@ -387,6 +407,20 @@ class DenseDpfPirServer(DpfPirServer):
             self._sharded_db = shard_database(
                 self._mesh, pad_rows_to_mesh(database.db_words, ndev)
             )
+        with self._mesh_lock:
+            plan = self._mesh_plan
+        if plan is not None:
+            # All shards flip in this one reference assignment: the new
+            # generation's sharded staging is assembled in full (a cache
+            # hit when `prestage_database` ran during snapshot staging)
+            # before any request can observe it, so no request ever sees
+            # shard i from generation N and shard j from N+1.
+            self._mesh_db = database.streaming_chunks(
+                cut_levels=plan.cut_levels,
+                bitmajor=plan.bitmajor,
+                mesh=self._mesh,
+                shard_axis=plan.shard_axis,
+            )
         return old
 
     def _parse_helper_request(self, data: bytes) -> "messages.HelperRequest":
@@ -422,7 +456,13 @@ class DenseDpfPirServer(DpfPirServer):
         # checked BEFORE entering dispatch() — dispatch registers the
         # shape on exit.
         seen = telemetry.compile_tracker.seen
-        if self._mesh is not None:
+        if self._mesh_is_2d():
+            inner_products = self._serve_mesh(keys, telemetry, seen)
+            if inner_products is None:  # plan infeasible / device OOM
+                inner_products = self._serve_single_device(
+                    keys, bitrev, impl, telemetry, seen
+                )
+        elif self._mesh is not None:
             with phases_mod.phase("h2d_transfer"):
                 staged = stage_keys(keys)
             key = shape_key(
@@ -744,6 +784,219 @@ class DenseDpfPirServer(DpfPirServer):
         return words_to_record_bytes(
             out, num_keys, self._database.max_value_size
         )
+
+    # -- mesh serving (2-D shard x key mesh) ----------------------------------
+
+    def _mesh_is_2d(self) -> bool:
+        return (
+            self._mesh is not None
+            and len(getattr(self._mesh, "axis_names", ())) == 2
+        )
+
+    def batch_key_multiple(self) -> int:
+        """Key-batch granularity the serving runtime should pad buckets
+        to: the key-axis size on a 2-D mesh (so batches land
+        pre-partitioned without a gather), 1 otherwise."""
+        if not self._mesh_is_2d():
+            return 1
+        return int(self._mesh.shape[tuple(self._mesh.axis_names)[1]])
+
+    def _ensure_mesh_plan(self, num_keys_hint: int):
+        """Build (once) the 2-D serving plan and the mesh-sharded
+        database staging. Returns the plan, or None when the geometry
+        is infeasible — the caller then serves single-device, and the
+        error sticks so the fallback is decided once, not per request."""
+        with self._mesh_lock:
+            if self._mesh_plan is not None:
+                return self._mesh_plan
+            if self._mesh_plan_error is not None:
+                return None
+            try:
+                plan = self._build_mesh_plan(num_keys_hint)
+                db = self._database.streaming_chunks(
+                    cut_levels=plan.cut_levels,
+                    bitmajor=plan.bitmajor,
+                    mesh=self._mesh,
+                    shard_axis=plan.shard_axis,
+                )
+            except Exception as exc:  # noqa: BLE001 - sticky fallback
+                self._mesh_plan_error = exc
+                self._note_mesh_fallback("plan", exc)
+                return None
+            self._mesh_plan = plan
+            self._mesh_db = db
+            return plan
+
+    def _build_mesh_plan(self, num_keys_hint: int):
+        import jax
+
+        from ..capacity.model import default_capacity_model
+        from ..parallel.sharded import ShardedServingPlan
+
+        axis_names = tuple(self._mesh.axis_names)
+        shards = int(self._mesh.shape[axis_names[0]])
+        key_devices = int(self._mesh.shape[axis_names[1]])
+        s_levels = max(0, (shards - 1).bit_length())
+        if (1 << s_levels) != shards:
+            raise ValueError(
+                f"shard axis must be a power of two, got {shards}"
+            )
+        # The streaming staging pads rows to the full covering subtree
+        # (2^expand blocks), so the scan geometry must cover it exactly.
+        expand = max(0, (self._num_blocks - 1).bit_length())
+        total_levels = self._dpf._tree_levels_needed - 1
+        if expand > total_levels:
+            raise ValueError(
+                f"tree depth {total_levels} cannot cover 2^{expand} "
+                "padded blocks"
+            )
+        if expand < s_levels:
+            raise ValueError(
+                f"2^{expand} chunk lanes cannot split over {shards} "
+                "shards"
+            )
+        model = default_capacity_model()
+        local_keys = -(-max(1, num_keys_hint) // key_devices)
+        chunk = min(
+            model.pick_streaming_split(local_keys, expand),
+            expand - s_levels,
+        )
+        cut = expand - chunk
+        return ShardedServingPlan(
+            self._mesh,
+            walk_levels=total_levels - expand,
+            cut_levels=cut,
+            chunk_levels=chunk,
+            ip=streaming_ip(jax.default_backend()),
+        )
+
+    def _note_mesh_fallback(self, stage: str, exc: BaseException) -> None:
+        import warnings
+
+        tracing.runtime_counters.inc("pir.mesh_fallbacks")
+        events_mod.emit(
+            "pir.mesh_fallback",
+            f"mesh serving disabled after {stage} failure; serving "
+            "single-device",
+            severity="warning",
+            stage=stage,
+            error=str(exc).splitlines()[0][:200],
+        )
+        warnings.warn(
+            f"mesh serving {stage} failed; falling back to single-device "
+            f"({str(exc).splitlines()[0][:200]})"
+        )
+
+    def _serve_mesh(self, keys, telemetry, seen):
+        """One batch through the 2-D mesh plan. Returns the response
+        list, or None to fall back to single-device (infeasible
+        geometry, or a device OOM that permanently demotes the mesh)."""
+        plan = self._ensure_mesh_plan(len(keys))
+        if plan is None:
+            return None
+        record = phases_mod.current_request()
+        if record is not None:
+            record.set_meta(
+                "serving_plan", {"mode": "mesh", "num_keys": len(keys)}
+            )
+        # Host-side assembly only: the placement is the plan's sharded
+        # stage_keys, so keys go straight to their key-axis devices
+        # pre-partitioned (no single-device detour, no dispatch-time
+        # relayout).
+        with self._mesh_exec_lock:
+            with phases_mod.phase("h2d_transfer"):
+                staged_host = stage_keys_host(keys)
+                staged = plan.stage_keys(staged_host)
+            key = shape_key(
+                ("m", f"mesh-{plan.ip}"),
+                ("q", int(staged[0].shape[0])),
+                ("b", self._num_blocks),
+                ("c", plan.cut_levels),
+            )
+            step = (
+                "device_compute" if seen("pir.plain", key) else "compile"
+            )
+            mesh_db = self._mesh_db
+            try:
+                with tracing.span(
+                    "evaluate_mesh",
+                    num_keys=len(keys),
+                    shards=plan.num_shards,
+                    key_devices=plan.num_key_devices,
+                ), telemetry.hbm.phase("selection"), \
+                        telemetry.compile_tracker.dispatch(
+                            "pir.plain", key
+                        ), \
+                        phases_mod.phase(step):
+                    out_dev = plan.run(staged, mesh_db)
+                    out = telemetry.transfers.to_host(
+                        out_dev, phase="result_readback"
+                    )
+            except Exception as exc:  # noqa: BLE001 - OOM-gated below
+                if not self._is_resource_exhausted(exc):
+                    raise
+                with self._mesh_lock:
+                    self._mesh_plan = None
+                    self._mesh_db = None
+                    self._mesh_plan_error = exc
+                self._note_mesh_fallback("dispatch", exc)
+                return None
+        return words_to_record_bytes(
+            out, len(keys), self._database.max_value_size
+        )
+
+    def prestage_database(self, database: DenseDpfPirDatabase) -> int:
+        """Stage `database` exactly the way THIS server will serve it
+        (snapshots call this for generation N+1 so the flip is a cache
+        hit): the mesh-sharded streaming staging when a 2-D plan is
+        active, the row-major single-device buffer otherwise. Returns
+        bytes staged."""
+        if self._mesh_is_2d():
+            plan = self._ensure_mesh_plan(num_keys_hint=64)
+            if plan is not None:
+                return database.prestage(
+                    mesh=self._mesh,
+                    cut_levels=plan.cut_levels,
+                    bitmajor=plan.bitmajor,
+                    shard_axis=plan.shard_axis,
+                )
+        return database.prestage()
+
+    def mesh_export(self) -> dict:
+        """The /statusz "Mesh" view: mesh shape, plan geometry, scratch
+        pool and donation state, per-shard staging detail, per-shard
+        HBM watermarks."""
+        if self._mesh is None:
+            return {"configured": False}
+        axis_names = tuple(getattr(self._mesh, "axis_names", ()))
+        out = {
+            "configured": True,
+            "axis_names": list(axis_names),
+            "shape": {
+                str(name): int(self._mesh.shape[name])
+                for name in axis_names
+            },
+            "devices": int(self._mesh.devices.size),
+            "two_dee": len(axis_names) == 2,
+        }
+        with self._mesh_lock:
+            plan = self._mesh_plan
+            err = self._mesh_plan_error
+        if err is not None:
+            out["fallback_error"] = str(err).splitlines()[0][:200]
+        if plan is not None:
+            out["plan"] = plan.export()
+        info = self._database.mesh_staging_info()
+        if info is not None:
+            watermarks = default_telemetry().hbm.export().get(
+                "watermark_bytes", {}
+            )
+            for shard in info.get("shards", ()):
+                shard["hbm_watermark_bytes"] = watermarks.get(
+                    f"db_staging/dev{shard['device']}"
+                )
+            out["staging"] = info
+        return out
 
     # -- multi-chip serving ---------------------------------------------------
 

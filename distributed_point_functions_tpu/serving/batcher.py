@@ -181,6 +181,11 @@ class DynamicBatcher:
         # flips land only at batch boundaries and in-flight batches
         # pin their generation's stagings.
         self._generation_source = None
+        # Key-bucket granularity: mesh serving pads buckets to a
+        # multiple of the key-axis size so batches land pre-partitioned
+        # over the key axis (see `set_key_multiple`). 1 = plain
+        # power-of-two buckets.
+        self._key_multiple = 1
         self._seen_buckets: set = set()
         self._closed = False
         self._worker = threading.Thread(
@@ -308,6 +313,20 @@ class DynamicBatcher:
         with self._cond:
             self._batch_cap = cap
 
+    # -- mesh hook ----------------------------------------------------------
+
+    def set_key_multiple(self, multiple: int) -> None:
+        """Pad every key bucket up to a multiple of `multiple` (the
+        serving mesh's key-axis size) so batches flow into the sharded
+        step pre-partitioned, with no gather and no fresh jit shape per
+        request count. Power-of-two buckets already satisfy any
+        power-of-two multiple <= the bucket; the rounding only moves
+        buckets smaller than the multiple."""
+        if multiple < 1:
+            raise ValueError("key multiple must be >= 1")
+        with self._cond:
+            self._key_multiple = int(multiple)
+
     # -- worker -------------------------------------------------------------
 
     def _pop_next(self):
@@ -399,6 +418,9 @@ class DynamicBatcher:
                 continue
             flat = [k for p in live for k in p.keys]
             bucket = bucket_size(len(flat))
+            multiple = self._key_multiple
+            if bucket % multiple:
+                bucket = -(-bucket // multiple) * multiple
             padded = flat + [flat[0]] * (bucket - len(flat))
             pad_waste = (bucket - len(flat)) / bucket
             if bucket in self._seen_buckets:

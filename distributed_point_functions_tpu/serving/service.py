@@ -317,6 +317,23 @@ class _Session:
                 admission=self.admission,
             )
             server.set_plain_handler(self._batched_plain_handler)
+        # Mesh wiring: a 2-D-mesh server tells the batcher its key-axis
+        # granularity (buckets pad to it, so batches land
+        # pre-partitioned) and the capacity model its shape (admission
+        # and brownout then price per-shard bytes and per-mesh q/s
+        # without any changes of their own).
+        is_2d = getattr(server, "_mesh_is_2d", None)
+        if callable(is_2d) and is_2d():
+            multiple = int(server.batch_key_multiple())
+            if multiple > 1 and self._batcher is not None:
+                self._batcher.set_key_multiple(multiple)
+            mesh = server._mesh
+            axes = tuple(mesh.axis_names)
+            from ..capacity.model import default_capacity_model
+
+            default_capacity_model().configure_mesh(
+                int(mesh.shape[axes[0]]), int(mesh.shape[axes[1]])
+            )
 
     @property
     def server(self) -> DenseDpfPirServer:

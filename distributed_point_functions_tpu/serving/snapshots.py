@@ -188,7 +188,15 @@ class SnapshotManager:
                 f"{cur.max_value_size}"
             )
         failpoints.fire("snapshot.stage")
-        staged_bytes = database.prestage()
+        # Stage in the layout the server actually serves (a mesh server
+        # shards generation N+1 over its shard axis here, so the flip
+        # swaps one fully-assembled staging — all shards at once, never
+        # a partial flip); plain `prestage()` otherwise.
+        prestage = getattr(self._server, "prestage_database", None)
+        if callable(prestage):
+            staged_bytes = prestage(database)
+        else:
+            staged_bytes = database.prestage()
         replaced = None
         with self._cond:
             if self._staging is not None and self._staging is not database:

@@ -1,0 +1,541 @@
+"""Pod-scale mesh serving tests on the virtual 8-device CPU mesh.
+
+The tentpole invariant: serving from a 2-D device mesh (database-shard
+axis x key-batch axis) is bit-identical to the single-device oracle on
+every path — materialized and streaming oracle tiers, non-power-of-two
+key batches padded onto the key axis, and across a snapshot rotation
+under live traffic (all shards flip at one batch boundary, never a
+partial flip). Plus the perf contracts: pre-partitioned dispatch adds no
+host relayout (per-request copy counts no higher than single-device)
+and the donated selection scratch stages once, not per request.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.capacity.model import (
+    CapacityModel,
+    ThroughputCalibration,
+    default_capacity_model,
+)
+from distributed_point_functions_tpu.observability.device import (
+    default_telemetry,
+)
+from distributed_point_functions_tpu.observability.events import EventJournal
+from distributed_point_functions_tpu.parallel.sharded import (
+    ShardedServingPlan,
+    make_mesh2d,
+)
+from distributed_point_functions_tpu.pir import messages
+from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
+from distributed_point_functions_tpu.pir.database import DenseDpfPirDatabase
+from distributed_point_functions_tpu.pir.server import (
+    DenseDpfPirServer,
+    clear_tier_floor,
+    set_tier_floor,
+)
+from distributed_point_functions_tpu.prng import xor_bytes
+from distributed_point_functions_tpu.serving import (
+    PlainSession,
+    ServingConfig,
+    SnapshotManager,
+)
+
+NUM_RECORDS = 2000  # pads to 2048 blocks-worth: 16 selection blocks
+RECORD_BYTES = 24
+RNG = np.random.default_rng(1301)
+
+RECORDS0 = [
+    bytes(RNG.integers(0, 256, RECORD_BYTES, dtype=np.uint8))
+    for _ in range(NUM_RECORDS)
+]
+# Generation 1 differs at every index so a torn (cross-generation) read
+# can never accidentally equal either oracle.
+RECORDS1 = [bytes(b ^ 0x5A for b in r) for r in RECORDS0]
+
+
+def require_mesh2d(shards=4, key_devices=2):
+    if len(jax.devices()) < shards * key_devices:
+        pytest.skip(f"needs {shards * key_devices} devices")
+    return make_mesh2d(shards, key_devices)
+
+
+def build_db(records):
+    builder = DenseDpfPirDatabase.Builder()
+    for r in records:
+        builder.insert(r)
+    return builder.build()
+
+
+def plain_request(keys):
+    return messages.PirRequest(
+        plain_request=messages.PlainRequest(dpf_keys=list(keys))
+    )
+
+
+def serve(server, keys):
+    return server.handle_request(
+        plain_request(keys)
+    ).dpf_pir_response.masked_response
+
+
+@pytest.fixture(autouse=True)
+def reset_process_state():
+    yield
+    clear_tier_floor()
+    default_capacity_model().configure_mesh(None)
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity against the single-device oracle
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_bit_identity_vs_materialized_and_streaming_oracle():
+    """Both parties' mesh responses are byte-identical to the
+    single-device server in its materialized AND streaming tiers, with
+    a non-power-of-two key batch (3 keys onto a key axis of 2)."""
+    mesh = require_mesh2d()
+    oracle = DenseDpfPirServer.create_plain(build_db(RECORDS0))
+    meshed = DenseDpfPirServer.create_plain(build_db(RECORDS0), mesh=mesh)
+
+    client = DenseDpfPirClient.create(NUM_RECORDS, lambda pt, ci: pt)
+    indices = [3, 1999, 777]
+    keys0, keys1 = client._generate_key_pairs(indices)
+
+    mesh_responses = {}
+    for party, keys in enumerate((keys0, keys1)):
+        got = serve(meshed, keys)
+        assert got == serve(oracle, keys)
+        mesh_responses[party] = got
+    # The mesh actually served (no silent single-device fallback).
+    assert meshed._mesh_plan is not None
+    assert meshed._mesh_plan.requests >= 2
+
+    # Same bytes against the streaming oracle tier.
+    set_tier_floor("streaming")
+    try:
+        streaming_oracle = DenseDpfPirServer.create_plain(
+            build_db(RECORDS0)
+        )
+        for party, keys in enumerate((keys0, keys1)):
+            assert mesh_responses[party] == serve(streaming_oracle, keys)
+    finally:
+        clear_tier_floor()
+
+    # And the two parties' mesh shares combine to the records.
+    for q, idx in enumerate(indices):
+        assert (
+            xor_bytes(mesh_responses[0][q], mesh_responses[1][q])
+            == RECORDS0[idx]
+        )
+
+
+def test_mesh_stage_keys_pads_onto_key_axis():
+    """A non-power-of-two key batch pads to a multiple of the key-axis
+    size at staging, pre-partitioned (no gather at dispatch)."""
+    from distributed_point_functions_tpu.pir.dense_eval import (
+        stage_keys_host,
+    )
+
+    mesh = require_mesh2d()
+    meshed = DenseDpfPirServer.create_plain(build_db(RECORDS0), mesh=mesh)
+    client = DenseDpfPirClient.create(NUM_RECORDS, lambda pt, ci: pt)
+    keys0, _ = client._generate_key_pairs([1, 2, 3])
+    plan = meshed._ensure_mesh_plan(3)
+    assert plan is not None
+    staged = plan.stage_keys(stage_keys_host(list(keys0)))
+    assert staged[0].shape[0] == 4  # 3 keys -> key-axis multiple of 2
+    assert staged[0].shape[0] % plan.num_key_devices == 0
+    # Partitioned over the key axis (each device holds nq/K rows) and
+    # spread across the whole mesh, not parked on one device.
+    assert (
+        staged[0].sharding.shard_shape(staged[0].shape)[0]
+        == staged[0].shape[0] // plan.num_key_devices
+    )
+    assert len(staged[0].sharding.device_set) == (
+        plan.num_key_devices * plan.num_shards
+    )
+
+
+# ---------------------------------------------------------------------------
+# Rotation: all shards flip at one batch boundary
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_rotation_under_traffic_never_tears():
+    """Snapshot rotation on a mesh session under live traffic: every
+    combined answer is entirely generation 0 or entirely generation 1
+    (RECORDS1 differs at every byte, so a partial-shard flip would
+    produce bytes matching neither), and the staged flip itself
+    transfers nothing (prestage made it a cache hit)."""
+    mesh = require_mesh2d()
+    config = ServingConfig(max_batch_size=8, max_wait_ms=1.0)
+    with PlainSession(
+        build_db(RECORDS0), config, mesh=mesh
+    ) as session:
+        manager = SnapshotManager(session, journal=EventJournal())
+        client = DenseDpfPirClient(NUM_RECORDS, lambda pt, info: pt)
+
+        def query(indices):
+            req0, req1 = client.create_plain_requests(indices)
+            r0 = session.handle_request(req0)
+            r1 = session.handle_request(req1)
+            return [
+                xor_bytes(a, b)
+                for a, b in zip(
+                    r0.dpf_pir_response.masked_response,
+                    r1.dpf_pir_response.masked_response,
+                )
+            ]
+
+        assert query([3, 77])[0] == RECORDS0[3]
+        assert session.server._mesh_plan is not None
+        # Warm every bucket shape the traffic below can form (3 keys
+        # -> bucket 4; two coalesced workers -> bucket 8): a
+        # first-shape mesh compile takes longer than the flip timeout
+        # on CPU, and a compiling batch holds the batch boundary open.
+        query([5, 123, 1500])
+        query([5, 123, 1500, 6, 7, 8])
+
+        stop = threading.Event()
+        torn = []
+
+        def traffic():
+            while not stop.is_set():
+                for got, idx in zip(query([5, 123, 1500]), (5, 123, 1500)):
+                    if got not in (RECORDS0[idx], RECORDS1[idx]):
+                        torn.append((idx, got))
+                # Leave a gap so the flip's zero-inflight batch
+                # boundary actually occurs under load.
+                stop.wait(0.02)
+
+        workers = [threading.Thread(target=traffic) for _ in range(2)]
+        for w in workers:
+            w.start()
+        try:
+            builder = DenseDpfPirDatabase.Builder()
+            for i, r in enumerate(RECORDS1):
+                builder.update(i, r)
+            db1 = builder.build_from(session.server.database)
+            ledger = default_telemetry().transfers
+            staged = manager.stage(db1)
+            assert staged > 0  # mesh-sharded staging moved real bytes
+            copies_before_flip = ledger.copies("db_staging")
+            manager.flip(timeout=30.0)
+            # The flip re-used the prestaged mesh staging: zero new
+            # db_staging uploads at the boundary.
+            assert ledger.copies("db_staging") == copies_before_flip
+        finally:
+            stop.set()
+            for w in workers:
+                w.join()
+
+        assert torn == []
+        assert manager.serving_generation() == 1
+        assert query([3])[0] == RECORDS1[3]
+        # Still mesh-served after the rotation.
+        assert session.server._mesh_plan is not None
+
+
+def test_mesh_unbatched_probe_races_batched_traffic():
+    """An unbatched direct `handle_plain_request` (the prober's probe
+    path) racing batched traffic must serialize on the mesh execution
+    lock: two shard_map programs interleaving their cross-shard psum
+    rendezvous on the same device set deadlock. Regression test — this
+    hung before `_mesh_exec_lock` existed; with it, both paths complete
+    and stay bit-identical."""
+    mesh = require_mesh2d()
+    config = ServingConfig(max_batch_size=4, max_wait_ms=0.5)
+    with PlainSession(
+        build_db(RECORDS0), config, mesh=mesh
+    ) as session:
+        client = DenseDpfPirClient(NUM_RECORDS, lambda pt, info: pt)
+        req0, req1 = client.create_plain_requests([9, 411])
+        # Warm both shapes once so the race below is over execution,
+        # not compiles.
+        session.handle_request(req0)
+        session.server.handle_plain_request(req0)
+        assert session.server._mesh_plan is not None
+
+        errors = []
+        results = {"batched": [], "unbatched": []}
+
+        def batched():
+            try:
+                for _ in range(6):
+                    a = session.handle_request(req0)
+                    b = session.handle_request(req1)
+                    results["batched"].append(
+                        xor_bytes(
+                            a.dpf_pir_response.masked_response[0],
+                            b.dpf_pir_response.masked_response[0],
+                        )
+                    )
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        def unbatched():
+            try:
+                for _ in range(6):
+                    a = session.server.handle_plain_request(req0)
+                    b = session.server.handle_plain_request(req1)
+                    results["unbatched"].append(
+                        xor_bytes(
+                            a.dpf_pir_response.masked_response[0],
+                            b.dpf_pir_response.masked_response[0],
+                        )
+                    )
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=batched),
+            threading.Thread(target=unbatched),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        assert not any(t.is_alive() for t in threads), (
+            "mesh execution deadlocked: concurrent shard_map programs "
+            "interleaved their collectives"
+        )
+        assert errors == []
+        assert results["batched"] == [RECORDS0[9]] * 6
+        assert results["unbatched"] == [RECORDS0[9]] * 6
+
+
+def test_mesh_swap_database_requires_full_staging_before_flip():
+    """Server-level flip atomicity: prestage_database stages the new
+    generation per-shard; swap_database then swaps one fully-assembled
+    staging reference (a cache hit — no transfer at the flip)."""
+    mesh = require_mesh2d()
+    meshed = DenseDpfPirServer.create_plain(build_db(RECORDS0), mesh=mesh)
+    client = DenseDpfPirClient.create(NUM_RECORDS, lambda pt, ci: pt)
+    keys0, keys1 = client._generate_key_pairs([42])
+    assert (
+        xor_bytes(serve(meshed, keys0)[0], serve(meshed, keys1)[0])
+        == RECORDS0[42]
+    )
+
+    builder = DenseDpfPirDatabase.Builder()
+    for i, r in enumerate(RECORDS1):
+        builder.update(i, r)
+    db1 = builder.build_from(meshed.database)
+    staged = meshed.prestage_database(db1)
+    assert staged > 0
+    assert meshed.prestage_database(db1) == 0  # idempotent: cache hit
+    ledger = default_telemetry().transfers
+    before = ledger.copies("db_staging")
+    meshed.swap_database(db1)
+    assert ledger.copies("db_staging") == before
+    assert (
+        xor_bytes(serve(meshed, keys0)[0], serve(meshed, keys1)[0])
+        == RECORDS1[42]
+    )
+
+
+# ---------------------------------------------------------------------------
+# Donation + relayout accounting (TransferLedger)
+# ---------------------------------------------------------------------------
+
+
+def test_donation_stages_scratch_once_not_per_request():
+    """ROADMAP 3a, asserted in the ledger: with the donated scratch
+    pool, N same-shape requests after warmup add ZERO
+    selection_scratch copies (the donated buffer recycles); with
+    DPF_TPU_DONATE=0 every request stages a fresh scratch. Donation
+    therefore removes one copy per steady-state request."""
+    mesh = require_mesh2d()
+    meshed = DenseDpfPirServer.create_plain(build_db(RECORDS0), mesh=mesh)
+    client = DenseDpfPirClient.create(NUM_RECORDS, lambda pt, ci: pt)
+    keys0, _ = client._generate_key_pairs([7, 8, 9, 10])
+    ledger = default_telemetry().transfers
+
+    serve(meshed, keys0)  # warm: stages the one pooled scratch
+    assert meshed._mesh_plan is not None
+    warm_scratch = ledger.copies("selection_scratch")
+    warm_keys = ledger.copies("key_staging")
+    n = 4
+    for _ in range(n):
+        serve(meshed, keys0)
+    assert ledger.copies("selection_scratch") == warm_scratch
+    # Exactly one batched key-staging copy per request, nothing else.
+    assert ledger.copies("key_staging") == warm_keys + n
+    assert meshed._mesh_plan.scratch.reuses >= n
+
+    # Control arm: donation off restages the scratch per request.
+    undonated = ShardedServingPlan(
+        mesh,
+        walk_levels=meshed._mesh_plan.walk_levels,
+        cut_levels=meshed._mesh_plan.cut_levels,
+        chunk_levels=meshed._mesh_plan.chunk_levels,
+        ip=meshed._mesh_plan.ip,
+        donate=False,
+    )
+    from distributed_point_functions_tpu.pir.dense_eval import (
+        stage_keys_host,
+    )
+
+    staged_host = stage_keys_host(list(keys0))
+    db = meshed._mesh_db
+    undonated.run(undonated.stage_keys(staged_host), db)  # warm
+    before = ledger.copies("selection_scratch")
+    for _ in range(n):
+        undonated.run(undonated.stage_keys(staged_host), db)
+    assert ledger.copies("selection_scratch") == before + n
+
+
+def test_mesh_per_request_copies_not_higher_than_single_device():
+    """Zero host relayout at dispatch: a warm mesh request costs no
+    more TransferLedger h2d copies than a warm single-device request
+    (both are exactly one batched key staging)."""
+    mesh = require_mesh2d()
+    oracle = DenseDpfPirServer.create_plain(build_db(RECORDS0))
+    meshed = DenseDpfPirServer.create_plain(build_db(RECORDS0), mesh=mesh)
+    client = DenseDpfPirClient.create(NUM_RECORDS, lambda pt, ci: pt)
+    keys0, _ = client._generate_key_pairs([11, 12, 13])
+    ledger = default_telemetry().transfers
+
+    serve(oracle, keys0)  # warm both paths (db + scratch staged)
+    serve(meshed, keys0)
+    assert meshed._mesh_plan is not None
+
+    before = ledger.copies()
+    serve(oracle, keys0)
+    single_device_copies = ledger.copies() - before
+
+    before = ledger.copies()
+    serve(meshed, keys0)
+    mesh_copies = ledger.copies() - before
+
+    assert mesh_copies <= single_device_copies
+    assert mesh_copies == 1  # the one batched key staging
+
+
+# ---------------------------------------------------------------------------
+# Batcher / capacity wiring
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_pads_buckets_to_key_multiple():
+    from distributed_point_functions_tpu.serving.batcher import (
+        DynamicBatcher,
+    )
+
+    batches = []
+
+    def evaluate(keys):
+        batches.append(len(keys))
+        return list(keys)
+
+    batcher = DynamicBatcher(evaluate, max_batch_size=16, max_wait_ms=0.5)
+    try:
+        batcher.set_key_multiple(8)
+        assert batcher.submit([b"a", b"b", b"c"]) == [b"a", b"b", b"c"]
+        assert batches[-1] == 8  # bucket_size(3)=4, padded to 8
+        batcher.set_key_multiple(1)
+        batcher.submit([b"a", b"b", b"c"])
+        assert batches[-1] == 4
+    finally:
+        batcher.close()
+
+
+def test_session_configures_mesh_capacity_and_key_multiple():
+    mesh = require_mesh2d()
+    with PlainSession(
+        build_db(RECORDS0), ServingConfig(max_batch_size=8), mesh=mesh
+    ) as session:
+        assert session.batcher._key_multiple == 2
+        model = default_capacity_model()
+        assert model.mesh_shape == (4, 2)
+        assert model.mesh_device_count() == 8
+
+
+def test_capacity_model_mesh_pricing():
+    model = CapacityModel(
+        device_memory_bytes=1 << 30,
+        calibration=ThroughputCalibration(history_path="/nonexistent"),
+    )
+    single_qps = model.serving_queries_per_sec()
+    single_bytes = model.price_pir_keys(64, num_blocks=1024).bytes_peak
+    model.configure_mesh(4, 2)
+    # Per-mesh throughput prior: device count x single-device until a
+    # calibrated multi-device record lands.
+    assert model.serving_queries_per_sec() == pytest.approx(
+        8 * single_qps
+    )
+    # Per-shard byte price is strictly below the materialized
+    # single-device peak, and per-mesh budget scales by device count.
+    mesh_bytes = model.price_pir_keys(64, num_blocks=1024).bytes_peak
+    assert 0 < mesh_bytes < single_bytes
+    assert (
+        model.mesh_selection_budget_bytes()
+        == 8 * model.selection_budget_bytes()
+    )
+    assert model.export()["mesh"]["devices"] == 8
+    model.configure_mesh(None)
+    assert model.price_pir_keys(64, num_blocks=1024).bytes_peak == (
+        single_bytes
+    )
+
+
+def test_statusz_mesh_section_and_debug_bundles(tmp_path):
+    import json
+    import urllib.request
+
+    from distributed_point_functions_tpu.observability.admin import (
+        AdminServer,
+    )
+    from distributed_point_functions_tpu.observability.bundle import (
+        BundleManager,
+    )
+
+    mesh = require_mesh2d()
+    meshed = DenseDpfPirServer.create_plain(build_db(RECORDS0), mesh=mesh)
+    client = DenseDpfPirClient.create(NUM_RECORDS, lambda pt, ci: pt)
+    keys0, _ = client._generate_key_pairs([5])
+    serve(meshed, keys0)  # builds the plan + mesh staging
+
+    bundles = BundleManager(directory=str(tmp_path))
+    with AdminServer(mesh=meshed.mesh_export, bundles=bundles) as admin:
+        base = f"http://127.0.0.1:{admin.port}"
+        page = urllib.request.urlopen(base + "/statusz").read().decode()
+        assert "<h2>Mesh</h2>" in page
+        assert "HBM watermark" in page
+        state = json.load(
+            urllib.request.urlopen(base + "/statusz?format=json")
+        )
+    mesh_state = state["mesh"]
+    assert mesh_state["configured"] and mesh_state["two_dee"]
+    assert mesh_state["shape"] == {"shard": 4, "key": 2}
+    # One staging row per device: each of the 4 chunk shards lands on
+    # both devices of its key-axis row (replicated over "key").
+    shards = mesh_state["staging"]["shards"]
+    assert len(shards) == 8
+    assert len({(s["chunk_start"], s["chunk_stop"]) for s in shards}) == 4
+    for shard in shards:
+        assert shard["bytes"] > 0 and shard["copies"] == 1
+        assert shard["hbm_watermark_bytes"] >= shard["bytes"]
+    assert mesh_state["plan"]["donate"] is True
+    # The mesh view rides incident debug bundles too.
+    bundle = bundles.trigger("test")
+    captured = json.load(open(f"{bundle['path']}/mesh.json"))
+    assert captured["shape"] == {"shard": 4, "key": 2}
+
+
+def test_make_mesh2d_validates_shape():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = make_mesh2d(2, 4)
+    assert tuple(mesh.axis_names) == ("shard", "key")
+    assert mesh.shape["shard"] == 2 and mesh.shape["key"] == 4
+    assert make_mesh2d(key_devices=2).shape["shard"] == (
+        len(jax.devices()) // 2
+    )
+    with pytest.raises(ValueError, match="needs"):
+        make_mesh2d(len(jax.devices()), 2)
