@@ -29,6 +29,7 @@ test-fast:     ## ~8 min hermetic signal incl. core invariants + tiny Pallas
 	    tests/test_regression_gate.py \
 	    tests/test_robustness.py tests/test_chaos.py \
 	    tests/test_snapshots.py \
+	    tests/test_pipelined_staging.py tests/test_pipelined_batcher.py \
 	    tests/test_capacity.py tests/test_overload.py \
 	    tests/test_heavy_hitters.py tests/test_incremental_reuse.py \
 	    tests/test_mesh_serving.py \

@@ -1171,10 +1171,17 @@ def _render_statusz(state: dict) -> str:
             f"({_fmt_bytes(totals['d2h_bytes'])}), "
             f"{totals['syncs']} sync waits</p>"
         )
+        hidden = float(totals.get("overlapped_ms", 0.0))
+        exposed = float(totals.get("sync_wait_ms", 0.0))
+        out.append(
+            f"<p>transfer time: {hidden:.1f} ms hidden behind host "
+            f"work (pipelined staging), {exposed:.1f} ms exposed in "
+            f"sync waits</p>"
+        )
         out.append(
             "<table><tr><th>phase</th><th>h2d copies</th>"
             "<th>h2d bytes</th><th>d2h copies</th><th>d2h bytes</th>"
-            "<th>syncs</th></tr>"
+            "<th>syncs</th><th>exposed ms</th><th>hidden ms</th></tr>"
         )
         for phase, entry in transfers["phases"].items():
             out.append(
@@ -1182,7 +1189,10 @@ def _render_statusz(state: dict) -> str:
                 f"<td>{_fmt_bytes(entry['h2d_bytes'])}</td>"
                 f"<td>{entry['d2h_copies']}</td>"
                 f"<td>{_fmt_bytes(entry['d2h_bytes'])}</td>"
-                f"<td>{entry['syncs']}</td></tr>"
+                f"<td>{entry['syncs']}</td>"
+                f"<td>{float(entry.get('sync_wait_ms', 0.0)):.1f}</td>"
+                f"<td>{float(entry.get('overlapped_ms', 0.0)):.1f}</td>"
+                "</tr>"
             )
         out.append("</table>")
 

@@ -457,14 +457,24 @@ class TransferLedger:
     latency program must drive to zero. With `enabled=False` the
     wrappers degrade to bare passthroughs (no lock, no counters) — the
     zero-overhead escape hatch.
+
+    Syncs are counted distinctly from copies on purpose: a pipelined
+    staging path issues many async copies but drains them with ONE
+    final sync, so `syncs << h2d_copies` is the ledger-visible
+    signature that transfers overlapped host work instead of each
+    paying its own round trip. `record_overlap(ms, phase)` accumulates
+    the companion `overlapped_ms` — host-side milliseconds spent doing
+    useful work while copies were already in flight, i.e. transfer
+    latency *hidden* behind the pipeline rather than exposed serially.
     """
 
     def __init__(self, registry=None, enabled: bool = True):
         self._lock = threading.Lock()
         self._registry = registry
         self.enabled = enabled
-        # phase -> {h2d_copies, h2d_bytes, d2h_copies, d2h_bytes, syncs}
-        self._phases: Dict[str, Dict[str, int]] = {}
+        # phase -> {h2d_copies, h2d_bytes, d2h_copies, d2h_bytes,
+        #           syncs, overlapped_ms}
+        self._phases: Dict[str, Dict[str, float]] = {}
 
     def bind_registry(self, registry) -> None:
         with self._lock:
@@ -472,16 +482,18 @@ class TransferLedger:
 
     # -- recording ----------------------------------------------------------
 
+    @staticmethod
+    def _new_entry() -> Dict[str, float]:
+        return {"h2d_copies": 0, "h2d_bytes": 0, "d2h_copies": 0,
+                "d2h_bytes": 0, "syncs": 0, "sync_wait_ms": 0.0,
+                "overlapped_ms": 0.0}
+
     def _record(self, phase: str, field: str, copies: int,
                 nbytes: int = 0) -> None:
         if not self.enabled:
             return
         with self._lock:
-            entry = self._phases.setdefault(
-                phase,
-                {"h2d_copies": 0, "h2d_bytes": 0, "d2h_copies": 0,
-                 "d2h_bytes": 0, "syncs": 0},
-            )
+            entry = self._phases.setdefault(phase, self._new_entry())
             if field == "sync":
                 entry["syncs"] += copies
             else:
@@ -511,8 +523,46 @@ class TransferLedger:
     def record_d2h(self, nbytes: int, phase: str, copies: int = 1) -> None:
         self._record(phase, "d2h", copies, int(nbytes))
 
-    def record_sync(self, phase: str) -> None:
+    def record_sync(self, phase: str, wait_ms: float = 0.0) -> None:
+        """One host<->device sync round trip; `wait_ms` is the measured
+        host wall time the sync blocked (the *exposed* half of the
+        phase's transfer time — see `record_overlap` for the hidden
+        half)."""
         self._record(phase, "sync", 1)
+        wait_ms = float(wait_ms)
+        if not self.enabled or wait_ms <= 0.0:
+            return
+        with self._lock:
+            entry = self._phases.setdefault(phase, self._new_entry())
+            entry["sync_wait_ms"] += wait_ms
+            registry = self._registry
+        if registry is not None:
+            try:
+                registry.counter(
+                    "device.sync_wait_ms", labels={"phase": phase}
+                ).inc(wait_ms)
+            except Exception:  # pragma: no cover - telemetry never raises
+                pass
+
+    def record_overlap(self, ms: float, phase: str) -> None:
+        """Credit `ms` of host work performed while transfers for
+        `phase` were in flight (the hidden half of the phase's
+        transfer time; the exposed half is whatever the final sync
+        still waits)."""
+        ms = float(ms)
+        if not self.enabled or ms <= 0.0:
+            return
+        with self._lock:
+            entry = self._phases.setdefault(phase, self._new_entry())
+            entry["overlapped_ms"] += ms
+            registry = self._registry
+        if registry is not None:
+            try:
+                registry.counter(
+                    "device.overlapped_ms", labels={"phase": phase}
+                ).inc(ms)
+            except Exception:  # pragma: no cover - telemetry never raises
+                pass
 
     # -- counted wrappers ---------------------------------------------------
 
@@ -540,12 +590,16 @@ class TransferLedger:
         return out
 
     def block_until_ready(self, x, *, phase: str = "unattributed"):
-        """Counted `jax.block_until_ready` (one sync round trip)."""
+        """Counted `jax.block_until_ready` (one sync round trip; the
+        blocked wall time lands in the phase's `sync_wait_ms`)."""
         import jax
 
+        t0 = time.perf_counter()
         out = jax.block_until_ready(x)
         if self.enabled:
-            self.record_sync(phase)
+            self.record_sync(
+                phase, wait_ms=(time.perf_counter() - t0) * 1e3
+            )
         return out
 
     # -- reading ------------------------------------------------------------
@@ -565,14 +619,46 @@ class TransferLedger:
                 if phase is None or p == phase
             )
 
+    def syncs(self, phase: Optional[str] = None) -> int:
+        """`block_until_ready` round trips (one phase, or all)."""
+        with self._lock:
+            return sum(
+                e["syncs"] for p, e in self._phases.items()
+                if phase is None or p == phase
+            )
+
+    def overlapped_ms(self, phase: Optional[str] = None) -> float:
+        """Milliseconds of transfer time hidden behind host work."""
+        with self._lock:
+            return sum(
+                e["overlapped_ms"] for p, e in self._phases.items()
+                if phase is None or p == phase
+            )
+
+    def sync_wait_ms(self, phase: Optional[str] = None) -> float:
+        """Milliseconds spent blocked in sync round trips (the exposed
+        half of the transfer time)."""
+        with self._lock:
+            return sum(
+                e.get("sync_wait_ms", 0.0)
+                for p, e in self._phases.items()
+                if phase is None or p == phase
+            )
+
     def export(self) -> dict:
         with self._lock:
             phases = {p: dict(e) for p, e in sorted(self._phases.items())}
-        totals = {"h2d_copies": 0, "h2d_bytes": 0, "d2h_copies": 0,
-                  "d2h_bytes": 0, "syncs": 0}
+        totals = self._new_entry()
         for entry in phases.values():
             for k in totals:
-                totals[k] += entry[k]
+                # Old-format entries (pre-overlap pickles/tests) may
+                # lack the newer keys; treat absent as zero.
+                totals[k] += entry.get(k, 0)
+        for ms_key in ("overlapped_ms", "sync_wait_ms"):
+            totals[ms_key] = round(totals[ms_key], 3)
+            for entry in phases.values():
+                if ms_key in entry:
+                    entry[ms_key] = round(entry[ms_key], 3)
         return {"enabled": self.enabled, "totals": totals,
                 "phases": phases}
 

@@ -23,7 +23,9 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 import warnings
+import weakref
 from typing import List, Sequence
 
 import jax
@@ -71,6 +73,45 @@ def _v2_tile_knobs() -> dict:
             continue
         knobs[key] = val
     return knobs
+
+
+_PIPELINE_ENV = "DPF_TPU_PIPELINED_STAGING"
+
+
+def pipelined_staging_enabled() -> bool:
+    """Whether chunked device stagings upload piece-by-piece on JAX's
+    async dispatch stream (one final sync) instead of one synchronous
+    full-image `device_put`. On by default; set
+    DPF_TPU_PIPELINED_STAGING=0 to restore the upfront path (the
+    bench's A/B baseline). Read per staging call so tests and capture
+    windows can flip it without rebuilding databases."""
+    return os.environ.get(_PIPELINE_ENV, "1").strip().lower() not in (
+        "0", "false", "off", "no",
+    )
+
+
+def _stage_pieces_pipelined(ledger, pieces, assemble,
+                            phase: str = "db_staging"):
+    """Pipelined H2D staging: each host piece is its own counted async
+    `device_put` — JAX's dispatch queue bounds the copy stream (the
+    host runs at most one piece ahead of the DMA engine, the
+    double-buffer depth) with no per-piece sync — then `assemble`
+    combines the parts device-side and ONE final counted sync drains
+    everything. The wall time between the first put returning and that
+    final sync is host work (issuing the remaining uploads,
+    dispatching the assembly) performed while copies were already in
+    flight; the ledger accumulates it as the phase's `overlapped_ms`,
+    the hidden half of the phase's transfer time."""
+    parts = []
+    t_first = None
+    for piece in pieces:
+        parts.append(ledger.device_put(piece, phase=phase))
+        if t_first is None:
+            t_first = time.perf_counter()
+    arr = assemble(parts)
+    if t_first is not None:
+        ledger.record_overlap((time.perf_counter() - t_first) * 1e3, phase)
+    return ledger.block_until_ready(arr, phase=phase)
 
 
 def words_to_record_bytes(
@@ -232,6 +273,19 @@ class DenseDpfPirDatabase:
         self._stage_lock = threading.RLock()
         self._failed_tiers: set = set()
         self._failed_knobs: set = set()  # v2 knob combos that crashed
+        # Delta-build lineage (`_from_delta` fills these): the previous
+        # generation (weakly, so a rotation chain never retains every
+        # ancestor's host image) and the sorted updated row indices.
+        # When the base's staging is still resident, `prestage()` /
+        # `db_words` / `streaming_chunks` scatter only these rows'
+        # chunks into a NEW device buffer instead of re-uploading the
+        # full image.
+        self._delta_base = None
+        self._delta_rows = None
+        # {mode, bytes_staged, bytes_full_image, bytes_saved,
+        #  generation} for the most recent prestage() call —
+        # snapshots/statusz/bench read the delta ratio here.
+        self.last_prestage_stats = None
 
     @classmethod
     def _from_delta(
@@ -260,6 +314,8 @@ class DenseDpfPirDatabase:
             host[i] = row.view("<u4").astype(np.uint32)
         db._host_words = host
         db._init_runtime()
+        db._delta_base = weakref.ref(prev)
+        db._delta_rows = sorted(int(i) for i in updates)
         return db
 
     @property
@@ -291,14 +347,80 @@ class DenseDpfPirDatabase:
         with self._stage_lock:
             if self._db_words is None:
                 telemetry = default_telemetry()
+                ledger = telemetry.transfers
                 with telemetry.hbm.phase("db_staging"):
-                    self._db_words = telemetry.transfers.block_until_ready(
-                        telemetry.transfers.device_put(
-                            self._host_words, phase="db_staging"
-                        ),
-                        phase="db_staging",
-                    )
+                    taken = False
+                    try:
+                        taken = self._stage_rowmajor_delta(ledger)
+                    except Exception as e:  # noqa: BLE001 - full restage
+                        warnings.warn(
+                            "delta row-major staging failed; staging in "
+                            f"full ({str(e).splitlines()[0][:200]})"
+                        )
+                    if not taken:
+                        host = self._host_words
+                        slabs = min(8, host.shape[0] // 128)
+                        if pipelined_staging_enabled() and slabs >= 2:
+                            self._db_words = _stage_pieces_pipelined(
+                                ledger,
+                                np.array_split(host, slabs),
+                                lambda parts: jnp.concatenate(
+                                    parts, axis=0
+                                ),
+                            )
+                        else:
+                            self._db_words = ledger.block_until_ready(
+                                ledger.device_put(
+                                    host, phase="db_staging"
+                                ),
+                                phase="db_staging",
+                            )
             return self._db_words
+
+    def _delta_base_db(self):
+        """The previous generation of a delta build, while something
+        still holds it alive (serving or retired-awaiting-drain);
+        None otherwise — the weakref keeps a rotation chain from
+        retaining every ancestor's host image."""
+        ref = self._delta_base
+        return ref() if ref is not None else None
+
+    def _stage_rowmajor_delta(self, ledger) -> bool:
+        """Delta staging of the row-major buffer: scatter only this
+        generation's updated rows into the base generation's resident
+        device buffer. `.at[rows].set` builds a NEW buffer — the base,
+        possibly still serving, is never mutated — while only the
+        updated rows (plus a tiny index vector) cross the PCIe bus.
+        Returns True when taken; callers fall back to full staging."""
+        rows = self._delta_rows
+        base = self._delta_base_db()
+        if rows is None or base is None:
+            return False
+        base_words = base._db_words
+        if base_words is None or tuple(base_words.shape) != tuple(
+            self._host_words.shape
+        ):
+            return False
+        if not rows:
+            # Empty delta: generation N+1's bytes are N's exactly, and
+            # jax arrays are immutable, so sharing the buffer is safe.
+            self._db_words = base_words
+            return True
+        num_rows, width = self._host_words.shape
+        if len(rows) * (width + 1) >= num_rows * width:
+            # The delta touches (nearly) everything: the scattered rows
+            # plus the index vector would cross the bus at full-image
+            # cost or worse. Stage in full instead.
+            return False
+        idx = np.asarray(rows, dtype=np.int32)
+        vals = np.ascontiguousarray(self._host_words[idx])
+        self._db_words = ledger.block_until_ready(
+            base_words.at[
+                ledger.device_put(idx, phase="db_staging")
+            ].set(ledger.device_put(vals, phase="db_staging")),
+            phase="db_staging",
+        )
+        return True
 
     def record(self, i: int) -> bytes:
         return self._records[i]
@@ -317,11 +439,19 @@ class DenseDpfPirDatabase:
 
         Without `mesh`: stages the row-major single-device buffer;
         layout variants (bit-major, bitrev, streaming) still stage
-        lazily on first use. With `mesh` (+ the serving plan's
-        `cut_levels`/`bitmajor`): stages the streaming chunk spans
-        pre-partitioned over the mesh's shard axis, each record shard
-        placed directly on its device — the flip is then a cache hit.
-        Returns the bytes staged by this call (0 if already resident).
+        lazily on first use — except for a **delta build**
+        (`Builder.build_from`), where (a) any layout the base
+        generation holds resident is rebuilt by scattering only the
+        updated rows/chunks into it (a new buffer; the base is never
+        mutated), and (b) the base's single-device streaming staging,
+        when present, is pre-built in the same layout so the post-flip
+        first request is a cache hit on the streaming tier too. Mesh
+        stagings always restage in full (per-device scatter of a
+        sharded buffer is not worth the choreography; documented
+        limitation). Returns the bytes this call moved host->device
+        (0 if everything was already resident); `last_prestage_stats`
+        carries {mode, bytes_staged, bytes_full_image, bytes_saved,
+        generation} for snapshots, /statusz, and the bench.
         """
         if mesh is not None:
             if cut_levels is None:
@@ -342,12 +472,63 @@ class DenseDpfPirDatabase:
                     shard_axis=shard_axis,
                 )
                 info = self._mesh_staging_info or {}
-                return int(info.get("total_bytes", 0))
+                staged = int(info.get("total_bytes", 0))
+                self.last_prestage_stats = {
+                    "mode": "full",
+                    "bytes_staged": staged,
+                    "bytes_full_image": staged,
+                    "bytes_saved": 0,
+                    "generation": int(self._generation),
+                }
+                return staged
+        telemetry = default_telemetry()
+        ledger = telemetry.transfers
         with self._stage_lock:
-            if self._db_words is not None:
+            bytes_before = ledger.bytes_h2d("db_staging")
+            full_equiv = 0
+            staged_new = False
+            base = (
+                self._delta_base_db()
+                if self._delta_rows is not None else None
+            )
+            # Serve-layout double buffer for delta builds: when the
+            # base generation holds a resident single-device streaming
+            # staging, build ours in the same layout now (the delta
+            # scatter path inside streaming_chunks) instead of leaving
+            # it to the post-flip first request.
+            if base is not None and self._streaming_stage is None:
+                with base._stage_lock:
+                    bstage = base._streaming_stage
+                if bstage is not None and len(bstage[0]) == 2:
+                    cut, bm = bstage[0]
+                    self.streaming_chunks(cut_levels=cut, bitmajor=bm)
+                    full_equiv += int(self._host_words_padded().nbytes)
+                    staged_new = True
+            if self._db_words is None:
+                _ = self.db_words
+                full_equiv += int(self._host_words.nbytes)
+                staged_new = True
+            if not staged_new:
                 return 0
-            _ = self.db_words
-            return int(self._host_words.nbytes)
+            if ledger.enabled:
+                staged = max(
+                    0, ledger.bytes_h2d("db_staging") - bytes_before
+                )
+            else:
+                staged = full_equiv
+            saved = max(0, full_equiv - staged)
+            self.last_prestage_stats = {
+                "mode": (
+                    "delta"
+                    if self._delta_rows is not None and saved > 0
+                    else "full"
+                ),
+                "bytes_staged": int(staged),
+                "bytes_full_image": int(full_equiv),
+                "bytes_saved": int(saved),
+                "generation": int(self._generation),
+            }
+            return int(staged)
 
     def release_stagings(self) -> int:
         """Drop every device staging (row-major, bit-major, bitrev,
@@ -516,41 +697,128 @@ class DenseDpfPirDatabase:
                 and self._streaming_stage[0] == key
             ):
                 return self._streaming_stage[1]
-            host = streaming_block_permute_records(
-                self._host_words_padded(), cut_levels
-            )
-            nc = 1 << cut_levels
             if mesh is not None:
+                host = streaming_block_permute_records(
+                    self._host_words_padded(), cut_levels
+                )
                 arr = self._stage_chunks_mesh(
-                    host, nc, mesh, shard_axis, bitmajor
+                    host, 1 << cut_levels, mesh, shard_axis, bitmajor
                 )
                 self._streaming_stage = (key, arr)
                 return arr
             self._mesh_staging_info = None
             ledger = default_telemetry().transfers
             with default_telemetry().hbm.phase("db_staging"):
-                if bitmajor:
-                    from ..ops.inner_product_pallas import (
-                        stage_db_chunks_bitmajor,
+                arr = None
+                try:
+                    arr = self._stage_streaming_delta(key, ledger)
+                except Exception as e:  # noqa: BLE001 - full restage
+                    warnings.warn(
+                        "delta streaming staging failed; restaging in "
+                        f"full ({str(e).splitlines()[0][:200]})"
                     )
-
-                    arr = ledger.block_until_ready(
-                        stage_db_chunks_bitmajor(
-                            ledger.device_put(host, phase="db_staging"),
-                            nc,
-                        ),
-                        phase="db_staging",
-                    )
-                else:
-                    arr = ledger.block_until_ready(
-                        ledger.device_put(
-                            host.reshape(nc, -1, host.shape[1]),
-                            phase="db_staging",
-                        ),
-                        phase="db_staging",
+                if arr is None:
+                    arr = self._stage_streaming_full(
+                        ledger, cut_levels, bitmajor
                     )
             self._streaming_stage = (key, arr)
             return arr
+
+    def _stage_streaming_full(self, ledger, cut_levels: int,
+                              bitmajor: bool):
+        """Full-image staging of the single-device streaming layout —
+        pipelined per-chunk when enabled (async puts, device-side
+        assembly, one sync), the one-shot upfront put otherwise."""
+        from .dense_eval_planes_v2 import streaming_block_permute_records
+
+        host = streaming_block_permute_records(
+            self._host_words_padded(), cut_levels
+        )
+        nc = 1 << cut_levels
+        chunks = host.reshape(nc, -1, host.shape[1])
+        if pipelined_staging_enabled() and nc >= 2:
+            if bitmajor:
+                from ..ops.inner_product_pallas import permute_db_bitmajor
+
+                # stage_db_chunks_bitmajor == vmap(permute_db_bitmajor)
+                # over equal chunk spans, so assembling the per-chunk
+                # uploads and vmapping reproduces it bit for bit.
+                return _stage_pieces_pipelined(
+                    ledger, list(chunks),
+                    lambda parts: jax.vmap(permute_db_bitmajor)(
+                        jnp.stack(parts)
+                    ),
+                )
+            return _stage_pieces_pipelined(ledger, list(chunks), jnp.stack)
+        if bitmajor:
+            from ..ops.inner_product_pallas import stage_db_chunks_bitmajor
+
+            return ledger.block_until_ready(
+                stage_db_chunks_bitmajor(
+                    ledger.device_put(host, phase="db_staging"), nc
+                ),
+                phase="db_staging",
+            )
+        return ledger.block_until_ready(
+            ledger.device_put(chunks, phase="db_staging"),
+            phase="db_staging",
+        )
+
+    def _stage_streaming_delta(self, key, ledger):
+        """Delta staging of the (non-mesh) streaming layout: upload
+        only the chunks containing an updated record and scatter them
+        into the base generation's resident staging (a new device
+        array; the base keeps serving its own buffers). Returns the
+        staged array, or None when the delta path does not apply (not
+        a delta build, base released or never staged this layout, key
+        mismatch)."""
+        rows = self._delta_rows
+        base = self._delta_base_db()
+        if rows is None or base is None or len(key) != 2:
+            return None
+        with base._stage_lock:
+            stage = base._streaming_stage
+        if stage is None or stage[0] != key:
+            return None
+        cut_levels, bitmajor = key
+        base_arr = stage[1]
+        from .dense_eval_planes_v2 import streaming_block_order
+
+        host = self._host_words_padded()
+        width = host.shape[1]
+        nb = host.shape[0] // 128
+        levels = max(0, (nb - 1).bit_length())
+        nc = 1 << cut_levels
+        if nb != 1 << levels or nc > nb or int(base_arr.shape[0]) != nc:
+            return None
+        bpc = nb // nc
+        order = streaming_block_order(levels, cut_levels)
+        # Updated record i lives in natural block i // 128, which the
+        # involution places at staged position order[i // 128]; staged
+        # positions group into chunks of bpc consecutive blocks.
+        touched = sorted({int(order[r // 128]) // bpc for r in rows})
+        if not touched:
+            return base_arr
+        if len(touched) >= nc:
+            # Every chunk holds an update: a scatter of all chunks is a
+            # full-image upload plus overhead. Restage in full instead.
+            return None
+        blocks = host.reshape(nb, 128, width)
+        pieces = np.stack([
+            blocks[order[c * bpc:(c + 1) * bpc]].reshape(bpc * 128, width)
+            for c in touched
+        ])
+        dvals = ledger.device_put(pieces, phase="db_staging")
+        if bitmajor:
+            from ..ops.inner_product_pallas import permute_db_bitmajor
+
+            dvals = jax.vmap(permute_db_bitmajor)(dvals)
+        didx = ledger.device_put(
+            np.asarray(touched, dtype=np.int32), phase="db_staging"
+        )
+        return ledger.block_until_ready(
+            base_arr.at[didx].set(dvals), phase="db_staging"
+        )
 
     def _stage_chunks_mesh(self, host, nc, mesh, shard_axis, bitmajor):
         """Place chunk spans pre-partitioned over the mesh's shard axis.

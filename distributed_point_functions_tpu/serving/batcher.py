@@ -32,6 +32,20 @@ missing piece:
   `DeadlineExceeded` without evaluating, and a bucket whose every
   request died skips the dispatch entirely — and the submitting thread
   enforces the same deadline on its wait.
+* With `pipeline_depth >= 2` the worker runs a bounded two-stage
+  pipeline: it dispatches bucket N (admission gate, padding,
+  `begin_batch` generation binding, the evaluation itself) while a
+  completion thread finishes bucket N-1 (result fan-out, phase
+  attribution, `end_batch`, cost-ledger feed). The handoff queue holds
+  at most `pipeline_depth - 1` evaluated buckets, so the worker never
+  runs further ahead than the pipeline depth. Semantics are preserved
+  exactly: the generation is still bound at dispatch by the worker
+  (serial, so flips still land only at batch boundaries), a bucket's
+  `end_batch` still fires only after its last response fans out (so a
+  rotation can never free buffers or idle-flip between the dispatch
+  and completion halves), and `close()` drains the in-flight stage
+  before returning. Depth 1 completes inline — the pre-pipeline
+  behavior, bit for bit.
 
 The batcher is generic over the evaluation function
 (`evaluate(keys) -> list of per-key results`), so it serves any of the
@@ -121,11 +135,34 @@ class _Pending:
         self.phases = phases_mod.current_request()
 
 
+class _BatchResult:
+    """One evaluated bucket between the worker's dispatch half and the
+    completion half. Everything the fan-out needs is captured at
+    dispatch time (on the worker), so completion never reads worker
+    state that a later bucket may have advanced."""
+
+    __slots__ = (
+        "live", "results", "error", "collected", "eval_ms", "assembly_s",
+        "bucket", "flat_len", "pad_waste", "generation", "batch_phases",
+        "transfer_bytes", "gate_t",
+    )
+
+    def __init__(self):
+        self.results = None
+        self.error = None
+        self.collected = {}
+        self.eval_ms = 0.0
+        self.batch_phases = None
+        self.transfer_bytes = 0
+
+
 class DynamicBatcher:
     """See module docstring. One background worker forms and evaluates
-    batches; `submit` blocks the calling thread until its slice of the
-    batch result is ready (or raises `Overloaded` / `DeadlineExceeded` /
-    the evaluation error)."""
+    batches (plus, at `pipeline_depth >= 2`, a completion thread that
+    fans out bucket N-1 while the worker dispatches bucket N); `submit`
+    blocks the calling thread until its slice of the batch result is
+    ready (or raises `Overloaded` / `DeadlineExceeded` / the
+    evaluation error)."""
 
     def __init__(
         self,
@@ -137,11 +174,14 @@ class DynamicBatcher:
         metrics: Optional[MetricsRegistry] = None,
         name: str = "batcher",
         admission: Optional[AdmissionController] = None,
+        pipeline_depth: int = 1,
     ):
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
+        if pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
         self._evaluate = evaluate
         self._max_batch_size = max_batch_size
         self._batch_cap: Optional[int] = None  # brownout step 2
@@ -170,6 +210,12 @@ class DynamicBatcher:
         )
         self._c_expired_in_batch = m.counter(f"{n}.expired_in_batch")
         self._c_batches_skipped = m.counter(f"{n}.batches_skipped_dead")
+        # Phase-attribution residual that would have gone negative:
+        # collected phase brackets exceeded the measured wall time
+        # (clock skew, nested brackets). `dispatch` clamps at zero and
+        # the excess lands here instead of corrupting the residual.
+        self._c_slop = m.counter(f"{n}.attribution_slop_ms")
+        m.gauge(f"{n}.pipeline_depth").set(float(pipeline_depth))
         self._cond = threading.Condition()
         # Weighted-fair across tenants under cost-aware admission;
         # plain FIFO otherwise (and WFQ degenerates to FIFO for a
@@ -188,6 +234,22 @@ class DynamicBatcher:
         self._key_multiple = 1
         self._seen_buckets: set = set()
         self._closed = False
+        # Depth-2 pipeline handoff: the worker appends evaluated
+        # buckets, the completion thread pops them. Bounded at
+        # pipeline_depth - 1 so the worker can run at most one bucket
+        # ahead of the completion half (depth 1 => no thread, inline
+        # completion = pre-pipeline behavior).
+        self._pipeline_depth = int(pipeline_depth)
+        self._complete_q: deque = deque()
+        self._complete_cond = threading.Condition()
+        self._worker_done = False
+        self._completer: Optional[threading.Thread] = None
+        if self._pipeline_depth > 1:
+            self._completer = threading.Thread(
+                target=self._complete_loop, daemon=True,
+                name=f"{name}-completer",
+            )
+            self._completer.start()
         self._worker = threading.Thread(
             target=self._run, daemon=True, name=f"{name}-worker"
         )
@@ -388,6 +450,16 @@ class DynamicBatcher:
         return batch, time.monotonic() - t_first
 
     def _run(self) -> None:
+        try:
+            self._run_loop()
+        finally:
+            # Unblock the completion thread (and let it exit once the
+            # handoff queue drains) no matter how the worker stopped.
+            with self._complete_cond:
+                self._worker_done = True
+                self._complete_cond.notify_all()
+
+    def _run_loop(self) -> None:
         while True:
             collected = self._collect()
             if collected is None:
@@ -444,6 +516,14 @@ class DynamicBatcher:
                     generation = None
             for p in live:
                 p.generation = generation
+            record = _BatchResult()
+            record.live = live
+            record.assembly_s = assembly_s
+            record.bucket = bucket
+            record.flat_len = len(flat)
+            record.pad_waste = pad_waste
+            record.generation = generation
+            record.gate_t = now
             try:
                 # Chaos site: a worker-side fault here must fan out to
                 # every live request and leave the worker serving.
@@ -461,81 +541,135 @@ class DynamicBatcher:
                         recorder.collect() as batch_phases:
                     # The batch-scoped record soaks up phase() brackets
                     # inside the evaluation path (h2d staging,
-                    # compile-vs-compute in pir/server); the fan-out
-                    # below re-attributes them to every live request.
+                    # compile-vs-compute in pir/server); the completion
+                    # half re-attributes them to every live request.
                     results = list(self._evaluate(padded))
-                eval_ms = (time.perf_counter() - t_eval) * 1e3
-                collected = (
+                record.eval_ms = (time.perf_counter() - t_eval) * 1e3
+                record.results = results
+                record.collected = (
                     batch_phases.snapshot()
                     if batch_phases is not None else {}
                 )
-                # Whatever the evaluation spent outside any phase
-                # bracket is batcher/handler overhead: dispatch.
-                dispatch_ms = max(0.0, eval_ms - sum(collected.values()))
+                record.batch_phases = batch_phases
+                # Measured worker-side, right after the evaluation
+                # returns, so bucket N's staging traffic can never
+                # bleed into bucket N-1's cost record on the
+                # completion thread.
+                record.transfer_bytes = max(
+                    0, _h2d_bytes(telemetry) - h2d_before
+                )
                 if len(results) < len(flat):
                     raise RuntimeError(
                         f"evaluate returned {len(results)} results for "
                         f"{len(flat)} keys"
                     )
             except Exception as e:  # noqa: BLE001 - fan the error out
-                for p in live:
-                    self._release(p)
-                    p.error = e
-                    p.event.set()
-                self._end_batch(generation)
-                continue
-            # Batch-level stage aggregates (once per batch) ...
-            tracing.add_span(
-                "batch_assembly", assembly_s * 1e3,
-                bucket=bucket, batch_keys=len(flat),
-            )
-            tracing.add_span(
-                "device_compute", eval_ms, pad_waste_ratio=round(pad_waste, 4)
-            )
-            offset = 0
-            done = time.monotonic()
-            for p in live:
-                p.result = results[offset:offset + len(p.keys)]
-                offset += len(p.keys)
-                queue_wait_ms = (now - p.t0) * 1e3
-                self._h_queue_wait.observe(queue_wait_ms)
-                self._h_latency.observe((done - p.t0) * 1e3)
-                # ... and per-request spans grafted onto the submitting
-                # thread's trace so /tracez decomposes each request.
-                if p.trace is not None:
-                    p.trace.add_span("queue_wait", queue_wait_ms)
-                    p.trace.add_span(
-                        "batch_assembly", assembly_s * 1e3,
-                        bucket=bucket, batch_keys=len(flat),
-                    )
-                    p.trace.add_span(
-                        "device_compute", eval_ms,
-                        pad_waste_ratio=round(pad_waste, 4),
-                    )
-                if p.phases is not None:
-                    p.phases.add("queue", queue_wait_ms)
-                    p.phases.add("batch", assembly_s * 1e3)
-                    p.phases.add_many(collected)
-                    p.phases.add("dispatch", dispatch_ms)
-                self._release(p)
-                p.event.set()
-            # The batch has fully retired against its generation: let a
-            # waiting flip proceed (and the old generation's stagings
-            # drop once its last batch lands here).
-            self._end_batch(generation)
-            # Terminal batch outcome: join the capacity-model estimate
-            # for the executed bucket with the measured device truth
-            # (after every waiter is released, so accounting adds no
-            # request latency).
-            self._observe_cost(
-                bucket, live, collected, eval_ms, batch_phases,
-                telemetry, h2d_before,
-            )
+                record.error = e
+            self._dispatch_complete(record)
 
-    def _observe_cost(
-        self, bucket, live, collected, eval_ms, batch_phases,
-        telemetry, h2d_before,
-    ) -> None:
+    # -- completion half ----------------------------------------------------
+
+    def _dispatch_complete(self, record: _BatchResult) -> None:
+        """Hand an evaluated bucket to the completion half. Depth 1
+        completes inline on the worker (pre-pipeline behavior);
+        otherwise the handoff queue is bounded at depth-1 evaluated
+        buckets, so the worker blocks rather than running unboundedly
+        ahead of fan-out."""
+        if self._completer is None:
+            self._finish(record)
+            return
+        with self._complete_cond:
+            while len(self._complete_q) >= self._pipeline_depth - 1:
+                self._complete_cond.wait()
+            self._complete_q.append(record)
+            self._complete_cond.notify_all()
+
+    def _complete_loop(self) -> None:
+        while True:
+            with self._complete_cond:
+                while not self._complete_q and not self._worker_done:
+                    self._complete_cond.wait()
+                if not self._complete_q:
+                    return
+                record = self._complete_q.popleft()
+                self._complete_cond.notify_all()
+            try:
+                self._finish(record)
+            except Exception as e:  # noqa: BLE001 - never kill the completer
+                for p in record.live:
+                    if not p.event.is_set():
+                        p.error = e
+                        p.event.set()
+
+    def _finish(self, rec: _BatchResult) -> None:
+        """Complete one evaluated bucket: error/result fan-out, phase
+        attribution, `end_batch`, cost-ledger feed. Runs inline on the
+        worker at depth 1 and on the completion thread otherwise; reads
+        only the `_BatchResult` snapshot, never live worker state."""
+        if rec.error is not None:
+            for p in rec.live:
+                self._release(p)
+                p.error = rec.error
+                p.event.set()
+            self._end_batch(rec.generation)
+            return
+        collected = rec.collected
+        # Whatever the evaluation spent outside any phase bracket is
+        # batcher/handler overhead: dispatch. Clamped at zero — when
+        # the brackets over-cover the wall time the excess is recorded
+        # as attribution slop instead of a negative residual.
+        collected_ms = sum(collected.values())
+        dispatch_ms = max(0.0, rec.eval_ms - collected_ms)
+        slop_ms = max(0.0, collected_ms - rec.eval_ms)
+        if slop_ms > 0.0:
+            self._c_slop.inc(slop_ms)
+        # Batch-level stage aggregates (once per batch) ...
+        tracing.add_span(
+            "batch_assembly", rec.assembly_s * 1e3,
+            bucket=rec.bucket, batch_keys=rec.flat_len,
+        )
+        tracing.add_span(
+            "device_compute", rec.eval_ms,
+            pad_waste_ratio=round(rec.pad_waste, 4),
+        )
+        offset = 0
+        done = time.monotonic()
+        for p in rec.live:
+            p.result = rec.results[offset:offset + len(p.keys)]
+            offset += len(p.keys)
+            queue_wait_ms = (rec.gate_t - p.t0) * 1e3
+            self._h_queue_wait.observe(queue_wait_ms)
+            self._h_latency.observe((done - p.t0) * 1e3)
+            # ... and per-request spans grafted onto the submitting
+            # thread's trace so /tracez decomposes each request.
+            if p.trace is not None:
+                p.trace.add_span("queue_wait", queue_wait_ms)
+                p.trace.add_span(
+                    "batch_assembly", rec.assembly_s * 1e3,
+                    bucket=rec.bucket, batch_keys=rec.flat_len,
+                )
+                p.trace.add_span(
+                    "device_compute", rec.eval_ms,
+                    pad_waste_ratio=round(rec.pad_waste, 4),
+                )
+            if p.phases is not None:
+                p.phases.add("queue", queue_wait_ms)
+                p.phases.add("batch", rec.assembly_s * 1e3)
+                p.phases.add_many(collected)
+                p.phases.add("dispatch", dispatch_ms)
+            self._release(p)
+            p.event.set()
+        # The batch has fully retired against its generation: let a
+        # waiting flip proceed (and the old generation's stagings
+        # drop once its last batch lands here).
+        self._end_batch(rec.generation)
+        # Terminal batch outcome: join the capacity-model estimate
+        # for the executed bucket with the measured device truth
+        # (after every waiter is released, so accounting adds no
+        # request latency).
+        self._observe_cost(rec)
+
+    def _observe_cost(self, rec: _BatchResult) -> None:
         """Feed the cost ledger one (estimate, truth) pair for this
         batch. The estimate is what the capacity model would charge for
         the executed padded bucket (corrections included, so the
@@ -545,26 +679,24 @@ class DynamicBatcher:
         phase brackets (stub evaluators in tests). Never raises."""
         try:
             plan_meta = (
-                batch_phases.get_meta("serving_plan")
-                if batch_phases is not None else None
+                rec.batch_phases.get_meta("serving_plan")
+                if rec.batch_phases is not None else None
             ) or {}
             tier = str(plan_meta.get("mode", "unplanned"))
-            actual_ms = collected.get("device_compute", 0.0)
+            actual_ms = rec.collected.get("device_compute", 0.0)
             if actual_ms <= 0.0:
                 actual_ms = max(
-                    0.0, eval_ms - collected.get("compile", 0.0)
+                    0.0, rec.eval_ms - rec.collected.get("compile", 0.0)
                 )
-            predicted = default_capacity_model().price_pir_keys(bucket)
+            predicted = default_capacity_model().price_pir_keys(rec.bucket)
             trace = next(
-                (p.trace for p in live if p.trace is not None), None
+                (p.trace for p in rec.live if p.trace is not None), None
             )
             costmodel_mod.default_cost_ledger().observe(
-                "pir", tier, str(bucket),
+                "pir", tier, str(rec.bucket),
                 predicted_device_ms=predicted.device_ms,
                 actual_device_ms=actual_ms,
-                transfer_bytes=max(
-                    0, _h2d_bytes(telemetry) - h2d_before
-                ),
+                transfer_bytes=rec.transfer_bytes,
                 trace=trace,
             )
         except Exception:  # noqa: BLE001 - accounting never breaks serving
@@ -573,12 +705,18 @@ class DynamicBatcher:
     # -- lifecycle ----------------------------------------------------------
 
     def close(self, timeout: float = 10.0) -> None:
-        """Drain the queue, then stop the worker. Subsequent submits
-        raise."""
+        """Drain the queue, then stop the worker — and, when pipelined,
+        the completion thread, so every in-flight bucket fans out
+        before close() returns. Subsequent submits raise."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
         self._worker.join(timeout=timeout)
+        if self._completer is not None:
+            with self._complete_cond:
+                self._worker_done = True
+                self._complete_cond.notify_all()
+            self._completer.join(timeout=timeout)
 
     def __enter__(self):
         return self

@@ -163,6 +163,11 @@ class ServingConfig:
     # next batch boundary; the window is the coordinator's bounded
     # Helper-first/Leader-last flip gap.
     snapshot_retries: int = 3
+    # Batcher pipeline depth: 2 (default) lets the worker dispatch
+    # bucket N while a completion thread fans out bucket N-1 (see
+    # serving/batcher.py); 1 restores strictly serial
+    # dispatch-then-complete batches.
+    pipeline_depth: int = 2
 
 
 # The deadline travels from handle_request into the server's plain
@@ -315,6 +320,7 @@ class _Session:
                 metrics=self.metrics,
                 name=f"{name}.batcher",
                 admission=self.admission,
+                pipeline_depth=self._config.pipeline_depth,
             )
             server.set_plain_handler(self._batched_plain_handler)
         # Mesh wiring: a 2-D-mesh server tells the batcher its key-axis

@@ -123,6 +123,10 @@ class SnapshotManager:
         self._g_staging = m.gauge(f"{name}.staging_generation")
         self._cond = threading.Condition()
         self._staging = None
+        # Most recent prestage accounting (database.last_prestage_stats
+        # from the last stage() call): mode full/delta, bytes staged vs
+        # the full image, bytes saved.
+        self._last_stage: Optional[dict] = None
         self._pending_flip = False
         # generation -> in-flight batch count (bound at begin_batch).
         self._inflight: dict = {}
@@ -197,12 +201,19 @@ class SnapshotManager:
             staged_bytes = prestage(database)
         else:
             staged_bytes = database.prestage()
+        # Delta prestage visibility: the database reports what it
+        # actually uploaded vs the full image (serving/snapshots
+        # rotation cost = `bytes_staged`; `bytes_saved` is the delta
+        # win, 0 for a full staging).
+        stage_stats = getattr(database, "last_prestage_stats", None)
         replaced = None
         with self._cond:
             if self._staging is not None and self._staging is not database:
                 replaced = self._staging
             self._staging = database
             self._g_staging.set(float(database.generation))
+            if stage_stats is not None:
+                self._last_stage = dict(stage_stats)
         if replaced is not None:
             replaced.release_stagings()
         return staged_bytes
@@ -461,6 +472,10 @@ class SnapshotManager:
                 "flips": self._c_flips.value,
                 "aborts": self._c_aborts.value,
                 "mismatches": self._c_mismatches.value,
+                "last_stage": (
+                    dict(self._last_stage)
+                    if self._last_stage is not None else None
+                ),
                 "history": [dict(r) for r in self._history],
             }
 

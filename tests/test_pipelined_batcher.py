@@ -1,0 +1,265 @@
+"""Depth-2 batcher pipeline: async dispatch with a completion thread.
+
+At `pipeline_depth >= 2` the batcher worker dispatches bucket N while
+a completion thread finishes bucket N-1. These tests pin the contract:
+results/errors are identical to the serial depth-1 path, `close()`
+drains the in-flight completion stage, the phase-attribution residual
+(`dispatch`) clamps at zero with the excess counted in
+`attribution_slop_ms`, and — the rotation-safety half — a snapshot
+flip can never apply between the dispatch and completion halves of a
+pipelined bucket (in-flight counts span begin_batch .. end_batch).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.observability import phases as phases_mod
+from distributed_point_functions_tpu.pir import (
+    DenseDpfPirClient,
+    DenseDpfPirDatabase,
+)
+from distributed_point_functions_tpu.pir import messages
+from distributed_point_functions_tpu.prng import xor_bytes
+from distributed_point_functions_tpu.serving import (
+    PlainSession,
+    ServingConfig,
+    SnapshotManager,
+)
+from distributed_point_functions_tpu.serving.batcher import DynamicBatcher
+from distributed_point_functions_tpu.observability.events import EventJournal
+
+
+# ---------------------------------------------------------------------------
+# Depth-2 equivalence on a stub evaluator
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_pipelined_results_match_serial(depth):
+    with DynamicBatcher(
+        lambda keys: [k * 3 for k in keys],
+        max_batch_size=8,
+        max_wait_ms=1.0,
+        pipeline_depth=depth,
+    ) as batcher:
+        out = {}
+
+        def work(i):
+            out[i] = batcher.submit([i, i + 1000])
+
+        threads = [
+            threading.Thread(target=work, args=(i,)) for i in range(24)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert out == {i: [3 * i, 3 * (i + 1000)] for i in range(24)}
+
+
+def test_pipelined_error_fans_out_and_worker_recovers():
+    flaky = {"fail": True}
+
+    def evaluate(keys):
+        if flaky["fail"]:
+            raise RuntimeError("boom")
+        return list(keys)
+
+    with DynamicBatcher(
+        evaluate, max_batch_size=4, max_wait_ms=1.0, pipeline_depth=2
+    ) as batcher:
+        errors = []
+
+        def work(i):
+            try:
+                batcher.submit([i])
+            except RuntimeError as e:
+                errors.append(str(e))
+
+        threads = [
+            threading.Thread(target=work, args=(i,)) for i in range(5)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == ["boom"] * 5
+        flaky["fail"] = False
+        assert batcher.submit([42]) == [42]
+
+
+def test_close_drains_the_inflight_completion_stage():
+    def slow(keys):
+        time.sleep(0.15)
+        return list(keys)
+
+    batcher = DynamicBatcher(
+        slow, max_batch_size=1, max_wait_ms=0.0, pipeline_depth=2
+    )
+    results = {}
+
+    def work(i):
+        results[i] = batcher.submit([i])
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    batcher.close()
+    for t in threads:
+        t.join(timeout=5.0)
+    assert results == {0: [0], 1: [1], 2: [2]}
+
+
+def test_validates_pipeline_depth():
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        DynamicBatcher(lambda k: k, pipeline_depth=0)
+
+
+# ---------------------------------------------------------------------------
+# dispatch attribution: non-negative residual, slop counted
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_clamps_at_zero_and_slop_is_counted():
+    """An evaluation whose phase brackets over-cover its wall time
+    (clock skew, out-of-band attribution) must not produce a negative
+    `dispatch` residual — it clamps at zero and the excess lands in
+    the `attribution_slop_ms` counter."""
+
+    def evaluate(keys):
+        # Out-of-band attribution far exceeding the actual wall time.
+        phases_mod.record("device_compute", 60_000.0)
+        return list(keys)
+
+    recorder = phases_mod.default_phase_recorder()
+    with DynamicBatcher(
+        evaluate, max_wait_ms=0.0, pipeline_depth=2
+    ) as batcher:
+        with recorder.request("test-client", fresh=True) as req:
+            assert batcher.submit([7]) == [7]
+        snapshot = req.snapshot()
+        assert snapshot.get("dispatch", 0.0) == 0.0
+        assert snapshot["device_compute"] == 60_000.0
+        counters = batcher.metrics.export()["counters"]
+        assert counters["batcher.attribution_slop_ms"] > 59_000.0
+
+
+def test_real_dispatch_time_still_attributes():
+    """With no phase brackets at all, the whole evaluation wall time is
+    dispatch — the clamp only removes the impossible negative case."""
+    with DynamicBatcher(
+        lambda keys: (time.sleep(0.02), list(keys))[1],
+        max_wait_ms=0.0,
+        pipeline_depth=2,
+    ) as batcher:
+        recorder = phases_mod.default_phase_recorder()
+        with recorder.request("test-client", fresh=True) as req:
+            assert batcher.submit([1]) == [1]
+        assert req.snapshot().get("dispatch", 0.0) >= 20.0
+        counters = batcher.metrics.export()["counters"]
+        assert counters.get("batcher.attribution_slop_ms", 0.0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Flip atomicity across the dispatch/completion split
+# ---------------------------------------------------------------------------
+
+NUM_RECORDS = 128
+RECORD_BYTES = 16
+RNG = np.random.default_rng(20260807)
+RECORDS0 = [
+    bytes(RNG.integers(0, 256, RECORD_BYTES, dtype=np.uint8))
+    for _ in range(NUM_RECORDS)
+]
+RECORDS1 = [bytes(b ^ 0xA5 for b in r) for r in RECORDS0]
+
+
+def build_db(records):
+    builder = DenseDpfPirDatabase.Builder()
+    for r in records:
+        builder.insert(r)
+    return builder.build()
+
+
+def delta_db(prev, records):
+    builder = DenseDpfPirDatabase.Builder()
+    for i, r in enumerate(records):
+        builder.update(i, r)
+    return builder.build_from(prev)
+
+
+def test_flip_never_applies_between_dispatch_and_completion():
+    """A pipelined bucket binds its generation at dispatch
+    (`begin_batch`) and retires it at completion (`end_batch`). While
+    it sits between the two halves — evaluated, waiting for fan-out —
+    the rotation's idle-apply path must refuse to flip: the in-flight
+    count spans the whole pipeline, not just the evaluation."""
+    indices = [1, 7]
+    client = DenseDpfPirClient(NUM_RECORDS, lambda pt, info: pt)
+    req0, req1 = client.create_plain_requests(indices)
+    combined = messages.PirRequest(
+        plain_request=messages.PlainRequest(
+            dpf_keys=list(req0.plain_request.dpf_keys)
+            + list(req1.plain_request.dpf_keys)
+        )
+    )
+    config = ServingConfig(
+        max_batch_size=8, max_wait_ms=1.0, pipeline_depth=2
+    )
+    with PlainSession(build_db(RECORDS0), config) as session:
+        manager = SnapshotManager(session, journal=EventJournal())
+        session.handle_request(combined)  # warm the jit path
+        batcher = session._batcher
+        entered = threading.Event()
+        gate = threading.Event()
+        orig_finish = batcher._finish
+
+        def gated_finish(rec):
+            entered.set()
+            gate.wait(timeout=10.0)
+            orig_finish(rec)
+
+        batcher._finish = gated_finish
+        try:
+            responses = {}
+
+            def query():
+                responses["resp"] = session.handle_request(combined)
+
+            thread = threading.Thread(target=query)
+            thread.start()
+            # The bucket is now evaluated (dispatch half done,
+            # generation 0 bound and counted in flight) but stuck
+            # before its completion half.
+            assert entered.wait(timeout=10.0)
+            manager.stage(delta_db(session.server.database, RECORDS1))
+            assert sum(manager.export()["inflight"].values()) >= 1
+            with pytest.raises(TimeoutError):
+                manager.flip(timeout=0.3)
+            # Still serving generation 0: the armed flip refused the
+            # idle-apply mid-bucket and timed out instead.
+            assert manager.serving_generation() == 0
+        finally:
+            gate.set()
+        thread.join(timeout=10.0)
+        batcher._finish = orig_finish
+        # The gated bucket fanned out against generation 0 exactly.
+        masked = responses["resp"].dpf_pir_response.masked_response
+        k = len(indices)
+        got = [xor_bytes(masked[i], masked[k + i]) for i in range(k)]
+        assert got == [RECORDS0[i] for i in indices]
+        # Drained: the flip now applies and generation 1 serves.
+        record = manager.flip(timeout=10.0)
+        assert record["to_generation"] == 1
+        resp = session.handle_request(combined)
+        masked = resp.dpf_pir_response.masked_response
+        got = [xor_bytes(masked[i], masked[k + i]) for i in range(k)]
+        assert got == [RECORDS1[i] for i in indices]
+        # stage() surfaced the delta-prestage accounting.
+        last_stage = manager.export()["last_stage"]
+        assert last_stage is not None
+        assert last_stage["bytes_staged"] >= 0

@@ -1,0 +1,285 @@
+"""Pipelined double-buffered staging and incremental delta prestage.
+
+The hot-path pipelining work (double-buffered H2D chunk staging, delta
+prestage) must never change a single staged bit: the pipelined path
+(async per-piece uploads, device-side assembly, ONE final counted
+sync) and the delta path (scatter of only the touched rows/chunks into
+the base generation's resident staging) are pure layout/transport
+optimizations. These tests pin that down:
+
+* bit-identity of pipelined vs upfront staging on every planner tier —
+  materialized (row-major `db_words`), streaming row-major chunks, and
+  chunked bit-major (the pallas2 scan layout) — plus the forced
+  8-device mesh staging against the single-device oracle;
+* the ledger signature of pipelining: strictly fewer syncs than h2d
+  copies, nonzero `overlapped_ms` (vs the upfront path's one copy /
+  one sync);
+* delta prestage equivalence: a `Builder.build_from` generation whose
+  `prestage()` scatters only updated rows/chunks produces buffers
+  byte-identical to a from-scratch full staging of the same records,
+  at a fraction of the staged bytes.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.observability.device import (
+    DeviceTelemetry,
+    default_telemetry,
+    set_default_telemetry,
+)
+from distributed_point_functions_tpu.parallel import make_mesh
+from distributed_point_functions_tpu.pir import DenseDpfPirDatabase
+from distributed_point_functions_tpu.pir.database import (
+    pipelined_staging_enabled,
+)
+
+NUM_RECORDS = 1024  # 8 selection blocks: enough for chunked plans
+RECORD_BYTES = 8
+RNG = np.random.default_rng(20260806)
+RECORDS = [
+    bytes(RNG.integers(0, 256, RECORD_BYTES, dtype=np.uint8))
+    for _ in range(NUM_RECORDS)
+]
+
+
+def build_db(records=RECORDS):
+    builder = DenseDpfPirDatabase.Builder()
+    for r in records:
+        builder.insert(r)
+    return builder.build()
+
+
+@pytest.fixture
+def telemetry():
+    prev = default_telemetry()
+    fresh = set_default_telemetry(DeviceTelemetry())
+    try:
+        yield fresh
+    finally:
+        set_default_telemetry(prev)
+
+
+@pytest.fixture
+def pipelined(monkeypatch):
+    monkeypatch.setenv("DPF_TPU_PIPELINED_STAGING", "1")
+    assert pipelined_staging_enabled()
+
+
+@pytest.fixture
+def upfront(monkeypatch):
+    monkeypatch.setenv("DPF_TPU_PIPELINED_STAGING", "0")
+    assert not pipelined_staging_enabled()
+
+
+def _staged_with_env(monkeypatch, value, stage_fn):
+    """Stage a fresh database with the pipelining env set to `value`
+    and return the staged buffer as a host array."""
+    monkeypatch.setenv("DPF_TPU_PIPELINED_STAGING", value)
+    return np.asarray(stage_fn(build_db()))
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity: pipelined == upfront on every tier
+# ---------------------------------------------------------------------------
+
+
+def test_rowmajor_pipelined_matches_upfront(monkeypatch, telemetry):
+    ref = _staged_with_env(monkeypatch, "0", lambda db: db.db_words)
+    pipe = _staged_with_env(monkeypatch, "1", lambda db: db.db_words)
+    np.testing.assert_array_equal(ref, pipe)
+
+
+@pytest.mark.parametrize("bitmajor", [False, True])
+@pytest.mark.parametrize("cut_levels", [1, 2])
+def test_streaming_pipelined_matches_upfront(
+    monkeypatch, telemetry, bitmajor, cut_levels
+):
+    """Streaming row-major (streaming tier) and per-chunk bit-major
+    (chunked/pallas2 tier) stagings are byte-identical either way."""
+
+    def stage(db):
+        return db.streaming_chunks(cut_levels=cut_levels, bitmajor=bitmajor)
+
+    ref = _staged_with_env(monkeypatch, "0", stage)
+    pipe = _staged_with_env(monkeypatch, "1", stage)
+    np.testing.assert_array_equal(ref, pipe)
+
+
+@pytest.mark.parametrize("bitmajor", [False, True])
+def test_mesh_staging_matches_single_device(
+    monkeypatch, telemetry, pipelined, bitmajor
+):
+    """The forced-8-device mesh staging assembles the same global bytes
+    as the single-device staging of the same plan."""
+    mesh = make_mesh(8, axis_name="shard")
+    single = np.asarray(
+        build_db().streaming_chunks(cut_levels=3, bitmajor=bitmajor)
+    )
+    meshed = np.asarray(
+        build_db().streaming_chunks(
+            cut_levels=3, bitmajor=bitmajor, mesh=mesh
+        )
+    )
+    np.testing.assert_array_equal(single, meshed)
+
+
+# ---------------------------------------------------------------------------
+# Ledger signature: many async copies, ONE sync, nonzero overlap
+# ---------------------------------------------------------------------------
+
+
+def test_pipelined_staging_syncs_fewer_than_copies(telemetry, pipelined):
+    ledger = telemetry.transfers
+    db = build_db()
+    ledger.reset()
+    _ = db.db_words
+    assert ledger.copies("db_staging") >= 2  # per-slab async uploads
+    assert ledger.syncs("db_staging") == 1  # ... drained by ONE sync
+    assert ledger.syncs("db_staging") < ledger.copies("db_staging")
+    assert ledger.overlapped_ms("db_staging") > 0.0
+
+    db2 = build_db()
+    ledger.reset()
+    _ = db2.streaming_chunks(cut_levels=2, bitmajor=True)
+    assert ledger.syncs("db_staging") < ledger.copies("db_staging")
+    assert ledger.overlapped_ms("db_staging") > 0.0
+
+
+def test_upfront_staging_is_one_copy_one_sync(telemetry, upfront):
+    ledger = telemetry.transfers
+    db = build_db()
+    ledger.reset()
+    _ = db.db_words
+    assert ledger.copies("db_staging") == 1
+    assert ledger.syncs("db_staging") == 1
+    assert ledger.overlapped_ms("db_staging") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Delta prestage: scatter only the touched rows/chunks, bit-identical
+# ---------------------------------------------------------------------------
+
+
+def delta_records(updates):
+    records = list(RECORDS)
+    for i in updates:
+        records[i] = bytes(b ^ 0x5A for b in records[i])
+    return records
+
+
+def delta_build(base, updates):
+    builder = DenseDpfPirDatabase.Builder()
+    records = delta_records(updates)
+    for i in updates:
+        builder.update(i, records[i])
+    return builder.build_from(base)
+
+
+UPDATES = [3, 129, 700]
+
+
+def test_delta_prestage_rowmajor_equivalence(telemetry, pipelined):
+    base = build_db()
+    _ = base.db_words  # base generation resident, as when serving
+    db1 = delta_build(base, UPDATES)
+    ledger = telemetry.transfers
+    before = ledger.bytes_h2d("db_staging")
+    staged = db1.prestage()
+    assert staged == ledger.bytes_h2d("db_staging") - before
+    # Only the touched rows (plus the index vector) crossed the bus.
+    assert 0 < staged < int(db1._host_words.nbytes)
+    stats = db1.last_prestage_stats
+    assert stats["mode"] == "delta"
+    assert stats["bytes_saved"] > 0
+    assert stats["bytes_staged"] + stats["bytes_saved"] == (
+        stats["bytes_full_image"]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(db1.db_words),
+        np.asarray(build_db(delta_records(UPDATES)).db_words),
+    )
+
+
+@pytest.mark.parametrize("bitmajor", [False, True])
+def test_delta_prestage_streaming_equivalence(
+    telemetry, pipelined, bitmajor
+):
+    """When the base generation serves a streaming/chunked staging, a
+    delta build's prestage() re-derives that layout by scattering only
+    the touched chunks — byte-identical to staging the new records
+    from scratch."""
+    base = build_db()
+    _ = base.streaming_chunks(cut_levels=2, bitmajor=bitmajor)
+    db1 = delta_build(base, UPDATES)
+    ledger = telemetry.transfers
+    before = ledger.bytes_h2d("db_staging")
+    staged = db1.prestage()
+    assert 0 < staged
+    stats = db1.last_prestage_stats
+    assert stats["mode"] == "delta"
+    assert stats["bytes_saved"] > 0
+    # The staged streaming layout is already resident (no new bytes)
+    # and matches the full-image oracle bit for bit.
+    mid = ledger.bytes_h2d("db_staging")
+    got = db1.streaming_chunks(cut_levels=2, bitmajor=bitmajor)
+    assert ledger.bytes_h2d("db_staging") == mid  # cache hit
+    np.testing.assert_array_equal(
+        np.asarray(got),
+        np.asarray(
+            build_db(delta_records(UPDATES)).streaming_chunks(
+                cut_levels=2, bitmajor=bitmajor
+            )
+        ),
+    )
+
+
+def test_delta_touching_everything_stages_in_full(telemetry, pipelined):
+    """A delta that rewrites (nearly) every row would cost full-image
+    bytes plus scatter overhead — the delta path steps aside and the
+    staging goes up in full, still bit-identical."""
+    base = build_db()
+    _ = base.db_words
+    all_rows = list(range(NUM_RECORDS))
+    db1 = delta_build(base, all_rows)
+    staged = db1.prestage()
+    assert staged == int(db1._host_words.nbytes)
+    assert db1.last_prestage_stats["mode"] == "full"
+    np.testing.assert_array_equal(
+        np.asarray(db1.db_words),
+        np.asarray(build_db(delta_records(all_rows)).db_words),
+    )
+
+
+def test_empty_delta_shares_the_base_buffer(telemetry, pipelined):
+    """`build_from` with zero updates shares the base's immutable
+    device buffer outright: nothing crosses the bus."""
+    base = build_db()
+    base_words = base.db_words
+    db1 = delta_build(base, [])
+    ledger = telemetry.transfers
+    before = ledger.bytes_h2d("db_staging")
+    staged = db1.prestage()
+    assert staged == 0
+    assert ledger.bytes_h2d("db_staging") == before
+    assert db1.db_words is base_words
+
+
+def test_released_base_falls_back_to_full(telemetry, pipelined):
+    """The delta base is held by weakref: once the previous generation
+    is garbage (rotation chains must not pin every ancestor's host
+    image), prestage degrades to a plain full staging."""
+    base = build_db()
+    _ = base.db_words
+    db1 = delta_build(base, UPDATES)
+    del base
+    import gc
+
+    gc.collect()
+    staged = db1.prestage()
+    assert staged == int(db1._host_words.nbytes)
+    assert db1.last_prestage_stats["mode"] == "full"
+    np.testing.assert_array_equal(
+        np.asarray(db1.db_words),
+        np.asarray(build_db(delta_records(UPDATES)).db_words),
+    )

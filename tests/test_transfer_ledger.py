@@ -41,6 +41,8 @@ def test_records_land_in_the_right_phase():
     led.record_h2d(4096, "db_staging", copies=2)
     led.record_d2h(256, "result_readback")
     led.record_sync("db_staging")
+    led.record_sync("key_staging", wait_ms=1.25)
+    led.record_overlap(2.5, "db_staging")
 
     assert led.copies("key_staging") == 1
     assert led.copies("db_staging") == 2
@@ -48,16 +50,26 @@ def test_records_land_in_the_right_phase():
     assert led.copies() == 3
     assert led.bytes_h2d("key_staging") == 1024
     assert led.bytes_h2d() == 5120
+    assert led.syncs("db_staging") == 1
+    assert led.syncs() == 2
+    assert led.overlapped_ms("db_staging") == 2.5
+    assert led.overlapped_ms("key_staging") == 0.0
+    assert led.sync_wait_ms("key_staging") == 1.25
+    assert led.sync_wait_ms("db_staging") == 0.0
+    assert led.sync_wait_ms() == 1.25
 
     export = led.export()
     assert export["enabled"] is True
     assert export["totals"] == {
         "h2d_copies": 3, "h2d_bytes": 5120,
-        "d2h_copies": 1, "d2h_bytes": 256, "syncs": 1,
+        "d2h_copies": 1, "d2h_bytes": 256, "syncs": 2,
+        "sync_wait_ms": 1.25, "overlapped_ms": 2.5,
     }
     assert export["phases"]["result_readback"]["d2h_bytes"] == 256
     assert export["phases"]["db_staging"]["syncs"] == 1
-    assert export["phases"]["key_staging"]["syncs"] == 0
+    assert export["phases"]["db_staging"]["overlapped_ms"] == 2.5
+    assert export["phases"]["key_staging"]["syncs"] == 1
+    assert export["phases"]["key_staging"]["sync_wait_ms"] == 1.25
 
 
 def test_wrappers_count_and_preserve_values():
@@ -89,7 +101,8 @@ def test_disabled_ledger_is_bare_passthrough():
     led = TransferLedger(enabled=False)
     led.record_h2d(1024, "key_staging")
     led.record_d2h(256, "result_readback")
-    led.record_sync("db_staging")
+    led.record_sync("db_staging", wait_ms=3.0)
+    led.record_overlap(5.0, "db_staging")
     x = np.ones(4, np.uint32)
     dev = led.device_put(x, phase="key_staging")
     led.block_until_ready(dev, phase="key_staging")
