@@ -317,6 +317,62 @@ def append_pipeline_history(point, bench):
         _log(f"pipeline history append skipped: {e}")
 
 
+def append_utilization_history(point, bench):
+    """Best-effort: append the two device-seconds-ledger records the
+    regression gate locks in — `device_duty_cycle_pct` (the fraction of
+    tracked worker time spent feeding the device, direction "higher")
+    and `pipeline_bubble_ms_p99` (the tail of the typed idle-bubble
+    reservoir, direction "lower"). The off-vs-on `overhead_pct` stays
+    report-only (<2% budget reviewed from the report, not gated).
+    Never fatal to the bench."""
+    if not point or point.get("duty_cycle_pct") is None:
+        return
+    try:
+        from benchmarks.regression_gate import append_record, git_rev
+
+        path = os.environ.get(
+            "BENCH_HISTORY_PATH", "benchmarks/results/history.jsonl"
+        )
+        rev = git_rev()
+        device = os.environ.get("BENCH_PLATFORM", "cpu")
+        status = "ok" if point["mismatches"] == 0 else "mismatch"
+        append_record(
+            {
+                "metric": "device_duty_cycle_pct",
+                "value": float(point["duty_cycle_pct"]),
+                "unit": "pct",
+                "direction": "higher",
+                "status": status,
+                "vs_baseline": None,
+                "git_rev": rev,
+                "device": device,
+                "bench": bench,
+                "concurrency": point["concurrency"],
+                "overhead_pct": point["overhead_pct"],
+            },
+            path=path,
+        )
+        if point.get("bubble_ms_p99") is not None:
+            append_record(
+                {
+                    "metric": "pipeline_bubble_ms_p99",
+                    "value": float(point["bubble_ms_p99"]),
+                    "unit": "ms",
+                    "direction": "lower",
+                    "status": status,
+                    "vs_baseline": None,
+                    "git_rev": rev,
+                    "device": device,
+                    "bench": bench,
+                    "bubbles": point["bubbles"],
+                    "bubble_causes": point["bubble_causes"],
+                },
+                path=path,
+            )
+    except Exception as e:  # noqa: BLE001 - accounting never fails a bench
+        _log(f"utilization history append skipped: {e}")
+
+
 def _closed_loop(handle, requests, concurrency):
     """Run `requests` through `handle` from `concurrency` closed-loop
     client threads; returns (wall_seconds, latencies_ms, responses)."""
@@ -807,6 +863,76 @@ def run_serving_bench():
         f"{pipeline_overhead['prestage_bytes_full_image']} bytes"
     )
 
+    # Utilization A/B: the same batched point back to back with the
+    # device-seconds ledger off (`ServingConfig(utilization=False)`,
+    # the batcher never sees a tracker) vs on (the default). The
+    # on-leg's duty cycle and bubble p99 become the gated
+    # `device_duty_cycle_pct` (direction "higher") and
+    # `pipeline_bubble_ms_p99` (direction "lower") history records;
+    # `overhead_pct` — the throughput cost of bracketing every
+    # worker/completion interval — stays report-only under the same
+    # <2% budget as the other always-on telemetry points.
+    def utilization_point():
+        from distributed_point_functions_tpu.observability.utilization import (
+            default_utilization_tracker,
+        )
+
+        concurrency = concurrency_levels[-1]
+        tracker = default_utilization_tracker()
+
+        def leg(enabled):
+            tracker.reset()
+            config = ServingConfig(
+                max_batch_size=max_batch,
+                max_wait_ms=2.0,
+                max_queue=max(256, 4 * num_requests),
+                batching=True,
+                utilization=enabled,
+            )
+            with PlainSession(database, config) as session:
+                wall, _, resps = _closed_loop(
+                    session.handle_request, requests, concurrency
+                )
+            bad = sum(
+                1
+                for got, want in zip(resps, oracle)
+                if got.dpf_pir_response.masked_response != want
+            )
+            return len(requests) / wall, bad
+
+        baseline_qps, baseline_bad = leg(False)
+        utilization_qps, utilization_bad = leg(True)
+        totals = tracker.export()["totals"]
+
+        return {
+            "concurrency": concurrency,
+            "requests_per_leg": len(requests),
+            "baseline_qps": round(baseline_qps, 2),
+            "utilization_qps": round(utilization_qps, 2),
+            "overhead_pct": round(
+                100.0 * (baseline_qps - utilization_qps) / baseline_qps,
+                2,
+            ),
+            "duty_cycle_pct": totals["duty_cycle_pct"],
+            "bubble_ms_p99": round(totals["bubble_ms_p99"], 3)
+            if totals["bubble_ms_p99"] is not None
+            else None,
+            "bubble_causes": sorted(totals["idle_s"]),
+            "bubbles": totals["bubbles"],
+            "mismatches": baseline_bad + utilization_bad,
+        }
+
+    utilization_overhead = utilization_point()
+    _log(
+        f"utilization A/B c={utilization_overhead['concurrency']}: off "
+        f"{utilization_overhead['baseline_qps']:.1f} -> on "
+        f"{utilization_overhead['utilization_qps']:.1f} q/s "
+        f"({utilization_overhead['overhead_pct']:+.1f}% overhead), duty "
+        f"cycle {utilization_overhead['duty_cycle_pct']}%, bubble p99 "
+        f"{utilization_overhead['bubble_ms_p99']} ms over "
+        f"{utilization_overhead['bubbles']} bubbles"
+    )
+
     # Mesh stage: the same closed-loop point served from a 2-D device
     # mesh (shard x key axes) behind the identical serving surface,
     # bit-checked against the same oracle. Also the donation proof:
@@ -932,6 +1058,7 @@ def run_serving_bench():
         and digest_overhead["mismatches"] == 0
         and ledger_overhead["mismatches"] == 0
         and pipeline_overhead["mismatches"] == 0
+        and utilization_overhead["mismatches"] == 0
         and (mesh_point is None or mesh_point["mismatches"] == 0)
     )
     compiles = batched_metrics["counters"].get(
@@ -957,6 +1084,7 @@ def run_serving_bench():
         "digest_overhead": digest_overhead,
         "ledger_overhead": ledger_overhead,
         "pipeline_overhead": pipeline_overhead,
+        "utilization_overhead": utilization_overhead,
         "mesh": mesh_point,
         "cost_model_residual_p50": cost_model_residual,
         "jit_bucket_compiles": compiles,
@@ -998,6 +1126,9 @@ def main():
         append_mesh_history(report["mesh"], bench="serving_bench")
         append_pipeline_history(
             report["pipeline_overhead"], bench="serving_bench"
+        )
+        append_utilization_history(
+            report["utilization_overhead"], bench="serving_bench"
         )
     if not report["correctness_ok"]:
         raise SystemExit("serving bench FAILED correctness")

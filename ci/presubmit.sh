@@ -62,12 +62,27 @@
 #                    host devices: closed-loop traffic against one
 #                    logical server spread over a 2-D (shard x key)
 #                    mesh, one snapshot rotation mid-traffic, zero
-#                    prober failures, no cross-generation reads, and
-#                    the per-shard staging visible in mesh_export
-#  14. perf-gate   — benchmarks/regression_gate.py --check-only against
+#                    prober failures, no cross-generation reads, the
+#                    per-shard staging visible in mesh_export, and the
+#                    per-shard busy rows on /utilz
+#  14. pipeline-smoke — the hot-path pipelining contract end to end:
+#                    depth-2 batcher under closed-loop load, one delta
+#                    rotation (prestage saves bytes), prober green
+#                    through the flip, /statusz shows overlapped
+#                    (hidden) transfer time
+#  15. util-smoke  — the device-seconds ledger end to end: closed-loop
+#                    traffic must put a nonzero duty cycle with a
+#                    populated bubble breakdown (causes summing to the
+#                    measured idle) on /utilz, an injected helper-leg
+#                    delay failpoint must journal a util.anomaly via
+#                    the rate-of-change watch, and a debug bundle
+#                    captured after the stall must carry >= 60 s of
+#                    flight-data history with the anomaly in its
+#                    journal tail
+#  16. perf-gate   — benchmarks/regression_gate.py --check-only against
 #                    the committed history fixture (CPU-safe: judges
 #                    records, runs no bench)
-#  15. dryrun      — 8-virtual-device multichip compile+step
+#  17. dryrun      — 8-virtual-device multichip compile+step
 # Benchmarks are excluded exactly as the reference excludes
 # `--test_tag_filters=-benchmark`. `FULL=1` appends the whole suite.
 set -u -o pipefail
@@ -845,9 +860,10 @@ print("rotation-smoke: OK (2 rotations under load: staleness "
 # is fully sharded (all shards generation N+1, never a partial flip).
 stage shard-smoke env JAX_PLATFORMS=cpu \
     XLA_FLAGS=--xla_force_host_platform_device_count=8 python -c '
-import threading, time
+import json, threading, time, urllib.request
 import numpy as np
 import jax
+from distributed_point_functions_tpu.observability.admin import AdminServer
 from distributed_point_functions_tpu.parallel.sharded import make_mesh2d
 from distributed_point_functions_tpu.pir import (
     DenseDpfPirClient, DenseDpfPirDatabase,
@@ -946,10 +962,19 @@ with PlainSession(build(recs[0]), config, mesh=mesh) as session:
     assert len(per_dev) == 8, info["staging"]
     assert len({(s["chunk_start"], s["chunk_stop"]) for s in per_dev}) == 4
     assert session.server._mesh_plan is not None, "fell back post-flip"
+    # The utilization ledger saw the mesh: every dispatch credits each
+    # of the 4 chunk shards, so /utilz grows one busy row per shard.
+    with AdminServer(registry=session.metrics) as admin:
+        url = "http://127.0.0.1:%d/utilz?format=json" % admin.port
+        util = json.load(urllib.request.urlopen(url))
+        shard_rows = util["shards"]
+        assert len(shard_rows) == 4, shard_rows
+        assert all(row["busy_s"] > 0.0 for row in shard_rows.values()), \
+            shard_rows
     completed = stats["completed"]
 print("shard-smoke: OK (mesh 4x2 over 8 forced devices, 1 rotation "
       f"under load, {completed} completed, 0 torn, prober green on "
-      "generation 1, staging sharded 4-ways)")
+      "generation 1, staging sharded 4-ways, 4 shard rows on /utilz)")
 '
 
 # --- pipeline-smoke: the hot-path pipelining contract (ISSUE 14) end
@@ -1089,6 +1114,165 @@ print("pipeline-smoke: OK (depth-2 batcher, 1 delta rotation under "
       f"load, {completed} completed, 0 torn, prober green on "
       f"generation 1, prestage saved {saved} of {full_image} bytes, "
       f"overlapped {hidden_ms:.1f} ms hidden)")
+'
+
+# --- util-smoke: the device-seconds ledger (ISSUE 15) end to end.
+# Closed-loop traffic with think-time gaps through a real Leader /
+# Helper pair must land a nonzero duty cycle on /utilz whose bubble
+# breakdown is populated with typed causes summing to the measured
+# idle; the flight-data sampler (driven on a synthetic 1 Hz clock so
+# the stage is fast and deterministic) accrues >= 60 s of history; an
+# injected 80 ms delay failpoint on the in-process helper leg spikes
+# the helper-latency p99 past the anomaly watch band and must journal
+# a util.anomaly; and a debug bundle captured after the stall must
+# carry the full time-series history plus the anomaly in its journal
+# tail.
+stage util-smoke env JAX_PLATFORMS=cpu python -c '
+import json, os, time, urllib.request
+import numpy as np
+from distributed_point_functions_tpu.observability import (
+    events as events_mod,
+)
+from distributed_point_functions_tpu.observability.admin import AdminServer
+from distributed_point_functions_tpu.observability.bundle import (
+    BundleManager,
+)
+from distributed_point_functions_tpu.observability.timeseries import (
+    MetricsSampler,
+)
+from distributed_point_functions_tpu.observability.utilization import (
+    default_utilization_tracker,
+)
+from distributed_point_functions_tpu.pir import (
+    DenseDpfPirClient, DenseDpfPirDatabase,
+)
+from distributed_point_functions_tpu.robustness.failpoints import (
+    default_failpoints,
+)
+from distributed_point_functions_tpu.serving import (
+    HelperSession, LeaderSession, ServingConfig,
+)
+from distributed_point_functions_tpu.serving.transport import (
+    InProcessTransport,
+)
+from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+NUM, NBYTES = 256, 16
+rng = np.random.default_rng(15)
+recs = [bytes(rng.integers(0, 256, NBYTES, dtype=np.uint8))
+        for _ in range(NUM)]
+builder = DenseDpfPirDatabase.Builder()
+for r in recs:
+    builder.insert(r)
+db = builder.build()
+
+config = ServingConfig(max_batch_size=4, max_wait_ms=1.0)
+tracker = default_utilization_tracker()
+with HelperSession(db, encrypt_decrypt.decrypt, config) as helper, \
+        LeaderSession(db, InProcessTransport(helper.handle_wire),
+                      config) as leader:
+    client = DenseDpfPirClient.create(NUM, encrypt_decrypt.encrypt)
+
+    def query(indices):
+        request, state = client.create_request(indices)
+        return client.handle_response(
+            leader.handle_request(request), state
+        )
+
+    assert query([3]) == [recs[3]]
+    # Closed-loop traffic with think-time gaps: the worker sees both
+    # busy evals and typed idle bubbles (empty_queue / batch_wait).
+    for i in range(24):
+        idx = (7 * i) % NUM
+        assert query([idx]) == [recs[idx]]
+        time.sleep(0.005)
+
+    # Flight-data sampler on a synthetic 1 Hz clock: fake timestamps
+    # end at the real monotonic clock so ring-horizon checks against
+    # the live clock keep every point. The helper_net phase reservoir
+    # is the stall-sensitive series: unlike the end-to-end latency
+    # histograms (whose p99 is pinned at the ~seconds first-compile
+    # outlier), its p99 sits at ~1 ms until the failpoint fires.
+    sampler = MetricsSampler(
+        registry=leader.metrics, utilization=tracker, jitter_frac=0.0,
+        include=("util.", "leader.", "phase_ms{phase=helper_net"),
+    )
+    base = time.monotonic() - 75.0
+    for i in range(70):
+        if i % 10 == 0:
+            query([(3 * i) % NUM])
+        sampler.sample_once(now=base + i)
+
+    # Inject the stall: 80 ms on every in-process helper roundtrip,
+    # >> the ~1 ms baseline, so helper-latency p99 blows through the
+    # 3x trailing-mean anomaly band on the next sample.
+    fps = default_failpoints()
+    fps.arm("transport.inproc.roundtrip", action="delay",
+            delay_ms=80.0, times=3)
+    try:
+        for i in range(3):
+            query([i])
+    finally:
+        fps.disarm("transport.inproc.roundtrip")
+    sampler.sample_once(now=base + 71.0)
+
+    anoms = events_mod.default_journal().tail(50, kind="util.anomaly")
+    assert anoms, "injected stall journaled no util.anomaly"
+    assert any(
+        "helper_net" in e.get("series", "")
+        and e.get("direction") == "spike"
+        for e in anoms
+    ), anoms
+
+    bundles = BundleManager(cooldown_s=0.0, max_bundles=2)
+    with AdminServer(registry=leader.metrics, utilization=tracker,
+                     timeseries=sampler, bundles=bundles) as admin:
+        url = "http://127.0.0.1:%d" % admin.port
+        snap = json.load(
+            urllib.request.urlopen(url + "/utilz?format=json")
+        )
+        totals = snap["totals"]
+        duty = totals["duty_cycle_pct"]
+        assert duty is not None and duty > 0.0, totals
+        causes = totals["idle_s"]
+        assert causes, "bubble breakdown empty"
+        assert set(causes) & {"empty_queue", "batch_wait",
+                              "admission_shed"}, causes
+        # Attribution is complete: typed causes sum to measured idle
+        # (each cause rounds independently, hence the tolerance).
+        assert abs(sum(causes.values()) - totals["idle_total_s"]) \
+            < 1e-3, totals
+        ts = json.load(
+            urllib.request.urlopen(url + "/timeseriesz?format=json")
+        )
+        assert ts["store"]["series_count"] > 0, ts
+
+        entry = bundles.trigger(
+            "injected_stall",
+            {"site": "transport.inproc.roundtrip"},
+        )
+        assert entry is not None, "bundle capture suppressed"
+        assert entry["sources"].get("timeseries") == "ok", entry
+        with open(os.path.join(entry["path"], "timeseries.json")) as f:
+            hist = json.load(f)
+        spans = [
+            pts[-1][0] - pts[0][0]
+            for tiers in hist["store"]["series"].values()
+            if len(pts := tiers.get("1s", [])) >= 2
+        ]
+        assert spans and max(spans) >= 60.0, \
+            "bundle carries < 60 s of history"
+        with open(os.path.join(entry["path"], "events.json")) as f:
+            journal_tail = json.load(f)
+        assert any(
+            e.get("kind") == "util.anomaly"
+            for e in journal_tail["events"]
+        ), "anomaly missing from bundle journal tail"
+        history_s = max(spans)
+print(f"util-smoke: OK (duty cycle {duty:.1f}%, "
+      f"{len(causes)} bubble causes summing to idle, util.anomaly "
+      f"journaled after 80 ms injected stall, bundle carries "
+      f"{history_s:.0f} s of flight data)")
 '
 
 stage perf-gate python -m benchmarks.regression_gate --check-only \

@@ -1,7 +1,8 @@
 """End-to-end request observability: tracing, device telemetry, SLOs,
-events, debug bundles, exposition, admin surface.
+events, debug bundles, exposition, utilization, timeseries, admin
+surface.
 
-Twelve pieces, importable from any layer above `utils/` (the layer DAG
+Fourteen pieces, importable from any layer above `utils/` (the layer DAG
 is serving -> observability -> utils; this package never imports pir/,
 ops/, or serving/ — `device`/`slo` reach JAX lazily and only for
 device facts):
@@ -48,11 +49,22 @@ device facts):
   tier, shape-bucket) residual reservoirs, detects sustained drift
   (journal event + SLO gauge), and feeds the guarded recalibration
   loop in `capacity/recalibrate.py`.
+* `utilization` — the device-utilization timeline: busy/idle interval
+  ledger over the batcher worker/completion threads, every idle bubble
+  attributed to a typed cause (empty_queue, batch_wait, pipeline_full,
+  staging_sync, helper_rtt, snapshot_flip, admission_shed), per-window
+  duty-cycle % and `device_feed_efficiency`, per-shard busy ratios
+  with a `util.straggler` journal watch (`/utilz`).
+* `timeseries` — the in-process flight-data recorder: a bounded
+  multi-resolution ring TSDB, a jittered sampler snapshotting selected
+  registry series plus the utilization windows, and a rate-of-change
+  anomaly watch journaling `util.anomaly` (`/timeseriesz`, debug
+  bundles).
 * `exposition` — Prometheus text rendering of the metrics registry,
   including OpenMetrics-style exemplars linking buckets to traces.
 * `admin` — the `/metrics` `/varz` `/healthz` `/statusz` `/tracez`
   `/eventz` `/probez` `/debugz` `/profilez` `/criticalz` `/capacityz`
-  operator HTTP endpoint.
+  `/utilz` `/timeseriesz` operator HTTP endpoint.
 """
 
 from .admin import AdminServer
@@ -100,6 +112,19 @@ from .phases import (
 )
 from .exposition import parse_labeled_name, render_prometheus
 from .slo import SloObjective, SloTracker
+from .timeseries import (
+    AnomalyWatch,
+    MetricsSampler,
+    TimeSeriesStore,
+    render_sparklines,
+    sparkline,
+)
+from .utilization import (
+    BUBBLE_CAUSES,
+    UtilizationTracker,
+    default_utilization_tracker,
+    set_default_utilization_tracker,
+)
 from .propagation import (
     EnvelopeError,
     encode_request,
@@ -126,7 +151,9 @@ from .tracing import (
 
 __all__ = [
     "AdminServer",
+    "AnomalyWatch",
     "AutoProfiler",
+    "BUBBLE_CAUSES",
     "BundleManager",
     "CompileTracker",
     "CostLedger",
@@ -137,14 +164,17 @@ __all__ = [
     "EventJournal",
     "FlightRecorder",
     "HbmAccountant",
+    "MetricsSampler",
     "PHASES",
     "PhaseRecorder",
     "RequestPhases",
     "SkewEstimate",
     "SloObjective",
     "SloTracker",
+    "TimeSeriesStore",
     "Trace",
     "TransferLedger",
+    "UtilizationTracker",
     "add_span",
     "current_request",
     "current_trace",
@@ -155,6 +185,7 @@ __all__ = [
     "default_phase_recorder",
     "default_recorder",
     "default_telemetry",
+    "default_utilization_tracker",
     "drift_objective",
     "emit",
     "encode_request",
@@ -164,6 +195,7 @@ __all__ = [
     "new_trace_id",
     "parse_labeled_name",
     "render_prometheus",
+    "render_sparklines",
     "reset_stages",
     "runtime_counters",
     "set_default_analyzer",
@@ -172,9 +204,11 @@ __all__ = [
     "set_default_phase_recorder",
     "set_default_recorder",
     "set_default_telemetry",
+    "set_default_utilization_tracker",
     "shape_bucket",
     "shape_key",
     "span",
+    "sparkline",
     "stage_summary",
     "trace_request",
     "try_decode_request",

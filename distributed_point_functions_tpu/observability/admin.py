@@ -1,6 +1,7 @@
 """Operator telemetry endpoint: /metrics, /varz, /healthz, /statusz,
-/tracez, /profilez, /eventz, /probez, /debugz, /criticalz, /capacityz —
-a stdlib `http.server` surface any session can hang off a port.
+/tracez, /profilez, /eventz, /probez, /debugz, /criticalz, /capacityz,
+/utilz, /timeseriesz — a stdlib `http.server` surface any session can
+hang off a port.
 
 The serving runtime's observability state (metrics registry, flight
 recorder, stage aggregates, runtime counters, device telemetry, SLO
@@ -50,10 +51,22 @@ this server is the scrape surface:
                              throughput-calibration staleness (text;
                              `?format=json`; requires a capacity
                              accuracy export)
+    /utilz                   device-utilization timeline: per-window
+                             duty-cycle %, typed bubble-cause
+                             breakdown, per-shard busy ratios and the
+                             straggler count (text; `?format=json`)
+    /timeseriesz             the in-process flight-data TSDB: one
+                             sparkline per sampled series (text;
+                             `?format=json` dumps every tier's points;
+                             requires a `timeseries` store/sampler)
     /profilez?duration_ms=N  on-demand xprof capture via
                              `utils/profiling.trace` into a fresh
                              directory; returns the trace dir (bounded
                              at 60 s; one capture at a time)
+
+The 404 reply's endpoint index is generated from the same route table
+`_route` dispatches on, so it can never go stale (`routes` is the
+public list).
 
 The registry is duck-typed (`.export() -> dict`) so this layer never
 imports `serving/` (check_layers: serving -> observability -> utils);
@@ -84,6 +97,7 @@ from . import critical_path as critical_path_mod
 from . import events as events_mod
 from .device import DeviceTelemetry, default_telemetry
 from .phases import PhaseRecorder, default_phase_recorder
+from .utilization import default_utilization_tracker
 
 logger = logging.getLogger(__name__)
 
@@ -123,6 +137,8 @@ class AdminServer:
         capacity=None,
         snapshots=None,
         mesh=None,
+        utilization=None,
+        timeseries=None,
     ):
         self._registry = registry
         self._recorder = (
@@ -187,6 +203,18 @@ class AdminServer:
         # bytes/copies and HBM watermarks. Opt-in; /statusz grows a
         # "Mesh" section when present.
         self._mesh = mesh
+        # utilization defaults to the process-wide tracker the serving
+        # hooks report into (`utilization.default_utilization_tracker`);
+        # timeseries is a `timeseries.MetricsSampler` (or a bare
+        # `TimeSeriesStore` — anything with `.store` or
+        # `series()/export()`), opt-in. A sampler handed over here is
+        # stopped with the server.
+        self._utilization = (
+            utilization
+            if utilization is not None
+            else default_utilization_tracker()
+        )
+        self._timeseries = timeseries
         self._name = name
         self._profile_dir = profile_dir
         self._profile_lock = threading.Lock()
@@ -209,6 +237,36 @@ class AdminServer:
                 bundles.add_source("snapshots", snapshots.export)
             if mesh is not None:
                 bundles.add_source("mesh", self._mesh_state)
+            bundles.add_source(
+                "utilization", self._utilization.export
+            )
+            if timeseries is not None:
+                bundles.add_source("timeseries", self._timeseries_state)
+        # The dispatch table IS the endpoint index: `_route` looks
+        # paths up here and the 404 body is generated from the same
+        # rows, so the "try ..." list can never go stale (asserted in
+        # tests/test_observability.py).
+        self._routes = (
+            ("/healthz", self._healthz),
+            ("/metrics", self._metricsz),
+            ("/varz", self._varz),
+            ("/statusz", self._statusz),
+            ("/tracez", self._tracez),
+            ("/eventz", self._eventz),
+            ("/probez", self._probez),
+            ("/debugz", self._debugz),
+            ("/criticalz", self._criticalz),
+            ("/capacityz", self._capacityz),
+            ("/utilz", self._utilz),
+            ("/timeseriesz", self._timeseriesz),
+            ("/profilez", self._profilez),
+        )
+        self._route_map = dict(self._routes)
+        self._unknown_body = (
+            "unknown endpoint; try "
+            + " ".join(path for path, _ in self._routes)
+            + "\n"
+        ).encode()
         outer = self
 
         class _Handler(http.server.BaseHTTPRequestHandler):
@@ -273,59 +331,53 @@ class AdminServer:
         source = getattr(self._mesh, "export", self._mesh)
         return source() if callable(source) else None
 
+    @property
+    def routes(self) -> tuple:
+        """The dispatched endpoint paths, in index order (the same
+        rows the 404 body is generated from)."""
+        return tuple(path for path, _ in self._routes)
+
     def _route(self, handler) -> None:
         parsed = urllib.parse.urlsplit(handler.path)
         path = parsed.path.rstrip("/") or "/"
-        if path == "/healthz":
-            self._healthz(handler)
-        elif path == "/statusz":
-            self._statusz(handler, parsed.query)
-        elif path == "/metrics":
-            from .exposition import render_prometheus
-
-            body = render_prometheus(self._merged_export()).encode()
-            self._reply(
-                handler, 200,
-                "text/plain; version=0.0.4; charset=utf-8", body,
-            )
-        elif path == "/varz":
-            body = json.dumps(
-                {
-                    "name": self._name,
-                    "uptime_s": self._uptime_s(),
-                    "started_at": self._started_unix,
-                    "metrics": self._merged_export(),
-                    "stages": tracing.stage_summary(),
-                },
-                indent=2, default=str,
-            ).encode()
-            self._reply(handler, 200, "application/json", body)
-        elif path == "/tracez":
-            body = json.dumps(
-                self._recorder.dump(), indent=2, default=str
-            ).encode()
-            self._reply(handler, 200, "application/json", body)
-        elif path == "/eventz":
-            self._eventz(handler, parsed.query)
-        elif path == "/probez":
-            self._probez(handler)
-        elif path == "/debugz":
-            self._debugz(handler)
-        elif path == "/criticalz":
-            self._criticalz(handler, parsed.query)
-        elif path == "/capacityz":
-            self._capacityz(handler, parsed.query)
-        elif path == "/profilez":
-            self._profilez(handler, parsed.query)
-        else:
+        target = self._route_map.get(path)
+        if target is None:
             self._reply(
                 handler, 404, "text/plain; charset=utf-8",
-                b"unknown endpoint; try /healthz /metrics /varz "
-                b"/statusz /tracez /eventz /probez /debugz /criticalz "
-                b"/capacityz /profilez\n",
+                self._unknown_body,
             )
+            return
+        target(handler, parsed.query)
 
-    def _healthz(self, handler) -> None:
+    def _metricsz(self, handler, query: str = "") -> None:
+        from .exposition import render_prometheus
+
+        body = render_prometheus(self._merged_export()).encode()
+        self._reply(
+            handler, 200,
+            "text/plain; version=0.0.4; charset=utf-8", body,
+        )
+
+    def _varz(self, handler, query: str = "") -> None:
+        body = json.dumps(
+            {
+                "name": self._name,
+                "uptime_s": self._uptime_s(),
+                "started_at": self._started_unix,
+                "metrics": self._merged_export(),
+                "stages": tracing.stage_summary(),
+            },
+            indent=2, default=str,
+        ).encode()
+        self._reply(handler, 200, "application/json", body)
+
+    def _tracez(self, handler, query: str = "") -> None:
+        body = json.dumps(
+            self._recorder.dump(), indent=2, default=str
+        ).encode()
+        self._reply(handler, 200, "application/json", body)
+
+    def _healthz(self, handler, query: str = "") -> None:
         breaches = (
             self._slo.breaches(evaluate=True)
             if self._slo is not None
@@ -428,7 +480,7 @@ class AdminServer:
             ("\n".join(lines) + "\n").encode(),
         )
 
-    def _probez(self, handler) -> None:
+    def _probez(self, handler, query: str = "") -> None:
         if self._prober is None:
             self._reply(
                 handler, 404, "text/plain; charset=utf-8",
@@ -440,7 +492,7 @@ class AdminServer:
         ).encode()
         self._reply(handler, 200, "application/json", body)
 
-    def _debugz(self, handler) -> None:
+    def _debugz(self, handler, query: str = "") -> None:
         if self._bundles is None:
             self._reply(
                 handler, 404, "text/plain; charset=utf-8",
@@ -703,6 +755,137 @@ class AdminServer:
             _render_statusz(state).encode(),
         )
 
+    def _utilz(self, handler, query: str = "") -> None:
+        params = urllib.parse.parse_qs(query)
+        state = self._utilization.export()
+        if params.get("format", [""])[0] == "json":
+            body = json.dumps(state, indent=2, default=str).encode()
+            self._reply(handler, 200, "application/json", body)
+            return
+        totals = state.get("totals", {})
+        duty = totals.get("duty_cycle_pct")
+        lines = [
+            f"# {self._name} device utilization "
+            f"(?format=json for machine-readable)",
+            f"enabled: {state.get('enabled')}  "
+            f"window: {state.get('window_s')}s  "
+            f"stragglers: {state.get('stragglers', 0)}",
+            "overall duty cycle: "
+            + (f"{duty:.1f}%" if duty is not None else "no data")
+            + f"  busy: {totals.get('busy_s', 0.0):.3f}s"
+            + f"  idle: {totals.get('idle_total_s', 0.0):.3f}s",
+        ]
+        idle = totals.get("idle_s") or {}
+        if idle:
+            lines.append("bubble breakdown (idle seconds by cause):")
+            total_idle = sum(idle.values()) or 1.0
+            for cause, seconds in sorted(
+                idle.items(), key=lambda kv: -kv[1]
+            ):
+                lines.append(
+                    f"  {cause:<16} {seconds:>10.3f}s "
+                    f"({seconds / total_idle * 100:5.1f}%)"
+                )
+        p50 = totals.get("bubble_ms_p50")
+        p99 = totals.get("bubble_ms_p99")
+        if p99 is not None:
+            lines.append(
+                f"bubble p50/p99: {p50:.2f}/{p99:.2f} ms "
+                f"over {totals.get('bubbles', 0)} bubbles"
+            )
+        windows = state.get("windows") or []
+        if windows:
+            lines.append(f"windows ({len(windows)} closed, newest last):")
+            for w in windows[-12:]:
+                worst = max(
+                    w["idle_s"].items(), key=lambda kv: kv[1]
+                )[0] if w["idle_s"] else "-"
+                lines.append(
+                    f"  t={w['t_start']:.1f} duty={w['duty_cycle_pct']:5.1f}% "
+                    f"feed={w['device_feed_efficiency']:.3f} "
+                    f"busy={w['busy_s']:.3f}s idle={w['idle_total_s']:.3f}s "
+                    f"worst_bubble={worst}"
+                )
+        shards = state.get("shards") or {}
+        if shards:
+            lines.append("per-shard busy seconds:")
+            for shard, entry in sorted(shards.items()):
+                lines.append(
+                    f"  shard {shard}: {entry['busy_s']:.3f}s"
+                )
+        threads = state.get("threads") or {}
+        if threads:
+            lines.append(
+                "threads: " + "  ".join(
+                    f"{name}(busy={t['busy_s']:.3f}s "
+                    f"idle={t['idle_s']:.3f}s)"
+                    for name, t in sorted(threads.items())
+                )
+            )
+        self._reply(
+            handler, 200, "text/plain; charset=utf-8",
+            ("\n".join(lines) + "\n").encode(),
+        )
+
+    def _timeseries_store(self):
+        """The underlying `TimeSeriesStore` — `timeseries` may be the
+        sampler (has `.store`) or the store itself."""
+        if self._timeseries is None:
+            return None
+        return getattr(self._timeseries, "store", self._timeseries)
+
+    def _timeseries_state(self) -> Optional[dict]:
+        store = self._timeseries_store()
+        if store is None:
+            return None
+        state = {"store": store.export()}
+        sampler_export = getattr(self._timeseries, "export", None)
+        if callable(sampler_export) and self._timeseries is not store:
+            state["sampler"] = sampler_export()
+        return state
+
+    def _timeseriesz(self, handler, query: str = "") -> None:
+        store = self._timeseries_store()
+        if store is None:
+            self._reply(
+                handler, 404, "text/plain; charset=utf-8",
+                b"no timeseries store attached\n",
+            )
+            return
+        params = urllib.parse.parse_qs(query)
+        if params.get("format", [""])[0] == "json":
+            body = json.dumps(
+                self._timeseries_state(), indent=2, default=str
+            ).encode()
+            self._reply(handler, 200, "application/json", body)
+            return
+        from .timeseries import render_sparklines
+
+        try:
+            tier = int(params.get("tier", ["0"])[0])
+        except ValueError:
+            tier = 0
+        export = store.export()
+        header = [
+            f"# {self._name} flight-data timeseries "
+            f"(?format=json for machine-readable, ?tier=N)",
+            "tiers: " + "  ".join(
+                f"[{i}] {t['step_s']:g}s x {t['slots']}"
+                for i, t in enumerate(export.get("tiers", []))
+            ),
+            f"series: {export.get('series_count', 0)}"
+            f"/{export.get('max_series', 0)}"
+            f"  dropped: {export.get('dropped_series', 0)}"
+            f"  samples: {export.get('samples', 0)}",
+            "",
+        ]
+        body = "\n".join(header) + render_sparklines(
+            store, tier=tier
+        ) + "\n"
+        self._reply(
+            handler, 200, "text/plain; charset=utf-8", body.encode()
+        )
+
     def _profilez(self, handler, query: str) -> None:
         params = urllib.parse.parse_qs(query)
         try:
@@ -763,6 +946,15 @@ class AdminServer:
         return self
 
     def stop(self) -> None:
+        # A sampler handed over as `timeseries` shares the server's
+        # lifecycle: stop its background thread before the listener so
+        # nothing keeps sampling a dead surface.
+        sampler_stop = getattr(self._timeseries, "stop", None)
+        if callable(sampler_stop):
+            try:
+                sampler_stop()
+            except Exception:  # noqa: BLE001 - shutdown keeps going
+                pass
         self._server.shutdown()
         self._server.server_close()
         if self._thread is not None:

@@ -47,6 +47,11 @@ Event kinds emitted by the library (the taxonomy; see DESIGN.md §15):
                            after its last in-flight batch landed
     prober.goldens_rotated the prober re-keyed its golden pairs to a
                            new database generation
+    util.straggler         a closed utilization window's max/min
+                           per-shard busy skew left the configured band
+    util.anomaly           the time-series sampler's rate-of-change
+                           watch tripped on a series (coalesced per
+                           series)
 
 Emitters call the module-level `emit(...)` (the process-global
 journal, mirroring `tracing.runtime_counters`); sessions that want an

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 import warnings
 
 import jax
@@ -906,9 +907,25 @@ class ShardedServingPlan:
         nk = int(staged_dev[0].shape[0])
         w = int(db_chunks.shape[-1])
         scratch = self.scratch.take((nk, w))
+        t_dispatch = time.monotonic()
         out, fresh = self._entry(scratch, *staged_dev, db_chunks)
         self.scratch.put(fresh)
         self.requests += 1
+        # Per-shard utilization rows: SPMD runs every shard for the
+        # same dispatch wall, so each participating shard is credited
+        # the step's wall time; skew between shards then shows up in
+        # the tracker's per-window busy ratios (straggler watch).
+        try:
+            from ..observability.utilization import (
+                default_utilization_tracker,
+            )
+
+            wall_s = time.monotonic() - t_dispatch
+            tracker = default_utilization_tracker()
+            for shard in range(self.num_shards):
+                tracker.record_shard_busy(shard, wall_s)
+        except Exception:  # noqa: BLE001 - accounting never breaks serving
+            pass
         return out
 
     def export(self) -> dict:

@@ -107,6 +107,16 @@ def _h2d_bytes(telemetry) -> int:
         return 0
 
 
+def _sync_wait_ms(telemetry) -> float:
+    """Cumulative exposed transfer-sync wall time from the ledger (0.0
+    when unavailable); deltas across an evaluation split the wall time
+    into device-feeding work vs `staging_sync` bubbles."""
+    try:
+        return float(telemetry.transfers.sync_wait_ms())
+    except Exception:  # noqa: BLE001 - accounting never breaks serving
+        return 0.0
+
+
 class _Pending:
     __slots__ = (
         "keys", "deadline", "event", "result", "error", "t0", "abandoned",
@@ -227,6 +237,10 @@ class DynamicBatcher:
         # flips land only at batch boundaries and in-flight batches
         # pin their generation's stagings.
         self._generation_source = None
+        # Utilization hook (`observability/utilization.py`): the worker
+        # and completion threads report busy/idle intervals with typed
+        # bubble causes (see `set_utilization`). None = no accounting.
+        self._util = None
         # Key-bucket granularity: mesh serving pads buckets to a
         # multiple of the key-axis size so batches land pre-partitioned
         # over the key axis (see `set_key_multiple`). 1 = plain
@@ -364,6 +378,39 @@ class DynamicBatcher:
             except Exception:  # noqa: BLE001 - bookkeeping never kills the worker
                 pass
 
+    # -- utilization hook ---------------------------------------------------
+
+    def set_utilization(self, tracker) -> None:
+        """Attach a `UtilizationTracker` (duck-typed:
+        `record_busy(seconds, thread=)` and `record_idle(cause,
+        seconds, thread=)`). The worker thread then attributes every
+        second it spends to device-feeding work or a typed bubble —
+        empty_queue / admission_shed / batch_wait / pipeline_full /
+        staging_sync — and the completion thread reports fan-out time;
+        None detaches."""
+        with self._cond:
+            self._util = tracker
+
+    def _util_busy(self, seconds: float, thread: str = "worker") -> None:
+        util = self._util
+        if util is None or seconds <= 0.0:
+            return
+        try:
+            util.record_busy(seconds, thread=thread)
+        except Exception:  # noqa: BLE001 - accounting never breaks serving
+            pass
+
+    def _util_idle(
+        self, cause: str, seconds: float, thread: str = "worker"
+    ) -> None:
+        util = self._util
+        if util is None or seconds <= 0.0:
+            return
+        try:
+            util.record_idle(cause, seconds, thread=thread)
+        except Exception:  # noqa: BLE001 - accounting never breaks serving
+            pass
+
     # -- brownout hook ------------------------------------------------------
 
     def set_batch_cap(self, cap: Optional[int]) -> None:
@@ -421,11 +468,17 @@ class DynamicBatcher:
         (batch, assembly_seconds) — assembly measured from the first
         pop, i.e. the window spent waiting for co-batchable arrivals —
         or None only at shutdown with an empty queue."""
+        util = self._util
+        empty_s = 0.0
+        form_s = 0.0
+        shed_before = self._c_shed.value if util is not None else 0
         with self._cond:
             while not self._queue:
                 if self._closed:
                     return None
+                t_wait = time.monotonic()
                 self._cond.wait()
+                empty_s += time.monotonic() - t_wait
             t_first = time.monotonic()
             batch = [self._pop_next()]
             num_keys = len(batch[0].keys)
@@ -445,8 +498,23 @@ class DynamicBatcher:
                 remaining = close_at - time.monotonic()
                 if remaining <= 0 or self._closed:
                     break
+                t_wait = time.monotonic()
                 self._cond.wait(remaining)
+                form_s += time.monotonic() - t_wait
             self._g_depth.set(len(self._queue))
+        # Bubble attribution, outside the lock. An empty-queue wait
+        # during which admission shed requests is idle the policy
+        # manufactured, not absent demand — attribute it there.
+        if util is not None:
+            if empty_s > 0.0:
+                cause = (
+                    "admission_shed"
+                    if self._c_shed.value - shed_before > 0
+                    else "empty_queue"
+                )
+                self._util_idle(cause, empty_s)
+            if form_s > 0.0:
+                self._util_idle("batch_wait", form_s)
         return batch, time.monotonic() - t_first
 
     def _run(self) -> None:
@@ -530,6 +598,7 @@ class DynamicBatcher:
                 failpoints.fire("batcher.evaluate")
                 telemetry = default_telemetry()
                 h2d_before = _h2d_bytes(telemetry)
+                sync_before = _sync_wait_ms(telemetry)
                 t_eval = time.perf_counter()
                 tracker = telemetry.compile_tracker
                 recorder = phases_mod.default_phase_recorder()
@@ -545,6 +614,17 @@ class DynamicBatcher:
                     # half re-attributes them to every live request.
                     results = list(self._evaluate(padded))
                 record.eval_ms = (time.perf_counter() - t_eval) * 1e3
+                # Utilization split: the evaluation wall is busy time
+                # minus whatever it spent blocked in exposed transfer
+                # syncs — those are `staging_sync` bubbles, so the
+                # causes still sum to measured idle.
+                if self._util is not None:
+                    eval_s = record.eval_ms / 1e3
+                    stall_s = min(eval_s, max(
+                        0.0, _sync_wait_ms(telemetry) - sync_before
+                    ) / 1e3)
+                    self._util_busy(eval_s - stall_s)
+                    self._util_idle("staging_sync", stall_s)
                 record.results = results
                 record.collected = (
                     batch_phases.snapshot()
@@ -578,11 +658,17 @@ class DynamicBatcher:
         if self._completer is None:
             self._finish(record)
             return
+        waited_s = 0.0
         with self._complete_cond:
             while len(self._complete_q) >= self._pipeline_depth - 1:
+                t_wait = time.monotonic()
                 self._complete_cond.wait()
+                waited_s += time.monotonic() - t_wait
             self._complete_q.append(record)
             self._complete_cond.notify_all()
+        # Worker blocked on the bounded handoff queue: the completion
+        # half is the bottleneck, not the device feed.
+        self._util_idle("pipeline_full", waited_s)
 
     def _complete_loop(self) -> None:
         while True:
@@ -593,8 +679,12 @@ class DynamicBatcher:
                     return
                 record = self._complete_q.popleft()
                 self._complete_cond.notify_all()
+            t_finish = time.monotonic()
             try:
                 self._finish(record)
+                self._util_busy(
+                    time.monotonic() - t_finish, thread="completer"
+                )
             except Exception as e:  # noqa: BLE001 - never kill the completer
                 for p in record.live:
                     if not p.event.is_set():

@@ -87,6 +87,10 @@ class Counter:
         with self._lock:
             return self._value
 
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
 
 class Gauge:
     """Point-in-time value (queue depth, in-flight requests)."""
@@ -110,6 +114,10 @@ class Gauge:
     def value(self) -> float:
         with self._lock:
             return self._value
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
 
 
 class Histogram:
@@ -156,6 +164,14 @@ class Histogram:
             return None
         i = min(len(ordered) - 1, max(0, round(p / 100 * (len(ordered) - 1))))
         return ordered[i]
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self._bounds) + 1)
+            self._samples.clear()
+            self._count = 0
+            self._sum = 0.0
+            self._exemplars.clear()
 
     def percentile(self, p: float) -> Optional[float]:
         """Exact percentile over the reservoir; None with no samples."""
@@ -261,12 +277,18 @@ class MetricsRegistry:
         return self.export()
 
     def reset(self) -> None:
-        """Drop every instrument so metric state cannot leak across test
-        cases or bench repetitions sharing one registry. Instruments are
-        recreated on next use; holders of old `Counter`/`Gauge`/
-        `Histogram` references keep writing to orphaned objects, so
-        long-lived callers should re-fetch by name after a reset."""
+        """Zero every instrument IN PLACE so metric state cannot leak
+        across test cases or bench repetitions sharing one registry.
+        Instruments stay registered and long-lived holders (the
+        batcher's counters, the sampler) keep writing to the same live
+        objects — no orphans, no re-fetch after a reset. Counters drop
+        to 0, gauges to 0.0, histograms to empty (bucket counts,
+        reservoir, exemplars)."""
         with self._lock:
-            self._counters.clear()
-            self._gauges.clear()
-            self._histograms.clear()
+            instruments = (
+                list(self._counters.values())
+                + list(self._gauges.values())
+                + list(self._histograms.values())
+            )
+        for instrument in instruments:
+            instrument._reset()

@@ -68,6 +68,7 @@ from ..observability import costmodel as costmodel_mod
 from ..observability import events as events_mod
 from ..observability import critical_path, propagation, tracing
 from ..observability import phases as phases_mod
+from ..observability.utilization import default_utilization_tracker
 from ..observability.device import (
     default_telemetry,
     install_jax_monitoring_listener,
@@ -168,6 +169,12 @@ class ServingConfig:
     # serving/batcher.py); 1 restores strictly serial
     # dispatch-then-complete batches.
     pipeline_depth: int = 2
+    # Device-utilization accounting (observability/utilization.py):
+    # the batcher worker/completion threads and the Leader's helper
+    # leg report busy/idle intervals with typed bubble causes into the
+    # process-wide tracker (read on /utilz). False detaches it — the
+    # knob the utilization_overhead benchmark flips.
+    utilization: bool = True
 
 
 # The deadline travels from handle_request into the server's plain
@@ -323,6 +330,16 @@ class _Session:
                 pipeline_depth=self._config.pipeline_depth,
             )
             server.set_plain_handler(self._batched_plain_handler)
+        # Device-utilization accounting: the batcher threads (and the
+        # helper leg below) report busy/idle intervals into the
+        # process-wide tracker; gauges/bubble histograms mirror into
+        # this session's registry. config.utilization=False detaches.
+        self._util = None
+        if self._config.utilization:
+            self._util = default_utilization_tracker()
+            self._util.bind_registry(self.metrics)
+            if self._batcher is not None:
+                self._batcher.set_utilization(self._util)
         # Mesh wiring: a 2-D-mesh server tells the batcher its key-axis
         # granularity (buckets pad to it, so batches land
         # pre-partitioned) and the capacity model its shape (admission
@@ -939,6 +956,20 @@ class LeaderSession(_Session):
         # Leader's own-share compute (by design), so the waterfall's
         # helper_rtt phase can exceed end-to-end minus device_compute.
         phases_mod.record("helper_rtt", rtt_ms)
+        # Utilization: only the RTT tail NOT hidden behind the
+        # own-share compute is a real barrier — the Leader sat idle
+        # from the share's end to the round-trip's return.
+        if getattr(self, "_util", None) is not None:
+            exposed_ms = (
+                rtt_ms if share_window[0] is None
+                else max(0.0, (t0 * 1e3 + rtt_ms) - share_window[0][1])
+            )
+            try:
+                self._util.record_idle(
+                    "helper_rtt", exposed_ms / 1e3, thread="leader"
+                )
+            except Exception:  # noqa: BLE001 - accounting never breaks serving
+                pass
         try:
             meta, inner = (
                 propagation.try_decode_response(data)

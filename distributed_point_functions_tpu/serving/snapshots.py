@@ -288,6 +288,7 @@ class SnapshotManager:
         generation stays staged and N keeps serving."""
         failpoints.fire("snapshot.flip")
         fired = None
+        waited_s = 0.0
         with self._cond:
             if self._staging is None:
                 raise RuntimeError("no staged generation to flip to")
@@ -308,8 +309,23 @@ class SnapshotManager:
                         f"{timeout:.1f}s (pins={self._pins}, inflight="
                         f"{sum(self._inflight.values())})"
                     )
+                t_wait = time.monotonic()
                 self._cond.wait(remaining)
+                waited_s += time.monotonic() - t_wait
             record = self._history[-1]
+        if waited_s > 0.0:
+            # The flip's drain wait (in-flight batches / pins) is a
+            # typed utilization bubble: the rotation held work back.
+            try:
+                from ..observability.utilization import (
+                    default_utilization_tracker,
+                )
+
+                default_utilization_tracker().record_idle(
+                    "snapshot_flip", waited_s, thread="rotation"
+                )
+            except Exception:  # noqa: BLE001 - accounting never breaks flips
+                pass
         if fired is not None:
             self._after_flip(fired)
         return dict(record)

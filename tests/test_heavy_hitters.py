@@ -210,18 +210,24 @@ def test_value_encoding():
 
 def test_metrics_snapshot_reset_isolation():
     registry = MetricsRegistry()
-    registry.counter("hh.rounds").inc(5)
+    held = registry.counter("hh.rounds")
+    held.inc(5)
     registry.gauge("hh.keys_live").set(8)
     registry.histogram("hh.round_ms").observe(1.5)
     snap = registry.snapshot()
     assert snap["counters"]["hh.rounds"] == 5
     registry.reset()
+    # Instruments zero IN PLACE: they stay registered (no orphans) with
+    # all values dropped.
     clean = registry.snapshot()
-    assert clean["counters"] == {}
-    assert clean["gauges"] == {}
-    assert clean["histograms"] == {}
-    # Instruments recreate on next use after a reset.
-    registry.counter("hh.rounds").inc(1)
+    assert clean["counters"]["hh.rounds"] == 0
+    assert clean["gauges"]["hh.keys_live"] == 0.0
+    assert clean["histograms"]["hh.round_ms"]["count"] == 0
+    assert clean["histograms"]["hh.round_ms"]["p99"] is None
+    # A reference held across the reset keeps writing to the SAME live
+    # object the registry serves by name.
+    held.inc(1)
+    assert registry.counter("hh.rounds") is held
     assert registry.snapshot()["counters"]["hh.rounds"] == 1
 
 

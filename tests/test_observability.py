@@ -890,3 +890,86 @@ def test_statusz_renders_circuit_breaker_rows():
         assert row["state"] == "open"
         assert row["state_code"] == 2
         assert row["degraded_mode"] is True
+
+
+# ---------------------------------------------------------------------------
+# Route table: the 404 index is generated, never hand-maintained
+# ---------------------------------------------------------------------------
+
+
+def test_404_endpoint_index_matches_dispatched_routes():
+    with AdminServer(registry=MetricsRegistry()) as admin:
+        base = f"http://127.0.0.1:{admin.port}"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + "/definitely_not_a_route")
+        assert e.value.code == 404
+        body = e.value.read().decode()
+        # The advertised index is generated from the same route table
+        # `_route` dispatches on — exactly, in order.
+        advertised = body.split("try ", 1)[1].split()
+        assert advertised == list(admin.routes)
+        assert "/utilz" in advertised and "/timeseriesz" in advertised
+        # And every advertised path really dispatches: none of them
+        # falls through to the unknown-endpoint reply (optional
+        # surfaces may 404 with their own "not attached" message).
+        for path in admin.routes:
+            url = base + path
+            if path == "/profilez":
+                url += "?duration_ms=1"
+            try:
+                urllib.request.urlopen(url).read()
+            except urllib.error.HTTPError as err:
+                assert "unknown endpoint" not in err.read().decode(), path
+
+
+def test_utilz_reports_live_closed_loop_duty_cycle():
+    from distributed_point_functions_tpu.observability.utilization import (
+        UtilizationTracker,
+        default_utilization_tracker,
+        set_default_utilization_tracker,
+    )
+
+    prev = default_utilization_tracker()
+    tracker = set_default_utilization_tracker(
+        UtilizationTracker(window_s=60.0)
+    )
+    try:
+        leader, helper = leader_helper_pair(InProcessTransport)
+        try:
+            for i in range(6):
+                values = run_query(leader, [i])
+                assert values[0] == RECORDS[i]
+        finally:
+            leader.close()
+            helper.close()
+        with AdminServer(
+            registry=leader.metrics, utilization=tracker
+        ) as admin:
+            base = f"http://127.0.0.1:{admin.port}"
+            state = json.load(
+                urllib.request.urlopen(base + "/utilz?format=json")
+            )
+        # The real batcher worker reported: evaluations became busy
+        # time and the waits became typed bubbles whose causes sum to
+        # the measured idle total.
+        tracked = state["current"]
+        totals = state["totals"]
+        busy = totals["busy_s"] + tracked["busy_s"]
+        idle = totals["idle_total_s"] + tracked["idle_total_s"]
+        assert busy > 0.0
+        assert idle > 0.0
+        causes = dict(totals["idle_s"])
+        for cause, s in tracked["idle_s"].items():
+            causes[cause] = causes.get(cause, 0.0) + s
+        # Causes sum to the measured idle total (within the export's
+        # per-cause 6-decimal rounding).
+        assert sum(causes.values()) == pytest.approx(idle, abs=1e-4)
+        assert set(causes) <= {
+            "empty_queue", "admission_shed", "batch_wait",
+            "pipeline_full", "staging_sync", "helper_rtt",
+            "snapshot_flip", "other",
+        }
+        # The helper leg reported its exposed RTT barrier.
+        assert "leader" in state["threads"] or "helper_rtt" in causes
+    finally:
+        set_default_utilization_tracker(prev)

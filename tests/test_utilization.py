@@ -1,0 +1,333 @@
+"""Device-utilization timeline and flight-data TSDB tests.
+
+Attribution tests inject a deterministic clock into the
+`UtilizationTracker` so busy/idle splits and window boundaries are
+exact; the batcher integration runs the real worker/completion threads
+against a stub evaluator and asserts the live bubble breakdown. TSDB
+tests flood the store past its series budget and assert the bound
+holds; sampler tests drive `sample_once` with a fake clock and check
+the anomaly watch journals `util.anomaly`.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from distributed_point_functions_tpu.observability import AdminServer
+from distributed_point_functions_tpu.observability.events import EventJournal
+from distributed_point_functions_tpu.observability.timeseries import (
+    AnomalyWatch,
+    MetricsSampler,
+    TimeSeriesStore,
+    render_sparklines,
+    sparkline,
+)
+from distributed_point_functions_tpu.observability.utilization import (
+    BUBBLE_CAUSES,
+    UtilizationTracker,
+)
+from distributed_point_functions_tpu.serving.batcher import DynamicBatcher
+from distributed_point_functions_tpu.serving.metrics import (
+    MetricsRegistry,
+    labeled_name,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class CapturingJournal:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, message, **fields):
+        self.events.append((kind, message, fields))
+
+
+# ---------------------------------------------------------------------------
+# UtilizationTracker: exact attribution under an injected clock
+# ---------------------------------------------------------------------------
+
+
+def test_synthetic_schedule_reproduces_exact_attribution():
+    clock = FakeClock()
+    tracker = UtilizationTracker(window_s=10.0, clock=clock)
+    # Window 1: 6 s busy, 3 s empty queue, 1 s batch wait.
+    tracker.record_busy(6.0)
+    tracker.record_idle("empty_queue", 3.0)
+    tracker.record_idle("batch_wait", 1.0)
+    clock.advance(10.0)
+    # Window 2: 2 s busy, 1 s staging sync, 1 s pipeline full.
+    tracker.record_busy(2.0)
+    tracker.record_idle("staging_sync", 1.0)
+    tracker.record_idle("pipeline_full", 1.0)
+    clock.advance(10.0)
+    snap = tracker.export()
+    assert len(snap["windows"]) == 2
+    w1, w2 = snap["windows"]
+    assert w1["duty_cycle_pct"] == 60.0
+    assert w1["idle_s"] == {"empty_queue": 3.0, "batch_wait": 1.0}
+    assert w1["device_feed_efficiency"] == 0.6
+    assert w2["duty_cycle_pct"] == 50.0
+    assert w2["idle_s"] == {"staging_sync": 1.0, "pipeline_full": 1.0}
+    totals = snap["totals"]
+    assert totals["busy_s"] == 8.0
+    assert totals["idle_total_s"] == 6.0
+    # The causes sum exactly to measured idle.
+    assert sum(totals["idle_s"].values()) == totals["idle_total_s"]
+    assert totals["duty_cycle_pct"] == pytest.approx(100 * 8 / 14, abs=0.01)
+    assert totals["bubbles"] == 4
+
+
+def test_unknown_cause_degrades_to_other_and_brackets_measure():
+    clock = FakeClock()
+    tracker = UtilizationTracker(window_s=100.0, clock=clock)
+    tracker.record_idle("not_a_cause", 1.0)
+    with tracker.busy():
+        clock.advance(2.0)
+    with tracker.idle("batch_wait"):
+        clock.advance(0.5)
+    snap = tracker.export()
+    assert snap["current"]["idle_s"] == {"other": 1.0, "batch_wait": 0.5}
+    assert snap["totals"]["busy_s"] == 2.0
+    assert "not_a_cause" not in BUBBLE_CAUSES
+
+
+def test_empty_windows_are_skipped_and_timeline_is_bounded():
+    clock = FakeClock()
+    tracker = UtilizationTracker(window_s=1.0, max_windows=5, clock=clock)
+    clock.advance(50.0)  # dead air: no windows
+    assert tracker.export()["windows"] == []
+    for _ in range(10):
+        tracker.record_busy(0.5)
+        clock.advance(1.0)
+    windows = tracker.export()["windows"]
+    assert len(windows) == 5  # deque bound holds
+
+
+def test_registry_mirror_and_reset():
+    clock = FakeClock()
+    registry = MetricsRegistry()
+    tracker = UtilizationTracker(window_s=1.0, clock=clock)
+    tracker.bind_registry(registry)
+    tracker.record_busy(0.75)
+    tracker.record_idle("helper_rtt", 0.25)
+    clock.advance(1.0)
+    assert tracker.last_duty_cycle_pct() == 75.0
+    export = registry.export()
+    assert export["gauges"]["util.duty_cycle_pct"] == 75.0
+    assert export["gauges"]["util.device_feed_efficiency"] == 0.75
+    name = labeled_name("util.bubble_ms", {"cause": "helper_rtt"})
+    assert export["histograms"][name]["count"] == 1
+    tracker.reset()
+    snap = tracker.export()
+    assert snap["windows"] == [] and snap["totals"]["busy_s"] == 0.0
+
+
+def test_straggler_skew_journals_event():
+    clock = FakeClock()
+    journal = CapturingJournal()
+    tracker = UtilizationTracker(
+        window_s=1.0, straggler_band=0.25, clock=clock, journal=journal
+    )
+    # Shard 0 busy the whole window, shard 3 nearly idle: skew 0.9.
+    tracker.record_shard_busy(0, 1.0)
+    tracker.record_shard_busy(3, 0.1)
+    clock.advance(1.0)
+    snap = tracker.export()
+    assert snap["stragglers"] == 1
+    assert snap["shards"][0]["busy_s"] == 1.0
+    (kind, message, fields) = journal.events[0]
+    assert kind == "util.straggler"
+    assert fields["max_shard"] == 0 and fields["min_shard"] == 3
+    assert fields["skew"] == pytest.approx(0.9)
+    # Balanced shards stay quiet.
+    tracker.record_shard_busy(0, 0.5)
+    tracker.record_shard_busy(3, 0.5)
+    clock.advance(1.0)
+    assert tracker.export()["stragglers"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Batcher integration: live threads, real causes
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_reports_busy_and_bubbles():
+    tracker = UtilizationTracker(window_s=60.0)
+    done = threading.Event()
+
+    def evaluate(keys):
+        time.sleep(0.01)
+        return [k * 2 for k in keys]
+
+    with DynamicBatcher(
+        evaluate, max_batch_size=4, max_wait_ms=5.0, pipeline_depth=2
+    ) as batcher:
+        batcher.set_utilization(tracker)
+        results = []
+
+        def client():
+            for _ in range(3):
+                results.extend(batcher.submit([1, 2]))
+                time.sleep(0.02)  # gaps -> empty_queue bubbles
+            done.set()
+
+        t = threading.Thread(target=client)
+        t.start()
+        t.join(timeout=10)
+    assert done.is_set() and results == [2, 4] * 3
+    snap = tracker.export()
+    current = snap["current"]
+    assert current["busy_s"] > 0.0  # evaluations credited
+    # The worker saw typed bubbles: waiting for the first request
+    # and/or holding the batch window open.
+    assert current["idle_s"], snap
+    assert set(current["idle_s"]) <= set(BUBBLE_CAUSES)
+    assert {"empty_queue", "batch_wait"} & set(current["idle_s"])
+    # Both halves of the pipeline reported.
+    assert snap["threads"]["worker"]["busy_s"] > 0.0
+    assert "completer" in snap["threads"]
+    # Worker-tracked time (busy + attributed idle) stays within the
+    # worker's wall clock — attribution never invents time.
+    worker = snap["threads"]["worker"]
+    assert worker["busy_s"] + worker["idle_s"] <= 10.0
+
+
+# ---------------------------------------------------------------------------
+# TimeSeriesStore: budgets and ring behavior
+# ---------------------------------------------------------------------------
+
+
+def test_store_budget_holds_under_labeled_metric_flood():
+    clock = FakeClock()
+    store = TimeSeriesStore(
+        tiers=((1.0, 16), (8.0, 8)), max_series=24, clock=clock
+    )
+    for i in range(400):
+        name = labeled_name("flood.metric", {"tenant": f"t{i}"})
+        store.record(name, float(i), t=float(i % 64))
+    export = store.export(now=64.0)
+    assert export["series_count"] <= 24
+    assert export["dropped_series"] == 400 - 24
+    assert store.occupancy() <= store.slot_budget()
+    assert store.slot_budget() == 24 * (16 + 8)
+    assert store.approx_bytes() > 0
+
+
+def test_ring_laps_expire_old_points_and_last_sample_wins():
+    clock = FakeClock()
+    store = TimeSeriesStore(tiers=((1.0, 4),), max_series=4, clock=clock)
+    for i in range(10):
+        store.record("s", float(i), t=float(i))
+    points = store.series("s", tier=0, now=10.0)
+    assert [v for _, v in points] == [6.0, 7.0, 8.0, 9.0]
+    # Two samples in the same slot: the later write wins.
+    store.record("s", 100.0, t=9.2)
+    points = store.series("s", tier=0, now=10.0)
+    assert points[-1][1] == 100.0
+    assert store.occupancy() <= store.slot_budget()
+
+
+def test_sparkline_rendering():
+    assert sparkline([]) == ""
+    assert len(sparkline([1, 2, 3, 4])) == 4
+    assert sparkline([5.0, 5.0]) == "▄▄"
+    clock = FakeClock()
+    store = TimeSeriesStore(tiers=((1.0, 8),), clock=clock)
+    for i in range(8):
+        store.record("ramp", float(i), t=float(i))
+    text = render_sparklines(store, tier=0)
+    assert "ramp" in text
+
+
+# ---------------------------------------------------------------------------
+# MetricsSampler: deterministic sampling, anomaly watch, shutdown
+# ---------------------------------------------------------------------------
+
+
+class FakeRegistry:
+    def __init__(self):
+        self.p99 = 5.0
+
+    def export(self):
+        return {
+            "counters": {"leader.requests": 10, "unselected.x": 1},
+            "gauges": {"device.hbm_peak": 2.0},
+            "histograms": {"helper.rtt_ms": {"p50": 1.0, "p99": self.p99}},
+        }
+
+
+def test_sampler_selects_series_and_samples_utilization():
+    clock = FakeClock()
+    tracker = UtilizationTracker(window_s=1.0, clock=clock)
+    tracker.record_busy(0.9)
+    tracker.record_idle("batch_wait", 0.1)
+    clock.advance(1.0)
+    sampler = MetricsSampler(
+        registry=FakeRegistry(), utilization=tracker, clock=clock
+    )
+    written = sampler.sample_once()
+    assert written > 0
+    names = sampler.store.names()
+    assert "leader.requests.count" in names
+    assert "helper.rtt_ms.p99" in names
+    assert "util.duty_cycle_pct" in names
+    assert "util.idle_s.batch_wait" in names
+    assert "unselected.x.count" not in names
+    points = sampler.store.series("util.duty_cycle_pct")
+    assert points[-1][1] == 90.0
+
+
+def test_anomaly_watch_journals_spike_into_event_journal():
+    clock = FakeClock()
+    journal = EventJournal(clock=clock)
+    reg = FakeRegistry()
+    sampler = MetricsSampler(
+        registry=reg,
+        clock=clock,
+        watch=AnomalyWatch(min_samples=3, journal=journal),
+    )
+    for _ in range(6):
+        sampler.sample_once()
+        clock.advance(1.0)
+    reg.p99 = 500.0  # injected stall: p99 spikes 100x
+    sampler.sample_once()
+    kinds = [e["kind"] for e in journal.tail(n=20)]
+    assert "util.anomaly" in kinds
+    event = [e for e in journal.tail(n=20) if e["kind"] == "util.anomaly"][-1]
+    assert event["series"] == "helper.rtt_ms.p99"
+    assert event["direction"] == "spike"
+    assert sampler.export()["watch"]["anomalies"] >= 1
+
+
+def test_sampler_thread_shuts_down_cleanly_with_admin_server():
+    sampler = MetricsSampler(
+        registry=FakeRegistry(), period_s=0.05, jitter_frac=0.1
+    )
+    sampler.start()
+    with AdminServer(timeseries=sampler) as admin:
+        assert sampler.running
+        deadline = time.monotonic() + 5.0
+        while not sampler.store.names() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        base = f"http://127.0.0.1:{admin.port}"
+        body = json.load(
+            urllib.request.urlopen(base + "/timeseriesz?format=json")
+        )
+        assert body["store"]["series_count"] > 0
+        assert body["sampler"]["running"] is True
+    # AdminServer.stop() stopped the sampler with the listener.
+    assert not sampler.running
+    assert sampler.export()["samples_taken"] > 0

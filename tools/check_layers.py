@@ -13,9 +13,12 @@ Three rules over `distributed_point_functions_tpu/`:
    import anything). `observability` sits near the bottom on purpose:
    every layer may instrument itself (spans, runtime counters,
    compile/HBM telemetry), but observability — `device.py`, `slo.py`,
-   and `critical_path.py` included — imports only `utils/`, stdlib, and
+   `critical_path.py`, `utilization.py`, and `timeseries.py`
+   included — imports only `utils/`, stdlib, and
    `robustness/` — never pir/ops/serving — so telemetry can never
-   create an upward edge. `capacity` (the shared byte/throughput
+   create an upward edge (serving pushes busy/idle intervals into the
+   utilization tracker through duck-typed hooks, same as
+   `default_telemetry`). `capacity` (the shared byte/throughput
    model plus admission and brownout policy) sits below every
    workload: pir, serving, and heavy_hitters all consume it, and it
    may instrument itself via observability but never import a
