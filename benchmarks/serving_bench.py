@@ -65,12 +65,23 @@ bench forces `--xla_force_host_platform_device_count=8` before JAX
 initializes so a record always lands; the forced-host CPU numbers gate
 correctness and relayout accounting only, not throughput.
 
+A **sparse ladder** closes the report: closed-loop string-keyed
+(cuckoo key-value) traffic through a `SparsePlainSession` with the
+batcher on, every masked response bit-checked against an unbatched
+sparse oracle, then a ~1%-key write batch landed as a SnapshotManager
+delta rotation on the live session. It emits two gated history records
+— `sparse_qps` (direction "higher") and
+`sparse_rotation_prestage_bytes_saved` (direction "higher", the bytes
+the touched-row prestage kept off the bus).
+
 Environment knobs: SERVING_BENCH_RECORDS (default 2048),
 SERVING_BENCH_RECORD_BYTES (32), SERVING_BENCH_CONCURRENCY ("1,4,16"),
 SERVING_BENCH_REQUESTS (total closed-loop requests per sweep point,
 default 64), SERVING_BENCH_MAX_BATCH (16), SERVING_BENCH_PROBER_PERIOD_S
 (cadence for the overhead point, default 5.0 — the prober default),
 SERVING_BENCH_MESH ("0" skips the mesh stage),
+SERVING_BENCH_SPARSE ("0" skips the sparse ladder),
+SERVING_BENCH_SPARSE_KEYS (sparse ladder key count, default 512),
 SERVING_BENCH_OUT (report path; empty string disables the file),
 BENCH_HISTORY ("0" skips the history.jsonl residual append),
 BENCH_HISTORY_PATH (append target, default
@@ -371,6 +382,62 @@ def append_utilization_history(point, bench):
             )
     except Exception as e:  # noqa: BLE001 - accounting never fails a bench
         _log(f"utilization history append skipped: {e}")
+
+
+def append_sparse_history(point, bench):
+    """Best-effort: append the two sparse-serving records the
+    regression gate locks in — `sparse_qps` (closed-loop key-value
+    throughput through the batched session, direction "higher") and
+    `sparse_rotation_prestage_bytes_saved` (bytes a ~1%-key write
+    batch's delta prestage kept off the bus, direction "higher").
+    Never fatal to the bench."""
+    if not point:
+        return
+    try:
+        from benchmarks.regression_gate import append_record, git_rev
+
+        path = os.environ.get(
+            "BENCH_HISTORY_PATH", "benchmarks/results/history.jsonl"
+        )
+        rev = git_rev()
+        device = os.environ.get("BENCH_PLATFORM", "cpu")
+        status = "ok" if point["mismatches"] == 0 else "mismatch"
+        append_record(
+            {
+                "metric": "sparse_qps",
+                "value": float(point["qps"]),
+                "unit": "queries/s",
+                "direction": "higher",
+                "status": status,
+                "vs_baseline": None,
+                "git_rev": rev,
+                "device": device,
+                "bench": bench,
+                "num_keys": point["num_keys"],
+                "num_buckets": point["num_buckets"],
+                "concurrency": point["concurrency"],
+            },
+            path=path,
+        )
+        append_record(
+            {
+                "metric": "sparse_rotation_prestage_bytes_saved",
+                "value": float(point["prestage_bytes_saved"]),
+                "unit": "bytes",
+                "direction": "higher",
+                "status": status,
+                "vs_baseline": None,
+                "git_rev": rev,
+                "device": device,
+                "bench": bench,
+                "keys_touched": point["rotation_keys_touched"],
+                "bytes_full_image": point["prestage_bytes_full_image"],
+                "prestage_mode": point["prestage_mode"],
+            },
+            path=path,
+        )
+    except Exception as e:  # noqa: BLE001 - accounting never fails a bench
+        _log(f"sparse history append skipped: {e}")
 
 
 def _closed_loop(handle, requests, concurrency):
@@ -1027,6 +1094,167 @@ def run_serving_bench():
             f"{mesh_point['batches']} batches"
         )
 
+    def sparse_point():
+        """Sparse (cuckoo key-value) ladder: closed-loop string-keyed
+        traffic through a `SparsePlainSession` (batcher on), every
+        masked response bit-checked against an unbatched sparse oracle,
+        then one ~1%-key write batch landed as a SnapshotManager delta
+        rotation (prestage stats read off the staged generation)."""
+        from distributed_point_functions_tpu.pir.cuckoo_database import (
+            CuckooHashedDpfPirDatabase,
+        )
+        from distributed_point_functions_tpu.pir.sparse_client import (
+            CuckooHashingSparseDpfPirClient,
+            KeyNotFound,
+        )
+        from distributed_point_functions_tpu.pir.sparse_server import (
+            CuckooHashingSparseDpfPirServer,
+        )
+        from distributed_point_functions_tpu.serving import (
+            SnapshotManager,
+            SparsePlainSession,
+            make_sparse_client,
+            sparse_lookup_plain,
+        )
+
+        num_keys = int(os.environ.get("SERVING_BENCH_SPARSE_KEYS", 512))
+        touched = max(1, num_keys // 100)
+        # Fixed-width keys/values: a delta rotation preserves the
+        # packed row width of each parallel dense store, so the write
+        # batch below must stay in-width to prestage as a delta.
+        records = {
+            b"skey-%06d" % i: (b"sval-%06d:" % i).ljust(
+                record_bytes, b"."
+            )[:record_bytes]
+            for i in range(num_keys)
+        }
+        params = CuckooHashingSparseDpfPirServer.generate_params(
+            num_keys, seed=b"0123456789abcdef"
+        )
+        builder = CuckooHashedDpfPirDatabase.Builder().set_params(params)
+        for kv in records.items():
+            builder.insert(kv)
+        sparse_db = builder.build()
+
+        sparse_client = CuckooHashingSparseDpfPirClient.create(
+            params, lambda pt, ci: pt
+        )
+        key_list = sorted(records)
+        sparse_requests = [
+            sparse_client.create_plain_requests(
+                [key_list[int(i)]]
+            )[0]
+            for i in rng.integers(0, num_keys, num_requests)
+        ]
+        sparse_oracle_server = (
+            CuckooHashingSparseDpfPirServer.create_plain(
+                params, sparse_db
+            )
+        )
+        sparse_oracle = [
+            sparse_oracle_server.handle_plain_request(
+                r
+            ).dpf_pir_response.masked_response
+            for r in sparse_requests
+        ]
+
+        concurrency = concurrency_levels[-1]
+        config = ServingConfig(
+            max_batch_size=max_batch,
+            max_wait_ms=2.0,
+            max_queue=max(256, 4 * num_requests),
+            batching=True,
+        )
+        with SparsePlainSession(params, sparse_db, config) as session:
+            # Warm pass: compiles every bucket shape the closed loop
+            # can form and makes the gen-0 stagings resident (the
+            # prerequisite for the rotation below to prestage as a
+            # delta rather than a full image).
+            for r in sparse_requests:
+                session.handle_request(r)
+            wall, lats, resps = _closed_loop(
+                session.handle_request, sparse_requests, concurrency
+            )
+            mismatches = sum(
+                1
+                for got, want in zip(resps, sparse_oracle)
+                if got.dpf_pir_response.masked_response != want
+            )
+
+            # Write batch: rewrite ~1% of the keys (new in-width
+            # values) plus one brand-new key, landed as a delta
+            # rotation while the session stays live.
+            manager = SnapshotManager(session)
+            delta = CuckooHashedDpfPirDatabase.Builder()
+            rewritten = key_list[:touched]
+            for key in rewritten:
+                delta.insert(
+                    (key, records[key][::-1])  # same width, new bytes
+                )
+            new_key = b"snew-%06d" % num_keys
+            delta.insert(
+                (new_key, (b"sval-new---:").ljust(
+                    record_bytes, b"."
+                )[:record_bytes])
+            )
+            db1 = delta.build_from(sparse_db)
+            staged_bytes = manager.stage(db1)
+            manager.flip(timeout=120.0)
+            stats = db1.last_prestage_stats or {}
+
+            lookup_client = make_sparse_client(session)
+            out = sparse_lookup_plain(
+                session,
+                lookup_client,
+                [rewritten[0], new_key, b"skey-no-such"],
+            )
+            lookup_mismatches = 0
+            if out[0] != records[rewritten[0]][::-1]:
+                lookup_mismatches += 1
+            if out[1] != (b"sval-new---:").ljust(
+                record_bytes, b"."
+            )[:record_bytes]:
+                lookup_mismatches += 1
+            if not isinstance(out[2], KeyNotFound):
+                lookup_mismatches += 1
+            generation = manager.serving_generation()
+
+        lats.sort()
+        return {
+            "num_keys": num_keys,
+            "num_buckets": params.num_buckets,
+            "num_hash_functions": params.num_hash_functions,
+            "concurrency": concurrency,
+            "qps": round(len(sparse_requests) / wall, 2),
+            "p50_ms": round(_percentile(lats, 0.50), 3),
+            "p95_ms": round(_percentile(lats, 0.95), 3),
+            "mismatches": mismatches + lookup_mismatches,
+            "rotation_keys_touched": touched + 1,
+            "rotation_staged_bytes": staged_bytes,
+            "prestage_mode": stats.get("mode"),
+            "prestage_bytes_saved": stats.get("bytes_saved", 0),
+            "prestage_bytes_staged": stats.get("bytes_staged", 0),
+            "prestage_bytes_full_image": stats.get(
+                "bytes_full_image", 0
+            ),
+            "serving_generation": generation,
+        }
+
+    sparse_point_r = None
+    if os.environ.get("SERVING_BENCH_SPARSE", "1") != "0":
+        sparse_point_r = sparse_point()
+    if sparse_point_r:
+        _log(
+            f"sparse {sparse_point_r['num_keys']} keys c="
+            f"{sparse_point_r['concurrency']}: "
+            f"{sparse_point_r['qps']:.1f} q/s  p50 "
+            f"{sparse_point_r['p50_ms']:.1f} ms  mismatches="
+            f"{sparse_point_r['mismatches']}  rotation "
+            f"{sparse_point_r['prestage_mode']} saved "
+            f"{sparse_point_r['prestage_bytes_saved']} of "
+            f"{sparse_point_r['prestage_bytes_full_image']} bytes"
+        )
+
     # Cost-model accuracy: the default ledger joined every terminal
     # batch the sweeps served against its admission-time price. The
     # aggregate is the samples-weighted mean of per-cell |residual_p50|
@@ -1060,6 +1288,10 @@ def run_serving_bench():
         and pipeline_overhead["mismatches"] == 0
         and utilization_overhead["mismatches"] == 0
         and (mesh_point is None or mesh_point["mismatches"] == 0)
+        and (
+            sparse_point_r is None
+            or sparse_point_r["mismatches"] == 0
+        )
     )
     compiles = batched_metrics["counters"].get(
         "plain.batcher.jit_bucket_compiles", 0
@@ -1086,6 +1318,7 @@ def run_serving_bench():
         "pipeline_overhead": pipeline_overhead,
         "utilization_overhead": utilization_overhead,
         "mesh": mesh_point,
+        "sparse": sparse_point_r,
         "cost_model_residual_p50": cost_model_residual,
         "jit_bucket_compiles": compiles,
         "batched_metrics": batched_metrics,
@@ -1130,6 +1363,7 @@ def main():
         append_utilization_history(
             report["utilization_overhead"], bench="serving_bench"
         )
+        append_sparse_history(report["sparse"], bench="serving_bench")
     if not report["correctness_ok"]:
         raise SystemExit("serving bench FAILED correctness")
 

@@ -231,6 +231,11 @@ class AdmissionController:
         self._policies: Dict[str, TenantPolicy] = {}
         self._buckets: Dict[str, TokenBucket] = {}
         self._outstanding_ms = 0.0
+        # Workload pricer: maps num_keys -> WorkCost. Defaults to the
+        # model's dense `price_pir_keys`; a sparse session installs
+        # `price_sparse_pir_keys` via `set_pricer` so admission charges
+        # two inner products per key (see serving/sparse.py).
+        self._pricer = None
         self._min_priority = 0  # brownout floor; 0 admits every class
         self._admitted_by_tenant: Dict[str, int] = {}
         self._shed_by_tenant: Dict[str, int] = {}
@@ -263,6 +268,12 @@ class AdmissionController:
     def policy(self, tenant: str) -> TenantPolicy:
         with self._lock:
             return self._policies.get(tenant) or TenantPolicy()
+
+    def set_pricer(self, pricer) -> None:
+        """Install a workload pricer (`num_keys -> WorkCost`); None
+        restores the model's dense `price_pir_keys`."""
+        with self._lock:
+            self._pricer = pricer
 
     # -- brownout hook -------------------------------------------------------
 
@@ -306,7 +317,12 @@ class AdmissionController:
                     ShedReason.QUOTA,
                     retry_after_s=bucket.time_until(num_keys, now=now),
                 )
-            cost = self.model.price_pir_keys(num_keys)
+            pricer = self._pricer
+            cost = (
+                pricer(num_keys)
+                if pricer is not None
+                else self.model.price_pir_keys(num_keys)
+            )
             drain_ms = self._outstanding_ms + cost.device_ms
             if deadline is not None and drain_ms > (deadline - now) * 1e3:
                 # Doomed: it would expire in queue. Shedding now costs

@@ -644,6 +644,35 @@ class CapacityModel:
             unit="pir_keys",
         )
 
+    def price_sparse_pir_keys(
+        self, num_keys: int, num_blocks: Optional[int] = None
+    ) -> WorkCost:
+        """Price a sparse (cuckoo key-value) serving request of
+        `num_keys` DPF keys over the bucket space. One expansion
+        produces one selection matrix, reused by **two** dense inner
+        products (parallel key and value stores over the same `1.5×n`
+        buckets) — so the byte peak matches the dense price for the
+        bucket geometry while device-ms doubles the per-key work. The
+        correction loop keys on its own "sparse" workload so dense
+        recalibration never skews sparse admission."""
+        qps = max(1e-6, self.serving_queries_per_sec())
+        if not num_blocks:
+            bytes_peak = 0
+        elif self._mesh_shape is not None:
+            bytes_peak = self.mesh_pir_bytes_per_shard(num_keys, num_blocks)
+        else:
+            bytes_peak = self.materialized_selection_bytes(
+                num_keys, num_blocks
+            )
+        return WorkCost(
+            bytes_peak=bytes_peak,
+            device_ms=self._corrected(
+                "sparse", num_keys, 2.0 * num_keys * 1e3 / qps
+            ),
+            quantity=num_keys,
+            unit="sparse_keys",
+        )
+
     def price_hh_level(
         self,
         num_keys: int,

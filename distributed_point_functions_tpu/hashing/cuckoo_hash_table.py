@@ -59,11 +59,15 @@ class CuckooHashTable:
         benchmark scale).
         """
         current = element.encode() if isinstance(element, str) else bytes(element)
-        if buckets is None:
-            buckets = tuple(
-                fn(current, self.num_buckets) for fn in self.hash_functions
-            )
         for _ in range(self.max_relocations):
+            if buckets is None:
+                # Lazily hashed: a preseeded slot (see `preseed`) stores
+                # no buckets, so an evicted preseeded element rehashes
+                # here on its first hop only.
+                buckets = tuple(
+                    fn(current, self.num_buckets)
+                    for fn in self.hash_functions
+                )
             h = self._rng.randrange(len(self.hash_functions))
             bucket = buckets[h]
             if self.table[bucket] is not None:
@@ -80,6 +84,20 @@ class CuckooHashTable:
         ):
             raise RuntimeError("cannot insert element: stash is full")
         self.stash.append(current)
+
+    def preseed(self, bucket: int, element: bytes) -> None:
+        """Pin `element` into `bucket` without hashing — used by delta
+        builds to reproduce a prior build's slot assignment before
+        inserting only the new keys. The slot stores no bucket tuple;
+        if a later insert evicts a preseeded element, `insert` rehashes
+        it lazily on its first relocation hop.
+        """
+        if not (0 <= bucket < self.num_buckets):
+            raise ValueError(f"bucket {bucket} out of range")
+        if self.table[bucket] is not None:
+            raise ValueError(f"bucket {bucket} already occupied")
+        current = element.encode() if isinstance(element, str) else bytes(element)
+        self.table[bucket] = (current, None)
 
     def get_table(self) -> List[Optional[bytes]]:
         return [

@@ -13,7 +13,7 @@ from .messages import (
 )
 from .server import DenseDpfPirServer, DpfPirServer
 from .cuckoo_database import CuckooHashedDpfPirDatabase, CuckooHashingParams
-from .sparse_client import CuckooHashingSparseDpfPirClient
+from .sparse_client import CuckooHashingSparseDpfPirClient, KeyNotFound
 from .sparse_server import CuckooHashingSparseDpfPirServer
 
 __all__ = [
@@ -21,6 +21,7 @@ __all__ = [
     "CuckooHashingParams",
     "CuckooHashingSparseDpfPirClient",
     "CuckooHashingSparseDpfPirServer",
+    "KeyNotFound",
     "DenseDpfPirClient",
     "DenseDpfPirDatabase",
     "DenseDpfPirServer",

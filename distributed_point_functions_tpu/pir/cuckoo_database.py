@@ -97,7 +97,111 @@ class CuckooHashedDpfPirDatabase:
                 size=len(self._records),
                 num_buckets=params.num_buckets,
                 generation=self._generation,
+                params=params,
+                slots=slots,
             )
+
+        def build_from(
+            self, prev: "CuckooHashedDpfPirDatabase"
+        ) -> "CuckooHashedDpfPirDatabase":
+            """Derive sparse generation N+1 from `prev` by **upsert**:
+            this builder's records overwrite or extend `prev`'s key→value
+            mapping (no delete path — retire a key with a full rebuild).
+
+            Keys already present keep their cuckoo bucket, so a write
+            batch touches exactly the buckets it changes; new keys are
+            inserted into a table preseeded with `prev`'s assignment
+            (evictions may relocate old keys, still counted as touched
+            buckets). Both parallel dense stores then go through
+            `DenseDpfPirDatabase.Builder.build_from`, which scatters
+            only the touched rows into the resident staging on
+            `prestage()` — a key-value write batch becomes a cheap
+            delta rotation. Oversized values fall back to a full dense
+            rebuild inside the dense builder; still correct, still
+            generation N+1.
+            """
+            if prev.params is None or prev.slots is None:
+                raise ValueError(
+                    "build_from needs a previous generation built by "
+                    "CuckooHashedDpfPirDatabase.Builder (params and slot "
+                    "assignment retained)"
+                )
+            params = prev.params
+            if self._params is not None and self._params != params:
+                raise ValueError(
+                    "build_from cannot change cuckoo params; rebuild "
+                    "from scratch to re-geometry"
+                )
+            for key in self._records:
+                if not key:
+                    raise ValueError("key cannot be empty")
+            prev_slots = list(prev.slots)
+            prev_keys = {
+                key: bucket
+                for bucket, key in enumerate(prev_slots)
+                if key is not None
+            }
+            new_keys = [k for k in self._records if k not in prev_keys]
+            if new_keys:
+                slots = self._insert_into(params, prev_slots, new_keys)
+            else:
+                slots = prev_slots
+            generation = prev.generation + 1
+            key_builder = DenseDpfPirDatabase.Builder()
+            value_builder = DenseDpfPirDatabase.Builder()
+
+            def value_at(key):
+                # Staged write wins; a relocated old key carries its
+                # value over from its previous bucket's value row.
+                if key is None:
+                    return b""
+                if key in self._records:
+                    return self._records[key]
+                return prev.value_database.record(prev_keys[key])
+
+            for bucket, key in enumerate(slots):
+                moved = key != prev_slots[bucket]
+                rewritten = (
+                    key is not None
+                    and key in self._records
+                    and self._records[key]
+                    != prev.value_database.record(bucket)
+                )
+                if moved or rewritten:
+                    key_builder.update(bucket, key or b"")
+                    value_builder.update(bucket, value_at(key))
+            return CuckooHashedDpfPirDatabase(
+                key_builder.build_from(prev.key_database),
+                value_builder.build_from(prev.value_database),
+                size=len(prev_keys) + len(new_keys),
+                num_buckets=params.num_buckets,
+                generation=generation,
+                params=params,
+                slots=slots,
+            )
+
+        def _insert_into(self, params, prev_slots, new_keys):
+            """Slot assignment extending `prev_slots` with `new_keys`:
+            a Python cuckoo table preseeded with the previous layout
+            (buckets lazily rehashed only if an old key gets evicted)."""
+            family = create_hash_family_from_config(
+                params.hash_family_config
+            )
+            hash_functions = create_hash_functions(
+                family, params.num_hash_functions
+            )
+            table = CuckooHashTable(
+                hash_functions,
+                params.num_buckets,
+                max_relocations=max(128, len(new_keys)),
+                max_stash_size=0,
+            )
+            for bucket, key in enumerate(prev_slots):
+                if key is not None:
+                    table.preseed(bucket, key)
+            for key in new_keys:
+                table.insert(key)
+            return table.get_table()
 
         def _build_slots(self, params):
             """bucket -> key (or None): the cuckoo assignment.
@@ -166,12 +270,24 @@ class CuckooHashedDpfPirDatabase:
         size: int,
         num_buckets: int,
         generation: int = 0,
+        params: Optional[CuckooHashingParams] = None,
+        slots: Optional[List[Optional[bytes]]] = None,
     ):
         self._key_database = key_database
         self._value_database = value_database
         self._size = size
         self._num_buckets = num_buckets
         self._generation = int(generation)
+        # Geometry + slot assignment, retained by Builder.build()/
+        # build_from() so (a) `Builder.build_from` can derive the next
+        # generation without re-hashing untouched keys and (b) the
+        # serving runtime can validate a staged snapshot's cuckoo
+        # geometry against the serving one. None when constructed
+        # directly (legacy path) — such databases can serve but not
+        # seed a delta build.
+        self._params = params
+        self._slots = list(slots) if slots is not None else None
+        self.last_prestage_stats = None
 
     @property
     def size(self) -> int:
@@ -200,8 +316,64 @@ class CuckooHashedDpfPirDatabase:
         return self._value_database
 
     @property
+    def params(self) -> Optional[CuckooHashingParams]:
+        """Cuckoo geometry this database was built under (None when
+        constructed without a Builder)."""
+        return self._params
+
+    @property
+    def slots(self) -> Optional[List[Optional[bytes]]]:
+        """bucket -> key (or None) assignment (None when constructed
+        without a Builder)."""
+        return self._slots
+
+    @property
     def num_selection_blocks(self) -> int:
         return self._key_database.num_selection_blocks
+
+    @property
+    def max_value_size(self) -> int:
+        """Largest packed row across both parallel dense stores."""
+        return max(
+            self._key_database.max_value_size,
+            self._value_database.max_value_size,
+        )
+
+    def prestage(self, mesh=None, **kwargs) -> int:
+        """Eagerly stage both parallel dense stores (the double-buffer
+        half of a sparse snapshot rotation); returns the bytes moved
+        host->device and merges both stores' `last_prestage_stats`.
+        For a `Builder.build_from` generation whose base stagings are
+        resident, each dense store scatters only its touched bucket
+        rows — `bytes_saved > 0` is the delta-rotation win."""
+        staged = self._key_database.prestage(mesh, **kwargs)
+        staged += self._value_database.prestage(mesh, **kwargs)
+        merged = {
+            "mode": None,
+            "bytes_staged": 0,
+            "bytes_full_image": 0,
+            "bytes_saved": 0,
+            "generation": self._generation,
+        }
+        for store in (self._key_database, self._value_database):
+            stats = store.last_prestage_stats
+            if not stats or stats.get("generation") != self._generation:
+                continue
+            for field in ("bytes_staged", "bytes_full_image",
+                          "bytes_saved"):
+                merged[field] += int(stats.get(field, 0))
+            if merged["mode"] != "delta":
+                merged["mode"] = stats.get("mode")
+        if merged["mode"] is not None:
+            self.last_prestage_stats = merged
+        return int(staged)
+
+    def release_stagings(self) -> int:
+        """Drop both stores' device stagings; returns buffers dropped."""
+        return (
+            self._key_database.release_stagings()
+            + self._value_database.release_stagings()
+        )
 
     def inner_product_with(
         self, selections: jnp.ndarray

@@ -31,6 +31,19 @@ class CuckooHashingSparseDpfPirRequestClientState:
     query_strings: List[bytes]
 
 
+@dataclasses.dataclass(frozen=True)
+class KeyNotFound:
+    """Typed absent-key result: no candidate bucket's key plaintext
+    matched the queried string. Honest semantics — a lookup never
+    degrades to a wrong value; callers branch on this type instead of
+    testing a value against None."""
+
+    key: bytes
+
+    def __bool__(self) -> bool:
+        return False
+
+
 def _is_prefix_padded_with_zeros(data: bytes, prefix: bytes) -> bool:
     if data[: len(prefix)] != prefix[: len(data)]:
         return False
@@ -148,3 +161,16 @@ class CuckooHashingSparseDpfPirClient:
                 ):
                     result[i] = raw[raw_index + 1]
         return result
+
+    def resolve(
+        self,
+        response: "messages.PirResponse",
+        client_state: CuckooHashingSparseDpfPirRequestClientState,
+    ) -> List:
+        """`handle_response` with typed absence: per query, the value
+        bytes when the key was present, else `KeyNotFound(key)`."""
+        values = self.handle_response(response, client_state)
+        return [
+            value if value is not None else KeyNotFound(key)
+            for key, value in zip(client_state.query_strings, values)
+        ]

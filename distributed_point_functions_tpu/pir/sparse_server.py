@@ -113,6 +113,85 @@ class CuckooHashingSparseDpfPirServer(DpfPirServer):
         """The params a client needs (hash config + bucket count)."""
         return self._params
 
+    @property
+    def database(self) -> CuckooHashedDpfPirDatabase:
+        """The currently-serving sparse database (the snapshot manager
+        reads its generation tag, mirroring `DenseDpfPirServer`)."""
+        return self._database
+
+    def validate_snapshot(
+        self, database: CuckooHashedDpfPirDatabase
+    ) -> None:
+        """Raise ValueError unless `database` is swappable in place of
+        the serving one: same cuckoo geometry (bucket count, hash count,
+        hash family + seed — a client hashing with the serving params
+        must land on the staged layout's buckets) and the same dense
+        row shapes (a staged selection batch must stay valid across the
+        flip). The serving runtime (`serving/snapshots.py`) calls this
+        polymorphically during `SnapshotManager.stage` and converts the
+        ValueError into a typed `SnapshotMismatch`."""
+        if database is None:
+            raise ValueError("database cannot be None")
+        if not hasattr(database, "num_buckets"):
+            raise ValueError(
+                "sparse server cannot serve a dense database snapshot"
+            )
+        if database.num_buckets != self._params.num_buckets:
+            raise ValueError(
+                f"snapshot has {database.num_buckets} buckets, serving "
+                f"geometry has {self._params.num_buckets}"
+            )
+        staged_params = getattr(database, "params", None)
+        if staged_params is not None and staged_params != self._params:
+            raise ValueError(
+                "snapshot cuckoo params (hash count/family/seed) do not "
+                "match the serving geometry"
+            )
+        if database.num_selection_blocks != self._num_blocks:
+            raise ValueError(
+                f"snapshot spans {database.num_selection_blocks} "
+                f"selection blocks, serving database spans "
+                f"{self._num_blocks}"
+            )
+        for name, staged, cur in (
+            ("key", database.key_database,
+             self._database.key_database),
+            ("value", database.value_database,
+             self._database.value_database),
+        ):
+            if staged.max_value_size != cur.max_value_size:
+                raise ValueError(
+                    f"snapshot {name} rows pack "
+                    f"{staged.max_value_size} bytes, serving database "
+                    f"packs {cur.max_value_size}"
+                )
+
+    def swap_database(
+        self, database: CuckooHashedDpfPirDatabase
+    ) -> CuckooHashedDpfPirDatabase:
+        """Atomically replace the serving sparse database (the snapshot
+        flip). Geometry is validated first (`validate_snapshot`); the
+        sharded step is retained — identical geometry compiles to the
+        same shapes — but the per-device database shards restage from
+        the new generation. Returns the previous database."""
+        self.validate_snapshot(database)
+        old, self._database = self._database, database
+        if self._sharded_dbs is not None:
+            from ..parallel.sharded import (
+                pad_rows_to_mesh,
+                shard_database,
+            )
+
+            ndev = self._mesh.devices.size
+            self._sharded_dbs = tuple(
+                shard_database(
+                    self._mesh, pad_rows_to_mesh(dense.db_words, ndev)
+                )
+                for dense in (database.key_database,
+                              database.value_database)
+            )
+        return old
+
     def get_public_params(self):
         """Wire-format params (`cuckoo_hashing_sparse_dpf_pir_server.h:99`):
         a `PirServerPublicParams` proto the client consumes remotely."""

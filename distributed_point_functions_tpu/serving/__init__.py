@@ -36,6 +36,14 @@ from .snapshots import (
     SnapshotManager,
     SnapshotMismatch,
 )
+from .sparse import (
+    SparseHelperSession,
+    SparseLeaderSession,
+    SparsePlainSession,
+    make_sparse_client,
+    sparse_lookup,
+    sparse_lookup_plain,
+)
 from .transport import (
     FramedTcpServer,
     InProcessTransport,
@@ -66,6 +74,9 @@ __all__ = [
     "ServingConfig",
     "SnapshotManager",
     "SnapshotMismatch",
+    "SparseHelperSession",
+    "SparseLeaderSession",
+    "SparsePlainSession",
     "TcpTransport",
     "TenantPolicy",
     "Transport",
@@ -73,7 +84,10 @@ __all__ = [
     "TransportTimeout",
     "bucket_size",
     "labeled_name",
+    "make_sparse_client",
     "parse_hostport",
     "recv_msg",
     "send_msg",
+    "sparse_lookup",
+    "sparse_lookup_plain",
 ]

@@ -246,6 +246,11 @@ class DynamicBatcher:
         # over the key axis (see `set_key_multiple`). 1 = plain
         # power-of-two buckets.
         self._key_multiple = 1
+        # Cost-ledger identity: which workload the terminal batches are
+        # joined under and how a bucket is priced (see
+        # `set_cost_model`). Defaults to dense pir pricing.
+        self._cost_workload = "pir"
+        self._cost_pricer = None
         self._seen_buckets: set = set()
         self._closed = False
         # Depth-2 pipeline handoff: the worker appends evaluated
@@ -410,6 +415,22 @@ class DynamicBatcher:
             util.record_idle(cause, seconds, thread=thread)
         except Exception:  # noqa: BLE001 - accounting never breaks serving
             pass
+
+    # -- cost-model hook ----------------------------------------------------
+
+    def set_cost_model(self, workload: str, pricer=None) -> None:
+        """Re-key the terminal-batch cost join: `workload` names the
+        ledger cell family (dense sessions use "pir", sparse sessions
+        "sparse") and `pricer`, when given, maps an executed padded
+        bucket size to a `WorkCost` estimate (defaults to the capacity
+        model's dense `price_pir_keys`). Sparse serving attaches
+        `price_sparse_pir_keys` here so the accuracy ledger and the
+        recalibration loop see sparse traffic as its own workload."""
+        if not workload:
+            raise ValueError("workload must be non-empty")
+        with self._cond:
+            self._cost_workload = str(workload)
+            self._cost_pricer = pricer
 
     # -- brownout hook ------------------------------------------------------
 
@@ -778,12 +799,18 @@ class DynamicBatcher:
                 actual_ms = max(
                     0.0, rec.eval_ms - rec.collected.get("compile", 0.0)
                 )
-            predicted = default_capacity_model().price_pir_keys(rec.bucket)
+            pricer = self._cost_pricer
+            if pricer is not None:
+                predicted = pricer(rec.bucket)
+            else:
+                predicted = default_capacity_model().price_pir_keys(
+                    rec.bucket
+                )
             trace = next(
                 (p.trace for p in rec.live if p.trace is not None), None
             )
             costmodel_mod.default_cost_ledger().observe(
-                "pir", tier, str(rec.bucket),
+                self._cost_workload, tier, str(rec.bucket),
                 predicted_device_ms=predicted.device_ms,
                 actual_device_ms=actual_ms,
                 transfer_bytes=rec.transfer_bytes,

@@ -39,6 +39,15 @@ one probe of each enabled kind:
     hh_sweep           a miniature heavy-hitters sweep over two
                        in-memory servers built from golden reports,
                        checked against `plaintext_heavy_hitters`
+    sparse_kv          (sparse sessions) golden key→value pairs through
+                       the batched cuckoo bucket-space path; each key's
+                       reconstructed candidate set must resolve to its
+                       oracle value
+    sparse_absent      (sparse sessions) a golden key guaranteed absent
+                       from the table; it must keep resolving to
+                       not-found — a well-formed wrong value for a
+                       missing key is the silent failure mode unique to
+                       key-value PIR
 
 For the dense probes the two plain responses are XORed together and
 compared byte-for-byte against the oracle records (`xor(share0,
@@ -92,6 +101,10 @@ from ..observability import events as events_mod
 from ..observability.slo import SloObjective
 from ..pir.client import DenseDpfPirClient
 from ..pir.server import set_tier_floor, tier_floor
+from ..pir.sparse_client import (
+    CuckooHashingSparseDpfPirClient,
+    _is_prefix_padded_with_zeros,
+)
 from ..prng import xor_bytes
 
 __all__ = ["Prober", "PROBE_STATUSES"]
@@ -107,6 +120,14 @@ _IDENTITY_KINDS = (
     "pir_unbatched",
 )
 
+# Sparse identity kinds: golden key→value pairs reconstructing through
+# the batched cuckoo path (`sparse_kv`), and a golden *absent* key that
+# must keep resolving to not-found (`sparse_absent` — a server that
+# starts answering wrong-but-well-formed values for absent keys is the
+# silent failure mode unique to key-value PIR). Stale ⇒ /healthz 503,
+# same as the dense identity kinds.
+_SPARSE_IDENTITY_KINDS = ("sparse_kv", "sparse_absent")
+
 
 class Prober:
     """Continuous blackbox canary over one serving session.
@@ -117,17 +138,27 @@ class Prober:
     first, middle, last — distinct). `encrypter` enables the
     `leader_e2e` probe; `hh_values` (+ optional `hh_config`) enables
     the `hh_sweep` probe. `clock` must be monotonic.
+
+    For a sparse (cuckoo key-value) session pass `sparse_records` — the
+    full key→value plaintext mapping — instead of (or alongside)
+    `records`: the dense probe kinds only run when `records` is given
+    (a sparse session answers bucket-space queries, so dense golden
+    *indices* are meaningless there), and `sparse_records` enables the
+    `sparse_kv` + `sparse_absent` kinds. `sparse_absent_key` overrides
+    the derived guaranteed-absent golden key.
     """
 
     def __init__(
         self,
         session,
-        records: Sequence[bytes],
+        records: Optional[Sequence[bytes]] = None,
         *,
         indices: Optional[Sequence[int]] = None,
         encrypter=None,
         hh_values: Optional[Sequence] = None,
         hh_config: Optional[HeavyHittersConfig] = None,
+        sparse_records: Optional[Dict[bytes, bytes]] = None,
+        sparse_absent_key: Optional[bytes] = None,
         period_s: float = 5.0,
         jitter: float = 0.2,
         max_duty_cycle: float = 0.05,
@@ -139,7 +170,7 @@ class Prober:
         clock=time.monotonic,
         rng_seed: int = 0,
     ):
-        if not records:
+        if not records and not sparse_records:
             raise ValueError("records must not be empty")
         if not 0.0 < max_duty_cycle <= 1.0:
             raise ValueError("max_duty_cycle must be in (0, 1]")
@@ -177,30 +208,47 @@ class Prober:
         # (None until one runs against a critical-path-aware session).
         self._last_critical: Optional[dict] = None
 
-        n = len(records)
-        if indices is None:
-            indices = sorted({0, n // 2, n - 1})
-        indices = [int(i) for i in indices]
-        for i in indices:
-            if not 0 <= i < n:
-                raise ValueError(f"golden index {i} out of bounds for {n}")
-        self._indices = indices
-        self._expected = [bytes(records[i]) for i in indices]
-
-        # Golden requests are precomputed once: DPF keys are stateless
-        # and reusable, so steady-state probing does no key generation.
-        # `create_plain_requests` never calls the encrypter, so a dummy
-        # suffices when no real one is configured.
-        client = DenseDpfPirClient(
-            n, encrypter if encrypter is not None else (lambda pt, info: pt)
-        )
-        self._client = client
-        self._db_size = n
-        self._plain_pair = client.create_plain_requests(indices)
+        self._dense = bool(records)
         self._e2e = None
-        if encrypter is not None:
-            request, state = client.create_request(indices)
-            self._e2e = (request, state, client)
+        if records:
+            n = len(records)
+            if indices is None:
+                indices = sorted({0, n // 2, n - 1})
+            indices = [int(i) for i in indices]
+            for i in indices:
+                if not 0 <= i < n:
+                    raise ValueError(
+                        f"golden index {i} out of bounds for {n}"
+                    )
+            self._indices = indices
+            self._expected = [bytes(records[i]) for i in indices]
+
+            # Golden requests are precomputed once: DPF keys are
+            # stateless and reusable, so steady-state probing does no
+            # key generation. `create_plain_requests` never calls the
+            # encrypter, so a dummy suffices when no real one is
+            # configured.
+            client = DenseDpfPirClient(
+                n,
+                encrypter
+                if encrypter is not None
+                else (lambda pt, info: pt),
+            )
+            self._client = client
+            self._db_size = n
+            self._plain_pair = client.create_plain_requests(indices)
+            if encrypter is not None:
+                request, state = client.create_request(indices)
+                self._e2e = (request, state, client)
+        else:
+            # Sparse-only prober: the dense kinds are disabled (a
+            # cuckoo session answers bucket-space queries; dense golden
+            # *indices* have no oracle meaning there).
+            self._indices = []
+            self._expected = []
+            self._client = None
+            self._db_size = 0
+            self._plain_pair = None
         # Snapshot rotation: the database generation the golden pairs
         # are keyed to, plus the SnapshotManagers to pin during each
         # probe so a probe's two shares never straddle a flip (see
@@ -210,6 +258,28 @@ class Prober:
         )
         self._generation = getattr(self._generation, "generation", 0)
         self._snapshot_pins: List = []
+
+        # Sparse goldens: a handful of known key→value pairs plus one
+        # key guaranteed absent, probed through the batched cuckoo path
+        # (`_probe_sparse`). The plain request pair covers all of them
+        # at once and is precomputed like the dense pair.
+        self._sparse_pair = None
+        self._sparse_keys: List[bytes] = []
+        self._sparse_expected: List[bytes] = []
+        self._sparse_absent: Optional[bytes] = None
+        self._sparse_client = None
+        self._sparse_num_hashes = 0
+        if sparse_records:
+            self._sparse_client = CuckooHashingSparseDpfPirClient.create(
+                session.server.public_params,
+                encrypter
+                if encrypter is not None
+                else (lambda pt, info: pt),
+            )
+            self._sparse_num_hashes = (
+                session.server.public_params.num_hash_functions
+            )
+            self._set_sparse_goldens(sparse_records, sparse_absent_key)
 
         self._hh = None
         if hh_values:
@@ -240,7 +310,9 @@ class Prober:
 
     def kinds(self) -> List[str]:
         """The probe kinds this prober runs each cycle."""
-        out = list(_IDENTITY_KINDS)
+        out = list(_IDENTITY_KINDS) if self._dense else []
+        if self._sparse_pair is not None:
+            out.extend(_SPARSE_IDENTITY_KINDS)
         if self._e2e is not None:
             out.append("leader_e2e")
         if self._hh is not None:
@@ -309,6 +381,79 @@ class Prober:
             generation=generation_now,
         )
 
+    def _set_sparse_goldens(self, sparse_records, absent_key) -> None:
+        """(Re)build the sparse golden set from a key→value mapping:
+        up to three present keys (sorted, for determinism), one
+        guaranteed-absent key, and the precomputed batched plain
+        request pair covering all of them. Caller holds `_lock` (or is
+        `__init__`)."""
+        norm = {}
+        for k, v in sparse_records.items():
+            kb = k.encode() if isinstance(k, str) else bytes(k)
+            norm[kb] = v.encode() if isinstance(v, str) else bytes(v)
+        keys = sorted(norm)[:3]
+        if absent_key is None:
+            # Keep the current absent golden while it stays absent; a
+            # write batch that introduces it forces a re-derivation.
+            absent_key = self._sparse_absent or b"prober-absent"
+            while absent_key in norm:
+                absent_key += b"!"
+        else:
+            absent_key = (
+                absent_key.encode()
+                if isinstance(absent_key, str)
+                else bytes(absent_key)
+            )
+            if absent_key in norm:
+                raise ValueError(
+                    "sparse_absent_key is present in sparse_records"
+                )
+        regenerate = (
+            keys != self._sparse_keys
+            or absent_key != self._sparse_absent
+            or self._sparse_pair is None
+        )
+        self._sparse_keys = keys
+        self._sparse_expected = [norm[k] for k in keys]
+        self._sparse_absent = absent_key
+        if regenerate:
+            self._sparse_pair = self._sparse_client.create_plain_requests(
+                keys + [absent_key]
+            )
+
+    def rotate_sparse_goldens(
+        self,
+        records: Dict[bytes, bytes],
+        *,
+        absent_key: Optional[bytes] = None,
+        generation: Optional[int] = None,
+    ) -> None:
+        """Re-key the sparse golden key→value pairs to a rotated
+        database generation. Unlike dense rotation the key set may
+        change (upserts add keys), so golden keys are re-picked from
+        the new mapping and the request pair regenerated when they
+        differ; the absent golden is kept while it stays absent."""
+        if not records:
+            raise ValueError("rotated sparse records must not be empty")
+        if self._sparse_client is None:
+            raise ValueError("prober has no sparse goldens to rotate")
+        with self._lock:
+            self._set_sparse_goldens(records, absent_key)
+            if generation is not None:
+                self._generation = int(generation)
+            generation_now = self._generation
+        journal = (
+            self._journal
+            if self._journal is not None
+            else events_mod.default_journal()
+        )
+        journal.emit(
+            "prober.goldens_rotated",
+            f"sparse golden pairs re-keyed to generation {generation_now}",
+            severity="info",
+            generation=generation_now,
+        )
+
     def bind_snapshots(self, manager, records_provider=None):
         """Track a `SnapshotManager` through rotations: every probe
         pins it (a probe's two shares must evaluate against ONE
@@ -325,7 +470,13 @@ class Prober:
         if records_provider is not None:
             def on_flip(record):
                 records = records_provider(record["to_generation"])
-                if records:
+                if not records:
+                    return
+                if isinstance(records, dict):
+                    self.rotate_sparse_goldens(
+                        records, generation=record["to_generation"]
+                    )
+                else:
                     self.rotate_goldens(
                         records, generation=record["to_generation"]
                     )
@@ -428,6 +579,57 @@ class Prober:
         got = client.handle_response(response, state)
         return self._check_records(got)
 
+    def _probe_sparse(self, absent: bool) -> Optional[str]:
+        """Run the sparse golden pair through the batched path and
+        resolve candidates client-side (the same zero-padded prefix
+        match `CuckooHashingSparseDpfPirClient` applies). With
+        `absent=False` every golden key must resolve to its oracle
+        value; with `absent=True` the absent golden must resolve to
+        nothing — a well-formed wrong value for a missing key is the
+        silent failure mode unique to key-value PIR."""
+        with self._lock:
+            pair = self._sparse_pair
+            keys = list(self._sparse_keys)
+            expected = list(self._sparse_expected)
+            absent_key = self._sparse_absent
+            num_hashes = self._sparse_num_hashes
+        req0, req1 = pair
+        resp0 = self._issue_batched(req0)
+        resp1 = self._issue_batched(req1)
+        raw = self._reconstruct(resp0, resp1)
+        queries = keys + [absent_key]
+        if len(raw) != 2 * num_hashes * len(queries):
+            return (
+                f"candidate count {len(raw)} != "
+                f"2 x {num_hashes} hashes x {len(queries)} queries"
+            )
+
+        def resolve(i: int) -> Optional[bytes]:
+            for j in range(num_hashes):
+                k = 2 * (num_hashes * i + j)
+                if _is_prefix_padded_with_zeros(raw[k], queries[i]):
+                    return raw[k + 1]
+            return None
+
+        if absent:
+            got = resolve(len(queries) - 1)
+            if got is not None:
+                return (
+                    f"absent key {absent_key!r} resolved to "
+                    f"{got.hex()[:32]}.. (want not-found)"
+                )
+            return None
+        for i, (key, want) in enumerate(zip(keys, expected)):
+            got = resolve(i)
+            if got is None:
+                return f"golden key {key!r}: not found (want present)"
+            if not _is_prefix_padded_with_zeros(got, want):
+                return (
+                    f"golden key {key!r}: expected {want.hex()[:32]}.. "
+                    f"got {got.hex()[:32]}.."
+                )
+        return None
+
     def _probe_hh_sweep(self) -> Optional[str]:
         server0, server1, expected = self._hh
         server0.reset()
@@ -460,6 +662,10 @@ class Prober:
                     detail = self._probe_unbatched()
                 elif kind == "leader_e2e":
                     detail = self._probe_leader_e2e()
+                elif kind == "sparse_kv":
+                    detail = self._probe_sparse(absent=False)
+                elif kind == "sparse_absent":
+                    detail = self._probe_sparse(absent=True)
                 elif kind == "hh_sweep":
                     detail = self._probe_hh_sweep()
                 else:  # pragma: no cover - kinds() is the source of truth
@@ -593,7 +799,10 @@ class Prober:
                         else None
                     ),
                     "fresh": age <= self._freshness_window_s,
-                    "identity": kind in _IDENTITY_KINDS,
+                    "identity": (
+                        kind in _IDENTITY_KINDS
+                        or kind in _SPARSE_IDENTITY_KINDS
+                    ),
                     "detail": last["detail"] if last else None,
                 }
         return out

@@ -623,21 +623,23 @@ class _Session:
 
 
 class PlainSession(_Session):
-    """Single-server (trusted) serving: plain requests, batched."""
+    """Single-server (trusted) serving: plain requests, batched.
+
+    `server=` swaps in a pre-built plain-role server (the sparse
+    sessions in `serving/sparse.py` reuse every session mechanic this
+    way); the default builds a dense server from `database`."""
 
     def __init__(
         self,
-        database: DenseDpfPirDatabase,
+        database: Optional[DenseDpfPirDatabase] = None,
         config: Optional[ServingConfig] = None,
         metrics: Optional[MetricsRegistry] = None,
         mesh=None,
+        server=None,
     ):
-        super().__init__(
-            DenseDpfPirServer.create_plain(database, mesh=mesh),
-            config,
-            metrics,
-            "plain",
-        )
+        if server is None:
+            server = DenseDpfPirServer.create_plain(database, mesh=mesh)
+        super().__init__(server, config, metrics, "plain")
 
 
 class HelperSession(_Session):
@@ -645,37 +647,44 @@ class HelperSession(_Session):
 
     def __init__(
         self,
-        database: DenseDpfPirDatabase,
-        decrypter,
+        database: Optional[DenseDpfPirDatabase] = None,
+        decrypter=None,
         config: Optional[ServingConfig] = None,
         metrics: Optional[MetricsRegistry] = None,
         mesh=None,
+        server=None,
     ):
-        super().__init__(
-            DenseDpfPirServer.create_helper(database, decrypter, mesh=mesh),
-            config,
-            metrics,
-            "helper",
-        )
+        if server is None:
+            server = DenseDpfPirServer.create_helper(
+                database, decrypter, mesh=mesh
+            )
+        super().__init__(server, config, metrics, "helper")
 
 
 class LeaderSession(_Session):
     """The Leader role: forwards the encrypted Helper leg over an
     injected `Transport` with timeout/retry/backoff, computes its own
-    share while waiting, and XOR-combines the masked responses."""
+    share while waiting, and XOR-combines the masked responses.
+
+    `server=` swaps in a pre-built leader-role server; build it around
+    this session's `self._send_to_helper` bound method (subclasses set
+    `self._transport` first, then construct the server — see
+    `serving/sparse.py:SparseLeaderSession`)."""
 
     def __init__(
         self,
-        database: DenseDpfPirDatabase,
-        helper_transport: Transport,
+        database: Optional[DenseDpfPirDatabase] = None,
+        helper_transport: Optional[Transport] = None,
         config: Optional[ServingConfig] = None,
         metrics: Optional[MetricsRegistry] = None,
         mesh=None,
+        server=None,
     ):
         self._transport = helper_transport
-        server = DenseDpfPirServer.create_leader(
-            database, self._send_to_helper, mesh=mesh
-        )
+        if server is None:
+            server = DenseDpfPirServer.create_leader(
+                database, self._send_to_helper, mesh=mesh
+            )
         super().__init__(server, config, metrics, "leader")
         m = self.metrics
         self._c_retries = m.counter("leader.helper_retries")

@@ -180,17 +180,35 @@ class SnapshotManager:
         a mismatch fails here, before any flip is armed. Replacing an
         already-staged (never-flipped) candidate drops its buffers."""
         cur = self._server.database
-        if database.size != cur.size:
-            raise ValueError(
-                f"staged generation size {database.size} != serving "
-                f"{cur.size}"
-            )
-        if database.max_value_size != cur.max_value_size:
-            raise ValueError(
-                "staged generation max_value_size "
-                f"{database.max_value_size} != serving "
-                f"{cur.max_value_size}"
-            )
+        validate = getattr(self._server, "validate_snapshot", None)
+        if callable(validate):
+            # Geometry-aware servers (the sparse cuckoo server) own
+            # their swap precondition: cuckoo bucket count, hash
+            # params/seed, dense row shapes. A mis-rotated snapshot
+            # surfaces as the typed rotation fault, not a ValueError
+            # that callers would read as a coding bug — and never
+            # serves garbage.
+            try:
+                validate(database)
+            except ValueError as e:
+                self._c_mismatches.inc()
+                raise SnapshotMismatch(
+                    cur.generation,
+                    database.generation,
+                    message=f"staged generation rejected: {e}",
+                ) from e
+        else:
+            if database.size != cur.size:
+                raise ValueError(
+                    f"staged generation size {database.size} != serving "
+                    f"{cur.size}"
+                )
+            if database.max_value_size != cur.max_value_size:
+                raise ValueError(
+                    "staged generation max_value_size "
+                    f"{database.max_value_size} != serving "
+                    f"{cur.max_value_size}"
+                )
         failpoints.fire("snapshot.stage")
         # Stage in the layout the server actually serves (a mesh server
         # shards generation N+1 over its shard axis here, so the flip

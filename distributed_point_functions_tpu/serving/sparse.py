@@ -1,0 +1,254 @@
+"""Sparse key-value PIR serving: cuckoo-hashed retrieval at
+production parity.
+
+String-keyed lookups ride the exact dense serving stack — the
+DynamicBatcher coalesces concurrent queries into padded power-of-two
+key buckets, the Leader/Helper sessions keep their wire envelopes,
+retry ladder, breaker, and generation handshake — because a sparse
+query *is* a dense request over the cuckoo bucket space
+(`pir/sparse_server.py`): each DPF key selects one bucket of the
+`1.5×n`-bucket table and the server answers with **two** masked
+responses per key, the bucket's key and its value, from the two
+parallel dense stores.
+
+The only seam is the per-key result shape. The batcher's contract is
+one result per submitted key; the dense sessions return one masked
+response per key, the sparse server returns two. The
+`_SparseEvaluationMixin` below adapts at exactly that seam: the
+evaluation function groups the interleaved (key, value) responses into
+one tuple per DPF key (so batcher coalescing, padding, pipelining, and
+generation binding all apply unchanged), and the plain handler
+re-flattens them to the reference's interleaved wire order. Everything
+else — deadlines, tenants, admission, brownout, snapshots, the wire-v3
+generation echo — is inherited, not reimplemented.
+
+Resolution is client-side (`pir/sparse_client.py`): each queried
+string hashes to `num_hash_functions` candidate buckets; the value
+whose returned key plaintext equals the query (zero-padded prefix
+check) wins, and a query matching no candidate resolves to the typed
+`KeyNotFound` — never a wrong value.
+
+Writes are snapshot rotations: build generation N+1 with
+`CuckooHashedDpfPirDatabase.Builder.build_from(prev)` (upsert; touched
+buckets only), `SnapshotManager.stage()` prestages just those bucket
+rows against the resident stagings (`bytes_saved > 0`), and the
+batch-boundary flip applies unchanged.
+
+Capacity treats sparse traffic as its own workload: admission prices
+`price_sparse_pir_keys` (two dense inner products per key) and the
+cost-accuracy ledger joins terminal batches under "sparse", so dense
+recalibration never skews sparse admission (or vice versa).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..capacity.model import default_capacity_model
+from ..pir import messages
+from ..pir.cuckoo_database import (
+    CuckooHashedDpfPirDatabase,
+    CuckooHashingParams,
+)
+from ..pir.sparse_client import (
+    CuckooHashingSparseDpfPirClient,
+    KeyNotFound,
+    _is_prefix_padded_with_zeros,
+)
+from ..pir.sparse_server import CuckooHashingSparseDpfPirServer
+from ..prng import xor_bytes
+from .metrics import MetricsRegistry
+from .service import (
+    _DEADLINE,
+    _EVAL_GENERATION,
+    _TENANT,
+    HelperSession,
+    LeaderSession,
+    PlainSession,
+    ServingConfig,
+)
+from .transport import Transport
+
+
+class _SparseEvaluationMixin:
+    """Adapts the dense session mechanics to the sparse server's
+    two-responses-per-key shape (see module docstring)."""
+
+    def _sparse_init(self) -> None:
+        """Post-`_Session.__init__` wiring: price sparse work as its
+        own workload, for both the admission controller (charge two
+        inner products per key before enqueueing) and the terminal
+        batch cost join (ledger cells under "sparse")."""
+        model = default_capacity_model()
+        num_blocks = self._server.database.num_selection_blocks
+
+        def pricer(num_keys):
+            return model.price_sparse_pir_keys(num_keys, num_blocks)
+
+        if self._batcher is not None:
+            self._batcher.set_cost_model("sparse", pricer)
+        if self.admission is not None:
+            self.admission.set_pricer(pricer)
+
+    def _evaluate_keys(self, keys):
+        """One real device step for the coalesced bucket-space key
+        batch; returns one `(key_bytes, value_bytes)` tuple per DPF key
+        — the batcher's one-result-per-key contract (padding duplicates
+        a real key, so its pair is well-formed and discarded)."""
+        response = self._server.handle_plain_request(
+            messages.PirRequest(
+                plain_request=messages.PlainRequest(dpf_keys=list(keys))
+            )
+        )
+        masked = response.dpf_pir_response.masked_response
+        if len(masked) != 2 * len(keys):
+            raise RuntimeError(
+                f"sparse evaluation returned {len(masked)} masked "
+                f"responses for {len(keys)} keys (want 2 per key)"
+            )
+        return [
+            (masked[2 * i], masked[2 * i + 1]) for i in range(len(keys))
+        ]
+
+    def _batched_plain_handler(self, request):
+        out, generation = self._batcher.submit_ex(
+            request.plain_request.dpf_keys,
+            deadline=_DEADLINE.get(),
+            tenant=_TENANT.get(),
+        )
+        if generation is not None:
+            # Same deliberately-unscoped publication as the dense
+            # handler: the enclosing entry point (Helper echo / Leader
+            # own-share binding) reads it up-stack on this context.
+            _EVAL_GENERATION.set(generation)
+        masked = []
+        for key_bytes, value_bytes in out:
+            masked.append(key_bytes)
+            masked.append(value_bytes)
+        return messages.PirResponse(
+            dpf_pir_response=messages.DpfPirResponse(
+                masked_response=masked
+            )
+        )
+
+
+class SparsePlainSession(_SparseEvaluationMixin, PlainSession):
+    """Single-server (trusted) sparse serving: bucket-space plain
+    requests, batched. The private two-server deployment is
+    `SparseLeaderSession` + `SparseHelperSession`."""
+
+    def __init__(
+        self,
+        params: CuckooHashingParams,
+        database: CuckooHashedDpfPirDatabase,
+        config: Optional[ServingConfig] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        mesh=None,
+    ):
+        server = CuckooHashingSparseDpfPirServer.create_plain(
+            params, database, mesh=mesh
+        )
+        super().__init__(config=config, metrics=metrics, server=server)
+        self._sparse_init()
+
+
+class SparseHelperSession(_SparseEvaluationMixin, HelperSession):
+    """The Helper role over a sparse database: decrypts its leg,
+    evaluates the bucket-space batch, masks both response streams."""
+
+    def __init__(
+        self,
+        params: CuckooHashingParams,
+        database: CuckooHashedDpfPirDatabase,
+        decrypter,
+        config: Optional[ServingConfig] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        mesh=None,
+    ):
+        server = CuckooHashingSparseDpfPirServer.create_helper(
+            params, database, decrypter, mesh=mesh
+        )
+        super().__init__(config=config, metrics=metrics, server=server)
+        self._sparse_init()
+
+
+class SparseLeaderSession(_SparseEvaluationMixin, LeaderSession):
+    """The Leader role over a sparse database: forwards the encrypted
+    Helper leg (retry ladder, breaker, wire-v3 generation handshake —
+    all inherited), computes its own two-per-key share while waiting,
+    XOR-combines."""
+
+    def __init__(
+        self,
+        params: CuckooHashingParams,
+        database: CuckooHashedDpfPirDatabase,
+        helper_transport: Transport,
+        config: Optional[ServingConfig] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        mesh=None,
+    ):
+        # The server needs the bound sender before LeaderSession's
+        # __init__ runs (same trick LeaderSession itself uses); the
+        # sender only fires at request time, after init completes.
+        self._transport = helper_transport
+        server = CuckooHashingSparseDpfPirServer.create_leader(
+            params, database, self._send_to_helper, mesh=mesh
+        )
+        super().__init__(
+            helper_transport=helper_transport,
+            config=config,
+            metrics=metrics,
+            server=server,
+        )
+        self._sparse_init()
+
+
+# -- client-side lookup helpers ---------------------------------------------
+
+
+def make_sparse_client(
+    session, encrypter=None
+) -> CuckooHashingSparseDpfPirClient:
+    """A lookup client bound to `session`'s cuckoo geometry. With no
+    `encrypter` the helper leg is left plaintext — fine for
+    `SparsePlainSession` and in-process tests; pass the deployment's
+    HPKE encrypter for a real Leader."""
+    if encrypter is None:
+        encrypter = lambda pt, info: pt  # noqa: E731 - identity leg
+    return CuckooHashingSparseDpfPirClient.create(
+        session.server.public_params, encrypter
+    )
+
+
+def sparse_lookup(session, client, query: Sequence[bytes]) -> List:
+    """One end-to-end key-value lookup through a combining role session
+    (`SparseLeaderSession`): per queried string, the value bytes when
+    present, else `KeyNotFound(key)`."""
+    request, state = client.create_request(list(query))
+    response = session.handle_request(request)
+    return client.resolve(response, state)
+
+
+def sparse_lookup_plain(session, client, query: Sequence[bytes]) -> List:
+    """Two-share lookup against ONE `SparsePlainSession`: both plain
+    DPF shares go through the same session over the same database, so
+    the XOR of the two masked streams is the plaintext (key, value)
+    candidates — the protocol identity the prober also leans on. Per
+    queried string: value bytes, else `KeyNotFound(key)`."""
+    qbytes = [
+        q.encode() if isinstance(q, str) else bytes(q) for q in query
+    ]
+    r0, r1 = client.create_plain_requests(qbytes)
+    a = session.handle_request(r0).dpf_pir_response.masked_response
+    b = session.handle_request(r1).dpf_pir_response.masked_response
+    raw = [xor_bytes(x, y) for x, y in zip(a, b)]
+    num_hashes = session.server.public_params.num_hash_functions
+    results: List = []
+    for i, q in enumerate(qbytes):
+        found = None
+        for j in range(num_hashes):
+            k = 2 * (num_hashes * i + j)
+            if found is None and _is_prefix_padded_with_zeros(raw[k], q):
+                found = raw[k + 1]
+        results.append(found if found is not None else KeyNotFound(q))
+    return results

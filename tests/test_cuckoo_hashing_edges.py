@@ -1,0 +1,216 @@
+"""Edge cases of the cuckoo hashing layer underpinning sparse PIR.
+
+The serving path trusts this layer twice over: `Builder.build()` for
+the initial assignment and `Builder.build_from()` for delta builds that
+preseed a prior layout and insert only new keys. These tests pin the
+corner behaviors those paths depend on — eviction/relocation, the
+lazily-rehashed preseeded slot, the bounded-stash failure mode,
+duplicate-key upsert semantics, empty builds, and determinism of
+`generate_params` under a fixed seed.
+"""
+
+import pytest
+
+from distributed_point_functions_tpu.hashing import (
+    CuckooHashTable,
+    create_hash_family_from_config,
+)
+from distributed_point_functions_tpu.hashing.hash_family import (
+    create_hash_functions,
+)
+from distributed_point_functions_tpu.pir.cuckoo_database import (
+    CuckooHashedDpfPirDatabase,
+)
+from distributed_point_functions_tpu.pir.sparse_server import (
+    CuckooHashingSparseDpfPirServer,
+)
+
+SEED = b"0123456789abcdef"
+
+
+def make_hash_functions(num=3, num_elements=8):
+    params = CuckooHashingSparseDpfPirServer.generate_params(
+        num_elements, seed=SEED
+    )
+    family = create_hash_family_from_config(params.hash_family_config)
+    return create_hash_functions(family, num), params
+
+
+def test_insert_relocates_on_collision():
+    """Force every key into one bucket: the eviction loop must still
+    place all of them (each key has several candidate buckets)."""
+    hash_functions, _ = make_hash_functions()
+    table = CuckooHashTable(
+        hash_functions, num_buckets=64, max_relocations=128
+    )
+    keys = [b"k%02d" % i for i in range(32)]
+    for key in keys:
+        table.insert(key)
+    placed = [e for e in table.get_table() if e is not None]
+    assert sorted(placed) + sorted(table.get_stash()) == sorted(
+        placed + table.get_stash()
+    )
+    assert sorted(placed + table.get_stash()) == sorted(keys)
+    # With generous buckets and relocations nothing should stash.
+    assert table.get_stash() == []
+
+
+def test_stash_overflow_raises():
+    """max_stash_size=0 turns placement failure into a hard error —
+    the database builder relies on this instead of silently dropping
+    keys (a dropped key would serve not-found for a present record)."""
+    hash_functions, _ = make_hash_functions(num=2)
+    table = CuckooHashTable(
+        hash_functions, num_buckets=2, max_relocations=4, max_stash_size=0
+    )
+    with pytest.raises(RuntimeError, match="stash is full"):
+        # 2 hash functions over 2 buckets hold at most 2 elements;
+        # the third must fail.
+        for i in range(8):
+            table.insert(b"key%d" % i)
+
+
+def test_unbounded_stash_absorbs_overflow():
+    hash_functions, _ = make_hash_functions(num=2)
+    table = CuckooHashTable(
+        hash_functions, num_buckets=2, max_relocations=4
+    )
+    keys = [b"key%d" % i for i in range(6)]
+    for key in keys:
+        table.insert(key)
+    placed = [e for e in table.get_table() if e is not None]
+    assert sorted(placed + table.get_stash()) == sorted(keys)
+    assert len(table.get_stash()) >= 4
+
+
+def test_preseeded_slot_rehashes_lazily_on_eviction():
+    """A preseeded element stores no bucket tuple; evicting it must
+    rehash it to a legal candidate bucket, not crash or misplace it.
+    This is the exact path `Builder.build_from` takes when a new key
+    lands on an old key's bucket."""
+    hash_functions, _ = make_hash_functions()
+    probe = CuckooHashTable(hash_functions, num_buckets=16,
+                            max_relocations=64)
+    old_key = b"old_key"
+    candidates = {fn(old_key, 16) for fn in hash_functions}
+
+    for target in sorted(candidates):
+        table = CuckooHashTable(
+            hash_functions, num_buckets=16, max_relocations=64
+        )
+        table.preseed(target, old_key)
+        # Fill every OTHER candidate bucket of old_key with preseeded
+        # squatters so that, once evicted, it must hop until it finds a
+        # free candidate (exercising multiple relocation hops).
+        for i in range(64):
+            filler = b"filler%02d" % i
+            table.insert(filler)
+        layout = table.get_table()
+        placed = [e for e in layout if e is not None]
+        assert old_key in placed + table.get_stash()
+        if old_key in placed:
+            bucket = layout.index(old_key)
+            assert bucket in candidates, (
+                f"evicted preseeded key rehashed to non-candidate "
+                f"bucket {bucket} (candidates {sorted(candidates)})"
+            )
+    del probe
+
+
+def test_preseed_validates_bucket():
+    hash_functions, _ = make_hash_functions()
+    table = CuckooHashTable(hash_functions, num_buckets=4,
+                            max_relocations=8)
+    with pytest.raises(ValueError, match="out of range"):
+        table.preseed(4, b"x")
+    with pytest.raises(ValueError, match="out of range"):
+        table.preseed(-1, b"x")
+    table.preseed(1, b"x")
+    with pytest.raises(ValueError, match="already occupied"):
+        table.preseed(1, b"y")
+
+
+def test_constructor_validation():
+    hash_functions, _ = make_hash_functions()
+    with pytest.raises(ValueError, match="num_buckets"):
+        CuckooHashTable(hash_functions, 0, 8)
+    with pytest.raises(ValueError, match="at least 2"):
+        CuckooHashTable(hash_functions[:1], 4, 8)
+    with pytest.raises(ValueError, match="max_relocations"):
+        CuckooHashTable(hash_functions, 4, -1)
+    with pytest.raises(ValueError, match="max_stash_size"):
+        CuckooHashTable(hash_functions, 4, 8, max_stash_size=-1)
+
+
+def test_table_layout_deterministic_for_fixed_inputs():
+    """Two tables built from identical inputs must produce identical
+    layouts (fixed rng_seed) — delta builds and probers depend on
+    reproducible assignment."""
+    hash_functions, _ = make_hash_functions()
+    keys = [b"key_%02d" % i for i in range(24)]
+    layouts = []
+    for _ in range(2):
+        table = CuckooHashTable(
+            hash_functions, num_buckets=36, max_relocations=64
+        )
+        for key in keys:
+            table.insert(key)
+        layouts.append(table.get_table())
+    assert layouts[0] == layouts[1]
+
+
+def test_generate_params_deterministic_under_fixed_seed():
+    a = CuckooHashingSparseDpfPirServer.generate_params(100, seed=SEED)
+    b = CuckooHashingSparseDpfPirServer.generate_params(100, seed=SEED)
+    assert a == b  # frozen dataclasses: field-wise equality
+    assert a.num_buckets == b.num_buckets
+    assert a.hash_family_config.seed == b.hash_family_config.seed
+    # Without a pinned seed each call draws a fresh family seed.
+    c = CuckooHashingSparseDpfPirServer.generate_params(100)
+    d = CuckooHashingSparseDpfPirServer.generate_params(100)
+    assert c.hash_family_config.seed != d.hash_family_config.seed
+
+
+def test_duplicate_key_insert_upserts():
+    """Builder.insert of the same key twice keeps ONE slot with the
+    last value (dict upsert) — the table never holds a key twice."""
+    params = CuckooHashingSparseDpfPirServer.generate_params(
+        4, seed=SEED
+    )
+    builder = CuckooHashedDpfPirDatabase.Builder().set_params(params)
+    builder.insert((b"dup", b"first"))
+    builder.insert((b"other", b"o"))
+    builder.insert((b"dup", b"second"))
+    db = builder.build()
+    assert db.size == 2
+    occupied = [s for s in db.slots if s is not None]
+    assert sorted(occupied) == [b"dup", b"other"]
+    bucket = db.slots.index(b"dup")
+    value = db.value_database.record(bucket)
+    assert value[: len(b"second")] == b"second"
+    assert all(byte == 0 for byte in value[len(b"second"):])
+
+
+def test_empty_build_rejected():
+    """An empty table build: generate_params(0) is invalid, and a
+    builder with params for n>0 but zero records still produces a
+    well-formed (all-vacant) database."""
+    with pytest.raises(ValueError, match="num_elements"):
+        CuckooHashingSparseDpfPirServer.generate_params(0, seed=SEED)
+    params = CuckooHashingSparseDpfPirServer.generate_params(
+        4, seed=SEED
+    )
+    db = CuckooHashedDpfPirDatabase.Builder().set_params(params).build()
+    assert db.size == 0
+    assert db.num_buckets == params.num_buckets
+    assert all(s is None for s in db.slots)
+
+
+def test_empty_key_rejected():
+    params = CuckooHashingSparseDpfPirServer.generate_params(
+        4, seed=SEED
+    )
+    builder = CuckooHashedDpfPirDatabase.Builder().set_params(params)
+    builder.insert((b"", b"v"))
+    with pytest.raises(ValueError, match="key cannot be empty"):
+        builder.build()

@@ -1,0 +1,362 @@
+"""Sparse key-value PIR through the production serving stack.
+
+Pins the PR's parity contract: string-keyed lookups routed through the
+DynamicBatcher and the Leader/Helper sessions must be bit-identical to
+the seed's unbatched `CuckooHashingSparseDpfPirServer` oracle, absent
+keys must resolve to the typed `KeyNotFound` (never a wrong value),
+key-value write batches must land as SnapshotManager delta rotations
+(`bytes_saved > 0`), a mis-rotated cuckoo geometry must raise
+`SnapshotMismatch`, and the forced-8-device mesh path must match the
+single-device one byte for byte.
+"""
+
+import threading
+import time
+
+import pytest
+
+from distributed_point_functions_tpu.parallel.sharded import make_mesh
+from distributed_point_functions_tpu.pir.cuckoo_database import (
+    CuckooHashedDpfPirDatabase,
+)
+from distributed_point_functions_tpu.pir.sparse_client import (
+    KeyNotFound,
+)
+from distributed_point_functions_tpu.pir.sparse_server import (
+    CuckooHashingSparseDpfPirServer,
+)
+from distributed_point_functions_tpu.serving import (
+    InProcessTransport,
+    ServingConfig,
+    SnapshotManager,
+    SnapshotMismatch,
+    SparseHelperSession,
+    SparseLeaderSession,
+    SparsePlainSession,
+    make_sparse_client,
+    sparse_lookup,
+    sparse_lookup_plain,
+)
+from distributed_point_functions_tpu.serving.prober import Prober
+from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+import jax
+
+SEED = b"0123456789abcdef"
+NUM_KEYS = 40
+
+# Fixed-width keys and values: delta rotations preserve each dense
+# store's packed row width, so goldens and upserts stay in-width.
+RECORDS = {b"key_%02d" % i: b"val_%02d" % i for i in range(NUM_KEYS)}
+
+
+def build_sparse(records=None, params=None, generation=0):
+    records = RECORDS if records is None else records
+    if params is None:
+        params = CuckooHashingSparseDpfPirServer.generate_params(
+            len(records), seed=SEED
+        )
+    builder = CuckooHashedDpfPirDatabase.Builder().set_params(params)
+    builder.set_generation(generation)
+    for kv in records.items():
+        builder.insert(kv)
+    return params, builder.build()
+
+
+def make_config(**overrides):
+    base = dict(
+        max_batch_size=8,
+        max_wait_ms=2.0,
+        helper_timeout_ms=None,
+        helper_retries=1,
+        helper_backoff_ms=1.0,
+        helper_backoff_max_ms=2.0,
+    )
+    base.update(overrides)
+    return ServingConfig(**base)
+
+
+QUERIES = [b"key_00", b"key_17", b"key_39", b"absent"]
+
+
+def test_batched_plain_bit_identical_to_unbatched_oracle():
+    """Every masked response through session+batcher must equal the
+    seed's unbatched sparse server, byte for byte."""
+    params, db = build_sparse()
+    session = SparsePlainSession(params, db, make_config())
+    oracle = CuckooHashingSparseDpfPirServer.create_plain(params, db)
+    client = make_sparse_client(session)
+
+    req0, req1 = client.create_plain_requests(QUERIES)
+    for request in (req0, req1):
+        batched = session.handle_request(request)
+        unbatched = oracle.handle_plain_request(request)
+        assert (
+            batched.dpf_pir_response.masked_response
+            == unbatched.dpf_pir_response.masked_response
+        )
+
+
+def test_plain_lookup_values_and_typed_absent():
+    params, db = build_sparse()
+    session = SparsePlainSession(params, db, make_config())
+    client = make_sparse_client(session)
+    out = sparse_lookup_plain(session, client, QUERIES)
+    assert out[0] == b"val_00"
+    assert out[1] == b"val_17"
+    assert out[2] == b"val_39"
+    assert isinstance(out[3], KeyNotFound)
+    assert out[3].key == b"absent"
+    assert not out[3]  # falsy: callers can branch on truthiness
+
+
+def test_leader_helper_end_to_end():
+    """Full two-party path: encrypted helper leg, one-time-pad unmask,
+    XOR combine — values and typed absence both survive the trip."""
+    params, db_h = build_sparse()
+    _, db_l = build_sparse()
+    helper = SparseHelperSession(
+        params, db_h, encrypt_decrypt.decrypt, make_config()
+    )
+    leader = SparseLeaderSession(
+        params,
+        db_l,
+        InProcessTransport(helper.handle_wire),
+        make_config(),
+    )
+    client = make_sparse_client(leader, encrypter=encrypt_decrypt.encrypt)
+    out = sparse_lookup(leader, client, QUERIES)
+    assert out[:3] == [b"val_00", b"val_17", b"val_39"]
+    assert isinstance(out[3], KeyNotFound)
+
+    # The leader's combined responses must match an unbatched oracle
+    # pair over the same two databases.
+    oracle_h = CuckooHashingSparseDpfPirServer.create_helper(
+        params, db_h, encrypt_decrypt.decrypt
+    )
+
+    def sender(helper_request, while_waiting):
+        while_waiting()
+        return oracle_h.handle_request(helper_request)
+
+    oracle_l = CuckooHashingSparseDpfPirServer.create_leader(
+        params, db_l, sender
+    )
+    request, state = client.create_request(QUERIES)
+    got = leader.handle_request(request)
+    want = oracle_l.handle_request(request)
+    assert (
+        got.dpf_pir_response.masked_response
+        == want.dpf_pir_response.masked_response
+    )
+    assert client.resolve(want, state)[:3] == out[:3]
+
+
+def test_write_batch_lands_as_delta_rotation_under_traffic():
+    """Upsert build_from + SnapshotManager stage/flip while lookups
+    hammer the session: prestage must be a delta (`bytes_saved > 0`),
+    no query may ever see a torn generation, and post-flip lookups
+    serve the new values."""
+    params, db = build_sparse()
+    session = SparsePlainSession(params, db, make_config())
+    client = make_sparse_client(session)
+    manager = SnapshotManager(session)
+
+    # Warm the serving path first: the base generation's device
+    # stagings must be resident for the rotation to prestage as a
+    # delta (and the cold jit compile stays out of the timed window).
+    warm = sparse_lookup_plain(session, client, [b"key_05"])
+    assert warm[0] == b"val_05"
+
+    stop = threading.Event()
+    failures = []
+
+    def traffic():
+        while not stop.is_set():
+            # A two-share plain lookup is two requests; pin the manager
+            # so the flip cannot land between them (cross-generation
+            # XOR is garbage by construction — same contract the prober
+            # enforces for its golden pairs).
+            with manager.pin():
+                out = sparse_lookup_plain(
+                    session, client, [b"key_05", b"absent"]
+                )
+            # key_05 is untouched by the write batch: either generation
+            # serves val_05; absent stays typed-absent throughout.
+            if out[0] != b"val_05" or not isinstance(
+                out[1], KeyNotFound
+            ):
+                failures.append(out)
+                return
+            # Leave unpinned windows so the armed flip can land at a
+            # batch boundary (a zero-gap pin loop would starve it).
+            time.sleep(0.05)
+
+    threads = [threading.Thread(target=traffic) for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        builder = CuckooHashedDpfPirDatabase.Builder()
+        builder.insert((b"key_03", b"VAL_03"))  # rewrite
+        builder.insert((b"new_01", b"val_99"))  # insert
+        db1 = builder.build_from(db)
+        assert db1.generation == 1
+        assert db1.size == NUM_KEYS + 1
+        staged = manager.stage(db1)
+        assert staged > 0
+        stats = db1.last_prestage_stats
+        assert stats is not None and stats["mode"] == "delta"
+        assert stats["bytes_saved"] > 0
+        assert (
+            stats["bytes_staged"] + stats["bytes_saved"]
+            == stats["bytes_full_image"]
+        )
+        manager.flip(timeout=60.0)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not failures, failures[:1]
+    assert manager.serving_generation() == 1
+
+    out = sparse_lookup_plain(
+        session, client, [b"key_03", b"new_01", b"key_07", b"absent"]
+    )
+    assert out[0] == b"VAL_03"
+    assert out[1] == b"val_99"
+    assert out[2] == b"val_07"
+    assert isinstance(out[3], KeyNotFound)
+
+
+def test_mis_rotated_geometry_raises_snapshot_mismatch():
+    """A snapshot built under different cuckoo params (other seed =
+    other hash functions = other bucket mapping) must be rejected as
+    `SnapshotMismatch`, not served — clients hash against the serving
+    geometry, so silently swapping it in would answer garbage."""
+    params, db = build_sparse()
+    session = SparsePlainSession(params, db, make_config())
+    manager = SnapshotManager(session)
+
+    wrong_params = CuckooHashingSparseDpfPirServer.generate_params(
+        NUM_KEYS, seed=b"fedcba9876543210"
+    )
+    _, wrong_db = build_sparse(params=wrong_params, generation=1)
+    with pytest.raises(SnapshotMismatch):
+        manager.stage(wrong_db)
+
+    # A dense snapshot is just as unservable on a sparse session.
+    from distributed_point_functions_tpu.pir.database import (
+        DenseDpfPirDatabase,
+    )
+
+    dense_builder = DenseDpfPirDatabase.Builder()
+    for i in range(NUM_KEYS):
+        dense_builder.insert(b"rec_%02d" % i)
+    with pytest.raises(SnapshotMismatch):
+        manager.stage(dense_builder.build())
+
+    assert session.server.database is db  # still serving generation 0
+
+
+def test_mesh_sparse_session_matches_single_device():
+    """SparsePlainSession over a forced 8-device mesh answers byte-
+    identically to the single-device session (and to the unbatched
+    oracle) — the batcher seam must not disturb the sharded path."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = make_mesh(8)
+    params, db = build_sparse()
+    single = SparsePlainSession(params, db, make_config())
+    sharded = SparsePlainSession(params, db, make_config(), mesh=mesh)
+    client = make_sparse_client(single)
+
+    req0, req1 = client.create_plain_requests(QUERIES)
+    oracle = CuckooHashingSparseDpfPirServer.create_plain(params, db)
+    for request in (req0, req1):
+        a = single.handle_request(request)
+        b = sharded.handle_request(request)
+        want = oracle.handle_plain_request(request)
+        assert (
+            a.dpf_pir_response.masked_response
+            == b.dpf_pir_response.masked_response
+        )
+        assert (
+            b.dpf_pir_response.masked_response
+            == want.dpf_pir_response.masked_response
+        )
+
+    out = sparse_lookup_plain(sharded, client, QUERIES)
+    assert out[:3] == [b"val_00", b"val_17", b"val_39"]
+    assert isinstance(out[3], KeyNotFound)
+
+
+def test_sparse_prober_kinds_pass_and_follow_rotation():
+    """sparse_kv + sparse_absent probe kinds run clean against a live
+    session, goldens follow a delta rotation via bind_snapshots, and a
+    served wrong-generation would be caught (forced here by rotating
+    the goldens without rotating the database)."""
+    params, db = build_sparse()
+    session = SparsePlainSession(params, db, make_config())
+    prober = Prober(session, sparse_records=RECORDS, period_s=0.1)
+    assert prober.kinds() == ["sparse_kv", "sparse_absent"]
+
+    results = prober.run_cycle()
+    assert [r["status"] for r in results] == ["pass", "pass"]
+    fresh = prober.freshness()
+    assert all(v["identity"] for v in fresh.values())
+
+    # Rotate one golden's value (key_00 is the first sorted golden).
+    manager = SnapshotManager(session)
+    new_records = dict(RECORDS)
+    new_records[b"key_00"] = b"VAL_XX"
+    prober.bind_snapshots(manager, lambda gen: new_records)
+    builder = CuckooHashedDpfPirDatabase.Builder()
+    builder.insert((b"key_00", b"VAL_XX"))
+    manager.stage(builder.build_from(db))
+    manager.flip(timeout=60.0)
+
+    results = prober.run_cycle()
+    assert [r["status"] for r in results] == ["pass", "pass"]
+    export = prober.export()
+    assert export["mismatches"] == 0 and export["errors"] == 0
+    assert export["generation"] == 1
+
+    # Desync the oracle on purpose: the kv probe must catch it.
+    prober.rotate_sparse_goldens({b"key_00": b"val_ZZ"})
+    bad = [r for r in prober.run_cycle() if r["kind"] == "sparse_kv"]
+    assert bad[0]["status"] == "mismatch"
+
+
+def test_sparse_session_admission_prices_sparse_workload():
+    """The session installs the sparse pricer: admission sees two
+    dense inner products per key and the cost ledger joins batches
+    under the "sparse" workload."""
+    params, db = build_sparse()
+    session = SparsePlainSession(
+        params, db, make_config(admission_enabled=True)
+    )
+    assert session.admission is not None
+    pricer = session.admission._pricer
+    assert pricer is not None
+    cost = pricer(4)
+    assert cost.unit == "sparse_keys"
+    # Uncorrected ratio on a fresh model (the process-wide default
+    # model may already carry observed-cost corrections from earlier
+    # traffic in this test run — that feedback is the point of the
+    # per-workload ledger, so don't assert through it).
+    from distributed_point_functions_tpu.capacity.model import (
+        CapacityModel,
+    )
+
+    model = CapacityModel()
+    sparse = model.price_sparse_pir_keys(
+        4, num_blocks=db.num_selection_blocks
+    )
+    dense = model.price_pir_keys(4, num_blocks=db.num_selection_blocks)
+    assert sparse.device_ms == pytest.approx(2.0 * dense.device_ms)
+    assert sparse.bytes_peak == dense.bytes_peak
+
+    client = make_sparse_client(session)
+    out = sparse_lookup_plain(session, client, [b"key_01", b"absent"])
+    assert out[0] == b"val_01"
+    assert isinstance(out[1], KeyNotFound)
