@@ -33,6 +33,10 @@ test-fast:     ## ~8 min hermetic signal incl. core invariants + tiny Pallas
 	    tests/test_capacity.py tests/test_overload.py \
 	    tests/test_heavy_hitters.py tests/test_incremental_reuse.py \
 	    tests/test_mesh_serving.py \
+	    tests/test_fleet.py tests/test_fleet_rotation.py \
+	    tests/test_fleet_consistency.py \
+	    tests/test_single_device_donation.py \
+	    tests/test_sparse_degraded.py \
 	    tests/test_pallas_fast.py tests/test_bench_ladder.py -q
 
 protos:        ## regenerate *_pb2.py from protos/*.proto

@@ -1,0 +1,371 @@
+"""Fleet benchmark: front-door throughput and fleet-wide rotation
+staleness over an in-process N-replica fleet.
+
+Builds N two-party Leader/Helper replicas (each side with its own
+`SnapshotManager`), registers them in a `ReplicaSet`, and drives
+closed-loop tenants through the `FleetRouter` front door — each
+tenant sticks to one replica and checks every reconstruction
+bit-for-bit against the oracle of *some single* generation
+(generations differ at every byte, so a torn XOR matches nothing; a
+torn pair inside a replica is refused as `SnapshotMismatch`, never
+answered). Mid-run the `FleetRotationCoordinator` rotates the whole
+fleet through quorum several times. Two headline numbers:
+
+- ``fleet_qps_3rep`` — steady-state completed reconstructions/second
+  through the front door (direction: higher).
+- ``fleet_rotation_staleness_ms`` — the worst per-replica
+  helper-first/leader-last flip window across all fleet rotations
+  (direction: lower).
+
+Run directly (JSON report on stdout, also written to
+``benchmarks/results/fleet_bench.json``; appends both records to the
+regression-gate history)::
+
+    JAX_PLATFORMS=cpu python -m benchmarks.fleet_bench
+
+Environment knobs: FLEET_BENCH_RECORDS (default 256),
+FLEET_BENCH_RECORD_BYTES (32), FLEET_BENCH_REPLICAS (3),
+FLEET_BENCH_THREADS (4), FLEET_BENCH_ROTATIONS (2),
+FLEET_BENCH_BASELINE_S (1.5), FLEET_BENCH_SETTLE_S (0.5),
+FLEET_BENCH_OUT (report path; empty string disables the file).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+
+def _log(msg: str) -> None:
+    print(f"[fleet-bench {time.strftime('%H:%M:%S')}] {msg}",
+          file=sys.stderr, flush=True)
+
+
+# Per-generation XOR masks: any two generations differ at every byte.
+_GEN_MASKS = [0x00, 0xA5, 0x3C, 0x5A, 0xC3, 0x69, 0x96, 0x0F, 0xF0]
+
+
+def _records_for_generation(base, gen):
+    mask = _GEN_MASKS[gen % len(_GEN_MASKS)]
+    if mask == 0:
+        return list(base)
+    return [bytes(b ^ mask for b in r) for r in base]
+
+
+def run_fleet_bench():
+    import numpy as np
+
+    from distributed_point_functions_tpu.fleet import (
+        FleetRotationCoordinator,
+        FleetRouter,
+        Replica,
+        ReplicaSet,
+    )
+    from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
+    from distributed_point_functions_tpu.pir.database import (
+        DenseDpfPirDatabase,
+    )
+    from distributed_point_functions_tpu.serving import (
+        HelperSession,
+        InProcessTransport,
+        LeaderSession,
+        ServingConfig,
+        SnapshotManager,
+        SnapshotMismatch,
+    )
+    from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+    num_records = int(os.environ.get("FLEET_BENCH_RECORDS", 256))
+    record_bytes = int(os.environ.get("FLEET_BENCH_RECORD_BYTES", 32))
+    num_replicas = int(os.environ.get("FLEET_BENCH_REPLICAS", 3))
+    num_threads = int(os.environ.get("FLEET_BENCH_THREADS", 4))
+    num_rotations = int(os.environ.get("FLEET_BENCH_ROTATIONS", 2))
+    baseline_s = float(os.environ.get("FLEET_BENCH_BASELINE_S", 1.5))
+    settle_s = float(os.environ.get("FLEET_BENCH_SETTLE_S", 0.5))
+
+    _log(
+        f"fleet: {num_replicas} replicas x ({num_records} x "
+        f"{record_bytes}B), {num_threads} closed-loop tenants, "
+        f"{num_rotations} quorum rotations"
+    )
+
+    rng = np.random.default_rng(21)
+    base_records = [
+        bytes(rng.integers(0, 256, record_bytes, dtype=np.uint8))
+        for _ in range(num_records)
+    ]
+    oracles = {0: _records_for_generation(base_records, 0)}
+
+    def build_full(records):
+        builder = DenseDpfPirDatabase.Builder()
+        for r in records:
+            builder.insert(r)
+        return builder.build()
+
+    config = ServingConfig(max_batch_size=8, max_wait_ms=2.0)
+    replica_set = ReplicaSet()
+    replicas = []
+    for i in range(num_replicas):
+        helper = HelperSession(
+            build_full(oracles[0]), encrypt_decrypt.decrypt, config
+        )
+        leader = LeaderSession(
+            build_full(oracles[0]),
+            InProcessTransport(helper.handle_wire),
+            config,
+        )
+        replica = Replica(
+            f"r{i}",
+            leader,
+            helper,
+            leader_snapshots=SnapshotManager(leader),
+            helper_snapshots=SnapshotManager(helper),
+        )
+        replicas.append(replica_set.add(replica))
+    router = FleetRouter(replica_set)
+    coordinator = FleetRotationCoordinator(replica_set)
+
+    client = DenseDpfPirClient.create(num_records, encrypt_decrypt.encrypt)
+    probe_indices = [int(i) for i in rng.integers(0, num_records, 16)]
+
+    # Warm every jit bucket (batch sizes 1..max) up front: the jit
+    # cache is keyed by shape, so one throwaway server warms the whole
+    # fleet. A cold compile mid-window would zero the baseline or hold
+    # a pin past the flip timeout.
+    from distributed_point_functions_tpu.pir import messages
+    from distributed_point_functions_tpu.pir.server import DenseDpfPirServer
+
+    _log("warming jit buckets")
+    t0 = time.perf_counter()
+    warm_server = DenseDpfPirServer.create_plain(build_full(oracles[0]))
+    warm_client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
+    warm_keys = list(
+        warm_client.create_plain_requests([0])[0].plain_request.dpf_keys
+    )
+    b = 1
+    while b <= 8:
+        warm_server.handle_plain_request(
+            messages.PirRequest(
+                plain_request=messages.PlainRequest(dpf_keys=warm_keys * b)
+            )
+        )
+        b *= 2
+    warm_request, warm_state = client.create_request([0])
+    for r in replicas:
+        client.handle_response(
+            r.leader.handle_request(warm_request), warm_state
+        )
+    _log(f"warmup done in {time.perf_counter() - t0:.1f}s")
+
+    lock = threading.Lock()
+    stats = {
+        "completed": 0, "torn": 0, "sheds": 0, "refusals": 0,
+        "other_errors": 0,
+    }
+    completion_times = []
+    stop = threading.Event()
+
+    def worker(tid):
+        from distributed_point_functions_tpu.serving.batcher import (
+            Overloaded,
+        )
+
+        tenant = f"tenant-{tid}"
+        i = tid
+        while not stop.is_set():
+            idx = probe_indices[i % len(probe_indices)]
+            i += num_threads
+            try:
+                # Front door picks the replica (sticky per tenant); the
+                # Leader pairs with its own Helper at ONE generation —
+                # a torn pair is refused as `SnapshotMismatch`, never
+                # answered.
+                replica = router.pick(tenant)
+                request, state = client.create_request([idx])
+                response = replica.leader.handle_request(request)
+                got = client.handle_response(response, state)[0]
+                now = time.monotonic()
+                with lock:
+                    ok = any(
+                        got == recs[idx] for recs in oracles.values()
+                    )
+                    stats["completed"] += 1
+                    if not ok:
+                        stats["torn"] += 1
+                    completion_times.append(now)
+            except Overloaded:
+                with lock:
+                    stats["sheds"] += 1
+                time.sleep(0.005)
+            except SnapshotMismatch:
+                # Typed refusal that out-lasted the leader's own retry
+                # budget: counted, re-issued by the closed loop.
+                with lock:
+                    stats["refusals"] += 1
+            except Exception:  # noqa: BLE001 - counted, bench continues
+                with lock:
+                    stats["other_errors"] += 1
+
+    threads = [
+        threading.Thread(target=worker, args=(t,), name=f"tenant-{t}")
+        for t in range(num_threads)
+    ]
+    for t in threads:
+        t.start()
+
+    t_base0 = time.monotonic()
+    time.sleep(baseline_s)
+    t_base1 = time.monotonic()
+
+    rotations = []
+    try:
+        for _ in range(num_rotations):
+            next_gen = replicas[0].serving_generation() + 1
+            next_records = _records_for_generation(base_records, next_gen)
+            with lock:
+                oracles[next_gen] = next_records
+
+            def next_dbs(replica):
+                def delta_from(db):
+                    builder = DenseDpfPirDatabase.Builder()
+                    for i, r in enumerate(next_records):
+                        builder.update(i, r)
+                    return builder.build_from(db)
+
+                return (
+                    delta_from(replica.leader.server.database),
+                    delta_from(replica.helper.server.database),
+                )
+
+            t_rot0 = time.monotonic()
+            report = coordinator.rotate(next_dbs)
+            t_rot1 = time.monotonic()
+            rotations.append({
+                "to_generation": report["to_generation"],
+                "staleness_ms": report["staleness_ms"],
+                "laggards": report["laggards"],
+                "rotate_wall_ms": round((t_rot1 - t_rot0) * 1e3, 3),
+            })
+            _log(
+                f"fleet rotation -> generation {report['to_generation']}"
+                f": worst staleness {report['staleness_ms']:.2f} ms, "
+                f"wall {(t_rot1 - t_rot0) * 1e3:.2f} ms, laggards "
+                f"{report['laggards'] or 'none'}"
+            )
+            with lock:
+                for g in list(oracles):
+                    if g < next_gen - 1:
+                        del oracles[g]
+            time.sleep(settle_s)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+
+    def qps_in(t0, t1):
+        with lock:
+            n = sum(1 for t in completion_times if t0 <= t < t1)
+        return n / max(t1 - t0, 1e-9)
+
+    baseline_qps = qps_in(t_base0, t_base1)
+    worst_staleness = max(
+        (r["staleness_ms"] for r in rotations), default=0.0
+    )
+    correctness_ok = (
+        stats["torn"] == 0 and stats["other_errors"] == 0
+        and len(rotations) == num_rotations
+        and all(not r["laggards"] for r in rotations)
+    )
+    report = {
+        "config": {
+            "num_records": num_records,
+            "record_bytes": record_bytes,
+            "replicas": num_replicas,
+            "threads": num_threads,
+            "rotations": num_rotations,
+            "baseline_s": baseline_s,
+        },
+        "fleet_qps": round(baseline_qps, 2),
+        "rotations": rotations,
+        "fleet_rotation_staleness_ms": round(worst_staleness, 3),
+        "traffic": dict(stats),
+        "correctness_ok": correctness_ok,
+        "router": router.export(),
+        "fleet": replica_set.export(),
+        "rotation_coordinator": coordinator.export(),
+    }
+    _log(
+        f"front door {baseline_qps:.1f} q/s across {num_replicas} "
+        f"replicas; worst rotation staleness {worst_staleness:.2f} ms; "
+        f"{stats['completed']} completed, {stats['sheds']} sheds, "
+        f"{stats['refusals']} refusals, {stats['torn']} torn, "
+        f"correctness {'ok' if correctness_ok else 'FAILED'}"
+    )
+
+    out = os.environ.get(
+        "FLEET_BENCH_OUT", "benchmarks/results/fleet_bench.json"
+    )
+    if out:
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2)
+        _log(f"report written to {out}")
+
+    for r in replicas:
+        r.leader.close()
+        if r.helper is not None:
+            r.helper.close()
+    return report
+
+
+def _append_history_records(report):
+    """Two records for the regression gate: front-door throughput
+    (higher) and fleet rotation staleness (lower). Best-effort like
+    every history append."""
+    try:
+        from benchmarks.regression_gate import append_record, git_rev
+
+        path = os.environ.get(
+            "BENCH_HISTORY_PATH", "benchmarks/results/history.jsonl"
+        )
+        status = "ok" if report["correctness_ok"] else "error"
+        rev = git_rev()
+        device = os.environ.get("BENCH_PLATFORM", "cpu")
+        append_record({
+            "metric": "fleet_qps_3rep",
+            "value": report["fleet_qps"],
+            "unit": "queries_per_sec",
+            "direction": "higher",
+            "vs_baseline": None,
+            "status": status,
+            "git_rev": rev,
+            "device": device,
+        }, path=path)
+        append_record({
+            "metric": "fleet_rotation_staleness_ms",
+            "value": report["fleet_rotation_staleness_ms"],
+            "unit": "ms",
+            "direction": "lower",
+            "vs_baseline": None,
+            "status": status,
+            "git_rev": rev,
+            "device": device,
+        }, path=path)
+    except Exception as e:  # noqa: BLE001 - history must not break a bench
+        _log(f"history append failed (non-fatal): {e}")
+
+
+def main():
+    report = run_fleet_bench()
+    if os.environ.get("BENCH_HISTORY", "1") != "0":
+        _append_history_records(report)
+    print(json.dumps(report, indent=2))
+    if not report["correctness_ok"]:
+        raise SystemExit("fleet bench FAILED correctness")
+
+
+if __name__ == "__main__":
+    main()

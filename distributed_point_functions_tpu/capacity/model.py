@@ -359,6 +359,41 @@ class CapacityModel:
         # binds a 2-D mesh so admission and brownout price per-shard
         # work and per-mesh throughput without any of their own changes.
         self._mesh_shape: Optional[tuple] = None
+        # Stable replica identity stamped onto price exports so a fleet
+        # front door can read many models side by side (fleet/router.py
+        # spreads tenants by these prices). None outside a fleet.
+        self._replica: Optional[str] = None
+
+    # -- replica identity ----------------------------------------------------
+
+    def set_replica(self, replica_id: Optional[str]) -> None:
+        """Label this model's exports with a fleet replica id (None
+        clears it)."""
+        self._replica = str(replica_id) if replica_id is not None else None
+
+    @property
+    def replica_id(self) -> Optional[str]:
+        return self._replica
+
+    def price_export(
+        self, num_keys: int = 8, num_blocks: Optional[int] = None
+    ) -> dict:
+        """The per-replica price card the fleet front door routes by:
+        one `price_pir_keys` probe at a small fixed batch, normalized
+        per key, plus the calibrated throughput it derives from. Cheap
+        enough to call per routing decision (no device work — pure
+        calibration arithmetic)."""
+        cost = self.price_pir_keys(num_keys, num_blocks)
+        return {
+            "replica": self._replica,
+            "probe_keys": int(num_keys),
+            "device_ms": round(cost.device_ms, 4),
+            "device_ms_per_key": round(
+                cost.device_ms / max(1, num_keys), 5
+            ),
+            "bytes_peak": cost.bytes_peak,
+            "queries_per_sec": round(self.serving_queries_per_sec(), 2),
+        }
 
     # -- serving mesh --------------------------------------------------------
 
@@ -697,6 +732,7 @@ class CapacityModel:
     def export(self) -> dict:
         """The /statusz view of the model."""
         out = {
+            "replica": self._replica,
             "device_memory_bytes": self._device_memory,
             "selection_budget_bytes": self.selection_budget_bytes(),
             "frontier_budget_bytes": self.frontier_budget_bytes(),

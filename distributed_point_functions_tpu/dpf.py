@@ -225,6 +225,18 @@ class BatchCutState:
         return pos_clipped.astype(np.int64)
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _gather_cut_lanes_donated(seeds, control, pos):
+    """Resume gather with the previous level's cut buffers donated: the
+    caller asserts this is the LAST read of that `BatchCutState`, so
+    XLA may reuse its HBM for the new frontier instead of holding both
+    levels' cut tensors live (the cut-state cache is the largest
+    retained buffer of a heavy-hitters sweep). On backends without
+    donation support this is a plain gather (warning filtered in
+    `pir.dense_eval`)."""
+    return jnp.take(seeds, pos, axis=1), jnp.take(control, pos, axis=1)
+
+
 def _build_fused_accumulate(plan, vt, blocks_needed):
     """One jitted program: multi-level walk + per-level value extraction
     + masked accumulation (the fused engine behind
@@ -1933,6 +1945,8 @@ class DistributedPointFunction:
         hierarchy_level: int,
         prefixes: Sequence[int],
         cuts: Optional[BatchCutState] = None,
+        *,
+        donate_cuts: bool = False,
     ):
         """Evaluate EVERY staged key at EVERY prefix of one hierarchy
         level, resuming from cached cut states — the batched per-level
@@ -1957,6 +1971,13 @@ class DistributedPointFunction:
         `[num_keys, len(prefixes)]` (party negation applied per key),
         and `new_cuts` the `BatchCutState` at `hierarchy_level` for the
         next level's resume.
+
+        `donate_cuts=True` asserts this call is the LAST read of
+        `cuts`: its seed/control device buffers are donated into the
+        resume gather so the new frontier can reuse their HBM. The
+        caller must discard `cuts` afterwards (on TPU its buffers are
+        deleted); opt-in because a chunked level resumes from one cut
+        state several times.
         """
         num_keys = staged.n
         num_prefixes = len(prefixes)
@@ -2013,8 +2034,13 @@ class DistributedPointFunction:
             pos_np = np.zeros((p_pad,), dtype=np.int64)
             pos_np[:num_prefixes] = cuts.positions(parents)
             pos = jnp.asarray(pos_np)
-            seeds = jnp.take(cuts.seeds, pos, axis=1)
-            control = jnp.take(cuts.control, pos, axis=1)
+            if donate_cuts:
+                seeds, control = _gather_cut_lanes_donated(
+                    cuts.seeds, cuts.control, pos
+                )
+            else:
+                seeds = jnp.take(cuts.seeds, pos, axis=1)
+                control = jnp.take(cuts.control, pos, axis=1)
 
         n_lanes = num_keys * p_pad
         seeds = seeds.reshape(n_lanes, 4)

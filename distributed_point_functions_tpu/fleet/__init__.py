@@ -1,0 +1,24 @@
+"""Replica fleet serving: registry, price-aware routing, quorum rotation.
+
+The top layer of the serving stack: N Leader/Helper pairs composed
+into one operable fleet. `registry` tracks replica health states fed
+by breaker transitions and probe freshness, `router` is the sticky
+price-aware front door with same-generation spillover, and `rotation`
+extends the per-pair snapshot handshake to a quorum-gated fleet-wide
+flip. Cross-replica bit-identity is proven by
+`serving.prober.CrossReplicaProbe` (which stays in serving/ so the
+layering keeps fleet -> serving one-way).
+"""
+
+from .registry import REPLICA_STATES, Replica, ReplicaSet
+from .rotation import FleetRotationCoordinator, QuorumFailed
+from .router import FleetRouter
+
+__all__ = [
+    "REPLICA_STATES",
+    "Replica",
+    "ReplicaSet",
+    "FleetRouter",
+    "FleetRotationCoordinator",
+    "QuorumFailed",
+]

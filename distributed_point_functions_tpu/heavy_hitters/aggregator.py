@@ -38,6 +38,7 @@ from ..capacity.model import default_capacity_model
 from ..dpf import BatchCutState, DistributedPointFunction
 from ..observability import costmodel as costmodel_mod
 from ..observability.device import default_telemetry, shape_key
+from ..pir.dense_eval import donation_enabled
 from ..value_types import IntType
 
 
@@ -227,6 +228,15 @@ class LevelAggregator:
                     hierarchy_level,
                     chunk,
                     cuts=cuts if resume else None,
+                    # The level's last chunk is the final read of the
+                    # previous level's cut state (`merged` replaces it
+                    # below), so its buffers are donated to the resume
+                    # gather — ROADMAP 3c, the BatchCutState half.
+                    donate_cuts=(
+                        resume
+                        and c == plan.num_chunks - 1
+                        and donation_enabled()
+                    ),
                 )
             shares.append(np.asarray(self._sum_over_keys(values)))
             cut_parts.append(cut)

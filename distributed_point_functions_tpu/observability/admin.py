@@ -1,7 +1,7 @@
 """Operator telemetry endpoint: /metrics, /varz, /healthz, /statusz,
 /tracez, /profilez, /eventz, /probez, /debugz, /criticalz, /capacityz,
-/utilz, /timeseriesz — a stdlib `http.server` surface any session can
-hang off a port.
+/utilz, /timeseriesz, /fleetz — a stdlib `http.server` surface any
+session can hang off a port.
 
 The serving runtime's observability state (metrics registry, flight
 recorder, stage aggregates, runtime counters, device telemetry, SLO
@@ -59,6 +59,11 @@ this server is the scrape surface:
                              sparkline per sampled series (text;
                              `?format=json` dumps every tier's points;
                              requires a `timeseries` store/sampler)
+    /fleetz                  replica-fleet registry view: per-replica
+                             health state, serving/staging generation,
+                             queue depth and live price card, plus
+                             state counts and the transition history
+                             (JSON; requires a `fleet` export)
     /profilez?duration_ms=N  on-demand xprof capture via
                              `utils/profiling.trace` into a fresh
                              directory; returns the trace dir (bounded
@@ -139,6 +144,8 @@ class AdminServer:
         mesh=None,
         utilization=None,
         timeseries=None,
+        fleet=None,
+        identity=None,
     ):
         self._registry = registry
         self._recorder = (
@@ -215,6 +222,15 @@ class AdminServer:
             else default_utilization_tracker()
         )
         self._timeseries = timeseries
+        # fleet is the replica-fleet registry view: a zero-arg callable
+        # or anything with `export() -> dict` (a `fleet.ReplicaSet` —
+        # duck-typed because fleet/ sits ABOVE this layer). identity is
+        # a static {"replica_id", "role", ...} dict stamped onto /varz
+        # and /statusz so every scrape of a fleet member says which
+        # replica (and which serving generation, read live from
+        # `snapshots`) produced it.
+        self._fleet = fleet
+        self._identity = dict(identity) if identity else None
         self._name = name
         self._profile_dir = profile_dir
         self._profile_lock = threading.Lock()
@@ -242,6 +258,8 @@ class AdminServer:
             )
             if timeseries is not None:
                 bundles.add_source("timeseries", self._timeseries_state)
+            if fleet is not None:
+                bundles.add_source("fleet", self._fleet_state)
         # The dispatch table IS the endpoint index: `_route` looks
         # paths up here and the 404 body is generated from the same
         # rows, so the "try ..." list can never go stale (asserted in
@@ -259,6 +277,7 @@ class AdminServer:
             ("/capacityz", self._capacityz),
             ("/utilz", self._utilz),
             ("/timeseriesz", self._timeseriesz),
+            ("/fleetz", self._fleetz),
             ("/profilez", self._profilez),
         )
         self._route_map = dict(self._routes)
@@ -331,6 +350,25 @@ class AdminServer:
         source = getattr(self._mesh, "export", self._mesh)
         return source() if callable(source) else None
 
+    def _fleet_state(self) -> Optional[dict]:
+        if self._fleet is None:
+            return None
+        source = getattr(self._fleet, "export", self._fleet)
+        return source() if callable(source) else None
+
+    def _identity_state(self) -> Optional[dict]:
+        """The stable replica identity plus the LIVE serving generation
+        (identity says who this scrape came from; the generation says
+        which database it was answering with at scrape time)."""
+        if self._identity is None:
+            return None
+        state = dict(self._identity)
+        if self._snapshots is not None:
+            state["serving_generation"] = (
+                self._snapshots.serving_generation()
+            )
+        return state
+
     @property
     def routes(self) -> tuple:
         """The dispatched endpoint paths, in index order (the same
@@ -364,6 +402,7 @@ class AdminServer:
                 "name": self._name,
                 "uptime_s": self._uptime_s(),
                 "started_at": self._started_unix,
+                "identity": self._identity_state(),
                 "metrics": self._merged_export(),
                 "stages": tracing.stage_summary(),
             },
@@ -688,6 +727,7 @@ class AdminServer:
             "name": self._name,
             "uptime_s": self._uptime_s(),
             "started_at": self._started_unix,
+            "identity": self._identity_state(),
             "device": self._device.export(),
             "slo": self._slo.export() if self._slo is not None else None,
             "phases": self._phases.waterfall(),
@@ -886,6 +926,17 @@ class AdminServer:
             handler, 200, "text/plain; charset=utf-8", body.encode()
         )
 
+    def _fleetz(self, handler, query: str = "") -> None:
+        state = self._fleet_state()
+        if state is None:
+            self._reply(
+                handler, 404, "text/plain; charset=utf-8",
+                b"no fleet attached\n",
+            )
+            return
+        body = json.dumps(state, indent=2, default=str).encode()
+        self._reply(handler, 200, "application/json", body)
+
     def _profilez(self, handler, query: str) -> None:
         params = urllib.parse.parse_qs(query)
         try:
@@ -999,6 +1050,16 @@ def _render_statusz(state: dict) -> str:
         f"<h1>{esc(str(state['name']))} /statusz</h1>",
         f"<p>uptime: {state['uptime_s']} s</p>",
     ]
+    identity = state.get("identity")
+    if identity is not None:
+        out.append(
+            "<p>"
+            + " &middot; ".join(
+                f"{esc(str(k))}: <b>{esc(str(v))}</b>"
+                for k, v in identity.items()
+            )
+            + "</p>"
+        )
 
     slo = state.get("slo")
     out.append("<h2>SLO burn</h2>")

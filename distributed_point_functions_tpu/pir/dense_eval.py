@@ -24,6 +24,7 @@ correction-word mode of `evaluate_prg_hwy.h:58-65`.
 from __future__ import annotations
 
 import functools
+import os
 import subprocess
 import warnings
 from typing import Sequence
@@ -45,10 +46,23 @@ _CLEAR_LSB = np.array(
 )
 
 
-@functools.partial(
-    jax.jit, static_argnames=("walk_levels", "expand_levels", "num_blocks")
+def donation_enabled() -> bool:
+    """Whether single-device serving donates its per-request staged key
+    tensors into the jit (`DPF_TPU_DONATE`, default on — the same knob
+    and default as the mesh plan's scratch donation). Read at call time
+    so tests can flip the env per arm."""
+    return os.environ.get("DPF_TPU_DONATE", "1") != "0"
+
+
+# On backends without donation support (CPU) every donated dispatch
+# warns; the control arm is the env knob, not the warning stream. Same
+# filter the mesh plan installs.
+warnings.filterwarnings(
+    "ignore", message=".*donated buffers were not usable.*"
 )
-def evaluate_selection_blocks(
+
+
+def _evaluate_selection_blocks(
     seeds0: jnp.ndarray,
     control0: jnp.ndarray,
     cw_seeds: jnp.ndarray,
@@ -86,6 +100,24 @@ def evaluate_selection_blocks(
             sel, ((0, 0), (0, num_blocks - sel.shape[1]), (0, 0))
         )
     return sel
+
+
+evaluate_selection_blocks = functools.partial(
+    jax.jit, static_argnames=("walk_levels", "expand_levels", "num_blocks")
+)(_evaluate_selection_blocks)
+
+# Donating twin for the serving hot path: the six staged key tensors
+# are freshly placed per batch (`stage_keys`) and dead after this call,
+# so XLA may reuse their HBM for the selection matrix instead of
+# holding both live. Deliberately a separate entry — the differential
+# tests feed ONE staging to several implementations, which donation
+# would invalidate — so only `DenseDpfPirServer` (via
+# `donation_enabled()`) dispatches here.
+evaluate_selection_blocks_donated = jax.jit(
+    _evaluate_selection_blocks,
+    static_argnames=("walk_levels", "expand_levels", "num_blocks"),
+    donate_argnums=(0, 1, 2, 3, 4, 5),
+)
 
 
 def _walk_zeros(seeds, control, cw_seeds_w, cw_left_w):
@@ -389,13 +421,7 @@ def stage_keys(keys: Sequence[DpfKey], host_walk_levels: int = 0):
     return tuple(out)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "walk_levels", "chunk_bits", "chunk_expand_levels", "num_chunks"
-    ),
-)
-def chunked_pir_inner_products(
+def _chunked_pir_inner_products(
     seeds0: jnp.ndarray,
     control0: jnp.ndarray,
     cw_seeds: jnp.ndarray,
@@ -482,3 +508,23 @@ def chunked_pir_inner_products(
         (jnp.arange(num_chunks, dtype=jnp.uint32), db_chunks),
     )
     return acc
+
+
+_CHUNKED_STATIC = (
+    "walk_levels", "chunk_bits", "chunk_expand_levels", "num_chunks"
+)
+
+chunked_pir_inner_products = functools.partial(
+    jax.jit, static_argnames=_CHUNKED_STATIC
+)(_chunked_pir_inner_products)
+
+# Donating twin: the six per-request staged key tensors (args 0-5) are
+# dead after the scan; `db_words` (arg 6) is the resident chunked
+# database buffer and must NEVER be donated — a consumed database
+# would force a full re-staging on the next request, which the
+# TransferLedger test pins at zero.
+chunked_pir_inner_products_donated = jax.jit(
+    _chunked_pir_inner_products,
+    static_argnames=_CHUNKED_STATIC,
+    donate_argnums=(0, 1, 2, 3, 4, 5),
+)

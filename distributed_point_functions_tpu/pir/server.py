@@ -39,6 +39,9 @@ from ..value_types import XorType
 from . import messages
 from .database import DenseDpfPirDatabase, words_to_record_bytes
 from .dense_eval import (
+    donation_enabled,
+    evaluate_selection_blocks,
+    evaluate_selection_blocks_donated,
     serving_expansion,
     stage_keys,
     stage_keys_host,
@@ -444,6 +447,14 @@ class DenseDpfPirServer(DpfPirServer):
                     f"expected {expected_cw}"
                 )
         impl, bitrev = serving_expansion()
+        if impl is evaluate_selection_blocks and donation_enabled():
+            # ROADMAP 3c: the materialized single-device entry donates
+            # its per-request staged key tensors (freshly placed by
+            # `stage_keys_walked`, dead after the call) so XLA can
+            # reuse their HBM for the selection matrix. The resident
+            # database buffer is a different argument path entirely
+            # (`inner_product_with`) and is never donated.
+            impl = evaluate_selection_blocks_donated
         if bitrev and (1 << self._expand_levels) < self._num_blocks:
             # The tree cannot cover the padded block count (domain
             # smaller than the database): the bitrev staging has no
@@ -761,8 +772,16 @@ class DenseDpfPirServer(DpfPirServer):
         """
         import numpy as np
 
-        from .dense_eval import chunked_pir_inner_products
+        from .dense_eval import (
+            chunked_pir_inner_products,
+            chunked_pir_inner_products_donated,
+        )
 
+        kernel = (
+            chunked_pir_inner_products_donated
+            if donation_enabled()
+            else chunked_pir_inner_products
+        )
         padded_blocks, db = self._chunked_database()
         # The planner caps chunk_expand_levels by budget and granule;
         # the chunk count re-derives from the granule-padded block
@@ -772,7 +791,7 @@ class DenseDpfPirServer(DpfPirServer):
         num_chunks = padded_blocks >> cel
 
         out = np.asarray(
-            chunked_pir_inner_products(
+            kernel(
                 *staged,
                 db,
                 walk_levels=self._walk_levels,

@@ -1,0 +1,283 @@
+"""Fleet registry and price-aware router tests.
+
+The contracts under test: replica health states are *fed* (breaker
+transitions drain/restore, probe staleness drains, operator verbs
+shed/readmit/kill — every transition journaled and counted); the
+router keeps tenants sticky to one replica, places new tenants by
+price x queue-depth score, spills over ONLY within the primary's
+serving generation (a cross-generation XOR is well-formed garbage),
+and aggregates a fleet-wide shed into one typed `Overloaded` carrying
+the smallest positive retry hint.
+"""
+
+from types import SimpleNamespace
+
+import pytest
+
+from distributed_point_functions_tpu.fleet import (
+    REPLICA_STATES,
+    FleetRouter,
+    Replica,
+    ReplicaSet,
+)
+from distributed_point_functions_tpu.observability.events import EventJournal
+from distributed_point_functions_tpu.serving.batcher import Overloaded
+from distributed_point_functions_tpu.serving.metrics import MetricsRegistry
+
+
+class StubBreaker:
+    def __init__(self):
+        self.listeners = []
+
+    def on_transition(self, cb):
+        self.listeners.append(cb)
+
+    def force(self, old, new):
+        for cb in self.listeners:
+            cb(old, new)
+
+
+class StubCapacity:
+    """Duck-typed price model with a pinned per-probe device-ms."""
+
+    def __init__(self, device_ms):
+        self.device_ms = float(device_ms)
+        self.replica = None
+
+    def set_replica(self, rid):
+        self.replica = rid
+
+    def price_export(self, num_keys=8, num_blocks=None):
+        return {
+            "replica": self.replica,
+            "probe_keys": num_keys,
+            "device_ms": self.device_ms,
+            "device_ms_per_key": self.device_ms / max(1, num_keys),
+            "bytes_peak": 0,
+            "queries_per_sec": 100.0,
+        }
+
+
+class StubSession:
+    """Duck-typed leader session: answers, sheds, or counts calls."""
+
+    def __init__(self, name, generation=0, shed=None):
+        self.name = name
+        self.shed = shed  # None, or an Overloaded to raise
+        self.calls = []
+        self.breaker = StubBreaker()
+        self.degraded = False
+        self.metrics = MetricsRegistry()
+        self.server = SimpleNamespace(
+            database=SimpleNamespace(generation=generation), role="plain"
+        )
+
+    def handle_request(self, request, deadline=None, tenant="default"):
+        if self.shed is not None:
+            raise self.shed
+        self.calls.append((request, tenant))
+        return f"resp:{self.name}"
+
+
+def make_replica(rid, generation=0, device_ms=1.0, shed=None):
+    return Replica(
+        rid,
+        StubSession(rid, generation=generation, shed=shed),
+        capacity=StubCapacity(device_ms),
+    )
+
+
+def make_set(*replicas, journal=None):
+    rs = ReplicaSet(journal=journal or EventJournal())
+    for r in replicas:
+        rs.add(r)
+    return rs
+
+
+# ---------------------------------------------------------------------------
+# Registry: states, transitions, breaker feed, freshness
+# ---------------------------------------------------------------------------
+
+
+def test_states_transitions_and_export():
+    journal = EventJournal()
+    rs = make_set(
+        make_replica("r0"), make_replica("r1"), journal=journal
+    )
+    assert [r.replica_id for r in rs.healthy()] == ["r0", "r1"]
+    rs.shed("r0", reason="operator drill")
+    assert rs.state("r0") == "draining"
+    assert [r.replica_id for r in rs.healthy()] == ["r1"]
+    rs.readmit("r0")
+    rs.kill("r1", reason="hardware gone")
+    assert rs.state("r1") == "dead"
+    assert [r.replica_id for r in rs.alive()] == ["r0"]
+    export = rs.export()
+    assert export["counts"] == {
+        "serving": 1, "staging": 0, "draining": 0, "dead": 1
+    }
+    assert export["sheds"] == 1 and export["readmissions"] == 1
+    assert export["deaths"] == 1
+    transitions = [(t["from"], t["to"]) for t in export["history"]]
+    assert ("serving", "draining") in transitions
+    assert ("draining", "serving") in transitions
+    assert ("serving", "dead") in transitions
+    row = export["replicas"]["r0"]
+    assert row["state"] == "serving"
+    assert row["price"]["replica"] == "r0"  # capacity stamped at add
+    kinds = [e["kind"] for e in journal.export()["events"]]
+    assert "fleet.replica_state" in kinds
+
+
+def test_unknown_state_and_duplicate_id_rejected():
+    rs = make_set(make_replica("r0"))
+    with pytest.raises(ValueError, match="unknown replica state"):
+        rs.mark("r0", "zombie")
+    with pytest.raises(KeyError):
+        rs.mark("nope", "serving")
+    with pytest.raises(ValueError, match="already registered"):
+        rs.add(make_replica("r0"))
+    assert set(REPLICA_STATES) == {
+        "serving", "staging", "draining", "dead"
+    }
+
+
+def test_breaker_open_drains_and_close_restores():
+    r0 = make_replica("r0")
+    rs = make_set(r0, make_replica("r1"))
+    r0.leader.breaker.force("closed", "open")
+    assert rs.state("r0") == "draining"
+    assert [r.replica_id for r in rs.healthy()] == ["r1"]
+    r0.leader.breaker.force("open", "half-open")
+    assert rs.state("r0") == "draining"  # half-open is not healthy yet
+    r0.leader.breaker.force("half-open", "closed")
+    assert rs.state("r0") == "serving"
+
+
+def test_breaker_close_does_not_override_operator_drain():
+    r0 = make_replica("r0")
+    rs = make_set(r0)
+    rs.shed("r0", reason="operator drill")
+    # A breaker closing must not readmit a replica an operator drained.
+    r0.leader.breaker.force("half-open", "closed")
+    assert rs.state("r0") == "draining"
+
+
+def test_probe_staleness_refresh_drains_and_restores():
+    r0 = make_replica("r0")
+    fresh = {"pir_unbatched": {"identity": True, "fresh": True}}
+    stale = {"pir_unbatched": {"identity": True, "fresh": False}}
+    state = {"freshness": fresh}
+    r0.prober = SimpleNamespace(freshness=lambda: state["freshness"])
+    rs = make_set(r0)
+    assert rs.refresh()["r0"] == "serving"
+    state["freshness"] = stale
+    assert rs.refresh()["r0"] == "draining"
+    assert rs.healthy() == []
+    state["freshness"] = fresh
+    assert rs.refresh()["r0"] == "serving"
+
+
+def test_listener_fires_on_transition():
+    seen = []
+    rs = make_set(make_replica("r0"))
+    rs.add_listener(lambda rid, old, new, why: seen.append((rid, old, new)))
+    rs.shed("r0")
+    assert seen == [("r0", "serving", "draining")]
+
+
+# ---------------------------------------------------------------------------
+# Router: sticky affinity, price scoring, spillover, typed aggregation
+# ---------------------------------------------------------------------------
+
+
+def test_new_tenant_lands_on_cheapest_idle_replica():
+    expensive = make_replica("costly", device_ms=9.0)
+    cheap = make_replica("cheap", device_ms=1.0)
+    router = FleetRouter(make_set(expensive, cheap))
+    assert router.pick("t1").replica_id == "cheap"
+    # Sticky: the pin survives a price flip.
+    expensive.capacity.device_ms = 0.01
+    assert router.pick("t1").replica_id == "cheap"
+    assert router.affinity("t1") == "cheap"
+
+
+def test_queue_depth_penalizes_cheap_but_backlogged_replica():
+    cheap = make_replica("cheap", device_ms=1.0)
+    pricier = make_replica("pricier", device_ms=2.0)
+    # Cheap replica has a deep admission queue: 1.0 * (1+9) > 2.0 * 1.
+    cheap.leader.metrics.gauge("plain.batcher.queue_depth").set(9)
+    router = FleetRouter(make_set(cheap, pricier))
+    assert router.pick("t1").replica_id == "pricier"
+
+
+def test_affinity_moves_when_pinned_replica_drains():
+    a = make_replica("a", device_ms=1.0)
+    b = make_replica("b", device_ms=2.0)
+    rs = make_set(a, b)
+    router = FleetRouter(rs)
+    assert router.pick("t1").replica_id == "a"
+    rs.shed("a")
+    assert router.pick("t1").replica_id == "b"
+    assert router.affinity("t1") == "b"
+    assert router.export()["affinity_moves"] == 1
+
+
+def test_requests_route_to_affine_replica():
+    a = make_replica("a", device_ms=1.0)
+    b = make_replica("b", device_ms=2.0)
+    router = FleetRouter(make_set(a, b))
+    assert router.handle_request("q1", tenant="t1") == "resp:a"
+    assert router.handle_request("q2", tenant="t1") == "resp:a"
+    assert a.leader.calls == [("q1", "t1"), ("q2", "t1")]
+    assert b.leader.calls == []
+    assert router.export()["routed"] == {"a": 2}
+
+
+def test_spillover_on_shed_stays_within_generation():
+    shedding = make_replica(
+        "shedding", device_ms=1.0,
+        shed=Overloaded("queue full", retry_after_s=0.5, reason="queue"),
+    )
+    same_gen = make_replica("same_gen", device_ms=5.0)
+    other_gen = make_replica("other_gen", device_ms=0.1, generation=7)
+    router = FleetRouter(make_set(shedding, same_gen, other_gen))
+    # Primary (cheapest healthy at gen 0... other_gen is cheaper but
+    # pinning happens by score; force affinity onto the shedding one.
+    router._affinity["t1"] = "shedding"
+    out = router.handle_request("q", tenant="t1")
+    # Spilled to the SAME-generation replica, never the cheaper
+    # replica serving generation 7.
+    assert out == "resp:same_gen"
+    assert other_gen.leader.calls == []
+    export = router.export()
+    assert export["spillovers"] == 1
+    assert export["generation_skips"] == 1
+
+
+def test_fleet_wide_shed_aggregates_typed_overloaded():
+    journal = EventJournal()
+    a = make_replica(
+        "a", shed=Overloaded("busy", retry_after_s=2.0, reason="queue")
+    )
+    b = make_replica(
+        "b", shed=Overloaded("busy", retry_after_s=0.25, reason="cost")
+    )
+    router = FleetRouter(make_set(a, b, journal=journal), journal=journal)
+    with pytest.raises(Overloaded) as excinfo:
+        router.handle_request("q", tenant="t1")
+    # One typed fleet error: smallest positive retry hint, fleet reason.
+    assert excinfo.value.reason == "fleet"
+    assert excinfo.value.retry_after_s == 0.25
+    assert router.export()["fleet_sheds"] == 1
+    kinds = [e["kind"] for e in journal.export()["events"]]
+    assert "fleet.shed" in kinds
+
+
+def test_no_healthy_replicas_is_typed_overloaded():
+    rs = make_set(make_replica("a"))
+    rs.kill("a")
+    router = FleetRouter(rs)
+    with pytest.raises(Overloaded) as excinfo:
+        router.pick("t1")
+    assert excinfo.value.reason == "fleet"

@@ -1,0 +1,273 @@
+"""Cross-replica consistency: the same golden must reconstruct
+bit-identically on every replica at the same generation.
+
+`CrossReplicaProbe` issues ONE golden plain pair to every replica,
+groups reconstructions by the generation each replica served from,
+and asserts bit-identity within each group (plus the oracle when
+known). A replica serving different bytes at the same generation is a
+divergence: journaled, counted, listener-fired (debug bundle). Also
+covers the `/fleetz` admin endpoint and the stable replica identity
+on `/varz` + `/statusz` (satellite 2).
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.fleet import Replica, ReplicaSet
+from distributed_point_functions_tpu.observability import AdminServer
+from distributed_point_functions_tpu.observability.bundle import (
+    BundleManager,
+)
+from distributed_point_functions_tpu.observability.events import EventJournal
+from distributed_point_functions_tpu.pir import DenseDpfPirDatabase
+from distributed_point_functions_tpu.serving import (
+    PlainSession,
+    ServingConfig,
+    SnapshotManager,
+)
+from distributed_point_functions_tpu.serving.prober import CrossReplicaProbe
+
+NUM_RECORDS = 64
+RECORD_BYTES = 16
+RNG = np.random.default_rng(1717)
+
+RECORDS0 = [
+    bytes(RNG.integers(0, 256, RECORD_BYTES, dtype=np.uint8))
+    for _ in range(NUM_RECORDS)
+]
+RECORDS1 = [bytes(b ^ 0xA5 for b in r) for r in RECORDS0]
+# Same size and generation as RECORDS0 but different bytes at every
+# index: what a replica restored from the wrong snapshot serves.
+RECORDS_CORRUPT = [bytes(b ^ 0x3C for b in r) for r in RECORDS0]
+
+
+def build_db(records):
+    builder = DenseDpfPirDatabase.Builder()
+    for r in records:
+        builder.insert(r)
+    return builder.build()
+
+
+def delta_db(prev, records):
+    builder = DenseDpfPirDatabase.Builder()
+    for i, r in enumerate(records):
+        builder.update(i, r)
+    return builder.build_from(prev)
+
+
+def make_config():
+    return ServingConfig(max_batch_size=8, max_wait_ms=2.0)
+
+
+def plain_replica(rid, records=RECORDS0):
+    session = PlainSession(build_db(records), make_config())
+    manager = SnapshotManager(session, journal=EventJournal())
+    return Replica(rid, session, leader_snapshots=manager)
+
+
+def close_all(replicas):
+    for r in replicas:
+        r.leader.close()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_identical_replicas_probe_bit_identical():
+    replicas = [plain_replica(f"r{i}") for i in range(3)]
+    probe = CrossReplicaProbe(replicas, RECORDS0, journal=EventJournal())
+    try:
+        result = probe.run_cycle()
+        assert result["status"] == "pass", result
+        assert result["divergences"] == []
+        assert result["errors"] == {}
+        assert result["replicas"] == ["r0", "r1", "r2"]
+        # All three answered from one generation group.
+        assert result["generations"] == {"0": ["r0", "r1", "r2"]}
+        assert probe.export()["divergences"] == 0
+    finally:
+        close_all(replicas)
+
+
+def test_divergent_replica_is_caught_and_bundled(tmp_path):
+    journal = EventJournal()
+    bundles = BundleManager(directory=str(tmp_path), cooldown_s=0.0)
+    replicas = [
+        plain_replica("r0"),
+        # Wrong snapshot, same size, same generation tag: the silent
+        # fleet failure no per-replica prober can see.
+        plain_replica("r1", records=RECORDS_CORRUPT),
+        plain_replica("r2"),
+    ]
+    probe = CrossReplicaProbe(replicas, RECORDS0, journal=journal)
+    probe.add_failure_listener(bundles.on_probe_failure)
+    try:
+        result = probe.run_cycle()
+        assert result["status"] == "mismatch"
+        offenders = {d["replica"] for d in result["divergences"]}
+        assert offenders == {"r1"}
+        # The divergence names the generation and golden index.
+        first = result["divergences"][0]
+        assert first["generation"] == 0 and first["against"] == "oracle"
+        kinds = [e["kind"] for e in journal.export()["events"]]
+        assert "fleet.divergence" in kinds
+        # The failure listener froze a debug bundle.
+        export = bundles.export()
+        assert export["fired"] == 1
+        path = export["bundles"][-1]["path"]
+        assert path and os.path.exists(path)
+        assert probe.export()["divergences"] == 1
+    finally:
+        close_all(replicas)
+
+
+def test_rotation_split_groups_by_generation_without_failing():
+    replicas = [plain_replica(f"r{i}") for i in range(3)]
+    # r2 already flipped to generation 1 (mid-rotation snapshot of the
+    # fleet); the others still serve generation 0. Legitimate split:
+    # grouped and reported, NOT a divergence.
+    r2 = replicas[2]
+    r2.snapshots.stage(delta_db(r2.leader.server.database, RECORDS1))
+    r2.snapshots.flip()
+    oracles = {0: RECORDS0, 1: RECORDS1}
+    probe = CrossReplicaProbe(
+        replicas,
+        RECORDS0,
+        records_provider=lambda gen: oracles.get(gen),
+        journal=EventJournal(),
+    )
+    try:
+        result = probe.run_cycle()
+        assert result["status"] == "pass", result
+        assert result["generations"] == {"0": ["r0", "r1"], "1": ["r2"]}
+    finally:
+        close_all(replicas)
+
+
+def test_divergence_against_peer_when_no_oracle_known():
+    replicas = [plain_replica("r0"), plain_replica("r1", RECORDS_CORRUPT)]
+    # Both flip to databases the probe has NO oracle for — divergence
+    # is still caught peer-against-peer within the generation group.
+    for r, records in ((replicas[0], RECORDS1),
+                       (replicas[1], RECORDS_CORRUPT)):
+        r.snapshots.stage(delta_db(r.leader.server.database, records))
+        r.snapshots.flip()
+    probe = CrossReplicaProbe(replicas, RECORDS0, journal=EventJournal())
+    try:
+        result = probe.run_cycle()
+        assert result["status"] == "mismatch"
+        assert result["divergences"][0]["against"] == "r0"
+    finally:
+        close_all(replicas)
+
+
+def test_probe_accepts_callable_replica_source():
+    rs = ReplicaSet(journal=EventJournal())
+    replicas = [rs.add(plain_replica(f"r{i}")) for i in range(2)]
+    probe = CrossReplicaProbe(
+        rs.healthy, RECORDS0, journal=EventJournal()
+    )
+    try:
+        assert probe.run_cycle()["status"] == "pass"
+        rs.kill("r1")
+        result = probe.run_cycle()
+        assert result["replicas"] == ["r0"]
+    finally:
+        close_all(replicas)
+
+
+# ---------------------------------------------------------------------------
+# /fleetz + replica identity on the admin surface (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+def test_fleetz_endpoint_serves_registry_view():
+    rs = ReplicaSet(journal=EventJournal())
+    replicas = [rs.add(plain_replica(f"r{i}")) for i in range(2)]
+    rs.shed("r1", reason="drill")
+    try:
+        with AdminServer(fleet=rs) as admin:
+            base = f"http://127.0.0.1:{admin.port}"
+            status, body = _get(f"{base}/fleetz")
+            assert status == 200
+            state = json.loads(body)
+            assert state["counts"] == {
+                "serving": 1, "staging": 0, "draining": 1, "dead": 0
+            }
+            assert state["replicas"]["r1"]["state"] == "draining"
+            assert state["replicas"]["r0"]["serving_generation"] == 0
+            assert state["sheds"] == 1
+            # The 404 index knows the new route.
+            status, body = _get(f"{base}/varz")
+            assert status == 200
+    finally:
+        close_all(replicas)
+
+
+def test_fleetz_404_without_fleet():
+    with AdminServer() as admin:
+        base = f"http://127.0.0.1:{admin.port}"
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            _get(f"{base}/fleetz")
+        assert excinfo.value.code == 404
+        assert "no fleet attached" in excinfo.value.read().decode()
+
+
+def test_varz_and_statusz_expose_replica_identity():
+    replica = plain_replica("fleet-r7")
+    try:
+        with AdminServer(
+            registry=replica.leader.metrics,
+            snapshots=replica.snapshots,
+            identity={"replica_id": "fleet-r7", "role": "leader"},
+        ) as admin:
+            base = f"http://127.0.0.1:{admin.port}"
+            status, body = _get(f"{base}/varz")
+            identity = json.loads(body)["identity"]
+            assert identity == {
+                "replica_id": "fleet-r7",
+                "role": "leader",
+                "serving_generation": 0,
+            }
+            # The generation is LIVE: a flip shows up on the next scrape.
+            replica.snapshots.stage(
+                delta_db(replica.leader.server.database, RECORDS1)
+            )
+            replica.snapshots.flip()
+            _, body = _get(f"{base}/varz")
+            assert json.loads(body)["identity"]["serving_generation"] == 1
+            status, body = _get(f"{base}/statusz?format=json")
+            assert json.loads(body)["identity"]["replica_id"] == "fleet-r7"
+            status, html = _get(f"{base}/statusz")
+            assert "fleet-r7" in html and "serving_generation" in html
+    finally:
+        replica.leader.close()
+
+
+def test_fleet_bundle_source_registered():
+    rs = ReplicaSet(journal=EventJournal())
+    replica = rs.add(plain_replica("r0"))
+    try:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            bundles = BundleManager(directory=tmp, cooldown_s=0.0)
+            with AdminServer(fleet=rs, bundles=bundles):
+                entry = bundles.trigger("test", {"why": "fleet source"})
+            with open(
+                os.path.join(entry["path"], "fleet.json"), "rb"
+            ) as f:
+                captured = json.load(f)
+            assert "replicas" in captured and "r0" in captured["replicas"]
+    finally:
+        replica.leader.close()

@@ -1,0 +1,298 @@
+"""Fleet-wide quorum rotation tests over real serving sessions.
+
+The invariants: a quorum of replicas staging generation N+1 commits
+the fleet (flip everywhere, Helper-first per pair); a replica killed
+mid-stage becomes a laggard that is SHED from the candidate set,
+re-converged party by party, and readmitted — with zero wrong bits
+served at any point; short of quorum NOTHING flips anywhere and
+`QuorumFailed` is typed; an unrecoverable laggard is marked dead, not
+retried forever.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.fleet import (
+    FleetRotationCoordinator,
+    QuorumFailed,
+    Replica,
+    ReplicaSet,
+)
+from distributed_point_functions_tpu.observability.events import EventJournal
+from distributed_point_functions_tpu.pir import (
+    DenseDpfPirClient,
+    DenseDpfPirDatabase,
+)
+from distributed_point_functions_tpu.prng import xor_bytes
+from distributed_point_functions_tpu.robustness import failpoints
+from distributed_point_functions_tpu.serving import (
+    HelperSession,
+    InProcessTransport,
+    LeaderSession,
+    PlainSession,
+    ServingConfig,
+    SnapshotManager,
+)
+from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+NUM_RECORDS = 64
+RECORD_BYTES = 16
+RNG = np.random.default_rng(4242)
+
+RECORDS0 = [
+    bytes(RNG.integers(0, 256, RECORD_BYTES, dtype=np.uint8))
+    for _ in range(NUM_RECORDS)
+]
+# Generation 1 differs at every byte so a cross-generation XOR can
+# never accidentally equal either oracle.
+RECORDS1 = [bytes(b ^ 0xA5 for b in r) for r in RECORDS0]
+
+
+def build_db(records):
+    builder = DenseDpfPirDatabase.Builder()
+    for r in records:
+        builder.insert(r)
+    return builder.build()
+
+
+def delta_db(prev, records):
+    builder = DenseDpfPirDatabase.Builder()
+    for i, r in enumerate(records):
+        builder.update(i, r)
+    return builder.build_from(prev)
+
+
+def make_config(**overrides):
+    base = dict(
+        max_batch_size=8,
+        max_wait_ms=2.0,
+        helper_timeout_ms=None,
+        helper_retries=2,
+        helper_backoff_ms=1.0,
+        helper_backoff_max_ms=2.0,
+    )
+    base.update(overrides)
+    return ServingConfig(**base)
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    reg = failpoints.default_failpoints()
+    reg.clear()
+    yield reg
+    reg.clear()
+
+
+def plain_replica(rid):
+    session = PlainSession(build_db(RECORDS0), make_config())
+    manager = SnapshotManager(session, journal=EventJournal())
+    return Replica(rid, session, leader_snapshots=manager)
+
+
+def make_fleet(n=3):
+    journal = EventJournal()
+    rs = ReplicaSet(journal=journal)
+    replicas = [rs.add(plain_replica(f"r{i}")) for i in range(n)]
+    return rs, replicas, journal
+
+
+def next_dbs(replica):
+    """databases callable: one fresh generation-1 delta per replica."""
+    leader_db = delta_db(replica.leader.server.database, RECORDS1)
+    helper_db = (
+        delta_db(replica.helper.server.database, RECORDS1)
+        if replica.helper is not None
+        else None
+    )
+    return leader_db, helper_db
+
+
+def query_plain(session, indices):
+    client = DenseDpfPirClient(NUM_RECORDS, lambda pt, info: pt)
+    req0, req1 = client.create_plain_requests(indices)
+    resp0 = session.handle_request(req0)
+    resp1 = session.handle_request(req1)
+    return [
+        xor_bytes(a, b)
+        for a, b in zip(
+            resp0.dpf_pir_response.masked_response,
+            resp1.dpf_pir_response.masked_response,
+        )
+    ]
+
+
+def close_all(replicas):
+    for r in replicas:
+        r.leader.close()
+        if r.helper is not None:
+            r.helper.close()
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_quorum_rotation_happy_path():
+    rs, replicas, journal = make_fleet(3)
+    coordinator = FleetRotationCoordinator(rs, journal=journal)
+    try:
+        report = coordinator.rotate(next_dbs)
+        assert report["to_generation"] == 1
+        assert report["quorum"] == 2  # majority of 3
+        assert sorted(report["acked"]) == ["r0", "r1", "r2"]
+        assert sorted(report["flipped"]) == ["r0", "r1", "r2"]
+        assert report["laggards"] == {}
+        for r in replicas:
+            assert r.serving_generation() == 1
+            assert rs.state(r.replica_id) == "serving"
+            assert query_plain(r.leader, [0, 33]) == [
+                RECORDS1[0], RECORDS1[33],
+            ]
+        kinds = [e["kind"] for e in journal.export()["events"]]
+        assert "fleet.rotation" in kinds
+        assert coordinator.export()["rotations"] == 1
+    finally:
+        close_all(replicas)
+
+
+def test_replica_killed_mid_stage_is_shed_converged_and_readmitted(
+    clean_failpoints,
+):
+    rs, replicas, journal = make_fleet(3)
+    coordinator = FleetRotationCoordinator(rs, journal=journal)
+    # Kill r1 exactly once, mid-stage: the per-replica chaos site fires
+    # between marking it `staging` and staging its managers.
+    clean_failpoints.arm("fleet.stage.r1", "error", times=1)
+    try:
+        report = coordinator.rotate(next_dbs)
+        # Quorum (2/3) held: the fleet committed to generation 1.
+        assert report["to_generation"] == 1
+        assert sorted(report["acked"]) == ["r0", "r2"]
+        # The laggard was shed, converged party by party, readmitted.
+        assert report["laggards"] == {"r1": "recovered"}
+        for r in replicas:
+            assert r.serving_generation() == 1
+            assert rs.state(r.replica_id) == "serving"
+            # Zero wrong bits: every replica answers generation 1.
+            assert query_plain(r.leader, [5, 63]) == [
+                RECORDS1[5], RECORDS1[63],
+            ]
+        export = rs.export()
+        assert export["sheds"] == 1 and export["readmissions"] == 1
+        transitions = [(t["replica"], t["to"]) for t in export["history"]]
+        assert ("r1", "draining") in transitions
+        assert ("r1", "serving") in transitions
+    finally:
+        close_all(replicas)
+
+
+def test_quorum_failure_aborts_everywhere(clean_failpoints):
+    rs, replicas, journal = make_fleet(3)
+    # Unanimity required: one mid-stage death must abort the rotation.
+    coordinator = FleetRotationCoordinator(rs, quorum=3, journal=journal)
+    clean_failpoints.arm("fleet.stage.r1", "error", times=1)
+    try:
+        with pytest.raises(QuorumFailed) as excinfo:
+            coordinator.rotate(next_dbs)
+        assert excinfo.value.to_generation == 1
+        assert sorted(excinfo.value.acked) == ["r0", "r2"]
+        assert sorted(excinfo.value.failed) == ["r1"]
+        # NOTHING flipped: every replica serves generation 0, nothing
+        # left staged, states restored.
+        for r in replicas:
+            assert r.serving_generation() == 0
+            assert r.staging_generation() is None
+            assert rs.state(r.replica_id) == "serving"
+            assert query_plain(r.leader, [7]) == [RECORDS0[7]]
+        kinds = [e["kind"] for e in journal.export()["events"]]
+        assert "fleet.quorum_failed" in kinds
+        assert coordinator.export()["quorum_failures"] == 1
+        # A clean retry converges from the aborted state.
+        report = coordinator.rotate(next_dbs)
+        assert report["laggards"] == {}
+        assert all(r.serving_generation() == 1 for r in replicas)
+    finally:
+        close_all(replicas)
+
+
+def test_unrecoverable_laggard_is_marked_dead(clean_failpoints):
+    rs, replicas, journal = make_fleet(3)
+    coordinator = FleetRotationCoordinator(rs, journal=journal)
+    clean_failpoints.arm("fleet.stage.r1", "error", times=1)
+    # Phase 1 stages r0 and r2 (two snapshot.stage firings); the THIRD
+    # stage is r1's laggard convergence — fail it too.
+    clean_failpoints.arm("snapshot.stage", "error", times=1, after=2)
+    try:
+        report = coordinator.rotate(next_dbs)
+        assert report["laggards"] == {"r1": "dead"}
+        assert rs.state("r1") == "dead"
+        assert rs.export()["deaths"] == 1
+        # The rest of the fleet committed and serves the new bits.
+        for rid in ("r0", "r2"):
+            r = rs.get(rid)
+            assert r.serving_generation() == 1
+            assert query_plain(r.leader, [3]) == [RECORDS1[3]]
+        # The dead replica is out of the alive/rotatable set.
+        assert sorted(r.replica_id for r in rs.alive()) == ["r0", "r2"]
+    finally:
+        close_all(replicas)
+
+
+def test_two_party_replicas_rotate_helper_first():
+    journal = EventJournal()
+    rs = ReplicaSet(journal=journal)
+    replicas = []
+    for i in range(2):
+        helper = HelperSession(
+            build_db(RECORDS0), encrypt_decrypt.decrypt, make_config()
+        )
+        leader = LeaderSession(
+            build_db(RECORDS0),
+            InProcessTransport(helper.handle_wire),
+            make_config(),
+        )
+        replica = Replica(
+            f"pair{i}",
+            leader,
+            helper,
+            leader_snapshots=SnapshotManager(
+                leader, journal=EventJournal()
+            ),
+            helper_snapshots=SnapshotManager(
+                helper, journal=EventJournal()
+            ),
+        )
+        replicas.append(rs.add(replica))
+    coordinator = FleetRotationCoordinator(rs, journal=journal)
+    try:
+        report = coordinator.rotate(next_dbs)
+        assert report["to_generation"] == 1
+        assert report["laggards"] == {}
+        # Each pair's measured helper->leader flip window landed.
+        for rid in ("pair0", "pair1"):
+            assert report["per_replica"][rid]["staleness_ms"] >= 0.0
+            assert report["per_replica"][rid]["helper_staged_bytes"] > 0
+        client = DenseDpfPirClient.create(
+            NUM_RECORDS, encrypt_decrypt.encrypt
+        )
+        for r in replicas:
+            assert r.serving_generation() == 1
+            assert r.helper_snapshots.serving_generation() == 1
+            request, state = client.create_request([9, 41])
+            response = r.leader.handle_request(request)
+            assert client.handle_response(response, state) == [
+                RECORDS1[9], RECORDS1[41],
+            ]
+    finally:
+        close_all(replicas)
+
+
+def test_rotation_requires_snapshot_managers():
+    rs = ReplicaSet(journal=EventJournal())
+    session = PlainSession(build_db(RECORDS0), make_config())
+    try:
+        rs.add(Replica("bare", session))  # no SnapshotManager
+        coordinator = FleetRotationCoordinator(rs)
+        with pytest.raises(ValueError, match="no rotatable replicas"):
+            coordinator.rotate(next_dbs)
+    finally:
+        session.close()

@@ -1,0 +1,144 @@
+"""Sparse KV serving under degraded own-share-only mode.
+
+The contract: when the Leader's helper-leg breaker is open and
+`allow_degraded=True`, a sparse lookup NEVER resolves to a wrong
+value. A one-share response reconstructs to garbage buckets, the
+cuckoo key-slot check rejects every one of them, and `resolve` returns
+the typed-falsy `KeyNotFound` for present and absent keys alike —
+absence of the second share degrades to absence of an answer, not to
+a fabricated value. Recovery restores real values for the same keys.
+"""
+
+import time
+
+import pytest
+
+from distributed_point_functions_tpu.pir.cuckoo_database import (
+    CuckooHashedDpfPirDatabase,
+)
+from distributed_point_functions_tpu.pir.sparse_client import KeyNotFound
+from distributed_point_functions_tpu.pir.sparse_server import (
+    CuckooHashingSparseDpfPirServer,
+)
+from distributed_point_functions_tpu.robustness import failpoints
+from distributed_point_functions_tpu.serving import (
+    InProcessTransport,
+    ServingConfig,
+    SparseHelperSession,
+    SparseLeaderSession,
+    make_sparse_client,
+    sparse_lookup,
+)
+from distributed_point_functions_tpu.testing import encrypt_decrypt
+
+SEED = b"0123456789abcdef"
+NUM_KEYS = 40
+RECORDS = {b"key_%02d" % i: b"val_%02d" % i for i in range(NUM_KEYS)}
+VALUES = set(RECORDS.values())
+
+
+def build_sparse(params=None):
+    if params is None:
+        params = CuckooHashingSparseDpfPirServer.generate_params(
+            len(RECORDS), seed=SEED
+        )
+    builder = CuckooHashedDpfPirDatabase.Builder().set_params(params)
+    for kv in RECORDS.items():
+        builder.insert(kv)
+    return params, builder.build()
+
+
+def make_config(**overrides):
+    base = dict(
+        max_batch_size=8,
+        max_wait_ms=2.0,
+        helper_timeout_ms=None,
+        helper_retries=0,
+        helper_backoff_ms=1.0,
+        helper_backoff_max_ms=2.0,
+        allow_degraded=True,
+        breaker_failure_threshold=1,
+        breaker_reset_ms=30.0,
+    )
+    base.update(overrides)
+    return ServingConfig(**base)
+
+
+@pytest.fixture(autouse=True)
+def clean_failpoints():
+    reg = failpoints.default_failpoints()
+    reg.clear()
+    yield reg
+    reg.clear()
+
+
+def make_pair(**config_overrides):
+    params, db_h = build_sparse()
+    _, db_l = build_sparse(params)
+    helper = SparseHelperSession(
+        params, db_h, encrypt_decrypt.decrypt, make_config()
+    )
+    leader = SparseLeaderSession(
+        params,
+        db_l,
+        InProcessTransport(helper.handle_wire),
+        make_config(**config_overrides),
+    )
+    return leader, helper
+
+
+QUERIES = [b"key_00", b"key_17", b"key_39", b"absent"]
+
+
+def test_degraded_lookups_stay_typed_absent_never_wrong(clean_failpoints):
+    # Helper leg dead for good: the first failure (threshold 1) opens
+    # the breaker and every subsequent lookup serves own-share-only.
+    clean_failpoints.arm("service.helper_leg", "error", times=None)
+    leader, helper = make_pair()
+    client = make_sparse_client(leader, encrypter=encrypt_decrypt.encrypt)
+    with helper, leader:
+        for _ in range(3):
+            out = sparse_lookup(leader, client, QUERIES)
+            for key, got in zip(QUERIES, out):
+                # The load-bearing half: a one-share reconstruction
+                # must never pass the key-slot check and surface as a
+                # value — neither the right one nor anybody else's.
+                assert isinstance(got, KeyNotFound), (key, got)
+                assert got.key == key
+                assert not got  # typed-falsy: callers branch safely
+                assert got not in VALUES
+        assert leader.degraded
+        assert leader.breaker.state == "open"
+        counters = leader.metrics.export()["counters"]
+        assert counters["leader.degraded_responses"] >= 3
+
+
+def test_degraded_recovery_restores_real_values(clean_failpoints):
+    # Exactly one helper-leg failure: breaker opens, one degraded
+    # answer, then the half-open probe succeeds and values come back.
+    clean_failpoints.arm("service.helper_leg", "error", times=1)
+    leader, helper = make_pair()
+    client = make_sparse_client(leader, encrypter=encrypt_decrypt.encrypt)
+    with helper, leader:
+        out = sparse_lookup(leader, client, QUERIES)
+        assert all(isinstance(v, KeyNotFound) for v in out)
+        assert leader.degraded
+
+        time.sleep(0.05)  # past breaker_reset_ms: next request probes
+        out = sparse_lookup(leader, client, QUERIES)
+        assert out[:3] == [b"val_00", b"val_17", b"val_39"]
+        assert isinstance(out[3], KeyNotFound) and out[3].key == b"absent"
+        assert not leader.degraded
+        assert leader.breaker.state == "closed"
+        assert leader.metrics.export()["counters"]["leader.degraded_exits"] == 1
+
+
+def test_degraded_disallowed_raises_instead_of_guessing(clean_failpoints):
+    # Without the opt-in, a dead helper is an error, not a degraded
+    # answer — the session must never silently serve one share.
+    clean_failpoints.arm("service.helper_leg", "error", times=None)
+    leader, helper = make_pair(allow_degraded=False)
+    client = make_sparse_client(leader, encrypter=encrypt_decrypt.encrypt)
+    with helper, leader:
+        with pytest.raises(Exception):
+            sparse_lookup(leader, client, [b"key_00"])

@@ -3,11 +3,13 @@
 
 Three rules over `distributed_point_functions_tpu/`:
 
-1. **Layer DAG** — `heavy_hitters -> serving -> pir -> capacity ->
-   ops -> observability -> robustness`, never the reverse, with
-   restricted
+1. **Layer DAG** — `fleet -> heavy_hitters -> serving -> pir ->
+   capacity -> ops -> observability -> robustness`, never the reverse,
+   with restricted
    layers: the serving runtime may only be imported by
-   `heavy_hitters/` (the one in-library session kind built on it), and
+   `heavy_hitters/` (the one in-library session kind built on it),
+   the prober, and `fleet/` (the replica composition layer: registry,
+   price-aware router, quorum rotation — the topmost leaf), and
    `heavy_hitters` itself is application-facing — no library layer
    imports it (applications — examples/, bench.py, benchmarks/ — may
    import anything). `observability` sits near the bottom on purpose:
@@ -56,6 +58,7 @@ ROOT = Path(__file__).resolve().parent.parent
 # layers only. Subpackages not listed are unconstrained by rule 1
 # (but still cycle-checked by rule 2).
 LAYERS = {
+    "fleet": 9,
     "prober": 8,
     "heavy_hitters": 7,
     "serving": 6,
@@ -75,14 +78,17 @@ LAYERS = {
 MODULE_LAYERS = {f"{PACKAGE}.serving.prober": "prober"}
 
 # Restricted layers: importable only from the listed source layers
-# (plus themselves). serving stays a near-leaf — its one in-library
-# consumer is the heavy_hitters session; heavy_hitters is a true leaf
-# only applications (and the prober) may import; the prober itself is
-# a true leaf.
+# (plus themselves). serving stays a near-leaf — its in-library
+# consumers are the heavy_hitters session, the prober, and the fleet
+# composition layer; heavy_hitters is a true leaf only applications
+# (and the prober) may import; the prober may additionally be consumed
+# by fleet/ (the registry hands `CrossReplicaProbe` the replicas);
+# fleet itself is the topmost true leaf.
 RESTRICTED = {
-    "serving": {"heavy_hitters", "prober"},
+    "serving": {"heavy_hitters", "prober", "fleet"},
     "heavy_hitters": {"prober"},
-    "prober": set(),
+    "prober": {"fleet"},
+    "fleet": set(),
 }
 
 # Application namespaces living outside the package: they may import
