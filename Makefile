@@ -35,6 +35,7 @@ test-fast:     ## ~8 min hermetic signal incl. core invariants + tiny Pallas
 	    tests/test_mesh_serving.py \
 	    tests/test_fleet.py tests/test_fleet_rotation.py \
 	    tests/test_fleet_consistency.py \
+	    tests/test_federation.py tests/test_fleet_telemetry.py \
 	    tests/test_single_device_donation.py \
 	    tests/test_sparse_degraded.py \
 	    tests/test_pallas_fast.py tests/test_bench_ladder.py -q

@@ -16,6 +16,17 @@ fleet through quorum several times. Two headline numbers:
 - ``fleet_rotation_staleness_ms`` — the worst per-replica
   helper-first/leader-last flip window across all fleet rotations
   (direction: lower).
+- ``fleet_routable_replicas_min`` — the smallest routable-replica
+  count the fleet telemetry plane observed once attached (through the
+  rotations; direction: higher — a clean run never dips below N).
+
+Plus a report-only A/B leg, ``fleet_telemetry_overhead``: the q/s
+window is measured once with no fleet telemetry attached and again
+with every replica scoped (`FleetTelemetry.scope` per replica, a
+sampler thread driving `sample()` continuously). The overhead budget
+is <2% of front-door q/s; the report flags ``overhead_within_budget``
+but the gate does not block on it (two short windows on a shared CI
+box are too noisy to gate — the number is for trend eyes).
 
 Run directly (JSON report on stdout, also written to
 ``benchmarks/results/fleet_bench.json``; appends both records to the
@@ -27,7 +38,9 @@ Environment knobs: FLEET_BENCH_RECORDS (default 256),
 FLEET_BENCH_RECORD_BYTES (32), FLEET_BENCH_REPLICAS (3),
 FLEET_BENCH_THREADS (4), FLEET_BENCH_ROTATIONS (2),
 FLEET_BENCH_BASELINE_S (1.5), FLEET_BENCH_SETTLE_S (0.5),
-FLEET_BENCH_OUT (report path; empty string disables the file).
+FLEET_BENCH_SAMPLE_PERIOD_S (1.0, the telemetry sampling cadence in
+the A/B leg), FLEET_BENCH_OUT (report path; empty string disables the
+file).
 """
 
 from __future__ import annotations
@@ -61,8 +74,12 @@ def run_fleet_bench():
     from distributed_point_functions_tpu.fleet import (
         FleetRotationCoordinator,
         FleetRouter,
+        FleetTelemetry,
         Replica,
         ReplicaSet,
+    )
+    from distributed_point_functions_tpu.serving.metrics import (
+        MetricsRegistry,
     )
     from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
     from distributed_point_functions_tpu.pir.database import (
@@ -85,6 +102,9 @@ def run_fleet_bench():
     num_rotations = int(os.environ.get("FLEET_BENCH_ROTATIONS", 2))
     baseline_s = float(os.environ.get("FLEET_BENCH_BASELINE_S", 1.5))
     settle_s = float(os.environ.get("FLEET_BENCH_SETTLE_S", 0.5))
+    sample_period_s = float(
+        os.environ.get("FLEET_BENCH_SAMPLE_PERIOD_S", 1.0)
+    )
 
     _log(
         f"fleet: {num_replicas} replicas x ({num_records} x "
@@ -125,7 +145,8 @@ def run_fleet_bench():
             helper_snapshots=SnapshotManager(helper),
         )
         replicas.append(replica_set.add(replica))
-    router = FleetRouter(replica_set)
+    fleet_registry = MetricsRegistry()
+    router = FleetRouter(replica_set, metrics=fleet_registry)
     coordinator = FleetRotationCoordinator(replica_set)
 
     client = DenseDpfPirClient.create(num_records, encrypt_decrypt.encrypt)
@@ -216,9 +237,45 @@ def run_fleet_bench():
     for t in threads:
         t.start()
 
+    # Window A: steady state with NO fleet telemetry attached.
     t_base0 = time.monotonic()
     time.sleep(baseline_s)
     t_base1 = time.monotonic()
+
+    # A/B leg: scope every replica into the fleet telemetry plane and
+    # drive `sample()` continuously from a sampler thread, then measure
+    # the same window again. The delta is the plane's whole cost:
+    # scoped journals, per-registry samplers, derived-gauge refresh,
+    # SLO grading.
+    _log("attaching fleet telemetry plane for the A/B window")
+    telemetry = FleetTelemetry(
+        replica_set, router=router, registry=fleet_registry
+    )
+    for r in replicas:
+        telemetry.scope(r)
+    coordinator.set_telemetry(telemetry)
+    min_routable = [None]
+    sample_stop = threading.Event()
+
+    def sample_loop():
+        while not sample_stop.is_set():
+            try:
+                routable = telemetry.sample()["routable"]
+                if min_routable[0] is None or routable < min_routable[0]:
+                    min_routable[0] = routable
+            except Exception:  # noqa: BLE001 - sampling must not kill bench
+                pass
+            sample_stop.wait(sample_period_s)
+
+    sampler_thread = threading.Thread(
+        target=sample_loop, name="fleet-sampler", daemon=True
+    )
+    sampler_thread.start()
+
+    # Window B: same duration, telemetry plane on.
+    t_ab0 = time.monotonic()
+    time.sleep(baseline_s)
+    t_ab1 = time.monotonic()
 
     rotations = []
     try:
@@ -264,6 +321,17 @@ def run_fleet_bench():
         stop.set()
         for t in threads:
             t.join(timeout=30.0)
+        # One last sample so the post-rotation fleet state (all
+        # replicas back to serving) is in the min-routable record, then
+        # stop the sampler.
+        try:
+            routable = telemetry.sample()["routable"]
+            if min_routable[0] is None or routable < min_routable[0]:
+                min_routable[0] = routable
+        except Exception:  # noqa: BLE001
+            pass
+        sample_stop.set()
+        sampler_thread.join(timeout=10.0)
 
     def qps_in(t0, t1):
         with lock:
@@ -271,6 +339,12 @@ def run_fleet_bench():
         return n / max(t1 - t0, 1e-9)
 
     baseline_qps = qps_in(t_base0, t_base1)
+    telemetry_qps = qps_in(t_ab0, t_ab1)
+    overhead_pct = (
+        round((baseline_qps - telemetry_qps) / baseline_qps * 100.0, 2)
+        if baseline_qps > 0
+        else None
+    )
     worst_staleness = max(
         (r["staleness_ms"] for r in rotations), default=0.0
     )
@@ -291,6 +365,21 @@ def run_fleet_bench():
         "fleet_qps": round(baseline_qps, 2),
         "rotations": rotations,
         "fleet_rotation_staleness_ms": round(worst_staleness, 3),
+        # Report-only A/B leg: the cost of the whole telemetry plane.
+        "fleet_telemetry_overhead": {
+            "qps_off": round(baseline_qps, 2),
+            "qps_on": round(telemetry_qps, 2),
+            "overhead_pct": overhead_pct,
+            "budget_pct": 2.0,
+            "within_budget": (
+                overhead_pct is not None and overhead_pct < 2.0
+            ),
+            "samples": telemetry.export()["samples"],
+            "series_count": telemetry.export()["timeseries"][
+                "series_count"
+            ],
+        },
+        "fleet_routable_replicas_min": min_routable[0],
         "traffic": dict(stats),
         "correctness_ok": correctness_ok,
         "router": router.export(),
@@ -303,6 +392,11 @@ def run_fleet_bench():
         f"{stats['completed']} completed, {stats['sheds']} sheds, "
         f"{stats['refusals']} refusals, {stats['torn']} torn, "
         f"correctness {'ok' if correctness_ok else 'FAILED'}"
+    )
+    _log(
+        f"telemetry A/B: {baseline_qps:.1f} q/s off -> "
+        f"{telemetry_qps:.1f} q/s on ({overhead_pct}% overhead, "
+        f"budget 2%); min routable {min_routable[0]}"
     )
 
     out = os.environ.get(
@@ -322,9 +416,10 @@ def run_fleet_bench():
 
 
 def _append_history_records(report):
-    """Two records for the regression gate: front-door throughput
-    (higher) and fleet rotation staleness (lower). Best-effort like
-    every history append."""
+    """Records for the regression gate: front-door throughput
+    (higher), fleet rotation staleness (lower), and the minimum
+    routable-replica count the telemetry plane observed (higher).
+    Best-effort like every history append."""
     try:
         from benchmarks.regression_gate import append_record, git_rev
 
@@ -354,6 +449,20 @@ def _append_history_records(report):
             "git_rev": rev,
             "device": device,
         }, path=path)
+        # Gated: the telemetry plane must keep seeing a fully routable
+        # fleet through rotations (healthy() counts staging, so a clean
+        # rotation never dips this).
+        if report.get("fleet_routable_replicas_min") is not None:
+            append_record({
+                "metric": "fleet_routable_replicas_min",
+                "value": float(report["fleet_routable_replicas_min"]),
+                "unit": "replicas",
+                "direction": "higher",
+                "vs_baseline": None,
+                "status": status,
+                "git_rev": rev,
+                "device": device,
+            }, path=path)
     except Exception as e:  # noqa: BLE001 - history must not break a bench
         _log(f"history append failed (non-fatal): {e}")
 

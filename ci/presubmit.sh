@@ -1537,6 +1537,245 @@ print(
 )
 '
 
+# --- fleet-obs-smoke: ISSUE 19 end to end. The same 3-replica fleet
+# under closed-loop traffic, now with the fleet telemetry plane
+# attached: per-replica scopes feeding one aggregator, a quorum
+# rotation with a DELAY failpoint on r1's stage site, then a forced
+# divergence (r2 staged different records at the same generation).
+# Asserts: /fleet-statusz?format=json carries per-replica rows AND the
+# merged view; the merged /fleet-timelinez tells the rotation story
+# causally (every replica's snapshot.flip before the fleet.rotation
+# commit event, each attributed to its replica); and the divergence
+# produces EXACTLY ONE fleet-wide debug bundle holding all three
+# replicas' sections plus the merged timeline.
+stage fleet-obs-smoke env JAX_PLATFORMS=cpu python -c '
+import contextlib, json, tempfile, threading, time, urllib.request
+import numpy as np
+from distributed_point_functions_tpu.fleet import (
+    FleetRotationCoordinator, FleetRouter, FleetTelemetry, Replica,
+    ReplicaSet,
+)
+from distributed_point_functions_tpu.observability import AdminServer
+from distributed_point_functions_tpu.observability.bundle import (
+    BundleManager,
+)
+from distributed_point_functions_tpu.observability.events import (
+    EventJournal,
+)
+from distributed_point_functions_tpu.pir.client import DenseDpfPirClient
+from distributed_point_functions_tpu.pir.database import (
+    DenseDpfPirDatabase,
+)
+from distributed_point_functions_tpu.prng import xor_bytes
+from distributed_point_functions_tpu.robustness import failpoints
+from distributed_point_functions_tpu.serving import (
+    PlainSession, ServingConfig, SnapshotManager,
+)
+from distributed_point_functions_tpu.serving.batcher import Overloaded
+from distributed_point_functions_tpu.serving.metrics import MetricsRegistry
+from distributed_point_functions_tpu.serving.prober import CrossReplicaProbe
+
+NUM, NB = 64, 16
+rng = np.random.default_rng(19)
+R0 = [bytes(rng.integers(0, 256, NB, dtype=np.uint8)) for _ in range(NUM)]
+R1 = [bytes(b ^ 0xA5 for b in r) for r in R0]
+R1_BAD = [bytes(b ^ 0x3C for b in r) for r in R0]  # r2s forced skew
+
+def full(records):
+    b = DenseDpfPirDatabase.Builder()
+    for r in records:
+        b.insert(r)
+    return b.build()
+
+def delta(prev, records):
+    b = DenseDpfPirDatabase.Builder()
+    for i, r in enumerate(records):
+        b.update(i, r)
+    return b.build_from(prev)
+
+cfg = ServingConfig(max_batch_size=8, max_wait_ms=2.0)
+journal = EventJournal(capacity=256)
+rs = ReplicaSet(journal=journal)
+reps = []
+for i in range(3):
+    s = PlainSession(full(R0), cfg)
+    reps.append(
+        rs.add(Replica("r%d" % i, s, leader_snapshots=SnapshotManager(s)))
+    )
+fleet_registry = MetricsRegistry()
+router = FleetRouter(rs, journal=journal, metrics=fleet_registry)
+probe = CrossReplicaProbe(
+    rs.healthy, R0,
+    records_provider=lambda gen: {0: R0, 1: R1}.get(gen),
+    journal=journal,
+)
+telemetry = FleetTelemetry(
+    rs, router=router, probe=probe, journal=journal,
+    registry=fleet_registry,
+)
+for r in reps:
+    telemetry.scope(r)
+bundle_dir = tempfile.mkdtemp(prefix="fleet-obs-smoke-")
+bundles = BundleManager(
+    directory=bundle_dir, cooldown_s=60.0, journal=journal,
+)
+telemetry.wire_bundles(bundles)
+
+client = DenseDpfPirClient(NUM, lambda pt, info: pt)
+w0, w1 = client.create_plain_requests([0])
+for r in reps:  # warm the jit bucket on every replica
+    r.leader.handle_request(w0)
+    r.leader.handle_request(w1)
+assert probe.run_cycle()["status"] == "pass"
+
+stats = {"done": 0, "wrong": 0, "sheds": 0}
+lock = threading.Lock()
+stop = threading.Event()
+
+def worker(tid):
+    tenant = "t%d" % tid
+    i = tid
+    while not stop.is_set():
+        idx = (i * 7) % NUM
+        i += 1
+        try:
+            rep = router.pick(tenant)
+            q0, q1 = client.create_plain_requests([idx])
+            with contextlib.ExitStack() as st:
+                for m in rep.managers():
+                    st.enter_context(m.pin())
+                a = rep.leader.handle_request(q0)
+                b = rep.leader.handle_request(q1)
+            got = xor_bytes(
+                a.dpf_pir_response.masked_response[0],
+                b.dpf_pir_response.masked_response[0],
+            )
+            with lock:
+                stats["done"] += 1
+                if not any(got == recs[idx] for recs in (R0, R1)):
+                    stats["wrong"] += 1
+        except Overloaded:
+            with lock:
+                stats["sheds"] += 1
+            time.sleep(0.002)
+        time.sleep(0.001)
+
+threads = [
+    threading.Thread(target=worker, args=(t,), daemon=True)
+    for t in range(3)
+]
+for t in threads:
+    t.start()
+telemetry.sample()
+time.sleep(0.3)
+
+# One quorum rotation with a DELAY failpoint on r1s stage site: a
+# latency spike is not a fault, so the fleet commits. Under closed-
+# loop traffic a replica may still miss the drain window and lag --
+# acceptable only if the coordinator converged and readmitted it
+# before returning. The telemetry resample hooked to the coordinator
+# refreshes staleness right at the commit.
+failpoints.default_failpoints().arm(
+    "fleet.stage.r1", "delay", delay_ms=100, times=1
+)
+coord = FleetRotationCoordinator(rs, journal=journal)
+coord.set_telemetry(telemetry)
+report = coord.rotate(
+    lambda rep: (delta(rep.leader.server.database, R1), None)
+)
+assert report["to_generation"] == 1, report
+assert set(report["laggards"].values()) <= {"recovered"}, report
+time.sleep(0.3)
+telemetry.sample()
+stop.set()
+for t in threads:
+    t.join(timeout=10)
+failpoints.default_failpoints().clear()
+assert stats["done"] > 0 and stats["wrong"] == 0, stats
+assert probe.run_cycle()["status"] == "pass"
+
+with AdminServer(fleet=rs, fleet_telemetry=telemetry) as admin:
+    base = "http://127.0.0.1:%d" % admin.port
+    state = json.loads(urllib.request.urlopen(
+        base + "/fleet-statusz?format=json", timeout=10).read())
+    # Per-replica rows AND the merged view, in one document.
+    assert sorted(state["replicas"]) == ["r0", "r1", "r2"], sorted(
+        state["replicas"])
+    per_replica_counts = {}
+    for rid, scrape in state["replicas"].items():
+        assert scrape["state"] == "serving", (rid, scrape["state"])
+        hist = scrape["metrics"]["histograms"]["plain.request_ms"]
+        per_replica_counts[rid] = hist["count"]
+        assert hist["count"] > 0, (rid, hist)
+    merged_hist = state["merged"]["histograms"]["plain.request_ms"]
+    assert merged_hist["count"] == sum(per_replica_counts.values())
+    assert merged_hist["replicas"] == ["r0", "r1", "r2"]
+    assert state["verdict"]["status"] == "ok", state["verdict"]
+    slo_states = {
+        o["name"]: o["state"] for o in state["slo"]["objectives"]
+    }
+    assert slo_states["fleet_routable_floor"] == "ok", slo_states
+
+    # The merged timeline tells the rotation story causally: every
+    # replica flipped (each snapshot.flip attributed to its replica)
+    # BEFORE the fleet.rotation commit event.
+    timeline = json.loads(urllib.request.urlopen(
+        base + "/fleet-timelinez?format=json&n=256", timeout=10).read())
+    events = timeline["events"]
+    flips = {
+        e["replica"]: i for i, e in enumerate(events)
+        if e["kind"] == "snapshot.flip"
+    }
+    commits = [
+        i for i, e in enumerate(events) if e["kind"] == "fleet.rotation"
+    ]
+    assert sorted(flips) == ["r0", "r1", "r2"], flips
+    assert len(commits) == 1, commits
+    assert all(i < commits[0] for i in flips.values()), (flips, commits)
+    text = urllib.request.urlopen(
+        base + "/fleet-timelinez?n=64", timeout=10).read().decode()
+    assert "fleet.rotation" in text and "r1" in text
+
+# Forced divergence: r2 stages DIFFERENT records and flips to the same
+# generation number the quorum is about to reach -- two replicas now
+# answer generation 2 with different bytes. The probe must catch it
+# and the plane must capture EXACTLY ONE fleet-wide bundle.
+coord.rotate(
+    lambda rep: (
+        delta(
+            rep.leader.server.database,
+            R1_BAD if rep.replica_id == "r2" else R0,
+        ),
+        None,
+    )
+)
+result = probe.run_cycle()
+assert result["status"] == "mismatch", result
+probe.run_cycle()  # a second divergent cycle lands in the cooldown
+export = bundles.export()
+assert export["fired"] == 1, export
+assert export["suppressed_cooldown"] >= 1, export
+entry = export["bundles"][0]
+assert entry["reason"] == "probe_failure", entry
+for source in (
+    "replica_r0", "replica_r1", "replica_r2",
+    "fleet_timeline", "fleet_status",
+):
+    assert entry["sources"][source] == "ok", (source, entry["sources"])
+with open(entry["path"] + "/fleet_timeline.json") as f:
+    bundled = json.load(f)
+assert any(
+    e["kind"] == "fleet.divergence" for e in bundled["events"]
+), [e["kind"] for e in bundled["events"]][-8:]
+for r in reps:
+    r.leader.close()
+print(
+    "fleet-obs-smoke: OK (%d lookups, /fleet-statusz per-replica+merged"
+    ", causal rotation timeline, forced divergence -> 1 fleet bundle "
+    "with all 3 replica sections)" % stats["done"]
+)
+'
+
 stage perf-gate python -m benchmarks.regression_gate --check-only \
     --history benchmarks/fixtures/history_fixture.jsonl
 

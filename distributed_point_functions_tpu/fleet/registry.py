@@ -116,7 +116,7 @@ class Replica:
             depth += sum(
                 v
                 for k, v in gauges.items()
-                if k.endswith(".queue_depth")
+                if k.split("{", 1)[0].endswith(".queue_depth")
             )
         return depth
 

@@ -83,9 +83,18 @@ class FleetRotationCoordinator:
         self._quorum = quorum
         self._clock = clock
         self._journal = journal
+        self._telemetry = None
         self._rotations = 0
         self._quorum_failures = 0
         self._last_report: Optional[dict] = None
+
+    def set_telemetry(self, telemetry):
+        """Attach a `FleetTelemetry` (duck-typed: `.sample()`) to be
+        resampled right after each rotation, so
+        `fleet.rotation_staleness_ms` reflects the flip immediately
+        instead of at the next sampler tick."""
+        self._telemetry = telemetry
+        return telemetry
 
     # -- helpers -------------------------------------------------------------
 
@@ -298,6 +307,11 @@ class FleetRotationCoordinator:
             severity="warning" if laggard_outcomes else "info",
             **{k: v for k, v in report.items() if k != "per_replica"},
         )
+        if self._telemetry is not None:
+            try:
+                self._telemetry.sample()
+            except Exception:  # noqa: BLE001 - telemetry never breaks rotation
+                pass
         return report
 
     def export(self) -> dict:

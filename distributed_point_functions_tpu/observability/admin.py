@@ -1,7 +1,7 @@
 """Operator telemetry endpoint: /metrics, /varz, /healthz, /statusz,
 /tracez, /profilez, /eventz, /probez, /debugz, /criticalz, /capacityz,
-/utilz, /timeseriesz, /fleetz — a stdlib `http.server` surface any
-session can hang off a port.
+/utilz, /timeseriesz, /fleetz, /fleet-statusz, /fleet-timelinez — a
+stdlib `http.server` surface any session can hang off a port.
 
 The serving runtime's observability state (metrics registry, flight
 recorder, stage aggregates, runtime counters, device telemetry, SLO
@@ -64,6 +64,17 @@ this server is the scrape surface:
                              queue depth and live price card, plus
                              state counts and the transition history
                              (JSON; requires a `fleet` export)
+    /fleet-statusz           the merged fleet telemetry view: fleet SLO
+                             verdict, per-replica rows (state, qps,
+                             scoped journal/TSDB counts), federated
+                             metric aggregates with replica labels and
+                             the router's spillover summary (text;
+                             `?format=json`; requires `fleet_telemetry`)
+    /fleet-timelinez         every replica's event journal interleaved
+                             on a monotonic-rebased fleet clock with
+                             replica attribution (text; `?format=json`,
+                             `?kind=`, `?n=`, `?min_severity=`;
+                             requires `fleet_telemetry`)
     /profilez?duration_ms=N  on-demand xprof capture via
                              `utils/profiling.trace` into a fresh
                              directory; returns the trace dir (bounded
@@ -145,6 +156,7 @@ class AdminServer:
         utilization=None,
         timeseries=None,
         fleet=None,
+        fleet_telemetry=None,
         identity=None,
     ):
         self._registry = registry
@@ -230,6 +242,14 @@ class AdminServer:
         # replica (and which serving generation, read live from
         # `snapshots`) produced it.
         self._fleet = fleet
+        # fleet_telemetry is the merged fleet telemetry plane (a
+        # `fleet.telemetry.FleetTelemetry` — duck-typed here because
+        # fleet/ sits ABOVE this layer: anything with `export()`,
+        # `timeline(n=, kind=, min_severity=)`, and `healthz()`).
+        # Opt-in; backs /fleet-statusz and /fleet-timelinez, and folds
+        # its verdict into /healthz (a fleet below its routable floor
+        # must drain at the front door, not per replica).
+        self._fleet_telemetry = fleet_telemetry
         self._identity = dict(identity) if identity else None
         self._name = name
         self._profile_dir = profile_dir
@@ -260,6 +280,10 @@ class AdminServer:
                 bundles.add_source("timeseries", self._timeseries_state)
             if fleet is not None:
                 bundles.add_source("fleet", self._fleet_state)
+            if fleet_telemetry is not None:
+                bundles.add_source(
+                    "fleet_telemetry", fleet_telemetry.export
+                )
         # The dispatch table IS the endpoint index: `_route` looks
         # paths up here and the 404 body is generated from the same
         # rows, so the "try ..." list can never go stale (asserted in
@@ -278,6 +302,8 @@ class AdminServer:
             ("/utilz", self._utilz),
             ("/timeseriesz", self._timeseriesz),
             ("/fleetz", self._fleetz),
+            ("/fleet-statusz", self._fleet_statusz),
+            ("/fleet-timelinez", self._fleet_timelinez),
             ("/profilez", self._profilez),
         )
         self._route_map = dict(self._routes)
@@ -422,9 +448,24 @@ class AdminServer:
             if self._slo is not None
             else []
         )
+        # Fleet verdict (opt-in): a hard fleet-SLO breach — routable
+        # replicas below the floor, stale divergence probes — degrades
+        # this endpoint the same way a local SLO breach does.
+        fleet_verdict = None
+        if self._fleet_telemetry is not None:
+            try:
+                fleet_verdict = self._fleet_telemetry.healthz()
+            except Exception as e:  # noqa: BLE001 - verdict must not 500
+                fleet_verdict = {
+                    "healthy": False,
+                    "status": f"error: {type(e).__name__}",
+                }
+        fleet_ok = fleet_verdict is None or bool(
+            fleet_verdict.get("healthy")
+        )
         if self._prober is None:
             # Legacy shape: bare liveness text, 503 on hard SLO breach.
-            if not breaches:
+            if not breaches and fleet_ok:
                 self._reply(
                     handler, 200, "text/plain; charset=utf-8", b"ok\n"
                 )
@@ -435,6 +476,12 @@ class AdminServer:
                 f"burning {b['burn_s']}s)\n"
                 for b in breaches
             )
+            if not fleet_ok:
+                lines += "".join(
+                    f"fleet breach: {b['name']} ({b['metric']} observed "
+                    f"{b['observed']} vs {b['threshold']})\n"
+                    for b in (fleet_verdict or {}).get("breaches", [])
+                ) or "fleet: degraded\n"
             self._reply(
                 handler, 503, "text/plain; charset=utf-8",
                 ("unhealthy\n" + lines).encode(),
@@ -448,13 +495,15 @@ class AdminServer:
         stale = sorted(
             k for k, v in freshness.items() if not v.get("fresh", True)
         )
-        healthy = not breaches and not stale
+        healthy = not breaches and not stale and fleet_ok
         detail = {
             "status": "ok" if healthy else "unhealthy",
             "slo_breaches": breaches,
             "probes": freshness,
             "stale_probes": stale,
         }
+        if fleet_verdict is not None:
+            detail["fleet"] = fleet_verdict
         self._reply(
             handler,
             200 if healthy else 503,
@@ -936,6 +985,118 @@ class AdminServer:
             return
         body = json.dumps(state, indent=2, default=str).encode()
         self._reply(handler, 200, "application/json", body)
+
+    def _fleet_statusz(self, handler, query: str = "") -> None:
+        """Merged fleet status: per-replica rows + federated aggregates
+        + fleet SLO verdict (text; ?format=json)."""
+        if self._fleet_telemetry is None:
+            self._reply(
+                handler, 404, "text/plain; charset=utf-8",
+                b"no fleet telemetry attached\n",
+            )
+            return
+        params = urllib.parse.parse_qs(query)
+        verdict = self._fleet_telemetry.healthz()
+        state = self._fleet_telemetry.export()
+        if params.get("format", [""])[0] == "json":
+            body = json.dumps(
+                {"verdict": verdict, **state}, indent=2, default=str
+            ).encode()
+            self._reply(handler, 200, "application/json", body)
+            return
+        lines = [
+            f"# fleet status ({state.get('name', 'fleet')}; ?format=json)",
+            f"verdict: {verdict['status']}  "
+            f"routable={verdict.get('routable')}  "
+            f"samples={state.get('samples')}",
+            "",
+            "slo:",
+        ]
+        for o in state.get("slo", {}).get("objectives", []):
+            lines.append(
+                f"  {o['name']:<28} {o['state']:<8} "
+                f"observed={o['observed']} vs {o['threshold']} "
+                f"[{o['severity']}] burn={o['burn_s']}s"
+            )
+        lines.append("")
+        lines.append("replicas:")
+        for rid, row in sorted(state.get("replicas", {}).items()):
+            ts = row.get("timeseries", {})
+            lines.append(
+                f"  {rid:<10} state={row.get('state')} "
+                f"qps={row.get('qps')} "
+                f"series={ts.get('series_count')} "
+                f"journal_events="
+                f"{row.get('journal', {}).get('emitted', 0)}"
+            )
+        merged = state.get("merged", {})
+        fleet_gauges = merged.get("fleet", {}).get("gauges", {})
+        if fleet_gauges:
+            lines.append("")
+            lines.append("fleet gauges:")
+            for name, value in sorted(fleet_gauges.items()):
+                lines.append(f"  {name:<40} {value}")
+        router = state.get("router")
+        if router:
+            lines.append("")
+            lines.append(
+                f"router: spillovers={router.get('spillovers')} "
+                f"rate={router.get('spillover_rate_pct')}% "
+                f"storms={router.get('spillover_storms')} "
+                f"fleet_sheds={router.get('fleet_sheds')}"
+            )
+        self._reply(
+            handler, 200, "text/plain; charset=utf-8",
+            ("\n".join(lines) + "\n").encode(),
+        )
+
+    def _fleet_timelinez(self, handler, query: str = "") -> None:
+        """Cross-replica event timeline, causally ordered on the
+        rebased clock (text; ?format=json ?kind= ?n= ?min_severity=)."""
+        if self._fleet_telemetry is None:
+            self._reply(
+                handler, 404, "text/plain; charset=utf-8",
+                b"no fleet telemetry attached\n",
+            )
+            return
+        params = urllib.parse.parse_qs(query)
+        kind = params.get("kind", [None])[0]
+        min_severity = params.get("min_severity", [None])[0]
+        try:
+            n = int(params.get("n", ["128"])[0])
+        except ValueError:
+            self._reply(
+                handler, 400, "text/plain; charset=utf-8",
+                b"n must be an integer\n",
+            )
+            return
+        timeline = self._fleet_telemetry.timeline(
+            n=n, kind=kind, min_severity=min_severity
+        )
+        if params.get("format", [""])[0] == "json":
+            body = json.dumps(timeline, indent=2, default=str).encode()
+            self._reply(handler, 200, "application/json", body)
+            return
+        lines = [
+            "# fleet timeline (newest last; rebased cross-replica clock;"
+            " ?format=json ?kind= ?n= ?min_severity=)",
+            "replicas: " + " ".join(timeline.get("replicas", [])),
+        ]
+        for e in timeline.get("events", []):
+            t_fleet = e.get("t_fleet")
+            when = (
+                time.strftime("%H:%M:%S", time.localtime(t_fleet))
+                if t_fleet is not None
+                else "--:--:--"
+            )
+            lines.append(
+                f"{when} {str(e.get('replica', '?')):<10} "
+                f"[{e['severity']:>7}] {e['kind']:<24} {e['message']}"
+            )
+        self._reply(
+            handler, 200, "text/plain; charset=utf-8",
+            ("\n".join(lines) + "\n").encode(),
+        )
 
     def _profilez(self, handler, query: str) -> None:
         params = urllib.parse.parse_qs(query)

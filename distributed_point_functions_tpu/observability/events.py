@@ -98,13 +98,26 @@ class EventJournal:
     monotonic source (injectable for tests). Thread-safe; `emit` is a
     deque append under one lock — cheap enough for transition-rate
     call sites (state changes, not per-request paths).
+
+    `scope` tags the journal with a replica identity: every event gains
+    a `replica` field (unless the emitter set one explicitly) and
+    coalesce keys are prefixed with the scope, so two replicas sharing
+    an emitter implementation in one process cannot coalesce each
+    other's storms together. Untagged journals (`scope=None`, the
+    default and the process-global journal) behave exactly as before.
     """
 
-    def __init__(self, capacity: int = 256, clock=time.monotonic):
+    def __init__(
+        self,
+        capacity: int = 256,
+        clock=time.monotonic,
+        scope: Optional[str] = None,
+    ):
         if capacity <= 0:
             raise ValueError("capacity must be positive")
         self._capacity = capacity
         self._clock = clock
+        self._scope = scope
         self._lock = threading.Lock()
         self._events: collections.deque = collections.deque(maxlen=capacity)
         self._seq = 0
@@ -118,6 +131,10 @@ class EventJournal:
     @property
     def capacity(self) -> int:
         return self._capacity
+
+    @property
+    def scope(self) -> Optional[str]:
+        return self._scope
 
     def emit(
         self,
@@ -136,6 +153,10 @@ class EventJournal:
             raise ValueError(
                 f"severity must be one of {SEVERITIES}, got {severity!r}"
             )
+        if self._scope is not None:
+            fields.setdefault("replica", self._scope)
+            if coalesce_key is not None:
+                coalesce_key = f"{self._scope}:{coalesce_key}"
         now = self._clock()
         trace = tracing.current_trace()
         with self._lock:
@@ -206,6 +227,7 @@ class EventJournal:
         with self._lock:
             return {
                 "capacity": self._capacity,
+                "scope": self._scope,
                 "emitted": self._emitted,
                 "coalesced": self._coalesced,
                 "dropped": max(0, self._emitted - len(self._events)),

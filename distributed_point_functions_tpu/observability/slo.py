@@ -23,6 +23,10 @@ Objective kinds:
                      crossing the budget is a bug, not load).
 * ``gauge_max``    — a registry gauge must stay <= threshold (HBM
                      watermark ceilings).
+* ``gauge_min``    — a registry gauge must stay >= threshold (a floor:
+                     the fleet's routable-replica count must not fall
+                     below quorum). An absent gauge is ``no_data``, not
+                     a breach — same grace as ``rate_min``.
 
 Config is data, not code (`SloTracker.from_config` accepts the parsed
 dict or a JSON path):
@@ -55,7 +59,7 @@ from . import events as events_mod
 
 __all__ = ["SloObjective", "SloTracker", "KINDS"]
 
-KINDS = ("p99_ms_max", "rate_min", "counter_max", "gauge_max")
+KINDS = ("p99_ms_max", "rate_min", "counter_max", "gauge_max", "gauge_min")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -161,6 +165,11 @@ class SloTracker:
             if value is None:
                 return None, "no_data"
             return value, ("ok" if value <= objective.threshold else "breach")
+        if objective.kind == "gauge_min":
+            value = export.get("gauges", {}).get(objective.metric)
+            if value is None:
+                return None, "no_data"
+            return value, ("ok" if value >= objective.threshold else "breach")
         # rate_min: needs a previous mark to compute a rate.
         value = export.get("counters", {}).get(objective.metric)
         if value is None:

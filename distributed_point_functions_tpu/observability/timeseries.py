@@ -322,6 +322,13 @@ class MetricsSampler:
     `include` filters registry names by prefix (None = the default
     prefix set; empty tuple = registry off). `clock` and `sample_once`
     make the whole pipeline deterministic; `start()` adds the thread.
+
+    `extra_sources` is a sequence of zero-arg callables returning
+    `{series_name: value}` — sampled verbatim every tick, bypassing the
+    `include` prefix filter (they are explicit by construction). The
+    fleet telemetry plane rides one sampler this way: its derived
+    gauges (`fleet.qps`, per-replica lag) become TSDB series without
+    the sampler knowing anything about fleets.
     """
 
     def __init__(
@@ -336,6 +343,7 @@ class MetricsSampler:
         journal=None,
         clock=time.monotonic,
         seed: int = 0,
+        extra_sources: Optional[Sequence] = None,
     ):
         self.store = store if store is not None else TimeSeriesStore(
             clock=clock
@@ -351,6 +359,7 @@ class MetricsSampler:
         self.watch = watch if watch is not None else AnomalyWatch(
             journal=journal
         )
+        self._extra_sources = list(extra_sources or [])
         self._clock = clock
         self._rng = random.Random(seed)
         self._stop = threading.Event()
@@ -374,6 +383,7 @@ class MetricsSampler:
         try:
             written += self._sample_registry(now)
             written += self._sample_utilization(now)
+            written += self._sample_extra(now)
             with self._lock:
                 self._samples_taken += 1
         except Exception:  # noqa: BLE001 - sampling never raises
@@ -405,6 +415,26 @@ class MetricsSampler:
                     written += self._put(
                         f"{name}.{suffix}", hist.get(suffix), now
                     )
+        return written
+
+    def add_extra_source(self, source) -> None:
+        """Register one more `() -> {series_name: value}` callable."""
+        with self._lock:
+            self._extra_sources.append(source)
+
+    def _sample_extra(self, now: float) -> int:
+        with self._lock:
+            sources = list(self._extra_sources)
+        written = 0
+        for source in sources:
+            try:
+                values = source() or {}
+            except Exception:  # noqa: BLE001 - sampling never raises
+                with self._lock:
+                    self._errors += 1
+                continue
+            for name, value in values.items():
+                written += self._put(name, value, now)
         return written
 
     def _sample_utilization(self, now: float) -> int:

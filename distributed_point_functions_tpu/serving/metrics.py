@@ -71,6 +71,16 @@ def labeled_name(base: str, labels: Optional[Dict[str, object]] = None) -> str:
     return f"{base}{{{inner}}}"
 
 
+def split_labeled_name(name: str):
+    """Inverse of `labeled_name`: `base{k=v,k2=v2}` -> (base, {k: v}).
+    Values come back as strings (labels are stringified on the way in)."""
+    if name.endswith("}") and "{" in name:
+        base, _, inner = name.partition("{")
+        pairs = [p.split("=", 1) for p in inner[:-1].split(",") if p]
+        return base, {k: v for k, v in pairs}
+    return name, {}
+
+
 class Counter:
     """Monotonic counter."""
 
@@ -276,6 +286,29 @@ class MetricsRegistry:
         last reset, instead of a since-process-start aggregate."""
         return self.export()
 
+    def histogram_counts(self, suffix: str) -> Dict[str, int]:
+        """Observation counts for histograms whose base name (labels
+        stripped) ends with `suffix`. O(matching histograms) with no
+        reservoir sort — the fleet QPS derivation polls this on every
+        telemetry sample, where a full `export()` would sort every
+        reservoir just to read one integer."""
+        with self._lock:
+            items = list(self._histograms.items())
+        return {
+            name: h.count
+            for name, h in items
+            if name.split("{", 1)[0].endswith(suffix)
+        }
+
+    def scoped(self, labels: Dict[str, object]) -> "ScopedRegistry":
+        """Cheap child registry: every instrument created through it
+        carries `labels` (e.g. `{"replica": "r0"}`) merged into the
+        call-site labels, and its `export`/`snapshot`/`reset` see only
+        its own slice of the parent namespace. The parent keeps the
+        single flat store, so `default_telemetry().bind_registry(parent)`
+        mirrors and `parent.reset()` keep their existing semantics."""
+        return ScopedRegistry(self, labels)
+
     def reset(self) -> None:
         """Zero every instrument IN PLACE so metric state cannot leak
         across test cases or bench repetitions sharing one registry.
@@ -290,5 +323,94 @@ class MetricsRegistry:
                 + list(self._gauges.values())
                 + list(self._histograms.values())
             )
+        for instrument in instruments:
+            instrument._reset()
+
+
+class ScopedRegistry:
+    """Label-scoped view over a parent `MetricsRegistry`.
+
+    Construction is O(len(labels)) and allocates no instrument storage:
+    the parent owns every Counter/Gauge/Histogram, this view only merges
+    its scope labels into each lookup. That makes one process hosting N
+    replicas cheap — N views over one registry — while `reset()` and
+    `snapshot()` on a view touch only instruments whose name carries
+    all of the view's labels, so one replica's bench reset cannot zero
+    its neighbors (the `reset()`/`snapshot()` interplay that a shared
+    flat registry used to get wrong). Nested `scoped()` composes by
+    merging label dicts (child wins on key conflicts).
+    """
+
+    def __init__(self, parent: MetricsRegistry, labels: Dict[str, object]):
+        if not labels:
+            raise ValueError("a scoped registry needs at least one label")
+        labeled_name("scope", labels)  # validate reserved characters now
+        self._parent = parent
+        self._labels = {str(k): str(v) for k, v in labels.items()}
+
+    @property
+    def parent(self) -> MetricsRegistry:
+        return self._parent
+
+    @property
+    def labels(self) -> Dict[str, str]:
+        return dict(self._labels)
+
+    def _merged(self, labels: Optional[Dict]) -> Dict:
+        if not labels:
+            return dict(self._labels)
+        merged = dict(self._labels)
+        merged.update(labels)
+        return merged
+
+    def counter(self, name: str, labels: Optional[Dict] = None) -> Counter:
+        return self._parent.counter(name, self._merged(labels))
+
+    def gauge(self, name: str, labels: Optional[Dict] = None) -> Gauge:
+        return self._parent.gauge(name, self._merged(labels))
+
+    def histogram(
+        self,
+        name: str,
+        buckets: Sequence[float] = DEFAULT_BUCKETS_MS,
+        labels: Optional[Dict] = None,
+    ) -> Histogram:
+        return self._parent.histogram(name, buckets, self._merged(labels))
+
+    def timed(self, name: str, labels: Optional[Dict] = None):
+        return self._parent.timed(name, self._merged(labels))
+
+    def scoped(self, labels: Dict[str, object]) -> "ScopedRegistry":
+        return ScopedRegistry(self._parent, self._merged(labels))
+
+    def owns(self, name: str) -> bool:
+        """True when instrument `name` carries every scope label."""
+        _, labels = split_labeled_name(name)
+        return all(labels.get(k) == v for k, v in self._labels.items())
+
+    def export(self) -> dict:
+        full = self._parent.export()
+        return {
+            kind: {k: v for k, v in section.items() if self.owns(k)}
+            for kind, section in full.items()
+        }
+
+    def snapshot(self) -> dict:
+        return self.export()
+
+    def reset(self) -> None:
+        """Zero only this view's slice of the parent (in place, same
+        live-object guarantee as `MetricsRegistry.reset`)."""
+        with self._parent._lock:
+            instruments = [
+                obj
+                for section in (
+                    self._parent._counters,
+                    self._parent._gauges,
+                    self._parent._histograms,
+                )
+                for name, obj in section.items()
+                if self.owns(name)
+            ]
         for instrument in instruments:
             instrument._reset()

@@ -308,6 +308,12 @@ class Prober:
     def name(self) -> str:
         return self._name
 
+    def set_journal(self, journal):
+        """Point probe events at a (replica-scoped) journal; None
+        restores the process journal."""
+        self._journal = journal
+        return journal
+
     def kinds(self) -> List[str]:
         """The probe kinds this prober runs each cycle."""
         out = list(_IDENTITY_KINDS) if self._dense else []
@@ -952,6 +958,7 @@ class CrossReplicaProbe:
         self._cycles = 0
         self._divergences = 0
         self._errors = 0
+        self._last_pass_mono: Optional[float] = None
         self._failure_listeners: List[Callable[[dict], None]] = []
 
         n = len(records)
@@ -969,6 +976,12 @@ class CrossReplicaProbe:
         # replicas' reconstructions is divergence by construction.
         client = DenseDpfPirClient(n, lambda pt, info: pt)
         self._plain_pair = client.create_plain_requests(self._indices)
+
+    def set_journal(self, journal):
+        """Point divergence events at a specific journal; None restores
+        the process journal."""
+        self._journal = journal
+        return journal
 
     def add_failure_listener(self, listener: Callable[[dict], None]) -> None:
         """`listener(result)` on every divergence/error cycle (wire
@@ -1094,6 +1107,8 @@ class CrossReplicaProbe:
                 self._divergences += 1
             if errors:
                 self._errors += len(errors)
+            if status == "pass":
+                self._last_pass_mono = self._clock()
             listeners = list(self._failure_listeners)
         result = {
             "kind": self._name,
@@ -1142,7 +1157,18 @@ class CrossReplicaProbe:
                     pass
         return result
 
+    def last_pass_age_s(self) -> Optional[float]:
+        """Seconds since the last fully passing cycle — the fleet SLO
+        "divergence-probe freshness" reads this. None until the probe
+        has passed once (graded as no_data, not a breach: a fleet that
+        has not been probed yet is not failing its SLO)."""
+        with self._lock:
+            if self._last_pass_mono is None:
+                return None
+            return max(0.0, self._clock() - self._last_pass_mono)
+
     def export(self) -> dict:
+        age = self.last_pass_age_s()
         with self._lock:
             return {
                 "name": self._name,
@@ -1151,5 +1177,8 @@ class CrossReplicaProbe:
                 "cycles": self._cycles,
                 "divergences": self._divergences,
                 "errors": self._errors,
+                "last_pass_age_s": (
+                    round(age, 3) if age is not None else None
+                ),
                 "history": [dict(r) for r in self._history],
             }

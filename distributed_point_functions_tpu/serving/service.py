@@ -334,6 +334,12 @@ class _Session:
         # helper leg below) report busy/idle intervals into the
         # process-wide tracker; gauges/bubble histograms mirror into
         # this session's registry. config.utilization=False detaches.
+        # Replica-scoped event routing: None means the process-global
+        # journal (single-replica deployments, unchanged); the fleet
+        # telemetry plane points this at a scoped journal so breaker
+        # transitions, degraded-mode flips, and generation-skew lines
+        # carry replica identity when N replicas share one process.
+        self._session_journal = None
         self._util = None
         if self._config.utilization:
             self._util = default_utilization_tracker()
@@ -378,6 +384,38 @@ class _Session:
         if self._batcher is not None:
             self._batcher.set_generation_source(manager)
         return manager
+
+    def set_journal(self, journal):
+        """Route this session's own events through `journal` (a
+        replica-scoped `EventJournal`); None restores the process
+        journal."""
+        self._session_journal = journal
+        return journal
+
+    def _emit(self, kind, message, severity="info", **fields):
+        journal = (
+            self._session_journal
+            if getattr(self, "_session_journal", None) is not None
+            else events_mod.default_journal()
+        )
+        try:
+            journal.emit(kind, message, severity=severity, **fields)
+        except Exception:  # noqa: BLE001 - journaling never breaks serving
+            pass
+
+    def set_utilization(self, tracker):
+        """Swap this session's utilization tracker — the fleet telemetry
+        plane rebinds each replica's sessions to a replica-scoped
+        tracker so N replicas in one process stop reporting busy/idle
+        into the shared process-global one. Mirrors the construction
+        wiring: gauges bind into this session's registry and the batcher
+        threads report into the new tracker from the next interval on."""
+        self._util = tracker
+        if tracker is not None:
+            tracker.bind_registry(self.metrics)
+            if self._batcher is not None:
+                self._batcher.set_utilization(tracker)
+        return tracker
 
     # -- QoS / brownout -----------------------------------------------------
 
@@ -582,7 +620,7 @@ class _Session:
                 # is the enforcement point — but worth a (coalesced)
                 # line on the timeline while the rotation window is
                 # open.
-                events_mod.emit(
+                self._emit(
                     "snapshot.mismatch",
                     f"request bound generation {req_generation}, "
                     f"evaluated against {served_generation}",
@@ -760,7 +798,7 @@ class LeaderSession(_Session):
 
     def _on_breaker_transition(self, old: str, new: str) -> None:
         self._g_breaker.set(float(self._breaker.state_code()))
-        events_mod.emit(
+        self._emit(
             "breaker.transition",
             f"helper-leg breaker {old} -> {new}",
             severity="error" if new == "open" else "info",
@@ -1177,7 +1215,7 @@ class LeaderSession(_Session):
             if not self._degraded:
                 self._degraded = True
                 self._g_degraded.set(1.0)
-                events_mod.emit(
+                self._emit(
                     "service.degraded",
                     "helper unavailable; serving leader-share-only",
                     severity="error",

@@ -153,6 +153,13 @@ class SnapshotManager:
                 if self._staging is not None else None
             )
 
+    def set_journal(self, journal):
+        """Point rotation events at a (replica-scoped) journal; None
+        restores the process journal. The fleet telemetry plane calls
+        this so flips/aborts/drains carry replica identity."""
+        self._journal = journal
+        return journal
+
     def _emit(self, kind, message, severity="info", **fields):
         journal = (
             self._journal

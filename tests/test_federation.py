@@ -1,0 +1,525 @@
+"""Federation merge rules, scoped telemetry identity, and fleet SLOs.
+
+The contracts under test: merge_metrics sums counters, means
+proportion gauges, bucket-merges same-layout histograms (and surfaces
+layout conflicts in `skipped`, never silently averaging); the merged
+timeline rebases each replica's monotonic clock by the median
+wall-mono offset so causal order survives skewed clocks; scoped
+EventJournals stamp replica identity and prefix coalesce keys so two
+replicas' storms cannot merge; ScopedRegistry is a label-scoped view
+whose reset/snapshot touch only its own slice; N samplers into scoped
+TSDBs never bleed series across replicas and honor `max_series`; and
+the `gauge_min` SLO kind breaches below the floor.
+"""
+
+import pytest
+
+from distributed_point_functions_tpu.observability import federation
+from distributed_point_functions_tpu.observability.events import EventJournal
+from distributed_point_functions_tpu.observability.slo import (
+    SloObjective,
+    SloTracker,
+)
+from distributed_point_functions_tpu.observability.timeseries import (
+    MetricsSampler,
+    TimeSeriesStore,
+)
+from distributed_point_functions_tpu.serving.metrics import (
+    MetricsRegistry,
+    split_labeled_name,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# Merge rules
+# ---------------------------------------------------------------------------
+
+
+class TestMergeRules:
+    def test_counters_sum_with_per_replica_attribution(self):
+        merged = federation.merge_metrics(
+            {
+                "r0": {"counters": {"x.requests": 3}},
+                "r1": {"counters": {"x.requests": 5}},
+            }
+        )
+        row = merged["counters"]["x.requests"]
+        assert row["value"] == 8
+        assert row["rule"] == "sum"
+        assert row["per_replica"] == {"r0": 3, "r1": 5}
+        assert merged["rows"]["counters"] == {
+            "x.requests{replica=r0}": 3,
+            "x.requests{replica=r1}": 5,
+        }
+
+    def test_gauges_sum_by_default_mean_for_proportions(self):
+        merged = federation.merge_metrics(
+            {
+                "r0": {
+                    "gauges": {"q.depth": 4.0, "util.duty_cycle_pct": 90.0}
+                },
+                "r1": {
+                    "gauges": {"q.depth": 6.0, "util.duty_cycle_pct": 70.0}
+                },
+            }
+        )
+        assert merged["gauges"]["q.depth"]["value"] == 10.0
+        assert merged["gauges"]["q.depth"]["rule"] == "sum"
+        duty = merged["gauges"]["util.duty_cycle_pct"]
+        assert duty["value"] == pytest.approx(80.0)
+        assert duty["rule"] == "mean"
+
+    def test_mean_suffixes_cover_the_proportion_family(self):
+        for name in ("a_pct", "b_ratio", "c_efficiency", "d_factor"):
+            assert federation.gauge_rule(name) == "mean"
+        assert federation.gauge_rule("queue_depth") == "sum"
+        # Labels don't confuse the rule.
+        assert federation.gauge_rule("a_pct{replica=r0}") == "mean"
+
+    def test_missing_replica_rows_merge_what_exists(self):
+        merged = federation.merge_metrics(
+            {
+                "r0": {"counters": {"only_r0": 2}},
+                "r1": {},
+                "r2": None,
+            }
+        )
+        assert merged["replicas"] == ["r0", "r1", "r2"]
+        assert merged["counters"]["only_r0"]["per_replica"] == {"r0": 2}
+
+    def test_label_replica_preserves_sorted_pairs(self):
+        assert (
+            federation.label_replica("x.requests", "r1")
+            == "x.requests{replica=r1}"
+        )
+        assert (
+            federation.label_replica("x.requests{tenant=a}", "r1")
+            == "x.requests{replica=r1,tenant=a}"
+        )
+        # Round-trips through the registry's own parser.
+        base, labels = split_labeled_name(
+            federation.label_replica("x{zz=1}", "r0")
+        )
+        assert base == "x" and labels == {"zz": "1", "replica": "r0"}
+
+
+class TestHistogramMerge:
+    @staticmethod
+    def _hist(registry, name, values):
+        h = registry.histogram(name, buckets=(1.0, 10.0, 100.0))
+        for v in values:
+            h.observe(v)
+        return registry.export()["histograms"][name]
+
+    def test_bucket_merge_sums_counts_and_estimates_percentiles(self):
+        r0, r1 = MetricsRegistry(), MetricsRegistry()
+        h0 = self._hist(r0, "lat_ms", [0.5] * 50)
+        h1 = self._hist(r1, "lat_ms", [50.0] * 50)
+        merged = federation.merge_histograms({"r0": h0, "r1": h1})
+        assert merged is not None
+        assert merged["count"] == 100
+        assert merged["sum"] == pytest.approx(0.5 * 50 + 50.0 * 50)
+        assert merged["max"] == pytest.approx(max(h0["max"], h1["max"]))
+        assert merged["replicas"] == ["r0", "r1"]
+        # p50 lands in the first bucket (<=1ms), p99 in the 10..100 one:
+        # the merged view knows half the fleet was fast and the tail
+        # slow, which neither replica's own percentiles could say.
+        assert merged["p50"] <= 1.0
+        assert 10.0 < merged["p99"] <= 100.0
+
+    def test_layout_conflict_is_skipped_not_averaged(self):
+        r0, r1 = MetricsRegistry(), MetricsRegistry()
+        h0 = self._hist(r0, "lat_ms", [1.0])
+        h1 = r1.histogram("lat_ms", buckets=(2.0, 20.0))
+        h1.observe(1.0)
+        merged = federation.merge_metrics(
+            {
+                "r0": {"histograms": {"lat_ms": h0}},
+                "r1": {
+                    "histograms": {
+                        "lat_ms": r1.export()["histograms"]["lat_ms"]
+                    }
+                },
+            }
+        )
+        assert merged["histograms"] == {}
+        assert merged["skipped"] == ["lat_ms"]
+
+    def test_percentile_interpolates_within_winning_bucket(self):
+        # 100 observations all in the (0, 10] bucket: rank 50 sits
+        # half-way up the bucket by linear interpolation.
+        buckets = {"10.0": 100, "+inf": 0}
+        p50 = federation.percentile_from_buckets(buckets, 100, 50)
+        assert p50 == pytest.approx(5.0)
+
+    def test_percentile_inf_clamps_to_largest_finite_bound(self):
+        buckets = {"10.0": 1, "+inf": 99}
+        p99 = federation.percentile_from_buckets(buckets, 100, 99)
+        assert p99 == pytest.approx(10.0)
+
+    def test_percentile_empty_is_none(self):
+        assert federation.percentile_from_buckets({}, 0, 50) is None
+
+    def test_merged_flat_is_registry_shaped(self):
+        flat = federation.merged_flat(
+            {
+                "r0": {"counters": {"c": 1}, "gauges": {"g_pct": 10.0}},
+                "r1": {"counters": {"c": 2}, "gauges": {"g_pct": 30.0}},
+            }
+        )
+        assert flat["counters"] == {"c": 3}
+        assert flat["gauges"] == {"g_pct": pytest.approx(20.0)}
+        assert flat["histograms"] == {}
+
+
+# ---------------------------------------------------------------------------
+# Timeline federation: skewed clocks
+# ---------------------------------------------------------------------------
+
+
+class TestTimelineMerge:
+    def test_rebase_offset_is_median_robust_to_stepped_wall(self):
+        events = [
+            {"t_wall": 1000.0, "t_mono": 10.0},
+            {"t_wall": 1001.0, "t_mono": 11.0},
+            # One stepped wall stamp (NTP jump) must not drag the offset.
+            {"t_wall": 5000.0, "t_mono": 12.0},
+        ]
+        assert federation.rebase_offset(events) == pytest.approx(990.0)
+        assert federation.rebase_offset([]) is None
+
+    def test_skewed_monotonic_clocks_merge_causally(self):
+        # Two replicas whose monotonic clocks share no epoch: replica a
+        # booted long ago (t_mono ~ 5000), replica b just booted
+        # (t_mono ~ 3). Wall clocks roughly agree. The causal story is
+        # a1 -> b1 -> a2 -> b2; raw monotonic order would give a1, a2
+        # first.
+        journal_a = [
+            {"kind": "step.a1", "t_wall": 100.0, "t_mono": 5000.0, "seq": 1},
+            {"kind": "step.a2", "t_wall": 102.0, "t_mono": 5002.0, "seq": 2},
+        ]
+        journal_b = [
+            {"kind": "step.b1", "t_wall": 101.0, "t_mono": 3.0, "seq": 1},
+            {"kind": "step.b2", "t_wall": 103.0, "t_mono": 5.0, "seq": 2},
+        ]
+        merged = federation.merge_timelines({"a": journal_a, "b": journal_b})
+        kinds = [e["kind"] for e in merged["events"]]
+        assert kinds == ["step.a1", "step.b1", "step.a2", "step.b2"]
+        # Every event carries the replica attribution and the rebased
+        # stamp; the offsets are surfaced as the audit trail.
+        assert [e["replica"] for e in merged["events"]] == [
+            "a", "b", "a", "b",
+        ]
+        assert all(e["t_fleet"] is not None for e in merged["events"])
+        assert merged["offsets"]["a"] == pytest.approx(-4900.0)
+        assert merged["offsets"]["b"] == pytest.approx(98.0)
+
+    def test_intra_replica_order_survives_rebase(self):
+        # A replica's own monotonic order is preserved exactly even
+        # when its wall clock stepped backwards mid-story.
+        journal = [
+            {"kind": "first", "t_wall": 200.0, "t_mono": 10.0, "seq": 1},
+            {"kind": "second", "t_wall": 150.0, "t_mono": 11.0, "seq": 2},
+            {"kind": "third", "t_wall": 201.0, "t_mono": 12.0, "seq": 3},
+        ]
+        merged = federation.merge_timelines({"a": journal})
+        assert [e["kind"] for e in merged["events"]] == [
+            "first", "second", "third",
+        ]
+
+    def test_kind_and_severity_filters_and_n(self):
+        journal = [
+            {
+                "kind": "fleet.rotation", "t_wall": 1.0, "t_mono": 1.0,
+                "seq": 1, "severity": "info",
+            },
+            {
+                "kind": "fleet.rotation.abort", "t_wall": 2.0, "t_mono": 2.0,
+                "seq": 2, "severity": "error",
+            },
+            {
+                "kind": "other", "t_wall": 3.0, "t_mono": 3.0,
+                "seq": 3, "severity": "warning",
+            },
+        ]
+        by_kind = federation.merge_timelines({"a": journal}, kind="fleet.rotation")
+        assert [e["kind"] for e in by_kind["events"]] == [
+            "fleet.rotation", "fleet.rotation.abort",
+        ]
+        by_sev = federation.merge_timelines({"a": journal}, min_severity="warning")
+        assert [e["kind"] for e in by_sev["events"]] == [
+            "fleet.rotation.abort", "other",
+        ]
+        newest = federation.merge_timelines({"a": journal}, n=1)
+        assert [e["kind"] for e in newest["events"]] == ["other"]
+
+    def test_journal_export_shape_is_accepted(self):
+        clock = FakeClock(5.0)
+        journal = EventJournal(capacity=8, clock=clock, scope="r0")
+        journal.emit("boot", "up")
+        merged = federation.merge_timelines({"r0": journal.export()})
+        assert merged["count"] == 1
+        assert merged["events"][0]["replica"] == "r0"
+
+
+# ---------------------------------------------------------------------------
+# Scoped event identity (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+class TestScopedJournal:
+    def test_scope_stamps_replica_field(self):
+        journal = EventJournal(capacity=8, scope="r1")
+        event = journal.emit("breaker.transition", "open")
+        assert event["replica"] == "r1"
+        assert journal.scope == "r1"
+        assert journal.export()["scope"] == "r1"
+
+    def test_explicit_replica_field_wins_over_scope(self):
+        journal = EventJournal(capacity=8, scope="r1")
+        event = journal.emit("x", "y", replica="override")
+        assert event["replica"] == "override"
+
+    def test_unscoped_journal_unchanged(self):
+        journal = EventJournal(capacity=8)
+        event = journal.emit("x", "y")
+        assert "replica" not in event
+        assert journal.scope is None
+        assert journal.export()["scope"] is None
+
+    def test_coalesce_keys_do_not_collide_across_scopes(self):
+        # Two replicas' scoped views emitting the same coalesce key into
+        # the SAME underlying capacity regime must not merge each
+        # other's storms; an unscoped emitter with the same key is a
+        # third identity.
+        clock = FakeClock(1.0)
+        a = EventJournal(capacity=16, clock=clock, scope="ra")
+        b = EventJournal(capacity=16, clock=clock, scope="rb")
+        plain = EventJournal(capacity=16, clock=clock)
+        for journal in (a, b, plain):
+            journal.emit("shed", "x", coalesce_key="storm", coalesce_s=60.0)
+            journal.emit("shed", "x", coalesce_key="storm", coalesce_s=60.0)
+        # Each journal coalesced its own repeat...
+        assert len(a.export()["events"]) == 1
+        assert a.export()["events"][0]["repeats"] == 1
+        # ...under a scope-prefixed key, so identities stay distinct.
+        assert a._coalesce.keys() == {"ra:storm"}
+        assert b._coalesce.keys() == {"rb:storm"}
+        assert plain._coalesce.keys() == {"storm"}
+
+
+# ---------------------------------------------------------------------------
+# ScopedRegistry (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+class TestScopedRegistry:
+    def test_labels_merge_into_call_sites(self):
+        parent = MetricsRegistry()
+        scoped = parent.scoped({"replica": "r0"})
+        scoped.counter("x.requests").inc(2)
+        scoped.gauge("x.depth", labels={"tenant": "a"}).set(3.0)
+        export = parent.export()
+        assert export["counters"] == {"x.requests{replica=r0}": 2}
+        assert export["gauges"] == {"x.depth{replica=r0,tenant=a}": 3.0}
+
+    def test_export_sees_only_own_slice(self):
+        parent = MetricsRegistry()
+        r0 = parent.scoped({"replica": "r0"})
+        r1 = parent.scoped({"replica": "r1"})
+        r0.counter("c").inc(1)
+        r1.counter("c").inc(5)
+        parent.counter("unscoped").inc(9)
+        assert r0.export()["counters"] == {"c{replica=r0}": 1}
+        assert r1.snapshot()["counters"] == {"c{replica=r1}": 5}
+        assert parent.export()["counters"]["unscoped"] == 9
+
+    def test_scoped_reset_leaves_siblings_and_parent_alone(self):
+        parent = MetricsRegistry()
+        r0 = parent.scoped({"replica": "r0"})
+        r1 = parent.scoped({"replica": "r1"})
+        c0 = r0.counter("c")
+        c0.inc(3)
+        r1.counter("c").inc(5)
+        parent.counter("unscoped").inc(7)
+        r0.histogram("h").observe(1.0)
+        r0.reset()
+        export = parent.export()
+        assert export["counters"]["c{replica=r0}"] == 0
+        assert export["counters"]["c{replica=r1}"] == 5
+        assert export["counters"]["unscoped"] == 7
+        assert export["histograms"]["h{replica=r0}"]["count"] == 0
+        # In-place: the live object the holder kept keeps working.
+        c0.inc(1)
+        assert parent.export()["counters"]["c{replica=r0}"] == 1
+
+    def test_parent_reset_zeroes_everything_in_place(self):
+        parent = MetricsRegistry()
+        scoped = parent.scoped({"replica": "r0"})
+        counter = scoped.counter("c")
+        counter.inc(3)
+        parent.gauge("g").set(2.0)
+        parent.reset()
+        export = parent.export()
+        assert export["counters"] == {"c{replica=r0}": 0}
+        assert export["gauges"] == {"g": 0.0}
+        counter.inc(1)
+        assert parent.export()["counters"]["c{replica=r0}"] == 1
+
+    def test_nested_scopes_compose(self):
+        parent = MetricsRegistry()
+        inner = parent.scoped({"replica": "r0"}).scoped({"tenant": "t"})
+        inner.counter("c").inc()
+        assert parent.export()["counters"] == {
+            "c{replica=r0,tenant=t}": 1
+        }
+
+    def test_empty_labels_rejected(self):
+        with pytest.raises(ValueError):
+            MetricsRegistry().scoped({})
+
+
+# ---------------------------------------------------------------------------
+# N trackers in one process: no series bleed, budget honored (satellite 4)
+# ---------------------------------------------------------------------------
+
+
+class TestPerReplicaSampling:
+    def test_n_samplers_separate_stores_no_bleed(self):
+        clock = FakeClock(10.0)
+        registries = {}
+        stores = {}
+        samplers = {}
+        for rid in ("r0", "r1", "r2"):
+            registry = MetricsRegistry()
+            registry.gauge("leader.q_depth").set(float(len(stores)))
+            store = TimeSeriesStore(max_series=8, clock=clock)
+            registries[rid] = registry
+            stores[rid] = store
+            samplers[rid] = MetricsSampler(
+                store=store, registry=registry, clock=clock
+            )
+        for sampler in samplers.values():
+            sampler.sample_once(clock())
+        for i, rid in enumerate(("r0", "r1", "r2")):
+            assert stores[rid].names() == ["leader.q_depth"]
+            points = stores[rid].series("leader.q_depth", now=clock())
+            assert points[-1][1] == float(i)
+
+    def test_shared_store_with_replica_labels_no_bleed(self):
+        clock = FakeClock(10.0)
+        parent = MetricsRegistry()
+        store = TimeSeriesStore(max_series=8, clock=clock)
+        for i, rid in enumerate(("r0", "r1")):
+            parent.scoped({"replica": rid}).gauge("leader.q_depth").set(
+                float(i)
+            )
+        MetricsSampler(store=store, registry=parent, clock=clock).sample_once(
+            clock()
+        )
+        assert store.names() == [
+            "leader.q_depth{replica=r0}",
+            "leader.q_depth{replica=r1}",
+        ]
+        assert store.series("leader.q_depth{replica=r0}", now=clock())[-1][
+            1
+        ] == 0.0
+        assert store.series("leader.q_depth{replica=r1}", now=clock())[-1][
+            1
+        ] == 1.0
+
+    def test_max_series_budget_under_per_replica_labels(self):
+        clock = FakeClock(10.0)
+        parent = MetricsRegistry()
+        store = TimeSeriesStore(max_series=4, clock=clock)
+        sampler = MetricsSampler(store=store, registry=parent, clock=clock)
+        for i in range(10):
+            parent.scoped({"replica": f"r{i}"}).gauge("leader.q").set(1.0)
+        sampler.sample_once(clock())
+        export = store.export(clock())
+        assert export["series_count"] == 4
+        assert export["dropped_series"] == 6
+        assert store.occupancy() <= store.slot_budget()
+
+    def test_extra_sources_bypass_prefix_filter(self):
+        clock = FakeClock(10.0)
+        store = TimeSeriesStore(max_series=8, clock=clock)
+        sampler = MetricsSampler(
+            store=store,
+            clock=clock,
+            extra_sources=[lambda: {"fleet.qps": 12.0}],
+        )
+        sampler.add_extra_source(lambda: {"fleet.routable_replicas": 3.0})
+        written = sampler.sample_once(clock())
+        assert written == 2
+        assert store.names() == ["fleet.qps", "fleet.routable_replicas"]
+
+    def test_extra_source_errors_counted_not_raised(self):
+        clock = FakeClock(10.0)
+        store = TimeSeriesStore(max_series=8, clock=clock)
+
+        def broken():
+            raise RuntimeError("scrape failed")
+
+        sampler = MetricsSampler(
+            store=store, clock=clock, extra_sources=[broken]
+        )
+        assert sampler.sample_once(clock()) == 0
+        assert sampler.export()["errors"] == 1
+
+
+# ---------------------------------------------------------------------------
+# gauge_min SLO kind
+# ---------------------------------------------------------------------------
+
+
+class TestGaugeMinSlo:
+    @staticmethod
+    def _tracker(registry, clock):
+        return SloTracker(
+            [
+                SloObjective(
+                    name="routable_floor",
+                    kind="gauge_min",
+                    metric="fleet.routable_replicas",
+                    threshold=2.0,
+                    severity="hard",
+                )
+            ],
+            registry,
+            clock=clock,
+        )
+
+    def test_breach_below_floor_ok_at_floor(self):
+        clock = FakeClock(1.0)
+        registry = MetricsRegistry()
+        tracker = self._tracker(registry, clock)
+        registry.gauge("fleet.routable_replicas").set(3.0)
+        (record,) = tracker.evaluate()
+        assert record["state"] == "ok"
+        registry.gauge("fleet.routable_replicas").set(1.0)
+        (record,) = tracker.evaluate()
+        assert record["state"] == "breach"
+        assert tracker.breaches(evaluate=True)
+        registry.gauge("fleet.routable_replicas").set(2.0)
+        (record,) = tracker.evaluate()
+        assert record["state"] == "ok"
+
+    def test_absent_gauge_is_no_data_not_breach(self):
+        clock = FakeClock(1.0)
+        tracker = self._tracker(MetricsRegistry(), clock)
+        (record,) = tracker.evaluate()
+        assert record["state"] == "no_data"
+        assert tracker.breaches(evaluate=True) == []

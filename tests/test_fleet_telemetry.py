@@ -1,0 +1,588 @@
+"""Fleet telemetry plane: scopes, aggregation, SLOs, bundles, hops.
+
+The contracts under test: `ReplicaTelemetry` threads one replica's
+identity into its journal/registry/TSDB scope and scrapes to a plain
+dict; `FleetTelemetry.sample()` derives the fleet gauges (routable
+floor, QPS from request-count deltas, generation lag, spillover rate,
+rotation staleness, probe age) and grades the fleet SLOs; a hard
+breach degrades the fleet healthz verdict and fires ONE fleet-wide
+debug bundle holding every replica's section plus the merged timeline;
+the router stamps `(replica, attempt, reason)` hop records so a
+primary-shed -> spillover-served request reads as one trace; and the
+admin endpoints `/fleet-statusz` / `/fleet-timelinez` render it all in
+text and JSON.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import pytest
+
+from distributed_point_functions_tpu.fleet import (
+    FleetRouter,
+    FleetTelemetry,
+    Replica,
+    ReplicaSet,
+    ReplicaTelemetry,
+    default_fleet_objectives,
+)
+from distributed_point_functions_tpu.observability import tracing
+from distributed_point_functions_tpu.observability.admin import AdminServer
+from distributed_point_functions_tpu.observability.bundle import BundleManager
+from distributed_point_functions_tpu.observability.events import EventJournal
+from distributed_point_functions_tpu.serving.batcher import Overloaded
+from distributed_point_functions_tpu.serving.metrics import MetricsRegistry
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+class StubCapacity:
+    def __init__(self, device_ms=1.0):
+        self.device_ms = float(device_ms)
+        self.replica = None
+
+    def set_replica(self, rid):
+        self.replica = rid
+
+    def price_export(self, num_keys=8, num_blocks=None):
+        return {
+            "replica": self.replica,
+            "probe_keys": num_keys,
+            "device_ms": self.device_ms,
+            "device_ms_per_key": self.device_ms / max(1, num_keys),
+            "bytes_peak": 0,
+            "queries_per_sec": 100.0,
+        }
+
+
+class StubSession:
+    """Duck-typed leader session that records request latency like the
+    real ones (`<role>.request_ms`) so QPS derivation has a source."""
+
+    def __init__(self, name, generation=0, shed=None):
+        self.name = name
+        self.shed = shed  # None, or an Overloaded to raise
+        self.breaker = None
+        self.degraded = False
+        self.metrics = MetricsRegistry()
+        self.server = SimpleNamespace(
+            database=SimpleNamespace(generation=generation), role="plain"
+        )
+
+    def handle_request(self, request, deadline=None, tenant="default"):
+        if self.shed is not None:
+            raise self.shed
+        self.metrics.histogram("plain.request_ms").observe(1.0)
+        return f"resp:{self.name}"
+
+
+def make_replica(rid, generation=0, device_ms=1.0, shed=None):
+    return Replica(
+        rid,
+        StubSession(rid, generation, shed),
+        capacity=StubCapacity(device_ms),
+    )
+
+
+def make_fleet(clock, n=3, journal=None, **telemetry_kwargs):
+    journal = journal if journal is not None else EventJournal(
+        capacity=64, clock=clock
+    )
+    replica_set = ReplicaSet(journal=journal)
+    replicas = [replica_set.add(make_replica(f"r{i}")) for i in range(n)]
+    telemetry = FleetTelemetry(
+        replica_set, journal=journal, clock=clock, **telemetry_kwargs
+    )
+    for replica in replicas:
+        telemetry.scope(replica)
+    return replica_set, replicas, telemetry
+
+
+@pytest.fixture
+def recorder():
+    prev = tracing.default_recorder()
+    rec = tracing.set_default_recorder(tracing.FlightRecorder())
+    yield rec
+    tracing.set_default_recorder(prev)
+
+
+# ---------------------------------------------------------------------------
+# ReplicaTelemetry
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaTelemetry:
+    def test_scope_is_replica_tagged(self):
+        clock = FakeClock()
+        telemetry = ReplicaTelemetry("r7", clock=clock)
+        event = telemetry.journal.emit("breaker.transition", "open")
+        assert event["replica"] == "r7"
+        assert telemetry.journal.scope == "r7"
+
+    def test_adopt_collects_session_registries(self):
+        clock = FakeClock()
+        replica = make_replica("r0")
+        telemetry = ReplicaTelemetry("r0", clock=clock).adopt(replica)
+        replica.leader.handle_request("q")
+        export = telemetry.metrics_export()
+        assert export["histograms"]["plain.request_ms"]["count"] == 1
+        assert telemetry.request_count() == 1
+
+    def test_scrape_shape(self):
+        clock = FakeClock()
+        replica = make_replica("r0")
+        telemetry = ReplicaTelemetry("r0", clock=clock).adopt(replica)
+        replica.leader.handle_request("q")
+        telemetry.sample_once(clock())
+        scrape = telemetry.scrape()
+        assert scrape["replica_id"] == "r0"
+        assert set(scrape) == {
+            "replica_id", "metrics", "journal", "utilization", "timeseries",
+        }
+        assert scrape["metrics"]["histograms"]["plain.request_ms"]["count"] == 1
+        assert scrape["timeseries"]["series_count"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# FleetTelemetry aggregation
+# ---------------------------------------------------------------------------
+
+
+class TestFleetSample:
+    def test_routable_and_qps_derivation(self):
+        clock = FakeClock()
+        _, replicas, telemetry = make_fleet(clock)
+        telemetry.sample()  # establish QPS marks
+        for _ in range(10):
+            replicas[0].leader.handle_request("q")
+        for _ in range(5):
+            replicas[1].leader.handle_request("q")
+        clock.advance(10.0)
+        result = telemetry.sample()
+        assert result["routable"] == 3
+        assert result["qps"] == pytest.approx(1.5)
+        gauges = telemetry.registry.export()["gauges"]
+        assert gauges["fleet.replica_qps{replica=r0}"] == pytest.approx(1.0)
+        assert gauges["fleet.replica_qps{replica=r1}"] == pytest.approx(0.5)
+        assert gauges["fleet.qps"] == pytest.approx(1.5)
+        # The derived gauges also land as flat fleet TSDB series.
+        assert "fleet.qps" in result["series"]
+        assert "fleet.replica_qps.r0" in result["series"]
+        assert telemetry.store.series("fleet.qps", now=clock())[-1][1] == (
+            pytest.approx(1.5)
+        )
+
+    def test_generation_lag_per_replica(self):
+        clock = FakeClock()
+        journal = EventJournal(capacity=64, clock=clock)
+        replica_set = ReplicaSet(journal=journal)
+        replica_set.add(make_replica("r0", generation=5))
+        replica_set.add(make_replica("r1", generation=3))
+        telemetry = FleetTelemetry(
+            replica_set, journal=journal, clock=clock
+        )
+        for replica in replica_set.replicas():
+            telemetry.scope(replica)
+        result = telemetry.sample()
+        assert result["generation_lag"] == {"r0": 0, "r1": 2}
+
+    def test_rotation_staleness_and_probe_age_feed_gauges(self):
+        clock = FakeClock()
+        _, _, telemetry = make_fleet(clock)
+        telemetry.set_rotation(
+            SimpleNamespace(
+                export=lambda: {"last_report": {"staleness_ms": 1250.0}}
+            )
+        )
+        telemetry.set_probe(SimpleNamespace(last_pass_age_s=lambda: 42.0))
+        telemetry.sample()
+        gauges = telemetry.registry.export()["gauges"]
+        assert gauges["fleet.rotation_staleness_ms"] == 1250.0
+        assert gauges["fleet.divergence_probe_age_s"] == 42.0
+
+    def test_merged_metrics_view_carries_replica_rows(self):
+        clock = FakeClock()
+        _, replicas, telemetry = make_fleet(clock)
+        for replica in replicas:
+            replica.leader.handle_request("q")
+        merged = telemetry.metrics()
+        hist = merged["histograms"]["plain.request_ms"]
+        assert hist["count"] == 3
+        assert hist["replicas"] == ["r0", "r1", "r2"]
+        assert "fleet" in merged
+
+    def test_export_is_statusz_shaped(self):
+        clock = FakeClock()
+        _, _, telemetry = make_fleet(clock)
+        telemetry.sample()
+        state = telemetry.export()
+        assert sorted(state["replicas"]) == ["r0", "r1", "r2"]
+        for scrape in state["replicas"].values():
+            assert scrape["state"] == "serving"
+        assert state["merged"]["replicas"] == ["r0", "r1", "r2"]
+        assert state["samples"] == 1
+        assert {o["name"] for o in state["slo"]["objectives"]} == {
+            "fleet_routable_floor",
+            "fleet_rotation_staleness",
+            "fleet_probe_freshness",
+            "fleet_spillover_rate",
+        }
+
+
+# ---------------------------------------------------------------------------
+# Fleet SLOs -> healthz
+# ---------------------------------------------------------------------------
+
+
+class TestFleetHealth:
+    def test_routable_floor_degrades_and_recovers(self):
+        clock = FakeClock()
+        replica_set, _, telemetry = make_fleet(clock)
+        assert telemetry.healthz()["status"] == "ok"
+        replica_set.shed("r1", reason="test")
+        replica_set.shed("r2", reason="test")
+        clock.advance(1.0)
+        verdict = telemetry.healthz()
+        assert verdict["status"] == "degraded"
+        assert verdict["healthy"] is False
+        assert verdict["routable"] == 1
+        assert [b["name"] for b in verdict["breaches"]] == [
+            "fleet_routable_floor"
+        ]
+        assert verdict["replicas"]["r1"] == "draining"
+        replica_set.readmit("r1", reason="test")
+        replica_set.readmit("r2", reason="test")
+        clock.advance(1.0)
+        assert telemetry.healthz()["status"] == "ok"
+
+    def test_soft_breach_does_not_degrade(self):
+        clock = FakeClock()
+        _, _, telemetry = make_fleet(clock)
+        # Spillover rate over the ceiling is soft: pages, doesn't drain.
+        telemetry.set_router(
+            SimpleNamespace(
+                spillover_rate_pct=lambda: 99.0, export=lambda: {}
+            )
+        )
+        verdict = telemetry.healthz()
+        assert verdict["status"] == "ok"
+        records = {r["name"]: r for r in telemetry.slo.evaluate()}
+        assert records["fleet_spillover_rate"]["state"] == "breach"
+
+    def test_one_fleet_bundle_with_every_replica_section(self, tmp_path):
+        clock = FakeClock()
+        replica_set, _, telemetry = make_fleet(clock)
+        bundles = BundleManager(
+            directory=str(tmp_path), cooldown_s=60.0, clock=clock,
+            journal=telemetry.journal,
+        )
+        telemetry.wire_bundles(bundles)
+        telemetry.sample()  # healthy baseline, no burn
+        assert bundles.export()["fired"] == 0
+        replica_set.kill("r1", reason="test")
+        replica_set.kill("r2", reason="test")
+        clock.advance(1.0)
+        telemetry.sample()  # burn transition -> ONE capture
+        clock.advance(1.0)
+        telemetry.sample()  # continuing breach: no new transition
+        export = bundles.export()
+        assert export["fired"] == 1
+        (entry,) = export["bundles"]
+        assert entry["reason"] == "slo_hard_breach"
+        for source in (
+            "replica_r0", "replica_r1", "replica_r2",
+            "fleet_timeline", "fleet_status",
+        ):
+            assert entry["sources"][source] == "ok"
+            assert os.path.exists(
+                os.path.join(entry["path"], f"{source}.json")
+            )
+        with open(os.path.join(entry["path"], "fleet_timeline.json")) as f:
+            timeline = json.load(f)
+        kinds = [e["kind"] for e in timeline["events"]]
+        assert "fleet.replica_state" in kinds
+
+    def test_probe_failure_triggers_fleet_bundle(self, tmp_path):
+        clock = FakeClock()
+        _, _, telemetry = make_fleet(clock)
+
+        class StubProbe:
+            def __init__(self):
+                self.listeners = []
+
+            def add_failure_listener(self, cb):
+                self.listeners.append(cb)
+
+            def last_pass_age_s(self):
+                return 1.0
+
+            def export(self):
+                return {"history": [1, 2, 3], "cycles": 3}
+
+        probe = StubProbe()
+        telemetry.set_probe(probe)
+        bundles = BundleManager(
+            directory=str(tmp_path), cooldown_s=60.0, clock=clock,
+            journal=telemetry.journal,
+        )
+        telemetry.wire_bundles(bundles)
+        (listener,) = probe.listeners
+        listener({"kind": "divergence", "status": "fail", "seq": 9})
+        export = bundles.export()
+        assert export["fired"] == 1
+        assert export["bundles"][0]["reason"] == "probe_failure"
+
+
+# ---------------------------------------------------------------------------
+# Fleet timeline
+# ---------------------------------------------------------------------------
+
+
+class TestFleetTimeline:
+    def test_replica_and_fleet_events_interleave_with_attribution(self):
+        # Real clocks here: the rebase anchors journals to one another
+        # on the wall clock, so deterministic cross-journal order needs
+        # t_wall and t_mono to advance together.
+        replica_set, _, telemetry = make_fleet(time.monotonic)
+        scopes = telemetry.scopes()
+        scopes["r0"].journal.emit("breaker.transition", "closed->open")
+        time.sleep(0.005)
+        replica_set.shed("r0", reason="breaker open")
+        time.sleep(0.005)
+        scopes["r1"].journal.emit("snapshot.flip", "gen 2")
+        timeline = telemetry.timeline()
+        rows = [
+            (e["replica"], e["kind"])
+            for e in timeline["events"]
+            if e["kind"] in (
+                "breaker.transition", "fleet.replica_state", "snapshot.flip",
+            )
+        ]
+        assert rows == [
+            ("r0", "breaker.transition"),
+            ("r0", "fleet.replica_state"),
+            ("r1", "snapshot.flip"),
+        ]
+        assert set(timeline["replicas"]) == {"r0", "r1", "r2", "fleet"}
+
+    def test_kind_filter_and_n(self):
+        clock = FakeClock()
+        _, _, telemetry = make_fleet(clock)
+        scopes = telemetry.scopes()
+        for i in range(4):
+            scopes["r0"].journal.emit("snapshot.flip", f"gen {i}")
+            scopes["r0"].journal.emit("other", "noise")
+            clock.advance(0.1)
+        timeline = telemetry.timeline(n=2, kind="snapshot")
+        assert [e["message"] for e in timeline["events"]] == [
+            "gen 2", "gen 3",
+        ]
+
+
+# ---------------------------------------------------------------------------
+# Router hop stitching + spillover counters (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+class TestRouterHops:
+    def test_spillover_trace_shows_both_hops(self, recorder):
+        journal = EventJournal(capacity=64)
+        replica_set = ReplicaSet(journal=journal)
+        replica_set.add(
+            make_replica(
+                "r0",
+                device_ms=1.0,
+                shed=Overloaded("full", retry_after_s=0.1, reason="queue_full"),
+            )
+        )
+        replica_set.add(make_replica("r1", device_ms=2.0))
+        metrics = MetricsRegistry()
+        router = FleetRouter(
+            replica_set, journal=journal, metrics=metrics
+        )
+        assert router.handle_request("q", tenant="t") == "resp:r1"
+        (trace,) = recorder.dump()["recent"]
+        assert trace["name"] == "fleet.request"
+        assert trace["attrs"]["hops"] == [
+            {
+                "replica": "r0", "attempt": 0,
+                "reason": "primary", "outcome": "shed",
+            },
+            {
+                "replica": "r1", "attempt": 1,
+                "reason": "spillover:queue_full", "outcome": "served",
+            },
+        ]
+        counters = metrics.export()["counters"]
+        assert counters[
+            "fleet.spillover{from=r0,reason=queue_full,to=r1}"
+        ] == 1
+
+    def test_primary_served_is_one_hop(self, recorder):
+        replica_set = ReplicaSet(journal=EventJournal(capacity=64))
+        replica_set.add(make_replica("r0"))
+        router = FleetRouter(replica_set, metrics=MetricsRegistry())
+        router.handle_request("q", tenant="t")
+        (trace,) = recorder.dump()["recent"]
+        assert trace["attrs"]["hops"] == [
+            {
+                "replica": "r0", "attempt": 0,
+                "reason": "primary", "outcome": "served",
+            }
+        ]
+        assert router.spillover_rate_pct() == 0.0
+
+    def test_spillover_storm_event_coalesces(self, recorder):
+        clock = FakeClock()
+        journal = EventJournal(capacity=64, clock=clock)
+        replica_set = ReplicaSet(journal=journal)
+        replica_set.add(
+            make_replica(
+                "r0",
+                device_ms=1.0,
+                shed=Overloaded("full", retry_after_s=0.1, reason="queue_full"),
+            )
+        )
+        replica_set.add(make_replica("r1", device_ms=2.0))
+        router = FleetRouter(
+            replica_set,
+            journal=journal,
+            metrics=MetricsRegistry(),
+            storm_band=0.2,
+            storm_window=8,
+            storm_coalesce_s=300.0,
+        )
+        for i in range(8):
+            router.handle_request(f"q{i}", tenant=f"t{i}")
+        assert router.spillover_rate_pct() == 100.0
+        storms = [
+            e for e in journal.export()["events"]
+            if e["kind"] == "fleet.spillover_storm"
+        ]
+        assert len(storms) == 1  # coalesced, not one line per request
+        assert storms[0]["severity"] == "warning"
+        assert storms[0]["rate_pct"] == 100.0
+        assert storms[0].get("repeats", 0) >= 1
+        assert router.export()["spillover_storms"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Admin endpoints
+# ---------------------------------------------------------------------------
+
+
+class TestFleetAdminEndpoints:
+    def test_fleet_statusz_and_timelinez(self):
+        clock = FakeClock()
+        _, replicas, telemetry = make_fleet(clock)
+        replicas[0].leader.handle_request("q")
+        telemetry.sample()
+        telemetry.scopes()["r0"].journal.emit(
+            "breaker.transition", "closed->open", severity="warning"
+        )
+        with AdminServer(
+            registry=MetricsRegistry(), fleet_telemetry=telemetry
+        ) as admin:
+            base = f"http://127.0.0.1:{admin.port}"
+            state = json.load(
+                urllib.request.urlopen(base + "/fleet-statusz?format=json")
+            )
+            assert state["verdict"]["status"] == "ok"
+            assert sorted(state["replicas"]) == ["r0", "r1", "r2"]
+            assert (
+                state["merged"]["histograms"]["plain.request_ms"]["count"]
+                == 1
+            )
+            text = (
+                urllib.request.urlopen(base + "/fleet-statusz")
+                .read()
+                .decode()
+            )
+            assert "fleet_routable_floor" in text
+            for rid in ("r0", "r1", "r2"):
+                assert rid in text
+
+            timeline = json.load(
+                urllib.request.urlopen(base + "/fleet-timelinez?format=json")
+            )
+            assert timeline["count"] >= 1
+            kinds = [e["kind"] for e in timeline["events"]]
+            assert "breaker.transition" in kinds
+            text = (
+                urllib.request.urlopen(base + "/fleet-timelinez")
+                .read()
+                .decode()
+            )
+            assert "breaker.transition" in text
+            assert "r0" in text
+
+            filtered = json.load(
+                urllib.request.urlopen(
+                    base + "/fleet-timelinez?format=json&kind=nothing.matches"
+                )
+            )
+            assert filtered["count"] == 0
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(base + "/fleet-timelinez?n=bogus")
+            assert e.value.code == 400
+
+    def test_endpoints_404_without_fleet_telemetry(self):
+        with AdminServer(registry=MetricsRegistry()) as admin:
+            base = f"http://127.0.0.1:{admin.port}"
+            for path in ("/fleet-statusz", "/fleet-timelinez"):
+                with pytest.raises(urllib.error.HTTPError) as e:
+                    urllib.request.urlopen(base + path)
+                assert e.value.code == 404
+
+    def test_fleet_breach_degrades_process_healthz(self):
+        clock = FakeClock()
+        replica_set, _, telemetry = make_fleet(clock)
+        replica_set.kill("r1", reason="test")
+        replica_set.kill("r2", reason="test")
+        with AdminServer(
+            registry=MetricsRegistry(), fleet_telemetry=telemetry
+        ) as admin:
+            base = f"http://127.0.0.1:{admin.port}"
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(base + "/healthz")
+            assert e.value.code == 503
+            body = e.value.read().decode()
+            assert "fleet breach: fleet_routable_floor" in body
+
+    def test_fleet_breach_in_json_healthz_with_prober(self):
+        clock = FakeClock()
+        replica_set, _, telemetry = make_fleet(clock)
+        replica_set.kill("r1", reason="test")
+        replica_set.kill("r2", reason="test")
+        prober = SimpleNamespace(
+            freshness=lambda: {}, export=lambda: {"probes": {}}
+        )
+        with AdminServer(
+            registry=MetricsRegistry(),
+            fleet_telemetry=telemetry,
+            prober=prober,
+        ) as admin:
+            base = f"http://127.0.0.1:{admin.port}"
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(base + "/healthz")
+            assert e.value.code == 503
+            detail = json.load(e.value)
+            assert detail["status"] == "unhealthy"
+            assert detail["fleet"]["status"] == "degraded"
+            assert [b["name"] for b in detail["fleet"]["breaches"]] == [
+                "fleet_routable_floor"
+            ]
