@@ -24,12 +24,18 @@ or through the headline harness (one bench-style JSON line)::
 
     BENCH_OVERLOAD=1 BENCH_PLATFORM=cpu python bench.py
 
+The request pool comes from `benchmarks/workload_gen.py` profiles
+(``--profile`` / OVERLOAD_BENCH_PROFILE). The default ``uniform``
+reproduces the retired inline generator byte-for-byte so the goodput
+history stays comparable; other profiles (``zipf``, ``diurnal``,
+``bursty``, ``mixed``) record their own suffixed history series.
+
 Environment knobs: OVERLOAD_BENCH_RECORDS (default 1024),
 OVERLOAD_BENCH_RECORD_BYTES (32), OVERLOAD_BENCH_BASE_THREADS (8),
 OVERLOAD_BENCH_MULTIPLIERS ("1,2"), OVERLOAD_BENCH_SECONDS (2.0 per
 point), OVERLOAD_BENCH_DEADLINE_MS (1000), OVERLOAD_BENCH_BUDGET_MS
-(admission queue cost budget, 250), OVERLOAD_BENCH_OUT (report path;
-empty string disables the file).
+(admission queue cost budget, 250), OVERLOAD_BENCH_PROFILE (uniform),
+OVERLOAD_BENCH_OUT (report path; empty string disables the file).
 """
 
 from __future__ import annotations
@@ -137,11 +143,12 @@ def run_overload_bench():
     )
     budget_ms = float(os.environ.get("OVERLOAD_BENCH_BUDGET_MS", 250.0))
 
+    profile_name = os.environ.get("OVERLOAD_BENCH_PROFILE", "uniform")
     _log(
         f"database: {num_records} x {record_bytes}B, base "
         f"{base_threads} threads, multipliers {multipliers}, "
         f"{duration_s}s/point, deadline {deadline_s * 1e3:.0f} ms, "
-        f"cost budget {budget_ms:.0f} ms"
+        f"cost budget {budget_ms:.0f} ms, profile {profile_name}"
     )
     builder = DenseDpfPirDatabase.Builder()
     for i in range(num_records):
@@ -150,13 +157,16 @@ def run_overload_bench():
         )
     database = builder.build()
 
-    import numpy as np
+    from benchmarks import workload_gen
 
-    rng = np.random.default_rng(8)
+    profile = workload_gen.PROFILES[profile_name]
+    # The `uniform` profile reproduces this bench's retired inline pool
+    # byte-for-byte (numpy seed 8, one integers() draw of 32), so the
+    # goodput history stays comparable across the generator handoff.
+    indices = workload_gen.key_pool(profile, num_records)
     client = DenseDpfPirClient.create(num_records, lambda pt, ci: pt)
     requests = [
-        client.create_plain_requests([int(i)])[0]
-        for i in rng.integers(0, num_records, 32)
+        client.create_plain_requests([int(i)])[0] for i in indices
     ]
     oracle_server = DenseDpfPirServer.create_plain(database)
     _log("computing oracle responses and warming jit buckets")
@@ -210,6 +220,7 @@ def run_overload_bench():
     )
     report = {
         "config": {
+            "profile": profile.name,
             "num_records": num_records,
             "record_bytes": record_bytes,
             "base_threads": base_threads,
@@ -258,8 +269,14 @@ def _append_history_record(report):
     try:
         from benchmarks.regression_gate import append_record, git_rev
 
+        metric = "serving_overload_goodput_queries_per_sec"
+        profile = report.get("config", {}).get("profile", "uniform")
+        if profile != "uniform":
+            # Non-uniform profiles track their own history series; the
+            # uniform rolling median must not drift on a zipf run.
+            metric = f"{metric}_{profile}"
         append_record({
-            "metric": "serving_overload_goodput_queries_per_sec",
+            "metric": metric,
             "value": report["overloaded_goodput_qps"],
             "unit": "queries/s",
             "direction": "higher",
@@ -274,13 +291,31 @@ def _append_history_record(report):
         _log(f"history append failed (non-fatal): {e}")
 
 
-def main():
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--profile",
+        default=os.environ.get("OVERLOAD_BENCH_PROFILE", "uniform"),
+        choices=sorted(_profile_names()),
+        help="workload_gen profile for the request pool "
+             "(uniform = the pre-profile history-compatible pool)",
+    )
+    args = parser.parse_args(argv)
+    os.environ["OVERLOAD_BENCH_PROFILE"] = args.profile
     report = run_overload_bench()
     if os.environ.get("BENCH_HISTORY", "1") != "0":
         _append_history_record(report)
     print(json.dumps(report, indent=2))
     if not report["correctness_ok"]:
         raise SystemExit("overload bench FAILED correctness")
+
+
+def _profile_names():
+    from benchmarks import workload_gen
+
+    return workload_gen.PROFILES.keys()
 
 
 if __name__ == "__main__":

@@ -86,10 +86,20 @@
 #                    (prestage saves bytes), zero sparse-prober
 #                    failures through the flip, and the golden absent
 #                    key resolving to typed not-found throughout
-#  17. perf-gate   — benchmarks/regression_gate.py --check-only against
+#  17. forecast-smoke — the predictive capacity plane end to end:
+#                    synthetic load ramped toward a deliberately
+#                    lowered calibrated capacity must journal a
+#                    forecast.breach_predicted BEFORE any hard SLO
+#                    burn, /forecastz?format=json must carry a finite
+#                    time-to-breach, the predictive governor must
+#                    visibly tighten tenant quotas on /capacityz, and
+#                    everything must revert exactly once the ramp
+#                    recedes — with every served response bit-identical
+#                    to the oracle throughout
+#  18. perf-gate   — benchmarks/regression_gate.py --check-only against
 #                    the committed history fixture (CPU-safe: judges
 #                    records, runs no bench)
-#  18. dryrun      — 8-virtual-device multichip compile+step
+#  19. dryrun      — 8-virtual-device multichip compile+step
 # Benchmarks are excluded exactly as the reference excludes
 # `--test_tag_filters=-benchmark`. `FULL=1` appends the whole suite.
 set -u -o pipefail
@@ -1773,6 +1783,141 @@ print(
     "fleet-obs-smoke: OK (%d lookups, /fleet-statusz per-replica+merged"
     ", causal rotation timeline, forced divergence -> 1 fleet bundle "
     "with all 3 replica sections)" % stats["done"]
+)
+'
+
+# --- forecast-smoke: the act-before-burn loop end to end. Synthetic
+# load ramps toward a deliberately lowered calibrated capacity on an
+# injected clock (deterministic Holt fit); the page must land in the
+# journal while the hard SLO has never burned, the governor must
+# tighten visibly and revert exactly, and every response served during
+# the drill must stay bit-identical to the oracle.
+stage forecast-smoke env JAX_PLATFORMS=cpu python -c '
+import json
+import urllib.request
+import numpy as np
+from distributed_point_functions_tpu.capacity import TenantPolicy
+from distributed_point_functions_tpu.capacity.admission import (
+    PredictiveGovernor,
+)
+from distributed_point_functions_tpu.observability import (
+    AdminServer, EventJournal, Forecaster, SloObjective, SloTracker,
+    TimeSeriesStore, WorkloadObservatory,
+)
+from distributed_point_functions_tpu.pir import (
+    DenseDpfPirClient, DenseDpfPirDatabase,
+)
+from distributed_point_functions_tpu.serving import (
+    PlainSession, ServingConfig,
+)
+
+t = [0.0]
+clock = lambda: t[0]
+
+builder = DenseDpfPirDatabase.Builder()
+rng = np.random.default_rng(2)
+for _ in range(16):
+    builder.insert(bytes(rng.integers(0, 256, 8, dtype=np.uint8)))
+db = builder.build()
+client = DenseDpfPirClient.create(16, lambda pt, ci: pt)
+request = client.create_plain_requests([5])[0]
+
+config = ServingConfig(
+    max_batch_size=4, max_wait_ms=1.0, admission_enabled=True
+)
+journal = EventJournal(capacity=128, clock=clock)
+store = TimeSeriesStore(tiers=((1.0, 240),), max_series=8, clock=clock)
+
+with PlainSession(db, config) as session:
+    want = session.handle_request(request).dpf_pir_response.masked_response
+    observatory = session.attach_workload(WorkloadObservatory())
+    session.set_tenant("ramp", TenantPolicy(rate_qps=500.0))
+
+    # The deliberately lowered calibrated capacity: 1% of the model.
+    calibrated = session.admission.model.serving_queries_per_sec()
+    lowered = max(1.0, 0.01 * calibrated)
+
+    forecaster = Forecaster(
+        store, window_s=60.0, horizon_s=120.0, page_horizon_s=120.0,
+        min_points=8, registry=session.metrics, journal=journal,
+        clock=clock,
+    )
+    forecaster.watch(
+        "load.rate_qps", ceiling_source=lambda: lowered,
+        label="offered load vs lowered calibrated capacity",
+    )
+    governor = PredictiveGovernor(
+        session.admission, forecaster.min_time_to_breach_s,
+        horizon_s=120.0, floor=0.25, metrics=session.metrics,
+        clock=clock,
+    )
+    # The hard SLO the prediction must beat: offered load at or above
+    # the lowered capacity.
+    hard = SloTracker(
+        [SloObjective(
+            name="load_ceiling", kind="gauge_max",
+            metric="load.rate_qps", threshold=lowered, severity="hard",
+        )],
+        session.metrics, clock=clock,
+    )
+
+    served = [0]
+    def tick(rate):
+        t[0] += 1.0
+        store.record("load.rate_qps", rate)
+        session.metrics.gauge("load.rate_qps").set(rate)
+        got = session.handle_request(request, tenant="ramp")
+        assert got.dpf_pir_response.masked_response == want
+        served[0] += 1
+
+    assert governor.update() == 1.0  # calm: policy as declared
+
+    # --- the ramp: 60 synthetic seconds climbing from 20% to 70% of
+    # the lowered capacity — never touching it. -----------------------
+    for i in range(60):
+        tick(lowered * (0.2 + 0.5 * i / 59.0))
+    state = forecaster.run()
+    ttb = state["min_time_to_breach_s"]
+    assert ttb is not None and 0.0 < ttb < forecaster.horizon_s, state
+    predicted = journal.tail(10, kind="forecast.breach_predicted")
+    assert predicted, "breach_predicted missing from the journal"
+    (burn,) = hard.evaluate()
+    assert burn["state"] == "ok" and burn["burn_s"] == 0.0, burn
+
+    # --- the governor tightens, visibly. -----------------------------
+    scale = governor.update()
+    assert scale < 1.0, scale
+    adm = session.admission.export()
+    assert adm["rate_scale"] == scale, adm
+    assert adm["tenants"]["ramp"]["effective_rate_qps"] < 500.0, adm
+    with AdminServer(registry=session.metrics, forecast=forecaster,
+                     governor=governor) as admin:
+        base = "http://127.0.0.1:%d" % admin.port
+        fz = json.load(
+            urllib.request.urlopen(base + "/forecastz?format=json")
+        )
+        assert fz["min_time_to_breach_s"] is not None, fz
+        assert fz["min_time_to_breach_s"] < 120.0, fz
+        assert fz["governor"]["scale"] < 1.0, fz
+        cz = urllib.request.urlopen(base + "/capacityz").read().decode()
+        assert "predictive governor: scale" in cz, cz
+        assert "ramp: rate 500.0 ->" in cz, cz
+
+    # --- the ramp recedes: forecast clears, exact revert. ------------
+    for _ in range(70):
+        tick(lowered * 0.2)
+    assert forecaster.min_time_to_breach_s() is None
+    assert governor.update() == 1.0
+    adm = session.admission.export()
+    assert adm["rate_scale"] == 1.0, adm
+    assert adm["tenants"]["ramp"]["effective_rate_qps"] == 500.0, adm
+    got = session.handle_request(request, tenant="ramp")
+    assert got.dpf_pir_response.masked_response == want
+    assert observatory.export()["observations"] >= served[0]
+print(
+    "forecast-smoke: OK (breach predicted %.0fs out with 0s of hard "
+    "burn, governor tightened to x%.2f on /capacityz and reverted, "
+    "%d bit-identical responses)" % (ttb, scale, served[0] + 2)
 )
 '
 

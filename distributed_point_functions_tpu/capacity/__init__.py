@@ -39,6 +39,7 @@ robustness — it never imports a workload.
 from .admission import (
     AdmissionController,
     AdmissionDecision,
+    PredictiveGovernor,
     ShedReason,
     TenantPolicy,
     TokenBucket,
@@ -72,6 +73,7 @@ __all__ = [
     "CapacityModel",
     "KILL_SWITCH_ENV",
     "LevelChunking",
+    "PredictiveGovernor",
     "Recalibrator",
     "ShedReason",
     "TenantPolicy",

@@ -91,10 +91,25 @@ class TokenBucket:
         if rate <= 0:
             raise ValueError("rate must be positive")
         self.rate = rate
+        self._base_rate = rate
         self.burst = burst if burst is not None else max(1.0, rate)
         self._clock = clock
         self._tokens = self.burst
         self._stamp = clock()
+
+    def set_scale(self, scale: float, now: Optional[float] = None) -> None:
+        """Scale the refill rate to `scale` x the configured rate
+        (predictive governor hook). Refills at the old rate first so
+        already-earned tokens are not retroactively repriced."""
+        if scale <= 0:
+            raise ValueError("scale must be positive")
+        now = self._clock() if now is None else now
+        self._refill(now)
+        self.rate = self._base_rate * scale
+
+    @property
+    def base_rate(self) -> float:
+        return self._base_rate
 
     def _refill(self, now: float) -> None:
         self._tokens = min(
@@ -237,6 +252,7 @@ class AdmissionController:
         # two inner products per key (see serving/sparse.py).
         self._pricer = None
         self._min_priority = 0  # brownout floor; 0 admits every class
+        self._rate_scale = 1.0  # predictive governor multiplier
         self._admitted_by_tenant: Dict[str, int] = {}
         self._shed_by_tenant: Dict[str, int] = {}
         self.metrics = metrics
@@ -252,6 +268,8 @@ class AdmissionController:
             }
             self._g_outstanding = metrics.gauge(f"{name}.outstanding_ms")
             self._g_min_priority = metrics.gauge(f"{name}.min_priority")
+            self._g_rate_scale = metrics.gauge(f"{name}.rate_scale")
+            self._g_rate_scale.set(1.0)
 
     # -- tenant policy -------------------------------------------------------
 
@@ -259,9 +277,12 @@ class AdmissionController:
         with self._lock:
             self._policies[tenant] = policy
             if policy.rate_qps is not None:
-                self._buckets[tenant] = TokenBucket(
+                bucket = TokenBucket(
                     policy.rate_qps, policy.burst, clock=self._clock
                 )
+                if self._rate_scale != 1.0:
+                    bucket.set_scale(self._rate_scale)
+                self._buckets[tenant] = bucket
             else:
                 self._buckets.pop(tenant, None)
 
@@ -288,6 +309,27 @@ class AdmissionController:
     @property
     def min_priority(self) -> int:
         return self._min_priority
+
+    # -- predictive governor hook --------------------------------------------
+
+    def set_rate_scale(self, scale: float) -> None:
+        """Scale every tenant bucket's refill to `scale` x its policy
+        rate (1.0 = policy as declared). The `PredictiveGovernor`
+        tightens this as forecast approaches capacity and restores it
+        when the forecast recedes; buckets created later inherit the
+        current scale."""
+        if scale <= 0:
+            raise ValueError("scale must be positive")
+        with self._lock:
+            self._rate_scale = float(scale)
+            for bucket in self._buckets.values():
+                bucket.set_scale(self._rate_scale)
+        if self.metrics is not None:
+            self._g_rate_scale.set(round(self._rate_scale, 4))
+
+    @property
+    def rate_scale(self) -> float:
+        return self._rate_scale
 
     # -- the decision --------------------------------------------------------
 
@@ -422,6 +464,9 @@ class AdmissionController:
                     "weight": policy.weight,
                     "priority": policy.priority,
                     "rate_qps": policy.rate_qps,
+                    "effective_rate_qps": (
+                        round(bucket.rate, 3) if bucket is not None else None
+                    ),
                     "tokens": (
                         round(bucket.tokens, 2) if bucket is not None else None
                     ),
@@ -432,5 +477,111 @@ class AdmissionController:
                 "queue_budget_ms": self.queue_budget_ms,
                 "outstanding_ms": round(self._outstanding_ms, 3),
                 "min_priority": self._min_priority,
+                "rate_scale": round(self._rate_scale, 4),
                 "tenants": tenants,
+            }
+
+
+class PredictiveGovernor:
+    """Act-before-burn: tightens tenant token buckets as the forecast
+    plane predicts a capacity breach, restores them as it recedes.
+
+    `forecast_source` is a zero-arg callable returning the earliest
+    predicted time-to-breach in seconds, or None when nothing inside
+    the horizon will breach (duck-typed so this package never imports
+    the observability forecaster's types — in practice it is
+    `Forecaster.min_time_to_breach_s`). `update()` maps that to a
+    refill scale:
+
+        ttb None or >= horizon_s  ->  1.0   (policy as declared)
+        ttb -> 0                  ->  linearly down to `floor`
+
+    and applies it via `AdmissionController.set_rate_scale`. The map
+    is stateless and monotone, so the revert after a ramp is automatic
+    and exact — no hysteresis to get stuck in.
+    """
+
+    def __init__(
+        self,
+        admission: AdmissionController,
+        forecast_source: Callable[[], Optional[float]],
+        *,
+        horizon_s: float = 120.0,
+        floor: float = 0.25,
+        name: str = "governor",
+        metrics=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if horizon_s <= 0:
+            raise ValueError("horizon_s must be positive")
+        if not 0 < floor <= 1.0:
+            raise ValueError("floor must be in (0, 1]")
+        self.admission = admission
+        self._forecast_source = forecast_source
+        self.horizon_s = float(horizon_s)
+        self.floor = float(floor)
+        self._name = name
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._updates = 0
+        self._tightenings = 0
+        self._last_ttb: Optional[float] = None
+        self._last_scale = 1.0
+        self.metrics = metrics
+        if metrics is not None:
+            self._g_scale = metrics.gauge(f"{name}.scale")
+            self._g_scale.set(1.0)
+
+    def scale_for(self, ttb: Optional[float]) -> float:
+        """The stateless ttb -> refill-scale map (see class docstring)."""
+        if ttb is None or ttb >= self.horizon_s:
+            return 1.0
+        return max(self.floor, max(0.0, ttb) / self.horizon_s)
+
+    def update(self, now: Optional[float] = None) -> float:
+        """One governor tick: read the forecast, apply the scale.
+        Returns the scale applied. A broken forecast source fails open
+        (scale 1.0) — prediction must never take down admission."""
+        try:
+            ttb = self._forecast_source()
+        except Exception:  # noqa: BLE001 - fail open
+            ttb = None
+        scale = self.scale_for(ttb)
+        previous = self.admission.rate_scale
+        self.admission.set_rate_scale(scale)
+        with self._lock:
+            self._updates += 1
+            if scale < previous:
+                self._tightenings += 1
+            self._last_ttb = ttb
+            self._last_scale = scale
+        if self.metrics is not None:
+            self._g_scale.set(round(scale, 4))
+        if scale != previous:
+            events_mod.emit(
+                "governor.scale",
+                f"{self._name}: scale {scale:.2f} "
+                f"(time-to-breach {ttb if ttb is not None else 'none'})",
+                severity="info" if scale >= 1.0 else "warning",
+                coalesce_key=f"governor.scale:{self._name}",
+                coalesce_s=10.0,
+                scale=round(scale, 4),
+                time_to_breach_s=ttb,
+            )
+        return scale
+
+    def export(self) -> dict:
+        with self._lock:
+            return {
+                "name": self._name,
+                "horizon_s": self.horizon_s,
+                "floor": self.floor,
+                "scale": round(self._last_scale, 4),
+                "time_to_breach_s": (
+                    round(self._last_ttb, 3)
+                    if self._last_ttb is not None
+                    else None
+                ),
+                "updates": self._updates,
+                "tightenings": self._tightenings,
             }

@@ -114,9 +114,14 @@ class ReplicaTelemetry:
         store: Optional[TimeSeriesStore] = None,
         journal_capacity: int = 256,
         max_series: int = 64,
+        workload=None,
         clock=time.monotonic,
     ):
         self.replica_id = str(replica_id)
+        # workload is a replica-scoped WorkloadObservatory (opt-in via
+        # the constructor or set_workload); its export rides the scrape
+        # so the aggregator can federate per-replica traffic shapes.
+        self.workload = workload
         self.journal = (
             journal
             if journal is not None
@@ -228,10 +233,20 @@ class ReplicaTelemetry:
                     total += int(hist.get("count", 0))
         return total
 
+    def set_workload(self, observatory) -> "ReplicaTelemetry":
+        """Attach (or replace) this replica's workload observatory and
+        wire its gauge source into the scoped samplers so its headline
+        numbers become TSDB series on every sampling pass."""
+        self.workload = observatory
+        if observatory is not None:
+            for sampler in self._samplers:
+                sampler.add_extra_source(observatory.gauge_source)
+        return self
+
     def scrape(self) -> dict:
         """Everything the aggregator (or a future RPC scraper) needs,
         as one plain dict."""
-        return {
+        out = {
             "replica_id": self.replica_id,
             "metrics": self.metrics_export(),
             "journal": self.journal.export(),
@@ -243,6 +258,9 @@ class ReplicaTelemetry:
                 ),
             },
         }
+        if self.workload is not None:
+            out["workload"] = self.workload.export()
+        return out
 
 
 class FleetTelemetry:
@@ -531,6 +549,13 @@ class FleetTelemetry:
                 "series_count": self.store.export()["series_count"],
             },
         }
+        workloads = {
+            rid: scrape["workload"]
+            for rid, scrape in per_replica.items()
+            if scrape.get("workload") is not None
+        }
+        if workloads:
+            out["workload"] = federation.merge_workloads(workloads)
         if self._router is not None:
             out["router"] = self._router.export()
         if self._rotation is not None:

@@ -2,7 +2,7 @@
 events, debug bundles, exposition, utilization, timeseries, admin
 surface.
 
-Fourteen pieces, importable from any layer above `utils/` (the layer DAG
+Sixteen pieces, importable from any layer above `utils/` (the layer DAG
 is serving -> observability -> utils; this package never imports pir/,
 ops/, or serving/ — `device`/`slo` reach JAX lazily and only for
 device facts):
@@ -60,11 +60,21 @@ device facts):
   registry series plus the utilization windows, and a rate-of-change
   anomaly watch journaling `util.anomaly` (`/timeseriesz`, debug
   bundles).
+* `workload` — per-tenant hot-path traffic characterization in bounded
+  memory: count-min sketch + top-K hot keys with an online Zipf fit,
+  EWMA arrival rate and CV² burstiness, deadline/batch histograms, and
+  periodicity detection over the TSDB's coarse tier (`/workloadz`).
+* `forecast` — the predictive capacity plane: dependency-free Holt
+  forecasts over selected TSDB series with confidence bands and
+  predicted time-to-breach against declared ceilings, journaled as
+  coalesced `forecast.breach_predicted` events and gradable as a soft
+  SLO objective (`/forecastz`).
 * `exposition` — Prometheus text rendering of the metrics registry,
   including OpenMetrics-style exemplars linking buckets to traces.
 * `admin` — the `/metrics` `/varz` `/healthz` `/statusz` `/tracez`
   `/eventz` `/probez` `/debugz` `/profilez` `/criticalz` `/capacityz`
-  `/utilz` `/timeseriesz` operator HTTP endpoint.
+  `/utilz` `/timeseriesz` `/workloadz` `/forecastz` operator HTTP
+  endpoint.
 """
 
 from .admin import AdminServer
@@ -111,7 +121,15 @@ from .phases import (
     set_default_phase_recorder,
 )
 from .exposition import parse_labeled_name, render_prometheus
+from .forecast import Forecaster, SeriesForecast, holt_fit
 from .slo import SloObjective, SloTracker
+from .workload import (
+    CountMinSketch,
+    TopKTracker,
+    WorkloadObservatory,
+    detect_periodicity,
+    fit_zipf_exponent,
+)
 from .timeseries import (
     AnomalyWatch,
     MetricsSampler,
@@ -157,28 +175,34 @@ __all__ = [
     "BundleManager",
     "CompileTracker",
     "CostLedger",
+    "CountMinSketch",
     "CounterGroup",
     "CriticalPathAnalyzer",
     "DeviceTelemetry",
     "EnvelopeError",
     "EventJournal",
     "FlightRecorder",
+    "Forecaster",
     "HbmAccountant",
     "MetricsSampler",
     "PHASES",
     "PhaseRecorder",
     "RequestPhases",
+    "SeriesForecast",
     "SkewEstimate",
     "SloObjective",
     "SloTracker",
     "TimeSeriesStore",
+    "TopKTracker",
     "Trace",
     "TransferLedger",
     "UtilizationTracker",
+    "WorkloadObservatory",
     "add_span",
     "current_request",
     "current_trace",
     "decompose_helper_leg",
+    "detect_periodicity",
     "default_analyzer",
     "default_cost_ledger",
     "default_journal",
@@ -191,6 +215,8 @@ __all__ = [
     "encode_request",
     "encode_response",
     "estimate_skew",
+    "fit_zipf_exponent",
+    "holt_fit",
     "install_jax_monitoring_listener",
     "new_trace_id",
     "parse_labeled_name",

@@ -1,7 +1,8 @@
 """Operator telemetry endpoint: /metrics, /varz, /healthz, /statusz,
 /tracez, /profilez, /eventz, /probez, /debugz, /criticalz, /capacityz,
-/utilz, /timeseriesz, /fleetz, /fleet-statusz, /fleet-timelinez — a
-stdlib `http.server` surface any session can hang off a port.
+/utilz, /timeseriesz, /workloadz, /forecastz, /fleetz, /fleet-statusz,
+/fleet-timelinez — a stdlib `http.server` surface any session can hang
+off a port.
 
 The serving runtime's observability state (metrics registry, flight
 recorder, stage aggregates, runtime counters, device telemetry, SLO
@@ -59,6 +60,20 @@ this server is the scrape surface:
                              sparkline per sampled series (text;
                              `?format=json` dumps every tier's points;
                              requires a `timeseries` store/sampler)
+    /workloadz               live traffic characterization: per-tenant
+                             arrival rate / burstiness, hot-key table
+                             with count-min estimates, online Zipf
+                             exponent, deadline and batch-size
+                             histograms, detected periodicity (text;
+                             `?format=json`; requires a `workload`
+                             observatory)
+    /forecastz               the predictive capacity plane: per-series
+                             Holt forecasts with confidence bands and
+                             predicted time-to-breach against declared
+                             ceilings, plus the predictive governor's
+                             current refill scale (text;
+                             `?format=json`; requires a `forecast`
+                             forecaster)
     /fleetz                  replica-fleet registry view: per-replica
                              health state, serving/staging generation,
                              queue depth and live price card, plus
@@ -155,6 +170,9 @@ class AdminServer:
         mesh=None,
         utilization=None,
         timeseries=None,
+        workload=None,
+        forecast=None,
+        governor=None,
         fleet=None,
         fleet_telemetry=None,
         identity=None,
@@ -234,6 +252,16 @@ class AdminServer:
             else default_utilization_tracker()
         )
         self._timeseries = timeseries
+        # workload (`workload.WorkloadObservatory`) and forecast
+        # (`forecast.Forecaster`) are the traffic-characterization and
+        # predictive-capacity planes; governor is a
+        # `capacity.PredictiveGovernor` (duck-typed `export()` —
+        # capacity sits above this layer). All three are opt-in:
+        # workload backs /workloadz, forecast backs /forecastz (and a
+        # /statusz section), governor folds into /capacityz.
+        self._workload = workload
+        self._forecast = forecast
+        self._governor = governor
         # fleet is the replica-fleet registry view: a zero-arg callable
         # or anything with `export() -> dict` (a `fleet.ReplicaSet` —
         # duck-typed because fleet/ sits ABOVE this layer). identity is
@@ -278,6 +306,10 @@ class AdminServer:
             )
             if timeseries is not None:
                 bundles.add_source("timeseries", self._timeseries_state)
+            if workload is not None:
+                bundles.add_source("workload", workload.export)
+            if forecast is not None:
+                bundles.add_source("forecast", forecast.export)
             if fleet is not None:
                 bundles.add_source("fleet", self._fleet_state)
             if fleet_telemetry is not None:
@@ -301,6 +333,8 @@ class AdminServer:
             ("/capacityz", self._capacityz),
             ("/utilz", self._utilz),
             ("/timeseriesz", self._timeseriesz),
+            ("/workloadz", self._workloadz),
+            ("/forecastz", self._forecastz),
             ("/fleetz", self._fleetz),
             ("/fleet-statusz", self._fleet_statusz),
             ("/fleet-timelinez", self._fleet_timelinez),
@@ -663,14 +697,19 @@ class AdminServer:
         )
 
     def _capacityz(self, handler, query: str) -> None:
-        if self._capacity is None:
+        if self._capacity is None and self._governor is None:
             self._reply(
                 handler, 404, "text/plain; charset=utf-8",
                 b"no capacity accuracy export attached\n",
             )
             return
         params = urllib.parse.parse_qs(query)
-        state = self._capacity.export()
+        state = self._capacity.export() if self._capacity is not None else {}
+        if self._governor is not None:
+            state["governor"] = self._governor.export()
+            admission = getattr(self._governor, "admission", None)
+            if admission is not None:
+                state["admission"] = admission.export()
         if params.get("format", [""])[0] == "json":
             body = json.dumps(state, indent=2, default=str).encode()
             self._reply(handler, 200, "application/json", body)
@@ -764,6 +803,28 @@ class AdminServer:
                     f"{status}={n}" for status, n in sorted(skipped.items())
                 )
             )
+        governor = state.get("governor")
+        if governor is not None:
+            ttb = governor.get("time_to_breach_s")
+            lines.append(
+                f"predictive governor: scale x{governor.get('scale')} "
+                f"(time-to-breach "
+                f"{'-' if ttb is None else f'{ttb:.0f} s'}, "
+                f"horizon {governor.get('horizon_s')} s, "
+                f"floor {governor.get('floor')}, "
+                f"tightenings {governor.get('tightenings', 0)})"
+            )
+            admission = state.get("admission") or {}
+            for tenant, entry in sorted(
+                (admission.get("tenants") or {}).items()
+            ):
+                if entry.get("rate_qps") is None:
+                    continue
+                lines.append(
+                    f"  {tenant}: rate {entry['rate_qps']} -> "
+                    f"{entry.get('effective_rate_qps')} q/s "
+                    f"(tokens {entry.get('tokens')})"
+                )
         self._reply(
             handler, 200, "text/plain; charset=utf-8",
             ("\n".join(lines) + "\n").encode(),
@@ -823,6 +884,23 @@ class AdminServer:
             "bundles": (
                 self._bundles.export()
                 if self._bundles is not None
+                else None
+            ),
+            "workload": (
+                self._workload.export()
+                if self._workload is not None
+                else None
+            ),
+            # last_run, not export(): /statusz must not trigger a fresh
+            # forecast pass on every scrape.
+            "forecast": (
+                self._forecast.last_run()
+                if self._forecast is not None
+                else None
+            ),
+            "governor": (
+                self._governor.export()
+                if self._governor is not None
                 else None
             ),
             "events": {
@@ -973,6 +1051,135 @@ class AdminServer:
         ) + "\n"
         self._reply(
             handler, 200, "text/plain; charset=utf-8", body.encode()
+        )
+
+    def _workloadz(self, handler, query: str = "") -> None:
+        """Live traffic characterization (text; ?format=json)."""
+        if self._workload is None:
+            self._reply(
+                handler, 404, "text/plain; charset=utf-8",
+                b"no workload observatory attached\n",
+            )
+            return
+        params = urllib.parse.parse_qs(query)
+        state = self._workload.export()
+        if params.get("format", [""])[0] == "json":
+            body = json.dumps(state, indent=2, default=str).encode()
+            self._reply(handler, 200, "application/json", body)
+            return
+        lines = [
+            f"# {self._name} workload observatory "
+            f"(?format=json for machine-readable)",
+            f"observations: {state.get('observations', 0)}  "
+            f"keys: {state.get('keys_observed', 0)}  "
+            f"rate: {state.get('rate_qps')} q/s  "
+            f"burstiness cv2: {state.get('burstiness_cv2')}",
+            f"zipf exponent: {state.get('zipf_exponent')}  "
+            f"hot share: {state.get('hot_share_pct')}%",
+        ]
+        periodicity = state.get("periodicity")
+        if periodicity:
+            lines.append(
+                f"periodicity: {periodicity['period_s']:g} s "
+                f"(strength {periodicity['strength']})"
+            )
+        sketch = state.get("sketch") or {}
+        lines.append(
+            f"sketch: {sketch.get('width')}x{sketch.get('depth')} "
+            f"error bound +/-{sketch.get('error_bound')} "
+            f"({sketch.get('total', 0)} keys)"
+        )
+        top = state.get("top_keys") or []
+        if top:
+            lines.append(f"{'key':>12}{'count':>10}{'err':>8}{'share':>8}")
+            for entry in top[:16]:
+                lines.append(
+                    f"{entry['key']:>12}{entry['count']:>10}"
+                    f"{entry['error']:>8}"
+                    f"{entry['share_pct']:>7.1f}%"
+                )
+        tenants = state.get("tenants") or {}
+        if tenants:
+            lines.append("per-tenant:")
+            for tenant, entry in sorted(tenants.items()):
+                lines.append(
+                    f"  {tenant:<16} rate {entry.get('rate_qps')} q/s  "
+                    f"cv2 {entry.get('burstiness_cv2')}  "
+                    f"share {entry.get('share_pct')}%"
+                )
+        for title, key in (
+            ("deadline ms", "deadline_ms"),
+            ("batch keys", "batch_keys"),
+        ):
+            hist = (state.get(key) or {}).get("buckets") or {}
+            if any(hist.values()):
+                lines.append(
+                    f"{title}: " + "  ".join(
+                        f"<={bound}:{n}" for bound, n in hist.items() if n
+                    )
+                )
+        lines.append(
+            f"memory: {state.get('approx_bytes')} / "
+            f"{state.get('byte_budget')} bytes "
+            f"({'within' if state.get('within_budget') else 'OVER'} budget)"
+        )
+        self._reply(
+            handler, 200, "text/plain; charset=utf-8",
+            ("\n".join(lines) + "\n").encode(),
+        )
+
+    def _forecastz(self, handler, query: str = "") -> None:
+        """Predictive capacity plane (text; ?format=json)."""
+        if self._forecast is None:
+            self._reply(
+                handler, 404, "text/plain; charset=utf-8",
+                b"no forecaster attached\n",
+            )
+            return
+        params = urllib.parse.parse_qs(query)
+        state = self._forecast.export()
+        if self._governor is not None:
+            state["governor"] = self._governor.export()
+        if params.get("format", [""])[0] == "json":
+            body = json.dumps(state, indent=2, default=str).encode()
+            self._reply(handler, 200, "application/json", body)
+            return
+        min_ttb = state.get("min_time_to_breach_s")
+        lines = [
+            f"# {self._name} capacity forecast "
+            f"(?format=json for machine-readable)",
+            f"window: {state.get('window_s'):g} s  "
+            f"horizon: {state.get('horizon_s'):g} s  "
+            f"page horizon: {state.get('page_horizon_s'):g} s",
+            "earliest predicted breach: "
+            + (
+                f"{min_ttb:.0f} s"
+                if min_ttb is not None
+                else "none inside horizon"
+            ),
+        ]
+        paging = state.get("paging") or []
+        if paging:
+            lines.append("PAGING: " + "  ".join(paging))
+        for entry in state.get("series") or []:
+            ttb = entry.get("time_to_breach_earliest_s")
+            lines.append(
+                f"{entry['label']}: state={entry['state']} "
+                f"last={entry.get('last')} level={entry.get('level')} "
+                f"trend/s={entry.get('trend_per_s')} "
+                f"ceiling={entry.get('ceiling')} "
+                f"breach in "
+                + ("-" if ttb is None else f"{ttb:.0f} s")
+            )
+        governor = state.get("governor")
+        if governor is not None:
+            lines.append(
+                f"governor: scale x{governor.get('scale')} "
+                f"(tightenings {governor.get('tightenings', 0)})"
+            )
+        self._reply(
+            handler, 200, "text/plain; charset=utf-8",
+            ("\n".join(lines) + "\n").encode(),
         )
 
     def _fleetz(self, handler, query: str = "") -> None:
@@ -1325,6 +1532,71 @@ def _render_statusz(state: dict) -> str:
             out.append("</table>")
         else:
             out.append("<p class=nodata>no tenants seen yet</p>")
+
+    workload = state.get("workload")
+    if workload is not None:
+        out.append("<h2>Workload</h2>")
+        out.append(
+            f"<p>rate {workload.get('rate_qps')} q/s, burstiness cv&sup2; "
+            f"{workload.get('burstiness_cv2')}, zipf exponent "
+            f"{workload.get('zipf_exponent')}, hot share "
+            f"{workload.get('hot_share_pct')}% "
+            f"({workload.get('observations', 0)} observations over "
+            f"{len(workload.get('tenants') or {})} tenants)</p>"
+        )
+
+    forecast = state.get("forecast")
+    if forecast is not None:
+        out.append("<h2>Forecast</h2>")
+        paging = forecast.get("paging") or []
+        min_ttb = forecast.get("min_time_to_breach_s")
+        cls = "breach" if paging else "ok"
+        out.append(
+            f"<p class={cls}>earliest predicted breach: "
+            + (
+                f"{min_ttb:.0f} s" if min_ttb is not None
+                else "none inside horizon"
+            )
+            + (
+                "; paging: " + ", ".join(esc(s) for s in paging)
+                if paging else ""
+            )
+            + f" (horizon {forecast.get('horizon_s')} s)</p>"
+        )
+        rows = forecast.get("series") or []
+        if rows:
+            out.append(
+                "<table><tr><th>series</th><th>state</th><th>last</th>"
+                "<th>trend/s</th><th>ceiling</th>"
+                "<th>breach in</th></tr>"
+            )
+            for entry in rows:
+                ttb = entry.get("time_to_breach_earliest_s")
+                row_cls = (
+                    "breach"
+                    if ttb is not None
+                    and ttb <= forecast.get("page_horizon_s", 0)
+                    else "ok"
+                )
+                out.append(
+                    f"<tr class={row_cls}><td>{esc(entry['label'])}</td>"
+                    f"<td>{esc(entry['state'])}</td>"
+                    f"<td>{entry.get('last', '-')}</td>"
+                    f"<td>{entry.get('trend_per_s', '-')}</td>"
+                    f"<td>{entry.get('ceiling', '-')}</td>"
+                    f"<td>{'-' if ttb is None else f'{ttb:.0f} s'}</td>"
+                    "</tr>"
+                )
+            out.append("</table>")
+        governor = state.get("governor")
+        if governor is not None:
+            g_cls = "ok" if governor.get("scale", 1.0) >= 1.0 else "breach"
+            out.append(
+                f"<p class={g_cls}>predictive governor: scale "
+                f"x{governor.get('scale')} "
+                f"(floor {governor.get('floor')}, tightenings "
+                f"{governor.get('tightenings', 0)})</p>"
+            )
 
     capacity = state.get("capacity")
     if capacity is not None:

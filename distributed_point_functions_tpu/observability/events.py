@@ -52,6 +52,12 @@ Event kinds emitted by the library (the taxonomy; see DESIGN.md §15):
     util.anomaly           the time-series sampler's rate-of-change
                            watch tripped on a series (coalesced per
                            series)
+    forecast.breach_predicted  the forecast plane predicts a watched
+                           series will cross its declared ceiling
+                           within the page horizon (coalesced per
+                           series; warning — pages, never drains)
+    governor.scale         the predictive governor changed the fleet's
+                           token-bucket refill scale (coalesced)
 
 Emitters call the module-level `emit(...)` (the process-global
 journal, mirroring `tracing.runtime_counters`); sessions that want an

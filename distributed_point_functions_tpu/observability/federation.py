@@ -58,6 +58,7 @@ __all__ = [
     "percentile_from_buckets",
     "rebase_offset",
     "merge_timelines",
+    "merge_workloads",
 ]
 
 # Gauges whose unit is a proportion: summing them across replicas is a
@@ -226,6 +227,122 @@ def merged_flat(exports: Dict[str, dict]) -> dict:
         },
         "gauges": {k: row["value"] for k, row in merged["gauges"].items()},
         "histograms": dict(merged["histograms"]),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Workload federation
+# ---------------------------------------------------------------------------
+
+
+def merge_workloads(exports: Dict[str, dict]) -> dict:
+    """Merge `{replica_id: WorkloadObservatory.export()}` into one
+    fleet traffic characterization.
+
+    Rules follow the metric table's spirit: arrival rates and
+    observation/key counts *sum* (a fleet serves the union of its
+    replicas' traffic); per-request shape numbers — burstiness CV² and
+    the Zipf exponent — are observation-weighted *means* (each replica
+    sees an unbiased sample of the same request population under a
+    routing front door); top-key tables merge by summing per-key counts
+    and errors across replicas, then re-ranking; deadline/batch-size
+    histograms bucket-sum (same fixed layout by construction). Sketches
+    themselves do not cross the wire — only their totals and the top-K
+    digests do — so the merged `hot_share_pct` is recomputed from the
+    merged table against the summed sketch totals."""
+    replicas = sorted(exports)
+    observations = 0
+    keys_observed = 0
+    sketch_total = 0
+    rate_sum = 0.0
+    rate_seen = False
+    cv2_num = zipf_num = 0.0
+    cv2_w = zipf_w = 0
+    top: Dict[object, Dict[str, int]] = {}
+    tenants: Dict[str, dict] = {}
+    deadline: Dict[str, int] = {}
+    batch: Dict[str, int] = {}
+    deadline_n = 0
+    for rid in replicas:
+        export = exports[rid] or {}
+        obs = int(export.get("observations") or 0)
+        observations += obs
+        keys_observed += int(export.get("keys_observed") or 0)
+        sketch_total += int(
+            (export.get("sketch") or {}).get("total") or 0
+        )
+        if export.get("rate_qps") is not None:
+            rate_sum += float(export["rate_qps"])
+            rate_seen = True
+        if export.get("burstiness_cv2") is not None and obs:
+            cv2_num += float(export["burstiness_cv2"]) * obs
+            cv2_w += obs
+        if export.get("zipf_exponent") is not None and obs:
+            zipf_num += float(export["zipf_exponent"]) * obs
+            zipf_w += obs
+        for entry in export.get("top_keys") or []:
+            row = top.setdefault(
+                entry["key"], {"count": 0, "error": 0}
+            )
+            row["count"] += int(entry.get("count") or 0)
+            row["error"] += int(entry.get("error") or 0)
+        for tenant, row in (export.get("tenants") or {}).items():
+            merged = tenants.setdefault(
+                tenant,
+                {"observations": 0, "keys": 0, "rate_qps": None},
+            )
+            merged["observations"] += int(row.get("observations") or 0)
+            merged["keys"] += int(row.get("keys") or 0)
+            if row.get("rate_qps") is not None:
+                merged["rate_qps"] = round(
+                    (merged["rate_qps"] or 0.0) + float(row["rate_qps"]),
+                    4,
+                )
+        for target, source_key in ((deadline, "deadline_ms"),
+                                   (batch, "batch_keys")):
+            hist = export.get(source_key) or {}
+            for bound, n in (hist.get("buckets") or {}).items():
+                target[bound] = target.get(bound, 0) + int(n)
+        deadline_n += int(
+            (export.get("deadline_ms") or {}).get("count") or 0
+        )
+    top_keys = sorted(
+        (
+            {"key": key, "count": row["count"], "error": row["error"]}
+            for key, row in top.items()
+        ),
+        key=lambda r: (-r["count"], str(r["key"])),
+    )
+    for row in top_keys:
+        row["share_pct"] = round(
+            row["count"] / sketch_total * 100.0, 2
+        ) if sketch_total else 0.0
+    for tenant, row in tenants.items():
+        row["share_pct"] = round(
+            row["observations"] / observations * 100.0, 2
+        ) if observations else 0.0
+    covered = sum(
+        max(0, row["count"] - row["error"]) for row in top_keys
+    )
+    return {
+        "replicas": replicas,
+        "observations": observations,
+        "keys_observed": keys_observed,
+        "rate_qps": round(rate_sum, 4) if rate_seen else None,
+        "burstiness_cv2": (
+            round(cv2_num / cv2_w, 4) if cv2_w else None
+        ),
+        "zipf_exponent": (
+            round(zipf_num / zipf_w, 4) if zipf_w else None
+        ),
+        "hot_share_pct": (
+            round(min(100.0, covered / sketch_total * 100.0), 2)
+            if sketch_total else None
+        ),
+        "top_keys": top_keys,
+        "tenants": dict(sorted(tenants.items())),
+        "deadline_ms": {"count": deadline_n, "buckets": deadline},
+        "batch_keys": {"count": observations, "buckets": batch},
     }
 
 

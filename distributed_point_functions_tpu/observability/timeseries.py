@@ -166,6 +166,58 @@ class TimeSeriesStore:
                 return []
             return rings[tier].points(now)
 
+    def query_range(
+        self,
+        name: str,
+        start: float,
+        end: float,
+        tier: Optional[int] = None,
+        now: Optional[float] = None,
+    ) -> Tuple[float, List[Tuple[float, Optional[float]]]]:
+        """Aligned-window accessor: `(step_s, [(t, value-or-None)])`
+        covering `[start, end]` on one tier's slot grid.
+
+        Unlike `series()` (live points only, caller re-aligns), this
+        returns one entry per slot with `None` marking gaps and
+        lap-expired slots — exactly what a forecaster or autocorrelation
+        pass needs. `tier=None` picks the finest tier whose horizon
+        still covers `start` (falling through to coarser tiers, then
+        the coarsest); an explicit tier index is honored as-is. An
+        unknown series yields the aligned grid of Nones, not an error,
+        so read-side callers never race series creation."""
+        if now is None:
+            now = self._clock()
+        if end < start:
+            raise ValueError("end must be >= start")
+        with self._lock:
+            if tier is None:
+                tier = len(self._tiers) - 1
+                for i, (step_s, slots) in enumerate(self._tiers):
+                    if step_s * slots >= (now - start):
+                        tier = i
+                        break
+            if not 0 <= tier < len(self._tiers):
+                raise ValueError(f"tier {tier} out of range")
+            step_s, slots = self._tiers[tier]
+            rings = self._series.get(name)
+            ring = rings[tier] if rings is not None else None
+            last_slot = int(end // step_s)
+            # Clamp to one ring-length of slots so a huge [start, end]
+            # can't loop unboundedly — older slots are gone anyway.
+            first_slot = max(
+                int(start // step_s), last_slot - slots + 1
+            )
+            lap_floor = int(now // step_s) - slots
+            samples: List[Tuple[float, Optional[float]]] = []
+            for sid in range(first_slot, last_slot + 1):
+                value: Optional[float] = None
+                if ring is not None and sid >= lap_floor:
+                    i = sid % slots
+                    if ring.slot_ids[i] == sid:
+                        value = ring.values[i]
+                samples.append((sid * step_s, value))
+            return step_s, samples
+
     def occupancy(self) -> int:
         """Live slots across every series and tier (<= slot_budget)."""
         with self._lock:
@@ -213,6 +265,11 @@ class AnomalyWatch:
     above the floor (collapse). Each finding journals one coalesced
     `util.anomaly` event and becomes the new history, so a sustained
     shift alarms once, not every second.
+
+    Near-zero series get an absolute noise floor: the trailing mean is
+    judged as at least `min_mean`, and the sample must move at least
+    `min_delta` from the mean, so a quiet counter ticking 0 -> 1 (e.g.
+    `fleet.spillover` on an idle fleet) is noise, not a 3x spike.
     """
 
     def __init__(
@@ -223,11 +280,15 @@ class AnomalyWatch:
         history: int = 30,
         coalesce_s: float = 30.0,
         journal=None,
+        min_mean: float = 1.0,
+        min_delta: float = 0.0,
     ):
         if ratio <= 1.0:
             raise ValueError("ratio must be > 1")
         self._ratio = float(ratio)
         self._floor = float(floor)
+        self._min_mean = float(min_mean)
+        self._min_delta = float(min_delta)
         self._min_samples = int(min_samples)
         self._history = int(history)
         self._coalesce_s = float(coalesce_s)
@@ -251,10 +312,15 @@ class AnomalyWatch:
             self._recent[name] = ring
         if not warm:
             return None
-        spike = value > self._ratio * mean + self._floor
+        judged_mean = max(mean, self._min_mean)
+        spike = (
+            value > self._ratio * judged_mean + self._floor
+            and (value - mean) >= self._min_delta
+        )
         collapse = (
-            mean > 2.0 * self._floor
+            mean > max(2.0 * self._floor, self._min_mean)
             and value < mean / self._ratio - self._floor
+            and (mean - value) >= self._min_delta
         )
         if not spike and not collapse:
             return None
@@ -292,6 +358,8 @@ class AnomalyWatch:
             return {
                 "ratio": self._ratio,
                 "floor": self._floor,
+                "min_mean": self._min_mean,
+                "min_delta": self._min_delta,
                 "min_samples": self._min_samples,
                 "series_watched": len(self._recent),
                 "anomalies": self._anomalies,

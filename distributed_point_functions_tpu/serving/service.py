@@ -403,6 +403,41 @@ class _Session:
         except Exception:  # noqa: BLE001 - journaling never breaks serving
             pass
 
+    def attach_workload(self, observatory, key_fn=None):
+        """Wire a `WorkloadObservatory` onto this session's hot path:
+        every `handle_request` observes batch size, tenant, and
+        deadline headroom. Key indices stay out by default — DPF keys
+        hide them from this server (the protocol's point) — unless the
+        caller supplies `key_fn(request) -> indices`, legitimate only
+        where indices are public (plain/trusted deployments, load
+        generators). Returns `observatory` for chaining."""
+        self._workload = observatory
+        self._workload_key_fn = key_fn
+        return observatory
+
+    def _observe_workload(self, request, deadline, tenant, now) -> None:
+        observatory = getattr(self, "_workload", None)
+        if observatory is None:
+            return
+        try:
+            plain = getattr(request, "plain_request", None)
+            num_keys = len(plain.dpf_keys) if plain is not None else 1
+            key_fn = getattr(self, "_workload_key_fn", None)
+            indices = key_fn(request) if key_fn is not None else None
+            observatory.observe(
+                num_keys=num_keys,
+                tenant=tenant,
+                key_indices=indices,
+                deadline_s=(
+                    max(0.0, deadline - now)
+                    if deadline is not None
+                    else None
+                ),
+                now=now,
+            )
+        except Exception:  # noqa: BLE001 - observation never breaks serving
+            pass
+
     def set_utilization(self, tracker):
         """Swap this session's utilization tracker — the fleet telemetry
         plane rebinds each replica's sessions to a replica-scoped
@@ -515,6 +550,7 @@ class _Session:
         admission QoS policy when enabled."""
         if deadline is None:
             deadline = self._default_deadline()
+        self._observe_workload(request, deadline, tenant, time.monotonic())
         token = _DEADLINE.set(deadline)
         tenant_token = _TENANT.set(tenant)
         try:

@@ -537,6 +537,36 @@ class RotationCoordinator:
         self._leader = leader
         self._helper = helper
         self._clock = clock
+        self._window_source = None
+
+    def set_window_source(self, source) -> None:
+        """Attach a forecast trough finder: a `window_s -> dict`
+        callable (duck-typed — in practice
+        `observability.forecast.Forecaster.window_source(series)`)
+        whose dict carries at least `start_offset_s`. None detaches."""
+        self._window_source = source
+
+    def suggest_window(self, window_s: float = 30.0) -> dict:
+        """When should the next rotation prestage start? With a window
+        source attached, the forecast's lowest-load window inside its
+        horizon; without one (or on any source error), now. Advisory
+        only — `rotate()` never blocks on it."""
+        suggestion = {
+            "window_s": float(window_s),
+            "start_offset_s": 0.0,
+            "source": "none",
+        }
+        if self._window_source is None:
+            return suggestion
+        try:
+            trough = self._window_source(window_s) or {}
+        except Exception:  # noqa: BLE001 - advisory must not break rotation
+            suggestion["source"] = "error"
+            return suggestion
+        suggestion.update(trough)
+        suggestion["window_s"] = float(window_s)
+        suggestion["source"] = "forecast"
+        return suggestion
 
     def rotate(
         self,

@@ -28,7 +28,6 @@ from distributed_point_functions_tpu.fleet import (
     Replica,
     ReplicaSet,
     ReplicaTelemetry,
-    default_fleet_objectives,
 )
 from distributed_point_functions_tpu.observability import tracing
 from distributed_point_functions_tpu.observability.admin import AdminServer
@@ -241,6 +240,29 @@ class TestFleetSample:
             "fleet_probe_freshness",
             "fleet_spillover_rate",
         }
+
+    def test_export_federates_per_replica_workloads(self):
+        from distributed_point_functions_tpu.observability.workload import (
+            WorkloadObservatory,
+        )
+
+        clock = FakeClock()
+        _, _, telemetry = make_fleet(clock, n=2)
+        # Workloads are opt-in: no scrape carries one yet, so the
+        # merged view is absent rather than empty.
+        assert "workload" not in telemetry.export()
+        for rid, keys in (("r0", [5] * 20 + [1] * 5), ("r1", [5] * 10)):
+            observatory = WorkloadObservatory(top_k=8)
+            for key in keys:
+                observatory.observe(key_indices=(key,), tenant=rid)
+            telemetry.scopes()[rid].set_workload(observatory)
+        merged = telemetry.export()["workload"]
+        assert merged["replicas"] == ["r0", "r1"]
+        assert merged["observations"] == 35
+        # Key 5's count sums across both replicas' top-K digests.
+        assert merged["top_keys"][0]["key"] == 5
+        assert merged["top_keys"][0]["count"] == 30
+        assert merged["tenants"]["r0"]["observations"] == 25
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,563 @@
+"""Predictive capacity plane tests: Holt fit math, the Forecaster's
+time-to-breach + act-before-burn journaling, the soft SLO wiring, the
+`PredictiveGovernor` loop closing into admission token buckets, the
+`RotationCoordinator` trough-window hook, fleet workload federation,
+and the `/forecastz` + `/capacityz` admin surfaces.
+
+Everything runs on injected clocks: the store, the forecaster, and the
+buckets share one `FakeClock`, so ramps and reverts are deterministic.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributed_point_functions_tpu.capacity.admission import (
+    AdmissionController,
+    PredictiveGovernor,
+    TenantPolicy,
+    TokenBucket,
+)
+from distributed_point_functions_tpu.fleet.telemetry import ReplicaTelemetry
+from distributed_point_functions_tpu.observability import (
+    AdminServer,
+    EventJournal,
+    Forecaster,
+    SloTracker,
+    TimeSeriesStore,
+    WorkloadObservatory,
+    holt_fit,
+)
+from distributed_point_functions_tpu.observability import events as events_mod
+from distributed_point_functions_tpu.observability import federation
+from distributed_point_functions_tpu.serving.metrics import MetricsRegistry
+from distributed_point_functions_tpu.serving.snapshots import (
+    RotationCoordinator,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class CapturingJournal:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, message, **fields):
+        self.events.append((kind, message, fields))
+
+
+def _ramping_forecaster(
+    clock,
+    *,
+    slope=10.0,
+    ceiling=1000.0,
+    n=60,
+    journal=None,
+    registry=None,
+    **kwargs,
+):
+    """A store+forecaster pair over a linear ramp: value = slope * t,
+    1s tier, watched against `ceiling`."""
+    store = TimeSeriesStore(tiers=((1.0, 120),), clock=clock)
+    for i in range(n):
+        clock.advance(1.0)
+        store.record("queue_ms", slope * clock.t)
+    forecaster = Forecaster(
+        store,
+        window_s=kwargs.pop("window_s", 30.0),
+        horizon_s=kwargs.pop("horizon_s", 120.0),
+        page_horizon_s=kwargs.pop("page_horizon_s", 120.0),
+        min_points=5,
+        journal=journal,
+        registry=registry,
+        clock=clock,
+        **kwargs,
+    )
+    forecaster.watch("queue_ms", ceiling=ceiling, label="queue depth")
+    return store, forecaster
+
+
+# ---------------------------------------------------------------------------
+# Holt fit
+# ---------------------------------------------------------------------------
+
+
+class TestHoltFit:
+    def test_exact_on_linear_series(self):
+        """A perfectly linear series leaves zero residuals and the
+        smoothed level/trend equal to the last sample and the step."""
+        fit = holt_fit([2.0 * i for i in range(1, 11)])
+        assert fit["level"] == pytest.approx(20.0)
+        assert fit["trend"] == pytest.approx(2.0)
+        assert fit["residual_std"] == pytest.approx(0.0, abs=1e-12)
+        assert fit["n"] == 10
+
+    def test_too_few_samples(self):
+        assert holt_fit([]) is None
+        assert holt_fit([1.0, 2.0]) is None
+
+    def test_flat_series_has_no_trend(self):
+        fit = holt_fit([7.0] * 20)
+        assert fit["level"] == pytest.approx(7.0)
+        assert fit["trend"] == pytest.approx(0.0, abs=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# Forecaster
+# ---------------------------------------------------------------------------
+
+
+class TestForecaster:
+    def test_ramp_predicts_finite_breach_and_journals(self):
+        clock = FakeClock()
+        journal = CapturingJournal()
+        registry = MetricsRegistry()
+        _, forecaster = _ramping_forecaster(
+            clock, journal=journal, registry=registry
+        )
+        state = forecaster.run()
+        (record,) = state["series"]
+        assert record["state"] == "ok"
+        assert record["trend_per_s"] == pytest.approx(10.0, rel=0.05)
+        # value ~600 climbing 10/s toward 1000: breach ~40s out.
+        assert record["time_to_breach_s"] == pytest.approx(40.0, abs=5.0)
+        earliest = record["time_to_breach_earliest_s"]
+        assert earliest is not None
+        assert earliest <= record["time_to_breach_s"]
+        assert state["min_time_to_breach_s"] == earliest
+        assert state["paging"] == ["queue_ms"]
+        # Act-before-burn: the coalesced warning event fired.
+        kinds = [kind for kind, _, _ in journal.events]
+        assert kinds == ["forecast.breach_predicted"]
+        _, message, fields = journal.events[0]
+        assert "queue depth" in message
+        assert fields["coalesce_key"] == "forecast.breach:queue_ms"
+        assert fields["time_to_breach_s"] == earliest
+        # The gauge the soft SLO grades.
+        gauge = registry.export()["gauges"][
+            "forecast.min_time_to_breach_s"
+        ]
+        assert gauge == pytest.approx(earliest, abs=0.01)
+
+    def test_repeat_predictions_coalesce_in_real_journal(self):
+        clock = FakeClock()
+        journal = EventJournal(capacity=32, clock=clock)
+        _, forecaster = _ramping_forecaster(
+            clock, journal=journal, coalesce_s=30.0
+        )
+        forecaster.run()
+        clock.advance(1.0)
+        forecaster.run()  # within coalesce window: same event, bumped
+        events = journal.tail(10, kind="forecast.breach_predicted")
+        assert len(events) == 1
+        assert events[0]["repeats"] >= 1
+
+    def test_calm_series_is_finite_gauge_no_page(self):
+        clock = FakeClock()
+        registry = MetricsRegistry()
+        _, forecaster = _ramping_forecaster(
+            clock, slope=0.0, registry=registry
+        )
+        state = forecaster.run()
+        assert state["min_time_to_breach_s"] is None
+        assert state["paging"] == []
+        assert forecaster.min_time_to_breach_s() is None
+        # Calm still writes a finite gauge (= horizon) so the soft
+        # gauge_min objective has data to grade.
+        assert registry.export()["gauges"][
+            "forecast.min_time_to_breach_s"
+        ] == pytest.approx(forecaster.horizon_s)
+
+    def test_insufficient_data_state(self):
+        clock = FakeClock()
+        store = TimeSeriesStore(tiers=((1.0, 60),), clock=clock)
+        forecaster = Forecaster(store, min_points=5, clock=clock)
+        forecaster.watch("nope", ceiling=10.0)
+        state = forecaster.run()
+        assert state["series"][0]["state"] == "insufficient_data"
+        assert state["min_time_to_breach_s"] is None
+
+    def test_ceiling_source_callable_and_broken_source(self):
+        clock = FakeClock()
+        store = TimeSeriesStore(tiers=((1.0, 120),), clock=clock)
+        for _ in range(30):
+            clock.advance(1.0)
+            store.record("load", 5.0 * clock.t)
+        forecaster = Forecaster(
+            store, window_s=20.0, horizon_s=60.0, min_points=5,
+            clock=clock, journal=CapturingJournal(),
+        )
+
+        def boom():
+            raise RuntimeError("capacity model gone")
+
+        forecaster.watch("load", ceiling_source=lambda: 200.0)
+        forecaster.watch("load", ceiling_source=boom, label="broken")
+        state = forecaster.run()
+        live, broken = state["series"]
+        assert live["ceiling"] == 200.0
+        assert live["time_to_breach_s"] is not None
+        # A broken ceiling source degrades to no_ceiling — forecast
+        # still published, just ungraded.
+        assert broken["ceiling"] is None
+        assert broken["state"] == "no_ceiling"
+
+    def test_direction_below_breaches_on_falling_series(self):
+        clock = FakeClock()
+        store = TimeSeriesStore(tiers=((1.0, 120),), clock=clock)
+        for _ in range(40):
+            clock.advance(1.0)
+            store.record("headroom", max(0.0, 500.0 - 5.0 * clock.t))
+        forecaster = Forecaster(
+            store, window_s=30.0, horizon_s=120.0, min_points=5,
+            clock=clock, journal=CapturingJournal(),
+        )
+        forecaster.watch("headroom", ceiling=100.0, direction="below")
+        record = forecaster.run()["series"][0]
+        # 300 falling 5/s toward 100: crossing ~40s out.
+        assert record["time_to_breach_s"] == pytest.approx(40.0, abs=6.0)
+
+    def test_objective_grades_soft_via_slo_tracker(self):
+        clock = FakeClock()
+        registry = MetricsRegistry()
+        _, forecaster = _ramping_forecaster(
+            clock, slope=0.0, registry=registry, horizon_s=300.0
+        )
+        objective = forecaster.objective(threshold_s=60.0)
+        assert objective.severity == "soft"  # pages, never drains
+        tracker = SloTracker([objective], registry, clock=clock)
+        forecaster.run()
+        (calm,) = tracker.evaluate()
+        assert calm["state"] == "ok"
+        assert calm["observed"] == pytest.approx(300.0)
+        # Now a ramp: predicted breach well inside 60s -> soft breach.
+        store = forecaster._store
+        for _ in range(60):
+            clock.advance(1.0)
+            store.record("queue_ms", 50.0 * clock.t)
+        forecaster.watch("queue_ms2", ceiling=1.0)  # ignored: no data
+        forecaster.run()
+        (burning,) = tracker.evaluate()
+        assert burning["state"] == "breach"
+        assert burning["severity"] == "soft"
+
+    def test_trough_window_prefers_forecast_minimum(self):
+        clock = FakeClock()
+        store = TimeSeriesStore(tiers=((1.0, 120),), clock=clock)
+        for i in range(60):
+            clock.advance(1.0)
+            store.record("rate", 1000.0 - 10.0 * clock.t)  # declining
+        forecaster = Forecaster(
+            store, window_s=30.0, horizon_s=60.0, min_points=5,
+            clock=clock,
+        )
+        falling = forecaster.trough_window("rate", window_s=10.0)
+        assert falling["state"] == "ok"
+        # Load is falling: the cheapest prestage window is at the far
+        # end of the horizon.
+        assert falling["start_offset_s"] == pytest.approx(
+            60.0 - 10.0, abs=2.0
+        )
+        assert falling["expected_value"] >= 0.0
+        # Unknown series: graceful insufficient_data, prestage "now".
+        unknown = forecaster.trough_window("missing", window_s=10.0)
+        assert unknown["state"] == "insufficient_data"
+        assert unknown["start_offset_s"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# TokenBucket scaling + admission governor hook
+# ---------------------------------------------------------------------------
+
+
+class TestTokenBucketScaling:
+    def test_set_scale_refills_at_old_rate_first(self):
+        clock = FakeClock()
+        bucket = TokenBucket(10.0, burst=10.0, clock=clock)
+        assert bucket.try_take(10.0)  # drain the burst
+        clock.advance(0.5)
+        # The 0.5s before the tightening was earned at 10/s: the
+        # rescale must not retroactively reprice it.
+        bucket.set_scale(0.5)
+        assert bucket.rate == pytest.approx(5.0)
+        assert bucket.base_rate == pytest.approx(10.0)
+        assert bucket.try_take(5.0)  # the 0.5s * 10/s already earned
+        assert not bucket.try_take(5.0)
+        clock.advance(1.0)  # now earning at 5/s
+        assert bucket.try_take(5.0)
+        assert not bucket.try_take(1.0)
+
+    def test_set_scale_restores_exactly(self):
+        clock = FakeClock()
+        bucket = TokenBucket(8.0, burst=4.0, clock=clock)
+        bucket.set_scale(0.25)
+        bucket.set_scale(1.0)
+        assert bucket.rate == pytest.approx(8.0)
+
+    def test_set_scale_validates(self):
+        bucket = TokenBucket(8.0)
+        with pytest.raises(ValueError):
+            bucket.set_scale(0.0)
+        with pytest.raises(ValueError):
+            bucket.set_scale(-1.0)
+
+    def test_admission_rate_scale_covers_existing_and_new_tenants(self):
+        clock = FakeClock()
+        registry = MetricsRegistry()
+        admission = AdmissionController(
+            metrics=registry, clock=clock, name="adm"
+        )
+        admission.set_tenant("a", TenantPolicy(rate_qps=100.0))
+        admission.set_rate_scale(0.5)
+        assert admission.rate_scale == 0.5
+        # Tenants declared after the tightening inherit it.
+        admission.set_tenant("b", TenantPolicy(rate_qps=40.0))
+        export = admission.export()
+        assert export["rate_scale"] == 0.5
+        assert export["tenants"]["a"]["rate_qps"] == 100.0
+        assert export["tenants"]["a"]["effective_rate_qps"] == (
+            pytest.approx(50.0)
+        )
+        assert export["tenants"]["b"]["effective_rate_qps"] == (
+            pytest.approx(20.0)
+        )
+        assert registry.export()["gauges"]["adm.rate_scale"] == 0.5
+        admission.set_rate_scale(1.0)
+        assert admission.export()["tenants"]["a"][
+            "effective_rate_qps"
+        ] == pytest.approx(100.0)
+        with pytest.raises(ValueError):
+            admission.set_rate_scale(0.0)
+
+
+class TestPredictiveGovernor:
+    def _governor(self, source, **kwargs):
+        clock = FakeClock()
+        admission = AdmissionController(clock=clock)
+        admission.set_tenant("t", TenantPolicy(rate_qps=100.0))
+        return PredictiveGovernor(
+            admission, source, clock=clock,
+            **{"horizon_s": 100.0, "floor": 0.25, **kwargs},
+        )
+
+    def test_scale_map_is_monotone_with_floor(self):
+        governor = self._governor(lambda: None)
+        assert governor.scale_for(None) == 1.0
+        assert governor.scale_for(100.0) == 1.0
+        assert governor.scale_for(500.0) == 1.0
+        assert governor.scale_for(50.0) == pytest.approx(0.5)
+        assert governor.scale_for(10.0) == pytest.approx(0.25)  # floored
+        assert governor.scale_for(0.0) == pytest.approx(0.25)
+        ttbs = [None, 100.0, 75.0, 50.0, 25.0, 10.0, 0.0]
+        scales = [governor.scale_for(t) for t in ttbs]
+        assert scales == sorted(scales, reverse=True)
+
+    def test_update_tightens_and_reverts_exactly(self):
+        ttb = {"value": None}
+        journal = CapturingJournal()
+        previous = events_mod.default_journal()
+        events_mod.set_default_journal(journal)
+        try:
+            governor = self._governor(lambda: ttb["value"])
+            assert governor.update() == 1.0
+            ttb["value"] = 40.0  # forecast closes in
+            assert governor.update() == pytest.approx(0.4)
+            assert governor.admission.rate_scale == pytest.approx(0.4)
+            assert governor.admission.export()["tenants"]["t"][
+                "effective_rate_qps"
+            ] == pytest.approx(40.0)
+            ttb["value"] = None  # forecast recedes: exact revert
+            assert governor.update() == 1.0
+            assert governor.admission.rate_scale == 1.0
+            state = governor.export()
+            assert state["updates"] == 3
+            assert state["tightenings"] == 1
+            kinds = [kind for kind, _, _ in journal.events]
+            assert kinds.count("governor.scale") == 2  # tighten + revert
+        finally:
+            events_mod.set_default_journal(previous)
+
+    def test_broken_forecast_source_fails_open(self):
+        def boom():
+            raise RuntimeError("forecaster crashed")
+
+        governor = self._governor(boom)
+        governor.admission.set_rate_scale(0.5)  # pre-tightened
+        assert governor.update() == 1.0  # fail open, not stuck at 0.5
+        assert governor.admission.rate_scale == 1.0
+
+    def test_constructor_validation(self):
+        clock = FakeClock()
+        admission = AdmissionController(clock=clock)
+        with pytest.raises(ValueError):
+            PredictiveGovernor(admission, lambda: None, horizon_s=0.0)
+        with pytest.raises(ValueError):
+            PredictiveGovernor(admission, lambda: None, floor=0.0)
+        with pytest.raises(ValueError):
+            PredictiveGovernor(admission, lambda: None, floor=1.5)
+
+
+# ---------------------------------------------------------------------------
+# Rotation prestage scheduling
+# ---------------------------------------------------------------------------
+
+
+class TestSuggestWindow:
+    def test_no_source_means_now(self):
+        coordinator = RotationCoordinator(object())
+        suggestion = coordinator.suggest_window(30.0)
+        assert suggestion == {
+            "window_s": 30.0,
+            "start_offset_s": 0.0,
+            "source": "none",
+        }
+
+    def test_forecast_source_schedules_into_trough(self):
+        clock = FakeClock()
+        store = TimeSeriesStore(tiers=((1.0, 120),), clock=clock)
+        for _ in range(60):
+            clock.advance(1.0)
+            store.record("rate", 1000.0 - 10.0 * clock.t)
+        forecaster = Forecaster(
+            store, window_s=30.0, horizon_s=60.0, min_points=5,
+            clock=clock,
+        )
+        coordinator = RotationCoordinator(object(), clock=clock)
+        coordinator.set_window_source(forecaster.window_source("rate"))
+        suggestion = coordinator.suggest_window(10.0)
+        assert suggestion["source"] == "forecast"
+        assert suggestion["state"] == "ok"
+        assert suggestion["start_offset_s"] > 0.0  # falling load: wait
+
+    def test_source_error_is_advisory_only(self):
+        coordinator = RotationCoordinator(object())
+        coordinator.set_window_source(
+            lambda window_s: (_ for _ in ()).throw(RuntimeError("x"))
+        )
+        suggestion = coordinator.suggest_window(30.0)
+        assert suggestion["source"] == "error"
+        assert suggestion["start_offset_s"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Fleet federation of workload scrapes
+# ---------------------------------------------------------------------------
+
+
+class TestWorkloadFederation:
+    def _observed(self, keys, tenant):
+        observatory = WorkloadObservatory(top_k=8)
+        for key in keys:
+            observatory.observe(
+                key_indices=(key,), tenant=tenant, deadline_s=0.1
+            )
+        return observatory
+
+    def test_replica_scrape_carries_workload(self):
+        clock = FakeClock()
+        telemetry = ReplicaTelemetry("r0", clock=clock)
+        assert "workload" not in telemetry.scrape()
+        telemetry.set_workload(self._observed([1, 1, 2], "a"))
+        scrape = telemetry.scrape()
+        assert scrape["workload"]["observations"] == 3
+        assert scrape["workload"]["tenants"]["a"]["observations"] == 3
+
+    def test_merge_sums_counts_and_reranks_top_keys(self):
+        export_a = self._observed([7] * 30 + [1] * 10, "a").export()
+        export_b = self._observed([7] * 5 + [2] * 40, "b").export()
+        merged = federation.merge_workloads(
+            {"r0": export_a, "r1": export_b}
+        )
+        assert merged["replicas"] == ["r0", "r1"]
+        assert merged["observations"] == 85
+        # Per-key counts sum across replicas, then re-rank: key 2 (40)
+        # leads key 7 (35).
+        top = {row["key"]: row["count"] for row in merged["top_keys"]}
+        assert top[7] == 35
+        assert top[2] == 40
+        assert merged["top_keys"][0]["key"] == 2
+        assert set(merged["tenants"]) == {"a", "b"}
+        assert merged["tenants"]["a"]["observations"] == 40
+        # Histograms bucket-sum (same fixed layout both sides).
+        assert merged["deadline_ms"]["count"] == 85
+        assert sum(
+            merged["batch_keys"]["buckets"].values()
+        ) >= 85  # +inf bucket double-listed by export layout
+
+    def test_merge_empty_and_partial(self):
+        assert federation.merge_workloads({})["observations"] == 0
+        merged = federation.merge_workloads(
+            {"r0": self._observed([1], "a").export(), "r1": {}}
+        )
+        assert merged["observations"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Admin surfaces
+# ---------------------------------------------------------------------------
+
+
+class TestForecastzEndpoint:
+    def test_text_json_governor_fold_and_statusz(self):
+        clock = FakeClock()
+        journal = CapturingJournal()
+        registry = MetricsRegistry()
+        _, forecaster = _ramping_forecaster(
+            clock, journal=journal, registry=registry
+        )
+        admission = AdmissionController(clock=clock)
+        admission.set_tenant("t", TenantPolicy(rate_qps=100.0))
+        governor = PredictiveGovernor(
+            admission,
+            forecaster.min_time_to_breach_s,
+            horizon_s=100.0,
+            floor=0.25,
+            clock=clock,
+        )
+        governor.update()
+        with AdminServer(
+            registry=registry, forecast=forecaster, governor=governor
+        ) as admin:
+            base = f"http://127.0.0.1:{admin.port}"
+            text = urllib.request.urlopen(base + "/forecastz").read()
+            assert b"capacity forecast" in text
+            assert b"earliest predicted breach" in text
+            assert b"queue depth" in text
+            assert b"governor: scale" in text
+            state = json.loads(
+                urllib.request.urlopen(
+                    base + "/forecastz?format=json"
+                ).read()
+            )
+            ttb = state["min_time_to_breach_s"]
+            assert ttb is not None and 0 < ttb < forecaster.horizon_s
+            assert state["series"][0]["series"] == "queue_ms"
+            assert state["governor"]["scale"] < 1.0
+            # /capacityz shows the tightened effective rate even with
+            # no cost ledger attached.
+            capacity = urllib.request.urlopen(base + "/capacityz").read()
+            assert b"predictive governor: scale" in capacity
+            assert b"t: rate 100.0 ->" in capacity
+            # /statusz folds the forecast summary in.
+            status = urllib.request.urlopen(base + "/statusz").read()
+            assert b"Forecast" in status
+
+    def test_404_without_forecaster(self):
+        with AdminServer(registry=MetricsRegistry()) as admin:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{admin.port}/forecastz"
+                )
+            assert err.value.code == 404
+            assert b"no forecaster" in err.value.read()

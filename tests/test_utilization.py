@@ -240,6 +240,50 @@ def test_ring_laps_expire_old_points_and_last_sample_wins():
     assert store.occupancy() <= store.slot_budget()
 
 
+def test_query_range_aligned_grid_marks_gaps_and_lap_expiry():
+    clock = FakeClock()
+    store = TimeSeriesStore(tiers=((1.0, 4),), max_series=4, clock=clock)
+    for i in range(10):
+        store.record("s", float(i), t=float(i))
+    # Slots 0..5 lapped out of the 4-slot ring: the grid still covers
+    # the requested window (clamped to one ring length) with None.
+    step_s, samples = store.query_range("s", 5.0, 9.0, tier=0, now=10.0)
+    assert step_s == 1.0
+    assert samples == [
+        (6.0, 6.0), (7.0, 7.0), (8.0, 8.0), (9.0, 9.0),
+    ]
+    # A gap inside the live window is None at its grid slot, not
+    # silently skipped (the forecaster needs the grid).
+    store.record("gappy", 1.0, t=20.0)
+    store.record("gappy", 3.0, t=22.0)
+    _, samples = store.query_range("gappy", 20.0, 22.0, tier=0, now=22.5)
+    assert samples == [(20.0, 1.0), (21.0, None), (22.0, 3.0)]
+
+
+def test_query_range_tier_fallthrough_and_validation():
+    clock = FakeClock()
+    store = TimeSeriesStore(
+        tiers=((1.0, 4), (10.0, 12)), max_series=4, clock=clock
+    )
+    for i in range(40):
+        store.record("s", float(i), t=float(i))
+    # tier=None: a window the 4s fine tier cannot cover falls through
+    # to the 10s tier; a short recent window stays on the fine tier.
+    step_s, _ = store.query_range("s", 0.0, 39.0, now=40.0)
+    assert step_s == 10.0
+    step_s, _ = store.query_range("s", 37.0, 39.0, now=40.0)
+    assert step_s == 1.0
+    # Unknown series: the aligned grid of Nones, never an error (the
+    # read side must not race series creation).
+    step_s, samples = store.query_range("missing", 37.0, 39.0, now=40.0)
+    assert step_s == 1.0
+    assert samples == [(37.0, None), (38.0, None), (39.0, None)]
+    with pytest.raises(ValueError):
+        store.query_range("s", 5.0, 1.0, now=40.0)
+    with pytest.raises(ValueError):
+        store.query_range("s", 0.0, 1.0, tier=7, now=40.0)
+
+
 def test_sparkline_rendering():
     assert sparkline([]) == ""
     assert len(sparkline([1, 2, 3, 4])) == 4
@@ -310,6 +354,32 @@ def test_anomaly_watch_journals_spike_into_event_journal():
     assert event["series"] == "helper.rtt_ms.p99"
     assert event["direction"] == "spike"
     assert sampler.export()["watch"]["anomalies"] >= 1
+
+
+def test_anomaly_watch_ignores_near_zero_series():
+    """Regression: a quiet counter ticking 0 -> 1 is noise, not a 3x
+    spike — the absolute noise floor (`min_mean`) must keep an idle
+    series (e.g. `fleet.spillover`) out of the journal."""
+    journal = EventJournal(clock=FakeClock())
+    watch = AnomalyWatch(min_samples=3, journal=journal)
+    for t in range(6):
+        assert watch.observe("fleet.spillover", 0.0, float(t)) is None
+    # The 0 -> 1 tick: infinitely above the trailing mean of 0, but
+    # below ratio * min_mean + floor.
+    assert watch.observe("fleet.spillover", 1.0, 6.0) is None
+    assert journal.tail(n=10, kind="util.anomaly") == []
+    assert watch.export()["anomalies"] == 0
+    # The floor only mutes near-zero series: a real spike on the same
+    # watch still fires.
+    for t in range(6):
+        watch.observe("busy", 100.0, float(t))
+    record = watch.observe("busy", 1000.0, 6.0)
+    assert record is not None and record["direction"] == "spike"
+    # And a collapse on a quiet series stays quiet (mean below the
+    # judged floor).
+    for t in range(6):
+        watch.observe("quiet", 0.4, float(t))
+    assert watch.observe("quiet", 0.0, 6.0) is None
 
 
 def test_sampler_thread_shuts_down_cleanly_with_admin_server():

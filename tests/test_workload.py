@@ -1,0 +1,365 @@
+"""Workload observatory tests: sketch/top-K correctness against exact
+oracles, Zipf-fit recovery of the generator's ground-truth exponent,
+bounded memory under key floods, the serving hot-path hook, and the
+`/workloadz` admin surface.
+
+Sketch tests use deterministic seeds so the probabilistic error bounds
+are asserted exactly (same stream every run); generator tests pin the
+`uniform` profile to the retired `overload_bench` pool byte-for-byte —
+the history-continuity invariant the profile handoff depends on.
+"""
+
+import collections
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from benchmarks import workload_gen
+from distributed_point_functions_tpu.observability import AdminServer
+from distributed_point_functions_tpu.observability.timeseries import (
+    TimeSeriesStore,
+)
+from distributed_point_functions_tpu.observability.workload import (
+    CountMinSketch,
+    TopKTracker,
+    WorkloadObservatory,
+    detect_periodicity,
+    fit_zipf_exponent,
+)
+from distributed_point_functions_tpu.serving.metrics import MetricsRegistry
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _zipf_stream(s=1.1, domain=4096, n=100_000, seed=7):
+    profile = workload_gen.WorkloadProfile(name="z", zipf_s=s)
+    return workload_gen.zipf_stream(profile, domain, n, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# Count-min sketch
+# ---------------------------------------------------------------------------
+
+
+class TestCountMinSketch:
+    def test_never_undershoots_and_bounds_overshoot_under_flood(self):
+        """Adversarial flood: a huge churn of one-off keys tries to
+        smear every counter. Estimates must stay >= truth and within
+        the Cormode-Muthukrishnan overshoot ceiling."""
+        sketch = CountMinSketch(width=256, depth=4, seed=3)
+        truth = collections.Counter()
+        tracked = [11, 222, 3333, 44444]
+        for i, key in enumerate(tracked):
+            for _ in range(100 * (i + 1)):
+                sketch.add(key)
+                truth[key] += 1
+        # The flood: 50k distinct keys, one observation each.
+        for key in range(10**6, 10**6 + 50_000):
+            sketch.add(key)
+            truth[key] += 1
+        bound = sketch.error_bound()
+        assert bound == pytest.approx(
+            2.718281828 * sketch.total / 256, rel=1e-6
+        )
+        for key in tracked + list(range(10**6, 10**6 + 100)):
+            estimate = sketch.estimate(key)
+            assert estimate >= truth[key]
+            assert estimate - truth[key] <= bound
+
+    def test_unseen_key_estimate_is_pure_collision_noise(self):
+        sketch = CountMinSketch(width=1024, depth=4, seed=0)
+        for key in range(1000):
+            sketch.add(key)
+        assert sketch.estimate(999_999_999) <= sketch.error_bound()
+
+    def test_export_shape_and_validation(self):
+        sketch = CountMinSketch(width=64, depth=2, seed=1)
+        sketch.add(5, count=3)
+        state = sketch.export()
+        assert state["width"] == 64 and state["depth"] == 2
+        assert state["total"] == 3
+        assert 0 < state["fill_pct"] <= 100
+        with pytest.raises(ValueError):
+            CountMinSketch(width=4)
+        with pytest.raises(ValueError):
+            CountMinSketch(depth=0)
+
+
+# ---------------------------------------------------------------------------
+# Top-K + Zipf fit against exact oracles
+# ---------------------------------------------------------------------------
+
+
+class TestTopKTracker:
+    def test_agrees_with_exact_oracle_on_zipf_stream(self):
+        """10^5 synthetic Zipf draws: the space-saving table's head
+        must match an exact Counter's head."""
+        stream = _zipf_stream(s=1.1, n=100_000)
+        tracker = TopKTracker(64)
+        truth = collections.Counter()
+        for key in stream:
+            tracker.add(key)
+            truth[key] += 1
+        exact_top10 = [k for k, _ in truth.most_common(10)]
+        tracked = {k: (c, e) for k, c, e in tracker.items()}
+        # Every true top-10 key is tracked, with count within the
+        # Metwally bound: true <= tracked <= true + error.
+        for key in exact_top10:
+            assert key in tracked
+            count, error = tracked[key]
+            assert truth[key] <= count <= truth[key] + error
+        # The table's own top-5 is exactly the true top-5 (order-free).
+        table_top5 = {k for k, _, _ in tracker.items()[:5]}
+        assert table_top5 == set(exact_top10[:5])
+
+    def test_capacity_never_exceeded(self):
+        tracker = TopKTracker(8)
+        for key in range(1000):
+            tracker.add(key)
+        assert len(tracker) == 8
+
+    def test_zipf_fit_recovers_generator_exponent(self):
+        """Satellite (d): fitted exponent within +-0.1 of the
+        generator's ground truth, via the full observatory path."""
+        for s in (0.9, 1.1, 1.3):
+            stream = _zipf_stream(s=s, n=100_000, seed=11)
+            observatory = WorkloadObservatory(top_k=64)
+            for key in stream:
+                observatory.observe(key_indices=(key,))
+            fitted = observatory.zipf_exponent()
+            assert fitted == pytest.approx(s, abs=0.1), (s, fitted)
+
+    def test_zipf_fit_degenerate_inputs(self):
+        assert fit_zipf_exponent([]) is None
+        assert fit_zipf_exponent([5.0, 4.0]) is None  # < min_points
+        # Uniform counts: no spread, exponent ~ 0.
+        assert fit_zipf_exponent([7.0] * 20) == pytest.approx(0.0)
+
+
+# ---------------------------------------------------------------------------
+# Periodicity
+# ---------------------------------------------------------------------------
+
+
+class TestPeriodicity:
+    def test_detects_sinusoid_period(self):
+        import math
+
+        step_s, lag = 10.0, 8
+        values = [
+            100 + 50 * math.sin(2 * math.pi * i / lag) for i in range(64)
+        ]
+        found = detect_periodicity(values, step_s)
+        assert found is not None
+        assert found["period_s"] == pytest.approx(lag * step_s, abs=step_s)
+        assert found["strength"] >= 0.4
+
+    def test_flat_and_short_series_yield_none(self):
+        assert detect_periodicity([5.0] * 64, 10.0) is None
+        assert detect_periodicity([1.0, 2.0, 3.0], 10.0) is None
+
+
+# ---------------------------------------------------------------------------
+# Observatory: hot path, bounded memory, export
+# ---------------------------------------------------------------------------
+
+
+class TestWorkloadObservatory:
+    def test_memory_bounded_under_key_and_tenant_flood(self):
+        """The fixed-byte-budget acceptance check: 10^5 distinct keys
+        and hundreds of tenant names must not grow the footprint."""
+        budget = 256 * 1024
+        observatory = WorkloadObservatory(byte_budget=budget)
+
+        def flood(start, n):
+            for i in range(start, start + n):
+                observatory.observe(
+                    num_keys=1 + i % 7,
+                    tenant=f"tenant-{i % 400}",
+                    key_indices=(i,),
+                    deadline_s=0.001 * (i % 500),
+                )
+
+        flood(0, 1_000)  # tenant table + sketch at steady state
+        plateau = observatory.approx_bytes()
+        flood(1_000, 99_000)
+        assert observatory.approx_bytes() == plateau  # flat, not just bounded
+        assert observatory.approx_bytes() <= budget
+        state = observatory.export()
+        assert state["within_budget"] is True
+        assert state["observations"] == 100_000
+        # Tenant table clamped: max_tenants plus the overflow bucket.
+        assert len(state["tenants"]) <= 16 + 1
+        assert "__other__" in state["tenants"]
+
+    def test_rate_and_burstiness_with_fake_clock(self):
+        clock = FakeClock()
+        observatory = WorkloadObservatory(ewma_alpha=0.3, clock=clock)
+        for _ in range(200):
+            clock.advance(0.01)  # steady 100 q/s
+            observatory.observe()
+        state = observatory.export()
+        assert state["rate_qps"] == pytest.approx(100.0, rel=0.05)
+        assert state["burstiness_cv2"] == pytest.approx(0.0, abs=0.05)
+
+    def test_deadline_and_batch_histograms(self):
+        observatory = WorkloadObservatory()
+        observatory.observe(num_keys=3, deadline_s=0.040)
+        observatory.observe(num_keys=1000, deadline_s=9.0)
+        state = observatory.export()
+        assert state["batch_keys"]["buckets"]["4"] == 1
+        assert state["batch_keys"]["buckets"]["+inf"] == 1
+        assert state["deadline_ms"]["buckets"]["50"] == 1
+        assert state["deadline_ms"]["buckets"]["+inf"] == 1
+        assert state["deadline_ms"]["count"] == 2
+
+    def test_hot_share_on_skewed_stream(self):
+        observatory = WorkloadObservatory(top_k=32)
+        for key in _zipf_stream(s=1.3, domain=1024, n=20_000):
+            observatory.observe(key_indices=(key,))
+        hot = observatory.hot_share_pct()
+        assert hot is not None and hot > 50.0
+
+    def test_gauge_source_binds_registry(self):
+        registry = MetricsRegistry()
+        observatory = WorkloadObservatory(registry=registry)
+        for i in range(50):
+            observatory.observe(key_indices=(i % 5,))
+        series = observatory.gauge_source()
+        assert "workload.observations" in series
+        assert registry.export()["gauges"]["workload.observations"] == 50.0
+
+    def test_periodicity_reads_coarse_tier(self):
+        import math
+
+        clock = FakeClock()
+        store = TimeSeriesStore(
+            tiers=((1.0, 60), (10.0, 360)), clock=clock
+        )
+        period_s = 80.0
+        for i in range(240):
+            clock.advance(10.0)
+            store.record(
+                "workload.rate_qps",
+                100 + 50 * math.sin(2 * math.pi * clock.t / period_s),
+            )
+        observatory = WorkloadObservatory(store=store, clock=clock)
+        found = observatory.periodicity()
+        assert found is not None
+        assert found["period_s"] == pytest.approx(period_s, abs=10.0)
+
+
+# ---------------------------------------------------------------------------
+# Workload generator (benchmarks/workload_gen.py)
+# ---------------------------------------------------------------------------
+
+
+class TestWorkloadGenerator:
+    def test_uniform_pool_reproduces_legacy_overload_bench_pool(self):
+        """History-continuity invariant: `--profile uniform` must build
+        the exact pool the retired inline generator built."""
+        import numpy as np
+
+        for num_records in (256, 1024, 4096):
+            legacy = [
+                int(i)
+                for i in np.random.default_rng(8).integers(
+                    0, num_records, 32
+                )
+            ]
+            assert workload_gen.key_pool(
+                workload_gen.PROFILES["uniform"], num_records
+            ) == legacy
+
+    def test_zipf_pool_skewed_and_deterministic(self):
+        profile = workload_gen.PROFILES["zipf"]
+        pool_a = workload_gen.key_pool(profile, 4096)
+        pool_b = workload_gen.key_pool(profile, 4096)
+        assert pool_a == pool_b  # seeded
+        assert len(pool_a) == profile.pool_size
+        # Skew: duplicates appear in a 64-draw pool under Zipf 1.1.
+        assert len(set(pool_a)) < len(pool_a)
+
+    def test_arrival_times_diurnal_and_bursty(self):
+        diurnal = workload_gen.PROFILES["diurnal"]
+        times = workload_gen.arrival_times(
+            diurnal, duration_s=60.0, base_rate_qps=50.0, seed=5
+        )
+        assert times == sorted(times)
+        assert all(0 <= t < 60.0 for t in times)
+        # Sinusoidal envelope: the peak half hosts more arrivals.
+        peak = sum(1 for t in times if t < 30.0)
+        trough = len(times) - peak
+        assert peak > trough
+        bursty = workload_gen.PROFILES["bursty"]
+        burst_times = workload_gen.arrival_times(
+            bursty, duration_s=30.0, base_rate_qps=50.0, seed=5
+        )
+        # Poisson bursts inject back-to-back duplicates.
+        repeats = sum(
+            1
+            for a, b in zip(burst_times, burst_times[1:])
+            if a == b
+        )
+        assert repeats >= bursty.burst_size
+
+    def test_tenant_mix_sampling_follows_weights(self):
+        import random
+
+        profile = workload_gen.PROFILES["mixed"]
+        rng = random.Random(0)
+        draws = collections.Counter(
+            workload_gen.pick_tenant(profile, rng).name
+            for _ in range(6000)
+        )
+        assert draws["interactive"] > draws["standard"] > draws["batch"]
+
+
+# ---------------------------------------------------------------------------
+# /workloadz admin surface
+# ---------------------------------------------------------------------------
+
+
+class TestWorkloadzEndpoint:
+    def test_text_json_and_404(self):
+        observatory = WorkloadObservatory()
+        for key in _zipf_stream(s=1.1, domain=512, n=5_000):
+            observatory.observe(
+                key_indices=(key,), tenant="probe", deadline_s=0.1
+            )
+        with AdminServer(
+            registry=MetricsRegistry(), workload=observatory
+        ) as admin:
+            base = f"http://127.0.0.1:{admin.port}"
+            text = urllib.request.urlopen(base + "/workloadz").read()
+            assert b"workload observatory" in text
+            assert b"sketch:" in text
+            assert b"per-tenant:" in text
+            state = json.loads(
+                urllib.request.urlopen(
+                    base + "/workloadz?format=json"
+                ).read()
+            )
+            assert state["observations"] == 5_000
+            assert state["top_keys"]
+            assert state["tenants"]["probe"]["observations"] == 5_000
+            # Folded into /statusz as well.
+            status = urllib.request.urlopen(base + "/statusz").read()
+            assert b"Workload" in status
+        with AdminServer(registry=MetricsRegistry()) as admin:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{admin.port}/workloadz"
+                )
+            assert err.value.code == 404
